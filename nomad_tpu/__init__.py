@@ -1,0 +1,22 @@
+"""nomad_tpu — a TPU-native cluster workload orchestrator.
+
+A ground-up rebuild of the capabilities of HashiCorp Nomad (reference:
+closerforever/nomad @ v0.13.0-dev) where the scheduling hot path — feasibility
+checking and bin-pack ranking of pending evaluations — runs as dense, vmapped
+JAX/XLA kernels over `[evals × nodes × resources]` tensors in TPU HBM, instead
+of the reference's scalar early-exit iterator chain (reference
+`scheduler/stack.go`).
+
+Layering (mirrors SURVEY.md §1, re-architected TPU-first):
+  structs/    core data model (reference `nomad/structs/structs.go`)
+  tensor/     snapshot → dense-tensor encoding + constraint compilation
+  kernels/    jitted feasibility/scoring/placement kernels
+  parallel/   device mesh + sharding of the node axis
+  scheduler/  reconciler + generic/system schedulers (reference `scheduler/`)
+  state/      in-memory MVCC state store (reference `nomad/state/`)
+  core/       control plane: eval broker, plan queue/applier, workers
+              (reference `nomad/{eval_broker,plan_queue,plan_apply,worker}.go`)
+  utils/      delay heap, top-K heap (reference `lib/`)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,249 @@
+"""Test fixture factories (reference `nomad/mock/mock.go` — Node :13, Job :175,
+Alloc :894, SystemJob :790, Eval :865). Values mirror the reference fixtures so
+transcribed test vectors stay comparable."""
+from __future__ import annotations
+
+import itertools
+import uuid
+
+from .structs import (
+    Allocation,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    Evaluation,
+    Job,
+    LogConfig,
+    NetworkResource,
+    Node,
+    NodeDeviceInstance,
+    NodeDeviceResource,
+    NodeReservedResources,
+    NodeResources,
+    Port,
+    ReschedulePolicy,
+    Resources,
+    RestartPolicy,
+    Task,
+    TaskGroup,
+    EphemeralDisk,
+    JOB_TYPE_BATCH,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSTEM,
+)
+
+_counter = itertools.count()
+
+
+def _id() -> str:
+    return str(uuid.uuid4())
+
+
+def node(**overrides) -> Node:
+    """Reference mock.Node (mock.go:13): 4000 MHz cpu, 8192 MiB mem, 100 GiB
+    disk, one 1000-mbit network, linux attrs, class "linux-medium-pc"."""
+    i = next(_counter)
+    n = Node(
+        id=_id(),
+        name=f"foobar-{i}",
+        datacenter="dc1",
+        node_class="",
+        attributes={
+            "kernel.name": "linux",
+            "arch": "x86",
+            "nomad.version": "0.5.0",
+            "driver.exec": "1",
+            "driver.mock_driver": "1",
+            "cpu.frequency": "1300",
+            "cpu.numcores": "4",
+        },
+        node_resources=NodeResources(
+            cpu=4000,
+            memory_mb=8192,
+            disk_mb=100 * 1024,
+            networks=[
+                NetworkResource(
+                    device="eth0", cidr="192.168.0.100/32", ip="192.168.0.100",
+                    mbits=1000,
+                )
+            ],
+        ),
+        reserved_resources=NodeReservedResources(
+            cpu=100, memory_mb=256, disk_mb=4 * 1024, reserved_ports="22",
+        ),
+    )
+    for k, v in overrides.items():
+        setattr(n, k, v)
+    n.compute_class()
+    return n
+
+
+def nvidia_node(**overrides) -> Node:
+    """Reference mock.NvidiaNode (mock.go:114): adds 4 Nvidia 1080ti GPUs."""
+    n = node(**overrides)
+    n.node_resources.devices = [
+        NodeDeviceResource(
+            vendor="nvidia",
+            type="gpu",
+            name="1080ti",
+            instances=[NodeDeviceInstance(id=_id(), healthy=True) for _ in range(4)],
+            attributes={"memory": 11, "cuda_cores": 3584},
+        )
+    ]
+    n.compute_class()
+    return n
+
+
+def job(**overrides) -> Job:
+    """Reference mock.Job (mock.go:175): service job, 1 group × 10 allocs,
+    web task (exec), 500 MHz / 256 MiB, one dynamic port."""
+    j = Job(
+        id=f"mock-service-{_id()}",
+        name="my-job",
+        type=JOB_TYPE_SERVICE,
+        priority=50,
+        all_at_once=False,
+        datacenters=["dc1"],
+        constraints=[],
+        task_groups=[
+            TaskGroup(
+                name="web",
+                count=10,
+                ephemeral_disk=EphemeralDisk(size_mb=150),
+                restart_policy=RestartPolicy(attempts=3, interval_s=600, delay_s=60, mode="delay"),
+                reschedule_policy=ReschedulePolicy(
+                    attempts=2, interval_s=600, delay_s=30,
+                    delay_function="exponential", max_delay_s=3600, unlimited=False,
+                ),
+                networks=[NetworkResource(mbits=50, dynamic_ports=[Port(label="http"), Port(label="admin")])],
+                tasks=[
+                    Task(
+                        name="web",
+                        driver="exec",
+                        config={"command": "/bin/date"},
+                        env={},
+                        log_config=LogConfig(),
+                        resources=Resources(cpu=500, memory_mb=256),
+                    )
+                ],
+                meta={"elb_check_type": "http"},
+            )
+        ],
+        status="pending",
+        version=0,
+        create_index=42,
+        modify_index=99,
+        job_modify_index=99,
+    )
+    from .structs.job import Constraint
+
+    j.constraints = [Constraint(ltarget="${attr.kernel.name}", rtarget="linux", operand="=")]
+    for k, v in overrides.items():
+        setattr(j, k, v)
+    return j
+
+
+def batch_job(**overrides) -> Job:
+    """Reference mock.BatchJob (mock.go:310)."""
+    j = job(**overrides)
+    j.type = JOB_TYPE_BATCH
+    if "id" not in overrides:
+        j.id = f"mock-batch-{_id()}"
+    return j
+
+
+def system_job(**overrides) -> Job:
+    """Reference mock.SystemJob (mock.go:790): system job, count ignored,
+    one web task at 500 MHz / 256 MiB."""
+    from .structs.job import Constraint
+
+    j = Job(
+        id=f"mock-system-{_id()}",
+        name="my-job",
+        type=JOB_TYPE_SYSTEM,
+        priority=100,
+        datacenters=["dc1"],
+        constraints=[Constraint(ltarget="${attr.kernel.name}", rtarget="linux", operand="=")],
+        task_groups=[
+            TaskGroup(
+                name="web",
+                count=1,
+                restart_policy=RestartPolicy(attempts=3, interval_s=600, delay_s=60, mode="delay"),
+                ephemeral_disk=EphemeralDisk(),
+                networks=[NetworkResource(mbits=50, dynamic_ports=[Port(label="http"), Port(label="admin")])],
+                tasks=[
+                    Task(
+                        name="web",
+                        driver="exec",
+                        config={"command": "/bin/date"},
+                        resources=Resources(cpu=500, memory_mb=256),
+                        log_config=LogConfig(),
+                    )
+                ],
+            )
+        ],
+        status="pending",
+        create_index=42,
+        modify_index=99,
+        job_modify_index=99,
+    )
+    for k, v in overrides.items():
+        setattr(j, k, v)
+    return j
+
+
+def eval_(**overrides) -> Evaluation:
+    """Reference mock.Eval (mock.go:865)."""
+    e = Evaluation(
+        id=_id(),
+        namespace="default",
+        priority=50,
+        type=JOB_TYPE_SERVICE,
+        job_id=_id(),
+        status="pending",
+    )
+    for k, v in overrides.items():
+        setattr(e, k, v)
+    return e
+
+
+def alloc_resources(cpu=500, memory_mb=256, disk_mb=150, task="web",
+                    networks=None) -> AllocatedResources:
+    return AllocatedResources(
+        tasks={
+            task: AllocatedTaskResources(
+                cpu=cpu, memory_mb=memory_mb,
+                networks=networks or [],
+            )
+        },
+        shared=AllocatedSharedResources(disk_mb=disk_mb),
+    )
+
+
+def alloc(**overrides) -> Allocation:
+    """Reference mock.Alloc (mock.go:894): web alloc of mock.Job with 500 MHz /
+    256 MiB / 150 MiB disk + one dynamic port."""
+    j = overrides.pop("job", None) or job()
+    a = Allocation(
+        id=_id(),
+        eval_id=_id(),
+        node_id="12345678-abcd-efab-cdef-123456789abc",
+        namespace="default",
+        task_group="web",
+        job_id=j.id,
+        job=j,
+        allocated_resources=alloc_resources(
+            networks=[
+                NetworkResource(
+                    device="eth0", ip="192.168.0.100", mbits=50,
+                    dynamic_ports=[Port(label="http", value=9876)],
+                )
+            ]
+        ),
+        desired_status="run",
+        client_status="pending",
+        name=f"{j.id}.web[0]",
+    )
+    for k, v in overrides.items():
+        setattr(a, k, v)
+    return a

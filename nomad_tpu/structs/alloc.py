@@ -1,0 +1,239 @@
+"""Allocation model (reference `structs.Allocation`, nomad/structs/structs.go:8507)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .job import Job, ReschedulePolicy
+from .resources import AllocatedResources, ComparableResources, Resources
+
+# Desired statuses (reference structs.go:8487-8493)
+ALLOC_DESIRED_RUN = "run"
+ALLOC_DESIRED_STOP = "stop"
+ALLOC_DESIRED_EVICT = "evict"
+
+# Client statuses (reference structs.go:8495-8502)
+ALLOC_CLIENT_PENDING = "pending"
+ALLOC_CLIENT_RUNNING = "running"
+ALLOC_CLIENT_COMPLETE = "complete"
+ALLOC_CLIENT_FAILED = "failed"
+ALLOC_CLIENT_LOST = "lost"
+
+
+@dataclass
+class RescheduleEvent:
+    """Reference `structs.RescheduleEvent` (structs.go:8943)."""
+
+    reschedule_time: float = 0.0
+    prev_alloc_id: str = ""
+    prev_node_id: str = ""
+    delay_s: float = 0.0
+
+
+@dataclass
+class RescheduleTracker:
+    events: List[RescheduleEvent] = field(default_factory=list)
+
+
+@dataclass
+class DesiredTransition:
+    """Reference `structs.DesiredTransition` (structs.go:8440): server-set
+    hints — migrate (drain), reschedule (failed alloc may be replaced)."""
+
+    migrate: Optional[bool] = None
+    reschedule: Optional[bool] = None
+
+    def should_migrate(self) -> bool:
+        return bool(self.migrate)
+
+    def should_reschedule(self) -> bool:
+        return bool(self.reschedule)
+
+
+@dataclass
+class AllocDeploymentStatus:
+    """Reference `structs.AllocDeploymentStatus` (structs.go:9094)."""
+
+    healthy: Optional[bool] = None
+    timestamp: float = 0.0
+    canary: bool = False
+    modify_index: int = 0
+
+    def is_healthy(self) -> bool:
+        return self.healthy is True
+
+    def is_unhealthy(self) -> bool:
+        return self.healthy is False
+
+
+@dataclass
+class NodeScoreMeta:
+    """Per-node score breakdown kept in metrics (reference
+    `structs.NodeScoreMeta`, structs.go:9268)."""
+
+    node_id: str = ""
+    scores: Dict[str, float] = field(default_factory=dict)
+    norm_score: float = 0.0
+
+
+@dataclass
+class AllocMetric:
+    """Placement metrics (reference `structs.AllocMetric`, structs.go:9172):
+    nodes evaluated/filtered/exhausted counters, per-class/constraint
+    breakdowns, top-K score metadata."""
+
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    nodes_available: Dict[str, int] = field(default_factory=dict)  # per-DC
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    quota_exhausted: List[str] = field(default_factory=list)
+    score_meta: List[NodeScoreMeta] = field(default_factory=list)
+    allocation_time_ns: int = 0
+    coalesced_failures: int = 0
+
+    def filter_node(self, node, reason: str) -> None:
+        self.nodes_filtered += 1
+        if node is not None and node.node_class:
+            self.class_filtered[node.node_class] = self.class_filtered.get(node.node_class, 0) + 1
+        if reason:
+            self.constraint_filtered[reason] = self.constraint_filtered.get(reason, 0) + 1
+
+    def exhausted_node(self, node, dimension: str) -> None:
+        self.nodes_exhausted += 1
+        if node is not None and node.node_class:
+            self.class_exhausted[node.node_class] = self.class_exhausted.get(node.node_class, 0) + 1
+        if dimension:
+            self.dimension_exhausted[dimension] = self.dimension_exhausted.get(dimension, 0) + 1
+
+    def score_node(self, node_id: str, name: str, score: float) -> None:
+        # Top-K retention mirrors AllocMetric.PopulateScoreMetaData (lib/kheap);
+        # kept simple here: bounded list, trimmed by the scheduler.
+        for sm in self.score_meta:
+            if sm.node_id == node_id:
+                sm.scores[name] = score
+                return
+        sm = NodeScoreMeta(node_id=node_id, scores={name: score})
+        self.score_meta.append(sm)
+
+
+@dataclass
+class Allocation:
+    """Reference `structs.Allocation` (structs.go:8507)."""
+
+    id: str = ""
+    namespace: str = "default"
+    eval_id: str = ""
+    name: str = ""          # "<job>.<group>[<index>]"
+    node_id: str = ""
+    node_name: str = ""
+    job_id: str = ""
+    job: Optional[Job] = None
+    task_group: str = ""
+    allocated_resources: Optional[AllocatedResources] = None
+    metrics: AllocMetric = field(default_factory=AllocMetric)
+    desired_status: str = ALLOC_DESIRED_RUN
+    desired_description: str = ""
+    desired_transition: DesiredTransition = field(default_factory=DesiredTransition)
+    client_status: str = ALLOC_CLIENT_PENDING
+    client_description: str = ""
+    task_states: Dict[str, object] = field(default_factory=dict)
+    deployment_id: str = ""
+    deployment_status: Optional[AllocDeploymentStatus] = None
+    reschedule_tracker: Optional[RescheduleTracker] = None
+    follow_up_eval_id: str = ""
+    previous_allocation: str = ""
+    next_allocation: str = ""
+    preempted_allocations: List[str] = field(default_factory=list)
+    preempted_by_allocation: str = ""
+    job_version: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    alloc_modify_index: int = 0
+    create_time: float = 0.0
+    modify_time: float = 0.0
+
+    def server_terminal_status(self) -> bool:
+        """Reference `Allocation.ServerTerminalStatus` (structs.go:8831)."""
+        return self.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT)
+
+    def client_terminal_status(self) -> bool:
+        """Reference `Allocation.ClientTerminalStatus` (structs.go:8842)."""
+        return self.client_status in (
+            ALLOC_CLIENT_COMPLETE,
+            ALLOC_CLIENT_FAILED,
+            ALLOC_CLIENT_LOST,
+        )
+
+    def terminal_status(self) -> bool:
+        """Reference `Allocation.TerminalStatus` (structs.go:8820): desired
+        stop/evict first, then terminal client statuses."""
+        return self.server_terminal_status() or self.client_terminal_status()
+
+    def comparable_resources(self) -> ComparableResources:
+        """Reference `Allocation.ComparableResources` (structs.go:8958)."""
+        if self.allocated_resources is not None:
+            return self.allocated_resources.comparable()
+        return ComparableResources()
+
+    def migrate_disk(self) -> bool:
+        if self.job is None:
+            return False
+        tg = self.job.lookup_task_group(self.task_group)
+        return tg is not None and tg.ephemeral_disk.sticky
+
+    def index(self) -> int:
+        """Parse the alloc index out of the name (reference
+        `structs.AllocIndexFromName` / `Allocation.Index`, structs.go:8905)."""
+        try:
+            return int(self.name.rsplit("[", 1)[1].rstrip("]"))
+        except (IndexError, ValueError):
+            return -1
+
+    def reschedule_eligible(self, policy: Optional[ReschedulePolicy], now: float) -> bool:
+        """Whether a failed alloc can be rescheduled now (reference
+        `Allocation.ShouldReschedule` + `RescheduleEligible`, structs.go:8711)."""
+        if policy is None:
+            return False
+        if policy.unlimited:
+            return True
+        if policy.attempts == 0:
+            return False
+        attempted = 0
+        if self.reschedule_tracker is not None:
+            for ev in self.reschedule_tracker.events:
+                if ev.reschedule_time > now - policy.interval_s:
+                    attempted += 1
+        return attempted < policy.attempts
+
+    def next_reschedule_time(self, policy: Optional[ReschedulePolicy], fail_time: float):
+        """Compute (time, eligible) for the next reschedule attempt (reference
+        `Allocation.NextRescheduleTime`, structs.go:8741) with exponential /
+        fibonacci / constant backoff (structs.go:8770 `NextDelay`)."""
+        if policy is None:
+            return 0.0, False
+        delay = self._next_delay(policy)
+        eligible = policy.unlimited or self.reschedule_eligible(policy, fail_time)
+        return fail_time + delay, eligible
+
+    def _next_delay(self, policy: ReschedulePolicy) -> float:
+        base = policy.delay_s
+        events = self.reschedule_tracker.events if self.reschedule_tracker else []
+        n = len(events)
+        if policy.delay_function == "constant":
+            return base
+        if policy.delay_function == "exponential":
+            d = base * (2 ** n)
+        elif policy.delay_function == "fibonacci":
+            a, b = 0.0, base
+            for _ in range(n):
+                a, b = b, a + b
+            d = b
+        else:
+            d = base
+        if policy.max_delay_s > 0:
+            d = min(d, policy.max_delay_s)
+        return d

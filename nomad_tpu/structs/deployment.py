@@ -1,0 +1,79 @@
+"""Deployment model (reference `structs.Deployment`, nomad/structs/structs.go:8166)."""
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+DEPLOYMENT_STATUS_RUNNING = "running"
+DEPLOYMENT_STATUS_PAUSED = "paused"
+DEPLOYMENT_STATUS_FAILED = "failed"
+DEPLOYMENT_STATUS_SUCCESSFUL = "successful"
+DEPLOYMENT_STATUS_CANCELLED = "cancelled"
+
+DEPLOYMENT_DESC_NEWER_JOB = "Cancelled due to newer version of job"
+DEPLOYMENT_DESC_FAILED_ALLOCS = "Failed due to unhealthy allocations"
+DEPLOYMENT_DESC_PROGRESS_DEADLINE = "Failed due to progress deadline"
+DEPLOYMENT_DESC_SUCCESSFUL = "Deployment completed successfully"
+
+
+@dataclass
+class DeploymentState:
+    """Per-task-group rollout state (reference `structs.DeploymentState`,
+    structs.go:8310)."""
+
+    auto_revert: bool = False
+    auto_promote: bool = False
+    promoted: bool = False
+    placed_canaries: list = field(default_factory=list)
+    desired_canaries: int = 0
+    desired_total: int = 0
+    placed_allocs: int = 0
+    healthy_allocs: int = 0
+    unhealthy_allocs: int = 0
+    progress_deadline_s: float = 0.0
+    require_progress_by: float = 0.0
+
+
+@dataclass
+class Deployment:
+    """Reference structs.go:8166."""
+
+    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    namespace: str = "default"
+    job_id: str = ""
+    job_version: int = 0
+    job_modify_index: int = 0
+    job_spec_modify_index: int = 0
+    job_create_index: int = 0
+    task_groups: Dict[str, DeploymentState] = field(default_factory=dict)
+    status: str = DEPLOYMENT_STATUS_RUNNING
+    status_description: str = "Deployment is running"
+    create_index: int = 0
+    modify_index: int = 0
+
+    def active(self) -> bool:
+        """Reference `Deployment.Active` (structs.go:8274)."""
+        return self.status in (DEPLOYMENT_STATUS_RUNNING, DEPLOYMENT_STATUS_PAUSED)
+
+    def has_placed_canaries(self) -> bool:
+        return any(ds.placed_canaries for ds in self.task_groups.values())
+
+    def requires_promotion(self) -> bool:
+        """Reference `Deployment.RequiresPromotion` (structs.go:8289)."""
+        return any(
+            ds.desired_canaries > 0 and not ds.promoted
+            for ds in self.task_groups.values()
+        )
+
+
+def new_deployment(job) -> Deployment:
+    """Reference `structs.NewDeployment` (structs.go:8242)."""
+    return Deployment(
+        namespace=job.namespace,
+        job_id=job.id,
+        job_version=job.version,
+        job_modify_index=job.modify_index,
+        job_spec_modify_index=job.job_modify_index,
+        job_create_index=job.create_index,
+    )

@@ -1,0 +1,65 @@
+"""Device accounting (reference `nomad/structs/devices.go` — `DeviceAccounter`
+:9, `AddAllocs` :69, `AddReserved` :105)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class DeviceAccounterInstance:
+    instances: Dict[str, int] = field(default_factory=dict)  # instance id -> use count
+
+
+class DeviceAccounter:
+    """Per-node accounting of device instance usage."""
+
+    def __init__(self, node) -> None:
+        self.devices: Dict[str, DeviceAccounterInstance] = {}
+        for dev in node.node_resources.devices:
+            inst = DeviceAccounterInstance()
+            for di in dev.instances:
+                inst.instances[di.id] = 0
+            self.devices[dev.id()] = inst
+
+    def add_allocs(self, allocs) -> bool:
+        """Count device use by non-terminal allocs; True if an instance is
+        used more than once (oversubscribed) — reference devices.go:69."""
+        collision = False
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            if alloc.allocated_resources is None:
+                continue
+            for tr in alloc.allocated_resources.tasks.values():
+                for ad in tr.devices:
+                    key = f"{ad.vendor}/{ad.type}/{ad.name}"
+                    acct = self.devices.get(key)
+                    if acct is None:
+                        continue
+                    for inst_id in ad.device_ids:
+                        if inst_id in acct.instances:
+                            acct.instances[inst_id] += 1
+                            if acct.instances[inst_id] > 1:
+                                collision = True
+        return collision
+
+    def add_reserved(self, ad) -> bool:
+        """Mark reserved device instances used (reference devices.go:105)."""
+        collision = False
+        key = f"{ad.vendor}/{ad.type}/{ad.name}"
+        acct = self.devices.get(key)
+        if acct is None:
+            return False
+        for inst_id in ad.device_ids:
+            if inst_id in acct.instances:
+                acct.instances[inst_id] += 1
+                if acct.instances[inst_id] > 1:
+                    collision = True
+        return collision
+
+    def free_instances(self, device_id: str) -> List[str]:
+        acct = self.devices.get(device_id)
+        if acct is None:
+            return []
+        return [i for i, c in acct.instances.items() if c == 0]

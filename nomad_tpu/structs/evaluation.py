@@ -1,0 +1,130 @@
+"""Evaluation model (reference `structs.Evaluation`, nomad/structs/structs.go:9500)."""
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+EVAL_STATUS_BLOCKED = "blocked"
+EVAL_STATUS_PENDING = "pending"
+EVAL_STATUS_COMPLETE = "complete"
+EVAL_STATUS_FAILED = "failed"
+EVAL_STATUS_CANCELLED = "canceled"
+
+# Trigger reasons (reference structs.go:9460-9480)
+TRIGGER_JOB_REGISTER = "job-register"
+TRIGGER_JOB_DEREGISTER = "job-deregister"
+TRIGGER_PERIODIC_JOB = "periodic-job"
+TRIGGER_NODE_DRAIN = "node-drain"
+TRIGGER_NODE_UPDATE = "node-update"
+TRIGGER_ALLOC_STOP = "alloc-stop"
+TRIGGER_SCHEDULED = "scheduled"
+TRIGGER_ROLLING_UPDATE = "rolling-update"
+TRIGGER_DEPLOYMENT_WATCHER = "deployment-watcher"
+TRIGGER_FAILED_FOLLOW_UP = "failed-follow-up"
+TRIGGER_MAX_PLANS = "max-plan-attempts"
+TRIGGER_RETRY_FAILED_ALLOC = "alloc-failure"
+TRIGGER_QUEUED_ALLOCS = "queued-allocs"
+TRIGGER_PREEMPTION = "preemption"
+TRIGGER_JOB_SCALING = "job-scaling"
+
+CORE_JOB_PRIORITY = 200  # reference structs.go JobMaxPriority * 2
+
+
+def new_id() -> str:
+    return str(uuid.uuid4())
+
+
+@dataclass
+class Evaluation:
+    """A unit of scheduling work (reference structs.go:9500)."""
+
+    id: str = field(default_factory=new_id)
+    namespace: str = "default"
+    priority: int = 50
+    type: str = "service"
+    triggered_by: str = TRIGGER_JOB_REGISTER
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    deployment_id: str = ""
+    status: str = EVAL_STATUS_PENDING
+    status_description: str = ""
+    wait_until: float = 0.0
+    next_eval: str = ""
+    previous_eval: str = ""
+    blocked_eval: str = ""
+    failed_tg_allocs: Dict[str, object] = field(default_factory=dict)
+    class_eligibility: Dict[str, bool] = field(default_factory=dict)
+    quota_limit_reached: str = ""
+    escaped_computed_class: bool = False
+    annotate_plan: bool = False
+    queued_allocations: Dict[str, int] = field(default_factory=dict)
+    leader_ack: str = ""
+    snapshot_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: float = 0.0
+    modify_time: float = 0.0
+
+    def terminal_status(self) -> bool:
+        return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED)
+
+    def should_enqueue(self) -> bool:
+        """Reference `Evaluation.ShouldEnqueue` (structs.go:9611)."""
+        return self.status == EVAL_STATUS_PENDING
+
+    def should_block(self) -> bool:
+        """Reference `Evaluation.ShouldBlock` (structs.go:9624)."""
+        return self.status == EVAL_STATUS_BLOCKED
+
+    def make_plan(self, job) -> "object":
+        from .plan import Plan
+
+        priority = self.priority
+        if job is not None:
+            priority = job.priority
+        return Plan(
+            eval_id=self.id,
+            priority=priority,
+            job=job,
+        )
+
+    def create_blocked_eval(self, class_eligibility: Dict[str, bool], escaped: bool,
+                            quota_reached: str) -> "Evaluation":
+        """Reference `Evaluation.CreateBlockedEval` (structs.go:9652)."""
+        return Evaluation(
+            id=new_id(),
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_QUEUED_ALLOCS,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_BLOCKED,
+            previous_eval=self.id,
+            class_eligibility=class_eligibility,
+            escaped_computed_class=escaped,
+            quota_limit_reached=quota_reached,
+            create_time=time.time(),
+            modify_time=time.time(),
+        )
+
+    def create_failed_follow_up_eval(self, wait_s: float) -> "Evaluation":
+        """Reference `Evaluation.CreateFailedFollowUpEval` (structs.go:9679)."""
+        return Evaluation(
+            id=new_id(),
+            namespace=self.namespace,
+            priority=self.priority,
+            type=self.type,
+            triggered_by=TRIGGER_FAILED_FOLLOW_UP,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait_until=time.time() + wait_s,
+            previous_eval=self.id,
+            create_time=time.time(),
+            modify_time=time.time(),
+        )

@@ -1,0 +1,362 @@
+"""Job / TaskGroup / Task model and placement-constraint stanzas.
+
+Behavioral reference: `nomad/structs/structs.go` — `Job` :3736, `TaskGroup`
+:5483, `Task` :6140, `Constraint` :7657, `Affinity` :7779, `Spread` :7867.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .resources import NetworkResource, Resources
+
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+JOB_TYPE_CORE = "_core"
+
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_DEAD = "dead"
+
+JOB_DEFAULT_PRIORITY = 50
+JOB_MIN_PRIORITY = 1
+JOB_MAX_PRIORITY = 100
+
+DEFAULT_NAMESPACE = "default"
+
+# Constraint operands (reference structs.go:7614-7655)
+CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
+CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
+CONSTRAINT_REGEX = "regexp"
+CONSTRAINT_VERSION = "version"
+CONSTRAINT_SEMVER = "semver"
+CONSTRAINT_SET_CONTAINS = "set_contains"
+CONSTRAINT_SET_CONTAINS_ALL = "set_contains_all"
+CONSTRAINT_SET_CONTAINS_ANY = "set_contains_any"
+CONSTRAINT_ATTRIBUTE_IS_SET = "is_set"
+CONSTRAINT_ATTRIBUTE_IS_NOT_SET = "is_not_set"
+
+
+@dataclass
+class Constraint:
+    """Reference `structs.Constraint` (structs.go:7657): LTarget op RTarget."""
+
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+
+    def copy(self) -> "Constraint":
+        return Constraint(self.ltarget, self.rtarget, self.operand)
+
+    def __str__(self) -> str:
+        return f"{self.ltarget} {self.operand} {self.rtarget}"
+
+
+@dataclass
+class Affinity:
+    """Reference `structs.Affinity` (structs.go:7779): weighted soft constraint,
+    weight in [-100, 100], zero weight invalid."""
+
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+    weight: int = 50
+
+    def copy(self) -> "Affinity":
+        return Affinity(self.ltarget, self.rtarget, self.operand, self.weight)
+
+
+@dataclass
+class SpreadTarget:
+    """Reference `structs.SpreadTarget` (structs.go:7925): value + percent."""
+
+    value: str = ""
+    percent: int = 0
+
+
+@dataclass
+class Spread:
+    """Reference `structs.Spread` (structs.go:7867): spread allocations over
+    values of `attribute`, optionally with desired percentages per target."""
+
+    attribute: str = ""
+    weight: int = 0
+    spread_target: List[SpreadTarget] = field(default_factory=list)
+
+
+@dataclass
+class RestartPolicy:
+    """Reference `structs.RestartPolicy` (structs.go:4769)."""
+
+    attempts: int = 2
+    interval_s: float = 1800.0
+    delay_s: float = 15.0
+    mode: str = "fail"  # "delay" | "fail"
+
+
+@dataclass
+class ReschedulePolicy:
+    """Reference `structs.ReschedulePolicy` (structs.go:4847)."""
+
+    attempts: int = 0
+    interval_s: float = 0.0
+    delay_s: float = 30.0
+    delay_function: str = "exponential"  # constant | exponential | fibonacci
+    max_delay_s: float = 3600.0
+    unlimited: bool = True
+
+
+@dataclass
+class MigrateStrategy:
+    """Reference `structs.MigrateStrategy` (structs.go:5088)."""
+
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+
+
+@dataclass
+class UpdateStrategy:
+    """Rolling-update / canary config (reference `structs.UpdateStrategy`,
+    structs.go:4174)."""
+
+    stagger_s: float = 30.0
+    max_parallel: int = 1
+    health_check: str = "checks"
+    min_healthy_time_s: float = 10.0
+    healthy_deadline_s: float = 300.0
+    progress_deadline_s: float = 600.0
+    auto_revert: bool = False
+    auto_promote: bool = False
+    canary: int = 0
+
+    def rolling(self) -> bool:
+        return self.max_parallel > 0
+
+
+@dataclass
+class EphemeralDisk:
+    """Reference `structs.EphemeralDisk` (structs.go:5928)."""
+
+    sticky: bool = False
+    size_mb: int = 300
+    migrate: bool = False
+
+
+@dataclass
+class VolumeRequest:
+    """Group volume request (reference `structs.VolumeRequest`,
+    nomad/structs/volumes.go:79): host or csi."""
+
+    name: str = ""
+    type: str = "host"  # "host" | "csi"
+    source: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class VolumeMount:
+    volume: str = ""
+    destination: str = ""
+    read_only: bool = False
+
+
+@dataclass
+class Service:
+    """Service registration (reference `structs.Service`, structs.go:5244).
+    Consul integration is stubbed; the shape is kept for jobspec parity."""
+
+    name: str = ""
+    port_label: str = ""
+    address_mode: str = "auto"
+    tags: List[str] = field(default_factory=list)
+    checks: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class LogConfig:
+    max_files: int = 10
+    max_file_size_mb: int = 10
+
+
+@dataclass
+class Template:
+    source_path: str = ""
+    dest_path: str = ""
+    embedded_tmpl: str = ""
+    change_mode: str = "restart"
+    change_signal: str = ""
+
+
+@dataclass
+class TaskArtifact:
+    getter_source: str = ""
+    getter_options: Dict[str, str] = field(default_factory=dict)
+    relative_dest: str = "local/"
+
+
+@dataclass
+class TaskLifecycle:
+    """Reference `structs.TaskLifecycleConfig` (structs.go:6120): prestart /
+    poststart / poststop hooks with sidecar flag."""
+
+    hook: str = ""  # "prestart" | "poststart" | "poststop"
+    sidecar: bool = False
+
+
+@dataclass
+class Task:
+    """Reference `structs.Task` (structs.go:6140)."""
+
+    name: str = ""
+    driver: str = "mock_driver"
+    user: str = ""
+    config: Dict[str, object] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    services: List[Service] = field(default_factory=list)
+    resources: Resources = field(default_factory=Resources)
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    lifecycle: Optional[TaskLifecycle] = None
+    templates: List[Template] = field(default_factory=list)
+    artifacts: List[TaskArtifact] = field(default_factory=list)
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    log_config: LogConfig = field(default_factory=LogConfig)
+    leader: bool = False
+    kill_timeout_s: float = 5.0
+    shutdown_delay_s: float = 0.0
+    kill_signal: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TaskGroup:
+    """Reference `structs.TaskGroup` (structs.go:5483)."""
+
+    name: str = ""
+    count: int = 1
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    tasks: List[Task] = field(default_factory=list)
+    networks: List[NetworkResource] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    reschedule_policy: Optional[ReschedulePolicy] = None
+    migrate_strategy: Optional[MigrateStrategy] = None
+    update: Optional[UpdateStrategy] = None
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    volumes: Dict[str, VolumeRequest] = field(default_factory=dict)
+    services: List[Service] = field(default_factory=list)
+    stop_after_client_disconnect_s: Optional[float] = None
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def lookup_task(self, name: str) -> Optional[Task]:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        return None
+
+
+@dataclass
+class PeriodicConfig:
+    """Reference `structs.PeriodicConfig` (structs.go:4900): cron spec."""
+
+    enabled: bool = True
+    spec: str = ""
+    spec_type: str = "cron"
+    prohibit_overlap: bool = False
+    time_zone: str = "UTC"
+
+
+@dataclass
+class ParameterizedJobConfig:
+    """Reference `structs.ParameterizedJobConfig` (structs.go:5010)."""
+
+    payload: str = "optional"  # "optional" | "required" | "forbidden"
+    meta_required: List[str] = field(default_factory=list)
+    meta_optional: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Multiregion:
+    """Reference `structs.Multiregion` (structs.go:4310)."""
+
+    strategy: Optional[dict] = None
+    regions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class ScalingPolicy:
+    """Reference `structs.ScalingPolicy` (structs.go:4534)."""
+
+    id: str = ""
+    target: Dict[str, str] = field(default_factory=dict)
+    policy: Dict[str, object] = field(default_factory=dict)
+    min: int = 0
+    max: int = 0
+    enabled: bool = True
+
+
+@dataclass
+class Job:
+    """Reference `structs.Job` (structs.go:3736)."""
+
+    id: str = ""
+    name: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    region: str = "global"
+    type: str = JOB_TYPE_SERVICE
+    priority: int = JOB_DEFAULT_PRIORITY
+    all_at_once: bool = False
+    datacenters: List[str] = field(default_factory=lambda: ["dc1"])
+    constraints: List[Constraint] = field(default_factory=list)
+    affinities: List[Affinity] = field(default_factory=list)
+    spreads: List[Spread] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    periodic: Optional[PeriodicConfig] = None
+    parameterized: Optional[ParameterizedJobConfig] = None
+    multiregion: Optional[Multiregion] = None
+    update: Optional[UpdateStrategy] = None
+    scaling_policies: List[ScalingPolicy] = field(default_factory=list)
+    payload: bytes = b""
+    meta: Dict[str, str] = field(default_factory=dict)
+    parent_id: str = ""
+    dispatched: bool = False
+    stop: bool = False
+    status: str = JOB_STATUS_PENDING
+    version: int = 0
+    stable: bool = False
+    submit_time: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+
+    def namespaced_id(self) -> tuple:
+        return (self.namespace, self.id)
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def stopped(self) -> bool:
+        return self.stop
+
+    def is_periodic(self) -> bool:
+        return self.periodic is not None
+
+    def is_parameterized(self) -> bool:
+        return self.parameterized is not None and not self.dispatched
+
+    def combined_task_resources(self, tg: TaskGroup) -> Resources:
+        """Sum of task asks in a group plus ephemeral disk (reference
+        `structs.TaskGroup` accounting used by the scheduler in
+        `scheduler/rank.go:231-320`)."""
+        total = Resources(cpu=0, memory_mb=0, disk_mb=tg.ephemeral_disk.size_mb)
+        for t in tg.tasks:
+            total.cpu += t.resources.cpu
+            total.memory_mb += t.resources.memory_mb
+        return total

@@ -1,0 +1,116 @@
+"""Node model (reference `structs.Node`, nomad/structs/structs.go:1708)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .resources import NodeReservedResources, NodeResources, ComparableResources
+
+# Node statuses (reference structs.go:1683-1692)
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+
+# Scheduling eligibility (reference structs.go:1694-1700)
+NODE_SCHED_ELIGIBLE = "eligible"
+NODE_SCHED_INELIGIBLE = "ineligible"
+
+
+@dataclass
+class DriverInfo:
+    """Fingerprint of one task driver on a node
+    (reference `structs.DriverInfo`, structs.go:1651)."""
+
+    attributes: Dict[str, str] = field(default_factory=dict)
+    detected: bool = False
+    healthy: bool = False
+    health_description: str = ""
+
+
+@dataclass
+class DrainStrategy:
+    """Node drain spec (reference `structs.DrainStrategy`, structs.go:1758):
+    deadline (seconds; -1 forces immediate), ignore_system_jobs."""
+
+    deadline_s: float = 0.0
+    ignore_system_jobs: bool = False
+    force_deadline_unix: float = 0.0
+
+
+@dataclass
+class Node:
+    """A fingerprintable client machine (reference structs.go:1708).
+
+    `attributes` carry hierarchical keys (`cpu.arch`, `driver.docker`,
+    `platform.aws.instance-type`, ...); `meta` is operator-supplied. Both feed
+    the constraint LUT compiler (nomad_tpu/tensor/constraints.py).
+    """
+
+    id: str = ""
+    name: str = ""
+    datacenter: str = "dc1"
+    node_class: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    node_resources: NodeResources = field(default_factory=NodeResources)
+    reserved_resources: NodeReservedResources = field(default_factory=NodeReservedResources)
+    drivers: Dict[str, DriverInfo] = field(default_factory=dict)
+    links: Dict[str, str] = field(default_factory=dict)
+    status: str = NODE_STATUS_READY
+    scheduling_eligibility: str = NODE_SCHED_ELIGIBLE
+    drain: Optional[DrainStrategy] = None
+    computed_class: str = ""
+    host_volumes: Dict[str, "ClientHostVolumeConfig"] = field(default_factory=dict)
+    csi_node_plugins: Dict[str, object] = field(default_factory=dict)
+    csi_controller_plugins: Dict[str, object] = field(default_factory=dict)
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def ready(self) -> bool:
+        """Reference `Node.Ready` (structs.go:1855): status ready, not
+        draining, eligible."""
+        return (
+            self.status == NODE_STATUS_READY
+            and self.drain is None
+            and self.scheduling_eligibility == NODE_SCHED_ELIGIBLE
+        )
+
+    def comparable_resources(self) -> ComparableResources:
+        return self.node_resources.comparable()
+
+    def comparable_reserved_resources(self) -> ComparableResources:
+        return self.reserved_resources.comparable()
+
+    def terminal_status(self) -> bool:
+        return self.status == NODE_STATUS_DOWN
+
+    def compute_class(self) -> None:
+        """Computed node class: hash of scheduling-relevant fields (reference
+        `structs.Node.ComputeClass`, nomad/structs/node_class.go:19). Kept for
+        parity metrics; the TPU path evaluates full-width and does not need the
+        memoization."""
+        import hashlib
+
+        h = hashlib.sha1()
+        for k in sorted(self.attributes):
+            if k.startswith("unique."):
+                continue
+            h.update(f"{k}={self.attributes[k]};".encode())
+        for k in sorted(self.meta):
+            if k.startswith("unique."):
+                continue
+            h.update(f"meta.{k}={self.meta[k]};".encode())
+        h.update(self.node_class.encode())
+        h.update(self.datacenter.encode())
+        self.computed_class = "v1:" + h.hexdigest()[:16]
+
+
+@dataclass
+class ClientHostVolumeConfig:
+    """Host volume fingerprinted on a node (reference
+    `structs.ClientHostVolumeConfig`, nomad/structs/volumes.go:9)."""
+
+    name: str = ""
+    path: str = ""
+    read_only: bool = False

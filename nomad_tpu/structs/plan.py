@@ -1,0 +1,130 @@
+"""Plan model (reference `structs.Plan`, nomad/structs/structs.go:9793)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .alloc import (
+    ALLOC_DESIRED_EVICT,
+    ALLOC_DESIRED_STOP,
+    ALLOC_CLIENT_LOST,
+    Allocation,
+)
+from .job import Job
+
+
+@dataclass
+class DesiredUpdates:
+    """Per-group counts surfaced by `nomad job plan` (reference
+    `structs.DesiredUpdates`, structs.go:10013)."""
+
+    ignore: int = 0
+    place: int = 0
+    migrate: int = 0
+    stop: int = 0
+    in_place_update: int = 0
+    destructive_update: int = 0
+    canary: int = 0
+    preemptions: int = 0
+
+
+@dataclass
+class PlanAnnotations:
+    desired_tg_updates: Dict[str, DesiredUpdates] = field(default_factory=dict)
+    preempted_allocs: List[Allocation] = field(default_factory=list)
+
+
+@dataclass
+class DeploymentStatusUpdate:
+    deployment_id: str = ""
+    status: str = ""
+    status_description: str = ""
+
+
+@dataclass
+class Plan:
+    """The scheduler's proposed mutation set (reference structs.go:9793):
+    per-node stop lists (`node_update`), per-node placements
+    (`node_allocation`), per-node preemptions, plus deployment changes."""
+
+    eval_id: str = ""
+    eval_token: str = ""
+    priority: int = 50
+    all_at_once: bool = False
+    job: Optional[Job] = None
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    deployment: Optional[object] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    annotations: Optional[PlanAnnotations] = None
+    snapshot_index: int = 0
+
+    def append_stopped_alloc(self, alloc: Allocation, desired_desc: str,
+                             client_status: str = "") -> None:
+        """Reference `Plan.AppendStoppedAlloc` (structs.go:9845): copy the
+        alloc, set desired stop (or preserve lost client status)."""
+        import copy
+
+        new_alloc = copy.copy(alloc)
+        new_alloc.job = None  # normalized in the plan; reattached at apply
+        new_alloc.desired_status = ALLOC_DESIRED_STOP
+        new_alloc.desired_description = desired_desc
+        if client_status:
+            new_alloc.client_status = client_status
+        self.node_update.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        """Reference `Plan.AppendAlloc` (structs.go:9923)."""
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def append_preempted_alloc(self, alloc: Allocation, preempting_alloc_id: str) -> None:
+        """Reference `Plan.AppendPreemptedAlloc` (structs.go:9892)."""
+        import copy
+
+        new_alloc = copy.copy(alloc)
+        new_alloc.job = None
+        new_alloc.desired_status = ALLOC_DESIRED_EVICT
+        new_alloc.preempted_by_allocation = preempting_alloc_id
+        new_alloc.desired_description = (
+            f"Preempted by alloc ID {preempting_alloc_id}"
+        )
+        self.node_preemptions.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def is_no_op(self) -> bool:
+        """Reference `Plan.IsNoOp` (structs.go:9931)."""
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and self.deployment is None
+            and not self.deployment_updates
+        )
+
+
+@dataclass
+class PlanResult:
+    """What the plan applier committed (reference `structs.PlanResult`,
+    structs.go:9976)."""
+
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
+    deployment: Optional[object] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    refresh_index: int = 0
+    alloc_index: int = 0
+
+    def full_commit(self, plan: Plan):
+        """Reference `PlanResult.FullCommit` (structs.go:9998): (full, expected,
+        actual) placement counts."""
+        expected = sum(len(v) for v in plan.node_allocation.values())
+        actual = sum(len(v) for v in self.node_allocation.values())
+        return expected == actual, expected, actual
+
+    def is_no_op(self) -> bool:
+        return (
+            not self.node_update
+            and not self.node_allocation
+            and not self.deployment_updates
+            and self.deployment is None
+        )

@@ -1,0 +1,259 @@
+"""Resource model.
+
+Behavioral reference: `nomad/structs/structs.go` — `NodeResources` :2368,
+`ComparableResources` :3640, `AllocatedResources` :3304, and the
+add/subtract/superset algebra used by `AllocsFit`
+(`nomad/structs/funcs.go:103`).
+
+The TPU build keeps a deliberately flattened resource algebra: the comparable
+form is (cpu_shares, memory_mb, disk_mb, device columns) because that is what
+both the fit check and the score kernels consume as dense columns.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Port:
+    """A labeled port reservation (reference `structs.Port`, structs.go:2156)."""
+
+    label: str = ""
+    value: int = 0
+    to: int = 0
+    host_network: str = "default"
+
+
+@dataclass
+class NetworkResource:
+    """Network ask/assignment for a task group or task.
+
+    Reference `structs.NetworkResource` (structs.go:2190): device, CIDR, IP,
+    MBits and reserved (static) / dynamic port lists.
+    """
+
+    mode: str = "host"
+    device: str = ""
+    cidr: str = ""
+    ip: str = ""
+    mbits: int = 0
+    reserved_ports: List[Port] = field(default_factory=list)
+    dynamic_ports: List[Port] = field(default_factory=list)
+
+    def copy(self) -> "NetworkResource":
+        return NetworkResource(
+            mode=self.mode,
+            device=self.device,
+            cidr=self.cidr,
+            ip=self.ip,
+            mbits=self.mbits,
+            reserved_ports=[dataclasses.replace(p) for p in self.reserved_ports],
+            dynamic_ports=[dataclasses.replace(p) for p in self.dynamic_ports],
+        )
+
+
+@dataclass
+class RequestedDevice:
+    """A device ask on a task (reference `structs.RequestedDevice`, structs.go:3099).
+
+    Name is `<vendor>/<type>/<name>`, `<type>/<name>` or `<type>` — matching
+    is by suffix-specificity (`structs.RequestedDevice.ID` / device.go matching).
+    """
+
+    name: str = ""
+    count: int = 1
+    constraints: list = field(default_factory=list)   # List[Constraint]
+    affinities: list = field(default_factory=list)    # List[Affinity]
+
+
+@dataclass
+class Resources:
+    """Task-level resource ask (reference `structs.Resources`, structs.go:2010).
+
+    cpu is MHz shares; memory/disk are MiB, matching the reference units.
+    """
+
+    cpu: int = 100
+    memory_mb: int = 300
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[RequestedDevice] = field(default_factory=list)
+
+    def copy(self) -> "Resources":
+        return Resources(
+            cpu=self.cpu,
+            memory_mb=self.memory_mb,
+            disk_mb=self.disk_mb,
+            networks=[n.copy() for n in self.networks],
+            devices=[dataclasses.replace(d) for d in self.devices],
+        )
+
+    def add(self, other: "Resources") -> None:
+        self.cpu += other.cpu
+        self.memory_mb += other.memory_mb
+        self.disk_mb += other.disk_mb
+
+
+@dataclass
+class NodeDeviceInstance:
+    id: str = ""
+    healthy: bool = True
+    locality: str = ""
+
+
+@dataclass
+class NodeDeviceResource:
+    """An installed device group on a node (reference `structs.NodeDeviceResource`,
+    structs.go:2855): vendor/type/name + instances + attributes."""
+
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    instances: List[NodeDeviceInstance] = field(default_factory=list)
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+    def id(self) -> str:
+        return f"{self.vendor}/{self.type}/{self.name}"
+
+    def matches(self, ask_name: str) -> bool:
+        """Suffix-specificity matching per reference `nodeDeviceIDMatches`
+        (scheduler/feasible.go device matching / structs.go:3119 `RequestedDevice`):
+        `<type>`, `<type>/<name>`, or `<vendor>/<type>/<name>`."""
+        parts = ask_name.split("/")
+        if len(parts) == 1:
+            return self.type == parts[0]
+        if len(parts) == 2:
+            return self.type == parts[0] and self.name == parts[1]
+        if len(parts) == 3:
+            return (
+                self.vendor == parts[0]
+                and self.type == parts[1]
+                and self.name == parts[2]
+            )
+        return False
+
+
+@dataclass
+class NodeResources:
+    """Total resources on a node (reference `structs.NodeResources`, structs.go:2368)."""
+
+    cpu: int = 0              # total cpu shares (MHz)
+    memory_mb: int = 0
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List[NodeDeviceResource] = field(default_factory=list)
+
+    def comparable(self) -> "ComparableResources":
+        return ComparableResources(
+            cpu=float(self.cpu), memory_mb=float(self.memory_mb), disk_mb=float(self.disk_mb)
+        )
+
+
+@dataclass
+class NodeReservedResources:
+    """Resources reserved for the OS/agent on a node
+    (reference `structs.NodeReservedResources`, structs.go:2716)."""
+
+    cpu: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    reserved_ports: str = ""  # comma-separated port spec, e.g. "22,80,8000-8100"
+
+    def comparable(self) -> "ComparableResources":
+        return ComparableResources(
+            cpu=float(self.cpu), memory_mb=float(self.memory_mb), disk_mb=float(self.disk_mb)
+        )
+
+
+@dataclass
+class AllocatedTaskResources:
+    """Resources actually granted to one task (reference structs.go:3479)."""
+
+    cpu: int = 0
+    memory_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+    devices: List["AllocatedDeviceResource"] = field(default_factory=list)
+
+
+@dataclass
+class AllocatedDeviceResource:
+    vendor: str = ""
+    type: str = ""
+    name: str = ""
+    device_ids: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AllocatedSharedResources:
+    """Group-shared resources (reference structs.go:3439): disk + group networks."""
+
+    disk_mb: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+
+@dataclass
+class AllocatedResources:
+    """Everything granted to an allocation (reference structs.go:3304)."""
+
+    tasks: Dict[str, AllocatedTaskResources] = field(default_factory=dict)
+    shared: AllocatedSharedResources = field(default_factory=AllocatedSharedResources)
+
+    def comparable(self) -> "ComparableResources":
+        """Flatten per reference `AllocatedResources.Comparable` (structs.go:3368):
+        sum task cpu/mem, take shared disk, union networks."""
+        c = ComparableResources(disk_mb=float(self.shared.disk_mb))
+        for t in self.tasks.values():
+            c.cpu += float(t.cpu)
+            c.memory_mb += float(t.memory_mb)
+            c.networks.extend(t.networks)
+        c.networks.extend(self.shared.networks)
+        return c
+
+
+@dataclass
+class ComparableResources:
+    """Flattened, comparable resource vector
+    (reference `structs.ComparableResources`, structs.go:3640).
+
+    Devices are carried as a `{device_id: count}` map so the fit check can do
+    superset over device columns too (the reference handles devices separately
+    via `DeviceAccounter`, structs_funcs; folding them into the comparable
+    algebra is the tensor-friendly equivalent).
+    """
+
+    cpu: float = 0.0
+    memory_mb: float = 0.0
+    disk_mb: float = 0.0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+    def add(self, other: "ComparableResources") -> None:
+        self.cpu += other.cpu
+        self.memory_mb += other.memory_mb
+        self.disk_mb += other.disk_mb
+        self.networks.extend(other.networks)
+
+    def subtract(self, other: "ComparableResources") -> None:
+        self.cpu -= other.cpu
+        self.memory_mb -= other.memory_mb
+        self.disk_mb -= other.disk_mb
+
+    def superset(self, other: "ComparableResources") -> Tuple[bool, str]:
+        """Reference `ComparableResources.Superset` (structs.go:3682): returns
+        (ok, exhausted-dimension-name)."""
+        if self.cpu < other.cpu:
+            return False, "cpu"
+        if self.memory_mb < other.memory_mb:
+            return False, "memory"
+        if self.disk_mb < other.disk_mb:
+            return False, "disk"
+        return True, ""
+
+    def copy(self) -> "ComparableResources":
+        return ComparableResources(
+            cpu=self.cpu,
+            memory_mb=self.memory_mb,
+            disk_mb=self.disk_mb,
+            networks=list(self.networks),
+        )
