@@ -1,0 +1,11 @@
+"""Jitted JAX kernels for feasibility + ranking (the TPU replacement for the
+reference's scalar iterator chain, `scheduler/stack.go:321`)."""
+
+from .placement import (  # noqa: F401
+    ClusterArrays,
+    PlacementResult,
+    TGParams,
+    place_task_group,
+    place_task_group_batch,
+    system_feasibility,
+)

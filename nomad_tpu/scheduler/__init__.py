@@ -1,0 +1,4 @@
+"""Scheduler package: reconciler, generic/system schedulers, TPU stack,
+scalar oracle (reference `scheduler/`)."""
+
+from .stack import PlanContext, SelectResult, TPUStack  # noqa: F401

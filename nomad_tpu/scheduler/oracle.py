@@ -1,0 +1,320 @@
+"""Scalar oracle: a faithful re-implementation of the reference's iterator
+chain, used as the parity baseline for the TPU kernels.
+
+This mirrors, step by step: `scheduler/stack.go:116` (GenericStack.Select),
+`feasible.go` (ConstraintChecker :674, DriverChecker :398, DistinctHosts
+:470), `rank.go` (BinPackIterator :188, JobAntiAffinity :474,
+ReschedulePenalty :544, NodeAffinity :589, ScoreNormalization :679) and
+`spread.go`. It is deliberately scalar/early-exit-free ("exact mode": full
+node scan + true max) so kernel-vs-oracle equality is well-defined; the
+log₂(n) Limit/MaxScore sampling of the reference is modeled separately by
+`sampled=` for strict Go-parity experiments.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..structs import (
+    Allocation,
+    BINPACK_MAX_FIT_SCORE,
+    ComparableResources,
+    Constraint,
+    Job,
+    Node,
+    TaskGroup,
+    allocs_fit,
+    score_fit_binpack,
+    score_fit_spread,
+)
+from ..structs.job import CONSTRAINT_DISTINCT_HOSTS
+from ..tensor.constraints import check_affinity, check_constraint
+from ..tensor.vocab import target_to_key
+
+
+def resolve_target(target: str, node: Node) -> Tuple[Optional[str], bool]:
+    """Reference resolveTarget (feasible.go:713)."""
+    if not target.startswith("${"):
+        return target, True
+    key = target_to_key(target)
+    if key == "node.unique.id":
+        return node.id, True
+    if key == "node.datacenter":
+        return node.datacenter, True
+    if key == "node.unique.name":
+        return node.name, True
+    if key == "node.class":
+        return node.node_class, True
+    if key and key.startswith("attr."):
+        v = node.attributes.get(key[5:])
+        return v, v is not None
+    if key and key.startswith("meta."):
+        v = node.meta.get(key[5:])
+        return v, v is not None
+    return None, False
+
+
+def meets_constraints(node: Node, constraints: Sequence[Constraint]) -> bool:
+    for c in constraints:
+        lval, lok = resolve_target(c.ltarget, node)
+        rval, rok = resolve_target(c.rtarget, node)
+        if not check_constraint(c.operand, lval, rval, lok, rok):
+            return False
+    return True
+
+
+def driver_ok(node: Node, driver: str) -> bool:
+    """Reference DriverChecker (feasible.go:398,427): DriverInfo
+    detected+healthy, legacy fallback to `driver.<name>` attr truthiness."""
+    info = node.drivers.get(driver)
+    if info is not None:
+        return info.detected and info.healthy
+    raw = node.attributes.get(f"driver.{driver}")
+    return raw in ("1", "true")
+
+
+@dataclass
+class OracleContext:
+    """Plan-relative state (reference EvalContext, scheduler/context.go:76)."""
+
+    nodes: List[Node]
+    allocs_by_node: Dict[str, List[Allocation]]  # non-terminal state allocs
+    plan_node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    plan_node_alloc: Dict[str, List[Allocation]] = field(default_factory=dict)
+    plan_node_preempt: Dict[str, List[Allocation]] = field(default_factory=dict)
+
+    def proposed_allocs(self, node_id: str) -> List[Allocation]:
+        """Reference EvalContext.ProposedAllocs (context.go:120)."""
+        proposed = [
+            a for a in self.allocs_by_node.get(node_id, [])
+            if not a.terminal_status()
+        ]
+        removed = {
+            a.id
+            for a in self.plan_node_update.get(node_id, [])
+            + self.plan_node_preempt.get(node_id, [])
+        }
+        by_id = {a.id: a for a in proposed if a.id not in removed}
+        for a in self.plan_node_alloc.get(node_id, []):
+            by_id[a.id] = a
+        return list(by_id.values())
+
+
+@dataclass
+class OracleOption:
+    node: Node
+    final_score: float
+    scores: List[float]
+
+
+def select_option(
+    ctx: OracleContext,
+    job: Job,
+    tg: TaskGroup,
+    penalty_nodes: Optional[set] = None,
+    algorithm: str = "binpack",
+    sampled: Optional[int] = None,
+) -> Optional[OracleOption]:
+    """One Select(): returns the best-scoring feasible node or None.
+
+    Mirrors GenericStack.Select (stack.go:116) with exact (full-scan) limit.
+    """
+    penalty_nodes = penalty_nodes or set()
+    combined_constraints = list(job.constraints) + list(tg.constraints)
+    for t in tg.tasks:
+        combined_constraints.extend(t.constraints)
+    drivers = {t.driver for t in tg.tasks}
+    job_distinct = any(
+        c.operand == CONSTRAINT_DISTINCT_HOSTS for c in job.constraints
+    )
+    tg_distinct = any(
+        c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints
+    )
+
+    affinities = list(job.affinities) + list(tg.affinities)
+    for t in tg.tasks:
+        affinities.extend(t.affinities)
+
+    ask = job.combined_task_resources(tg)
+
+    spreads = list(tg.spreads) + list(job.spreads)
+
+    best: Optional[OracleOption] = None
+    candidates = ctx.nodes if sampled is None else ctx.nodes[:sampled]
+    for node in candidates:
+        if not node.ready():
+            continue
+        if node.datacenter not in job.datacenters:
+            continue
+        if not all(driver_ok(node, d) for d in drivers):
+            continue
+        if not meets_constraints(node, combined_constraints):
+            continue
+
+        proposed = ctx.proposed_allocs(node.id)
+
+        # DistinctHosts (feasible.go:534)
+        if job_distinct or tg_distinct:
+            collision = False
+            for a in proposed:
+                jc = a.job_id == job.id
+                tc = a.task_group == tg.name
+                if (job_distinct and jc) or (jc and tc):
+                    collision = True
+                    break
+            if collision:
+                continue
+
+        # BinPack fit + score (rank.go:188)
+        util = ComparableResources()
+        for a in proposed:
+            util.add(a.comparable_resources())
+        util.cpu += ask.cpu
+        util.memory_mb += ask.memory_mb
+        util.disk_mb += ask.disk_mb
+
+        available = node.comparable_resources()
+        available.subtract(node.comparable_reserved_resources())
+        fits, _dim = available.superset(util)
+        if not fits:
+            continue
+
+        # Bandwidth (reference: NetworkIndex.Overcommitted inside AllocsFit,
+        # network.go:66; AssignNetwork bandwidth check :428)
+        ask_bw = sum(nw.mbits for nw in tg.networks) + sum(
+            nw.mbits for t in tg.tasks for nw in t.resources.networks
+        )
+        used_bw = sum(nw.mbits for a in proposed for nw in a.comparable_resources().networks)
+        avail_bw = sum(nw.mbits for nw in node.node_resources.networks)
+        if used_bw + ask_bw > avail_bw:
+            continue
+
+        scores: List[float] = []
+        if algorithm == "spread":
+            fitness = score_fit_spread(node, util)
+        else:
+            fitness = score_fit_binpack(node, util)
+        scores.append(fitness / BINPACK_MAX_FIT_SCORE)
+
+        # JobAntiAffinity (rank.go:505)
+        collisions = sum(
+            1 for a in proposed
+            if a.job_id == job.id and a.task_group == tg.name
+        )
+        if collisions > 0:
+            scores.append(-1.0 * (collisions + 1) / max(tg.count, 1))
+
+        # ReschedulePenalty (rank.go:570)
+        if node.id in penalty_nodes:
+            scores.append(-1.0)
+
+        # NodeAffinity (rank.go:640)
+        if affinities:
+            sum_w = sum(abs(float(a.weight)) for a in affinities)
+            total = 0.0
+            for a in affinities:
+                lval, lok = resolve_target(a.ltarget, node)
+                rval, rok = resolve_target(a.rtarget, node)
+                if check_affinity(a.operand, lval, rval, lok, rok):
+                    total += float(a.weight)
+            if total != 0.0:
+                scores.append(total / sum_w)
+
+        # Spread (spread.go:120)
+        if spreads:
+            sboost = _spread_score(ctx, job, tg, spreads, node)
+            if sboost != 0.0:
+                scores.append(sboost)
+
+        final = sum(scores) / len(scores)
+        if best is None or final > best.final_score:
+            best = OracleOption(node=node, final_score=final, scores=scores)
+    return best
+
+
+def _spread_score(
+    ctx: OracleContext, job: Job, tg: TaskGroup, spreads, node: Node
+) -> float:
+    """Reference SpreadIterator.Next (spread.go:110) + evenSpreadScoreBoost
+    (:178). Property counts include existing (non-terminal) allocs of the job's
+    task group plus in-plan placements, keyed by the spread attribute value of
+    each alloc's node (propertyset.go:132,160)."""
+    sum_weights = sum(s.weight for s in spreads)
+    total = 0.0
+    nodes_by_id = {n.id: n for n in ctx.nodes}
+    for spread in spreads:
+        key = target_to_key(spread.attribute) or spread.attribute
+        # Build combined use map for this tg over proposed allocs
+        use: Dict[str, int] = {}
+        for n2 in ctx.nodes:
+            props = ctx.proposed_allocs(n2.id)
+            cnt = sum(
+                1 for a in props
+                if a.job_id == job.id and a.task_group == tg.name
+            )
+            if cnt:
+                val, ok = _node_property(n2, key)
+                if ok:
+                    use[val] = use.get(val, 0) + cnt
+        nval, ok = _node_property(node, key)
+        if not ok:
+            total -= 1.0
+            continue
+        used_count = use.get(nval, 0) + 1
+        if spread.spread_target:
+            desired_counts = {
+                st.value: (st.percent / 100.0) * tg.count
+                for st in spread.spread_target
+            }
+            s = sum(desired_counts.values())
+            implicit = None
+            if 0 < s < tg.count:
+                implicit = tg.count - s
+            desired = desired_counts.get(nval, implicit)
+            if desired is None or desired <= 0:
+                total -= 1.0
+                continue
+            w = spread.weight / sum_weights
+            total += ((desired - used_count) / desired) * w
+        else:
+            total += _even_spread_boost(use, nval)
+    return total
+
+
+def _node_property(node: Node, key: str) -> Tuple[str, bool]:
+    if key == "node.datacenter":
+        return node.datacenter, True
+    if key == "node.class":
+        return node.node_class, True
+    if key == "node.unique.id":
+        return node.id, True
+    if key == "node.unique.name":
+        return node.name, True
+    if key.startswith("attr."):
+        v = node.attributes.get(key[5:])
+        return v or "", v is not None
+    if key.startswith("meta."):
+        v = node.meta.get(key[5:])
+        return v or "", v is not None
+    return "", False
+
+
+def _even_spread_boost(use: Dict[str, int], nval: str) -> float:
+    """Reference evenSpreadScoreBoost (spread.go:178)."""
+    if not use:
+        return 0.0
+    current = use.get(nval, 0)
+    minc = min(use.values())
+    maxc = max(use.values())
+    if minc == 0:
+        delta_boost = -1.0
+    else:
+        delta_boost = float(minc - current) / float(minc)
+    if current != minc:
+        return delta_boost
+    if minc == maxc:
+        return -1.0
+    if minc == 0:
+        return 1.0
+    return float(maxc - minc) / float(minc)

@@ -1,0 +1,352 @@
+"""TPUStack — the device-backed replacement for GenericStack.
+
+Reference: `scheduler/stack.go:321` builds the iterator chain once per
+scheduler invocation; `SetNodes` (:70) shuffles and sets the log₂(n) limit,
+`Select` (:116) runs one alloc's placement. Here the per-(job, task-group)
+constraint/affinity/spread programs compile to LUTs once, and a single jitted
+kernel call places *all* allocs of the group (scan) — or a whole batch of
+evaluations (vmap) — full-width over the node axis.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..kernels.placement import ClusterArrays, PlacementResult, TGParams
+from ..structs import Allocation, Job, TaskGroup
+from ..structs.job import CONSTRAINT_DISTINCT_HOSTS
+from ..tensor.cluster import R_TOTAL, ClusterTensors
+from ..tensor.constraints import (
+    CompiledAffinities,
+    CompiledConstraints,
+    compile_affinities,
+    compile_constraints,
+)
+from ..tensor.vocab import MISSING, target_to_key
+from .oracle import OracleContext, driver_ok, meets_constraints
+
+
+def _bucket(n: int, lo: int = 1) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class PlanContext:
+    """Plan-relative inputs for one evaluation (mirrors what the reference
+    threads through ctx.Plan(), scheduler/context.go:120)."""
+
+    stopped_allocs: List[Allocation] = field(default_factory=list)
+    preempted_allocs: List[Allocation] = field(default_factory=list)
+    placed: List[Tuple[str, str, np.ndarray]] = field(default_factory=list)
+    # (node_id, task_group, usage_row) for in-plan placements of this job
+    penalty_node_ids: frozenset = frozenset()
+
+
+@dataclass
+class SelectResult:
+    node_ids: List[Optional[str]]
+    scores: List[float]
+    nodes_feasible: int
+    nodes_fit: List[int]
+    raw: PlacementResult = None
+
+
+class TPUStack:
+    """Compiles placement programs and drives the placement kernel."""
+
+    def __init__(self, cluster: ClusterTensors, algorithm: str = "binpack",
+                 jit: bool = True) -> None:
+        self.cluster = cluster
+        self.algorithm = algorithm
+        self._jit = jit
+        self._snapshot_version = -1
+        self._dev_arrays: Optional[ClusterArrays] = None
+
+    # ---- device snapshot management ----
+
+    def device_arrays(self) -> ClusterArrays:
+        import jax.numpy as jnp
+
+        if self._dev_arrays is None or self._snapshot_version != self.cluster.version:
+            snap = self.cluster.snapshot()
+            self._dev_arrays = ClusterArrays(
+                capacity=jnp.asarray(snap.capacity),
+                used=jnp.asarray(snap.used),
+                node_ok=jnp.asarray(snap.node_ok),
+                attrs=jnp.asarray(snap.attrs),
+            )
+            self._snapshot_version = self.cluster.version
+        return self._dev_arrays
+
+    # ---- program compilation ----
+
+    def compile_tg(
+        self,
+        job: Job,
+        tg: TaskGroup,
+        n_place: int,
+        plan: Optional[PlanContext] = None,
+        max_allocs: Optional[int] = None,
+    ) -> Tuple[TGParams, int]:
+        """Build TGParams (numpy; converted on dispatch)."""
+        plan = plan or PlanContext()
+        cl = self.cluster
+        n = cl.n_cap
+        vocab = cl.vocab
+
+        combined = list(job.constraints) + list(tg.constraints)
+        for t in tg.tasks:
+            combined.extend(t.constraints)
+        drivers = sorted({t.driver for t in tg.tasks})
+
+        cc = compile_constraints(
+            combined, vocab, datacenters=job.datacenters, drivers=drivers
+        )
+        affinities = list(job.affinities) + list(tg.affinities)
+        for t in tg.tasks:
+            affinities.extend(t.affinities)
+        ca = compile_affinities(affinities, vocab)
+
+        # LUT widths can differ between the two compiles (vocab can grow);
+        # normalize to a common width so the kernel sees one V.
+        v = max(cc.lut.shape[1] if cc.lut.size else 2,
+                ca.lut.shape[1] if ca.lut.size else 2)
+        feas_lut = _pad_lut(cc.lut, v, fill=False, dtype=np.bool_)
+        aff_lut = _pad_lut(ca.lut, v, fill=0.0, dtype=np.float32)
+        # Keys interned during compilation must exist as attrs columns before
+        # the device gather (token −1 everywhere for brand-new keys).
+        while vocab.num_keys > cl.k_cap:
+            cl._grow_keys()
+            cl.version += 1
+
+        # host-evaluated constraints (node-dependent RTarget) → extra mask
+        extra = np.ones(n, dtype=bool)
+        if cc.needs_host or ca.needs_host:
+            for node_id, row in cl.row_of.items():
+                node = cl.nodes[node_id]
+                if cc.needs_host and not meets_constraints(node, cc.needs_host):
+                    extra[row] = False
+
+        # distinct_hosts flags (feasible.go:494-500: job level vs tg level)
+        dh_job = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in job.constraints)
+        dh_tg = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints)
+        # NB: tg-level distinct_hosts requires job+tg collision; job-level only
+        # job collision. The kernel has one count vector; encode tg-level by
+        # using the jobtg counts as the distinct counts.
+        distinct = dh_job or dh_tg
+
+        # per-eval count vectors (state + plan adjustments)
+        jc, jtc = cl.job_count_vectors(job.id, tg.name)
+        for a in plan.stopped_allocs + plan.preempted_allocs:
+            if a.job_id == job.id:
+                row = cl.row_of.get(a.node_id)
+                if row is not None:
+                    jc[row] = max(jc[row] - 1, 0)
+                    if a.task_group == tg.name:
+                        jtc[row] = max(jtc[row] - 1, 0)
+        for node_id, tgname, _usage in plan.placed:
+            row = cl.row_of.get(node_id)
+            if row is not None:
+                jc[row] += 1
+                if tgname == tg.name:
+                    jtc[row] += 1
+        dh_counts = jc if dh_job else jtc
+
+        # resource deltas: in-plan stops/preempts release, placements consume
+        deltas: List[Tuple[int, np.ndarray]] = []
+        for a in plan.stopped_allocs + plan.preempted_allocs:
+            row_entry = cl.alloc_usage.get(a.id)
+            if row_entry is not None:
+                deltas.append(row_entry)
+        for node_id, _tgname, usage in plan.placed:
+            row = cl.row_of.get(node_id)
+            if row is not None:
+                deltas.append((row, -usage))
+        d = _bucket(max(len(deltas), 1))
+        delta_idx = np.full(d, -1, dtype=np.int32)
+        delta_res = np.zeros((d, R_TOTAL), dtype=np.float32)
+        for i, (row, usage) in enumerate(deltas):
+            delta_idx[i] = row
+            delta_res[i] = usage
+
+        # penalty vector
+        penalty = np.zeros(n, dtype=bool)
+        for nid in plan.penalty_node_ids:
+            row = cl.row_of.get(nid)
+            if row is not None:
+                penalty[row] = True
+
+        # ask vector
+        ask = np.zeros(R_TOTAL, dtype=np.float32)
+        res = job.combined_task_resources(tg)
+        ask[0], ask[1], ask[2] = res.cpu, res.memory_mb, res.disk_mb
+        ask[3] = sum(nw.mbits for nw in tg.networks) + sum(
+            nw.mbits for t in tg.tasks for nw in t.resources.networks
+        )
+        for t in tg.tasks:
+            for dev in t.resources.devices:
+                col = self._device_ask_col(dev.name)
+                if col is not None:
+                    ask[col] += dev.count
+
+        # spread programs
+        spreads = list(tg.spreads) + list(job.spreads)
+        sp = self._compile_spreads(job, tg, spreads, plan, v)
+
+        m = max_allocs if max_allocs is not None else _bucket(max(n_place, 1))
+        params = TGParams(
+            ask=ask,
+            n_place=np.int32(n_place),
+            desired_count=np.float32(max(tg.count, 1)),
+            algorithm=np.int32(1 if self.algorithm == "spread" else 0),
+            key_idx=cc.key_idx,
+            lut=feas_lut,
+            aff_key_idx=ca.key_idx,
+            aff_lut=aff_lut,
+            aff_inv_sum=np.float32(ca.inv_sum_abs_weight),
+            penalty=penalty,
+            extra_mask=extra,
+            distinct_hosts=np.bool_(distinct),
+            job_count0=dh_counts,
+            jobtg_count0=jtc,
+            delta_idx=delta_idx,
+            delta_res=delta_res,
+            spread_key_idx=sp[0],
+            spread_weight=sp[1],
+            spread_has_targets=sp[2],
+            spread_desired=sp[3],
+            spread_counts0=sp[4],
+            spread_active=sp[5],
+        )
+        return params, m
+
+    def _device_ask_col(self, name: str) -> Optional[int]:
+        # Match the ask against registered device columns by suffix specificity
+        # (structs.RequestedDevice matching)
+        for dev_id, col in self.cluster.device_cols.items():
+            vendor, dtype, dname = dev_id.split("/")
+            parts = name.split("/")
+            if (
+                (len(parts) == 1 and parts[0] == dtype)
+                or (len(parts) == 2 and parts == [dtype, dname])
+                or (len(parts) == 3 and parts == [vendor, dtype, dname])
+            ):
+                return col
+        return None
+
+    def _compile_spreads(self, job, tg, spreads, plan: PlanContext, v: int):
+        cl = self.cluster
+        s_n = _bucket(max(len(spreads), 1))
+        key_idx = np.zeros(s_n, dtype=np.int32)
+        weight = np.zeros(s_n, dtype=np.float32)
+        has_targets = np.zeros(s_n, dtype=bool)
+        desired = np.full((s_n, v), -1.0, dtype=np.float32)
+        counts0 = np.zeros((s_n, v), dtype=np.float32)
+        active = np.zeros(s_n, dtype=bool)
+        if not spreads:
+            return key_idx, weight, has_targets, desired, counts0, active
+        sum_w = sum(s.weight for s in spreads) or 1
+        for i, spread in enumerate(spreads):
+            key = target_to_key(spread.attribute) or spread.attribute
+            k = cl.vocab.intern_key(key)
+            kv = cl.vocab.key_vocabs[k]
+            key_idx[i] = k
+            weight[i] = spread.weight / sum_w
+            active[i] = True
+            if spread.spread_target:
+                has_targets[i] = True
+                dc = {
+                    st.value: (st.percent / 100.0) * tg.count
+                    for st in spread.spread_target
+                }
+                total = sum(dc.values())
+                implicit = None
+                if 0 < total < tg.count:
+                    implicit = float(tg.count) - total
+                for tok, value in enumerate(kv.values):
+                    dv = dc.get(value, implicit)
+                    desired[i, tok] = dv if dv is not None else -1.0
+                # missing slot stays −1 (⇒ −1 penalty)
+            # current counts per value token: allocs of (job, tg) per node value
+            for _aid, (row, tgname) in cl.job_allocs.get(job.id, {}).items():
+                if tgname != tg.name:
+                    continue
+                tok = cl.attrs[row, k]
+                if tok != MISSING:
+                    counts0[i, tok] += 1
+            for a in plan.stopped_allocs + plan.preempted_allocs:
+                if a.job_id == job.id and a.task_group == tg.name:
+                    row = cl.row_of.get(a.node_id)
+                    if row is not None:
+                        tok = cl.attrs[row, k]
+                        if tok != MISSING and counts0[i, tok] > 0:
+                            counts0[i, tok] -= 1
+            for node_id, tgname, _u in plan.placed:
+                if tgname == tg.name:
+                    row = cl.row_of.get(node_id)
+                    if row is not None:
+                        tok = cl.attrs[row, k]
+                        if tok != MISSING:
+                            counts0[i, tok] += 1
+        return key_idx, weight, has_targets, desired, counts0, active
+
+    # ---- selection ----
+
+    def select(
+        self,
+        job: Job,
+        tg: TaskGroup,
+        n_place: int,
+        plan: Optional[PlanContext] = None,
+    ) -> SelectResult:
+        """Place `n_place` allocs of one task group. One kernel dispatch."""
+        from ..kernels.placement import place_task_group, place_task_group_jit
+
+        params, m = self.compile_tg(job, tg, n_place, plan)
+        arrays = self.device_arrays()
+        if self._jit:
+            result = place_task_group_jit(arrays, _to_device(params), m)
+        else:
+            result = place_task_group(arrays, _to_device(params), m)
+        sel = np.asarray(result.sel_idx)
+        scores = np.asarray(result.sel_score)
+        snap_rows = self.cluster.node_of_row
+        node_ids: List[Optional[str]] = []
+        out_scores: List[float] = []
+        for i in range(n_place):
+            row = int(sel[i])
+            node_ids.append(snap_rows[row] if row >= 0 else None)
+            out_scores.append(float(scores[i]))
+        return SelectResult(
+            node_ids=node_ids,
+            scores=out_scores,
+            nodes_feasible=int(result.nodes_feasible),
+            nodes_fit=[int(x) for x in np.asarray(result.nodes_fit)[:n_place]],
+            raw=result,
+        )
+
+
+def _pad_lut(lut: np.ndarray, v: int, fill, dtype) -> np.ndarray:
+    """Widen LUT rows to v columns, keeping the missing slot in the LAST
+    column (the kernel maps token −1 → V−1)."""
+    if lut.size == 0:
+        return np.zeros((lut.shape[0] if lut.ndim == 2 else 0, v), dtype=dtype)
+    c, old_v = lut.shape
+    if old_v == v:
+        return lut.astype(dtype)
+    out = np.full((c, v), fill, dtype=dtype)
+    out[:, : old_v - 1] = lut[:, : old_v - 1]
+    out[:, -1] = lut[:, -1]
+    return out
+
+
+def _to_device(params: TGParams) -> TGParams:
+    import jax.numpy as jnp
+
+    return TGParams(*[jnp.asarray(x) for x in params])

@@ -1,0 +1,12 @@
+"""Snapshot → tensor encoding (nodes, allocs, constraint LUT programs)."""
+
+from .cluster import ClusterSnapshot, ClusterTensors, R_CPU, R_DISK, R_MEM, R_TOTAL  # noqa: F401
+from .constraints import (  # noqa: F401
+    CompiledAffinities,
+    CompiledConstraints,
+    check_affinity,
+    check_constraint,
+    compile_affinities,
+    compile_constraints,
+)
+from .vocab import MISSING, AttrVocab, KeyVocab, target_to_key  # noqa: F401

@@ -1,0 +1,276 @@
+"""Cluster state → dense tensors.
+
+Encodes the scheduling-relevant view of the cluster (reference: what
+`scheduler/stack.go` + `rank.go` read through the `State` snapshot) as arrays:
+
+  capacity  f32[N, R]  node resources − reserved (cpu, memMB, diskMB, devices…)
+  used      f32[N, R]  Σ non-terminal alloc utilization per node
+  node_ok   bool[N]    ready() && real row
+  attrs     i32[N, K]  value token per (node, interned key); −1 = missing
+
+Rows are assigned per node and recycled; arrays grow by power-of-two buckets
+so jitted kernel shapes stay stable. The `used` matrix is maintained
+incrementally as allocations are upserted — the device never re-walks the
+alloc table (the reference recomputes ProposedAllocs per node per eval,
+`scheduler/context.go:120`; here plan-relative deltas are applied as sparse
+scatters in the kernel instead).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..structs.alloc import Allocation
+from ..structs.node import Node
+from .vocab import MISSING, AttrVocab
+
+R_CPU, R_MEM, R_DISK, R_BW = 0, 1, 2, 3
+BASE_RESOURCES = 4
+MAX_DEVICE_COLS = 4
+R_TOTAL = BASE_RESOURCES + MAX_DEVICE_COLS
+
+
+def _bucket(n: int, lo: int = 64) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class ClusterSnapshot:
+    """A consistent device-ready view (numpy; moved to device by the stack)."""
+
+    capacity: np.ndarray   # f32[N, R]
+    used: np.ndarray       # f32[N, R]
+    node_ok: np.ndarray    # bool[N]
+    attrs: np.ndarray      # i32[N, K]
+    n_rows: int            # live row count (≤ N)
+    row_to_node_id: List[Optional[str]]
+
+
+class ClusterTensors:
+    """Incremental tensorization of nodes + allocations."""
+
+    def __init__(self, n_cap: int = 64, k_cap: int = 64) -> None:
+        self.vocab = AttrVocab()
+        self.n_cap = n_cap
+        self.k_cap = k_cap
+        self.capacity = np.zeros((n_cap, R_TOTAL), dtype=np.float32)
+        self.used = np.zeros((n_cap, R_TOTAL), dtype=np.float32)
+        self.node_ok = np.zeros(n_cap, dtype=bool)
+        self.attrs = np.full((n_cap, k_cap), MISSING, dtype=np.int32)
+        self.row_of: Dict[str, int] = {}
+        self.node_of_row: List[Optional[str]] = [None] * n_cap
+        self.nodes: Dict[str, Node] = {}
+        self.free_rows: List[int] = list(range(n_cap - 1, -1, -1))
+        # device-type column registry: "vendor/type/name" -> column offset
+        self.device_cols: Dict[str, int] = {}
+        # alloc accounting: alloc_id -> (row, usage f32[R])
+        self.alloc_usage: Dict[str, Tuple[int, np.ndarray]] = {}
+        # job -> {alloc_id: (row, task_group)} for per-eval count vectors
+        self.job_allocs: Dict[str, Dict[str, Tuple[int, str]]] = {}
+        self.version = 0
+
+    # ---- nodes ----
+
+    def _grow_rows(self) -> None:
+        new_cap = self.n_cap * 2
+        for name in ("capacity", "used"):
+            arr = getattr(self, name)
+            grown = np.zeros((new_cap, R_TOTAL), dtype=arr.dtype)
+            grown[: self.n_cap] = arr
+            setattr(self, name, grown)
+        ok = np.zeros(new_cap, dtype=bool)
+        ok[: self.n_cap] = self.node_ok
+        self.node_ok = ok
+        at = np.full((new_cap, self.k_cap), MISSING, dtype=np.int32)
+        at[: self.n_cap] = self.attrs
+        self.attrs = at
+        self.free_rows = list(range(new_cap - 1, self.n_cap - 1, -1)) + self.free_rows
+        self.node_of_row.extend([None] * (new_cap - self.n_cap))
+        self.n_cap = new_cap
+
+    def _grow_keys(self) -> None:
+        new_k = self.k_cap * 2
+        at = np.full((self.n_cap, new_k), MISSING, dtype=np.int32)
+        at[:, : self.k_cap] = self.attrs
+        self.attrs = at
+        self.k_cap = new_k
+
+    def _set_attr(self, row: int, key: str, value: str) -> None:
+        k, tok = self.vocab.intern(key, value)
+        while k >= self.k_cap:
+            self._grow_keys()
+        self.attrs[row, k] = tok
+
+    def device_col(self, device_id: str) -> Optional[int]:
+        col = self.device_cols.get(device_id)
+        if col is None:
+            if len(self.device_cols) >= MAX_DEVICE_COLS:
+                return None
+            col = BASE_RESOURCES + len(self.device_cols)
+            self.device_cols[device_id] = col
+        return col
+
+    def upsert_node(self, node: Node) -> int:
+        row = self.row_of.get(node.id)
+        if row is None:
+            if not self.free_rows:
+                self._grow_rows()
+            row = self.free_rows.pop()
+            self.row_of[node.id] = row
+            self.node_of_row[row] = node.id
+        self.nodes[node.id] = node
+        res = node.node_resources
+        rsv = node.reserved_resources
+        cap = np.zeros(R_TOTAL, dtype=np.float32)
+        cap[R_CPU] = res.cpu - rsv.cpu
+        cap[R_MEM] = res.memory_mb - rsv.memory_mb
+        cap[R_DISK] = res.disk_mb - rsv.disk_mb
+        # Bandwidth as a hard fit column (reference: NetworkIndex.Overcommitted
+        # inside AllocsFit, structs/network.go:66)
+        cap[R_BW] = sum(nw.mbits for nw in res.networks)
+        for dev in res.devices:
+            col = self.device_col(dev.id())
+            if col is not None:
+                cap[col] = sum(1 for i in dev.instances if i.healthy)
+        self.capacity[row] = cap
+        self.node_ok[row] = node.ready()
+        # attributes
+        self.attrs[row, :] = MISSING
+        self._set_attr(row, "node.unique.id", node.id)
+        self._set_attr(row, "node.unique.name", node.name)
+        self._set_attr(row, "node.datacenter", node.datacenter)
+        self._set_attr(row, "node.class", node.node_class)
+        for k, v in node.attributes.items():
+            self._set_attr(row, f"attr.{k}", v)
+        for k, v in node.meta.items():
+            self._set_attr(row, f"meta.{k}", v)
+        # Driver health pseudo-attrs (reference DriverChecker, feasible.go:398:
+        # DriverInfo detected+healthy, legacy fallback to attr truthiness)
+        drivers = set()
+        for name, info in node.drivers.items():
+            drivers.add(name)
+            healthy = "1" if (info.detected and info.healthy) else "0"
+            self._set_attr(row, f"__driver.{name}", healthy)
+        for k, v in node.attributes.items():
+            if k.startswith("driver.") and "." not in k[len("driver."):]:
+                name = k[len("driver."):]
+                if name not in drivers:
+                    truthy = "1" if v in ("1", "true") else "0"
+                    self._set_attr(row, f"__driver.{name}", truthy)
+        self.version += 1
+        return row
+
+    def remove_node(self, node_id: str) -> None:
+        row = self.row_of.pop(node_id, None)
+        if row is None:
+            return
+        self.nodes.pop(node_id, None)
+        self.node_of_row[row] = None
+        self.capacity[row] = 0
+        self.used[row] = 0
+        self.node_ok[row] = False
+        self.attrs[row, :] = MISSING
+        self.free_rows.append(row)
+        self.version += 1
+
+    # ---- allocations ----
+
+    def usage_row(self, alloc: Allocation) -> np.ndarray:
+        """Alloc utilization as a resource row (comparable form, reference
+        `Allocation.ComparableResources`, structs.go:8958 + device counts)."""
+        u = np.zeros(R_TOTAL, dtype=np.float32)
+        cr = alloc.comparable_resources()
+        u[R_CPU] = cr.cpu
+        u[R_MEM] = cr.memory_mb
+        u[R_DISK] = cr.disk_mb
+        u[R_BW] = sum(nw.mbits for nw in cr.networks)
+        if alloc.allocated_resources is not None:
+            for tr in alloc.allocated_resources.tasks.values():
+                for dev in tr.devices:
+                    key = f"{dev.vendor}/{dev.type}/{dev.name}"
+                    col = self.device_cols.get(key)
+                    if col is not None:
+                        u[col] += len(dev.device_ids)
+        return u
+
+    def upsert_alloc(self, alloc: Allocation) -> None:
+        """Maintain `used` and the job index. Terminal allocs release usage
+        (mirrors the reference's non-terminal filter in AllocsByNodeTerminal,
+        state_store usage via context.go:122)."""
+        prev = self.alloc_usage.pop(alloc.id, None)
+        if prev is not None:
+            row, usage = prev
+            self.used[row] -= usage
+        japs = self.job_allocs.setdefault(alloc.job_id, {})
+        japs.pop(alloc.id, None)
+
+        if alloc.terminal_status():
+            if not japs:
+                self.job_allocs.pop(alloc.job_id, None)
+            self.version += 1
+            return
+
+        row = self.row_of.get(alloc.node_id)
+        if row is None:
+            self.version += 1
+            return
+        usage = self.usage_row(alloc)
+        self.used[row] += usage
+        self.alloc_usage[alloc.id] = (row, usage)
+        japs[alloc.id] = (row, alloc.task_group)
+        self.version += 1
+
+    def remove_alloc(self, alloc_id: str, job_id: str = "") -> None:
+        prev = self.alloc_usage.pop(alloc_id, None)
+        if prev is not None:
+            row, usage = prev
+            self.used[row] -= usage
+        if job_id and job_id in self.job_allocs:
+            self.job_allocs[job_id].pop(alloc_id, None)
+        else:
+            for japs in self.job_allocs.values():
+                if alloc_id in japs:
+                    del japs[alloc_id]
+                    break
+        self.version += 1
+
+    # ---- per-eval vectors ----
+
+    def job_count_vectors(
+        self, job_id: str, task_group: str
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(job_counts[N], jobtg_counts[N]): live proposed-alloc counts for a
+        job / (job, tg) per node — feeds distinct_hosts (feasible.go:534) and
+        job anti-affinity (rank.go:505)."""
+        jc = np.zeros(self.n_cap, dtype=np.float32)
+        jtc = np.zeros(self.n_cap, dtype=np.float32)
+        for row, tg in self.job_allocs.get(job_id, {}).values():
+            jc[row] += 1
+            if tg == task_group:
+                jtc[row] += 1
+        return jc, jtc
+
+    def rows_for_allocs(self, alloc_ids) -> List[Tuple[int, np.ndarray]]:
+        out = []
+        for aid in alloc_ids:
+            entry = self.alloc_usage.get(aid)
+            if entry is not None:
+                out.append(entry)
+        return out
+
+    # ---- snapshot ----
+
+    def snapshot(self) -> ClusterSnapshot:
+        return ClusterSnapshot(
+            capacity=self.capacity,
+            used=self.used,
+            node_ok=self.node_ok,
+            attrs=self.attrs,
+            n_rows=self.n_cap - len(self.free_rows),
+            row_to_node_id=list(self.node_of_row),
+        )

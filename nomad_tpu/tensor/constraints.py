@@ -1,0 +1,297 @@
+"""Constraint semantics + LUT compilation.
+
+Scalar semantics mirror `scheduler/feasible.go:750` (`checkConstraint`) and its
+helpers (:803 lexical order, :825 version, :896 regexp, :929 set_contains).
+
+The TPU formulation: a constraint whose RTarget is a literal depends on the
+node only through the node's value of one key — so for each constraint we
+precompute a boolean LUT over that key's value vocabulary (plus a
+missing-value slot), and the device evaluates `lut[c, token[n, key(c)]]` for
+all nodes at once. Regex/version/lexical logic runs exactly once per distinct
+value on the host instead of once per node, with identical results.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..structs.job import (
+    CONSTRAINT_ATTRIBUTE_IS_NOT_SET,
+    CONSTRAINT_ATTRIBUTE_IS_SET,
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+    CONSTRAINT_REGEX,
+    CONSTRAINT_SEMVER,
+    CONSTRAINT_SET_CONTAINS,
+    CONSTRAINT_SET_CONTAINS_ALL,
+    CONSTRAINT_SET_CONTAINS_ANY,
+    CONSTRAINT_VERSION,
+    Affinity,
+    Constraint,
+)
+from .goversion import check_version_constraint
+from .vocab import MISSING, AttrVocab, target_to_key
+
+_regex_cache: dict = {}
+
+
+def _regex(pattern: str):
+    r = _regex_cache.get(pattern)
+    if r is None:
+        try:
+            r = re.compile(pattern)
+        except re.error:
+            r = False
+        _regex_cache[pattern] = r
+    return r
+
+
+def check_lexical_order(op: str, lval: str, rval: str) -> bool:
+    """Reference checkLexicalOrder (feasible.go:803)."""
+    if op == "<":
+        return lval < rval
+    if op == "<=":
+        return lval <= rval
+    if op == ">":
+        return lval > rval
+    if op == ">=":
+        return lval >= rval
+    return False
+
+
+def _set_contains_all(lval: str, rval: str) -> bool:
+    have = {p.strip() for p in lval.split(",")}
+    return all(p.strip() in have for p in rval.split(","))
+
+
+def _set_contains_any(lval: str, rval: str) -> bool:
+    have = {p.strip() for p in lval.split(",")}
+    return any(p.strip() in have for p in rval.split(","))
+
+
+def check_constraint(
+    operand: str,
+    lval: Optional[str],
+    rval: Optional[str],
+    lfound: bool,
+    rfound: bool,
+) -> bool:
+    """Scalar oracle for one constraint (reference feasible.go:750)."""
+    if operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+        return True
+    if operand in ("=", "==", "is"):
+        return lfound and rfound and lval == rval
+    if operand in ("!=", "not"):
+        # NB: the reference does not require found-ness for != (feasible.go:763)
+        lv = lval if lfound else None
+        rv = rval if rfound else None
+        return lv != rv
+    if operand in ("<", "<=", ">", ">="):
+        return lfound and rfound and check_lexical_order(operand, lval, rval)
+    if operand == CONSTRAINT_ATTRIBUTE_IS_SET:
+        return lfound
+    if operand == CONSTRAINT_ATTRIBUTE_IS_NOT_SET:
+        return not lfound
+    if operand == CONSTRAINT_VERSION:
+        return lfound and rfound and check_version_constraint(lval, rval)
+    if operand == CONSTRAINT_SEMVER:
+        return lfound and rfound and check_version_constraint(lval, rval, strict_semver=True)
+    if operand == CONSTRAINT_REGEX:
+        if not (lfound and rfound):
+            return False
+        r = _regex(rval)
+        return bool(r and r.search(lval))
+    if operand in (CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL):
+        return lfound and rfound and _set_contains_all(lval, rval)
+    if operand == CONSTRAINT_SET_CONTAINS_ANY:
+        return lfound and rfound and _set_contains_any(lval, rval)
+    return False
+
+
+def check_affinity(operand: str, lval, rval, lfound: bool, rfound: bool) -> bool:
+    """Reference checkAffinity (feasible.go:790) — same table."""
+    return check_constraint(operand, lval, rval, lfound, rfound)
+
+
+@dataclass
+class CompiledConstraints:
+    """Device-ready feasibility program for one (job, task-group).
+
+    key_idx[c]  column into the attrs matrix (i32[C])
+    lut[c, v]   constraint verdict for value-token v; last slot = missing
+    C == 0 means "always feasible".
+    `needs_host` lists constraints the LUT model cannot express (RTarget is
+    itself node-dependent) — evaluated host-side into an extra mask.
+    """
+
+    key_idx: np.ndarray
+    lut: np.ndarray
+    needs_host: List[Constraint] = field(default_factory=list)
+    distinct_hosts_job: bool = False
+    distinct_hosts_tg: bool = False
+    distinct_property: List[Constraint] = field(default_factory=list)
+
+
+@dataclass
+class CompiledAffinities:
+    """Device-ready affinity program: per-affinity weight LUTs.
+
+    aff_lut[a, v] = weight if the affinity matches value-token v else 0.
+    inv_sum_abs_weight = 1 / Σ|w| (0 when no affinities).
+    """
+
+    key_idx: np.ndarray
+    lut: np.ndarray
+    inv_sum_abs_weight: float
+    needs_host: List[Affinity] = field(default_factory=list)
+
+
+def _lut_width(vocab: AttrVocab, pad_to: int) -> int:
+    # Bucket the LUT width to limit recompilation as vocabularies grow.
+    w = max(vocab.max_vocab + 1, 2)
+    b = pad_to
+    while b < w:
+        b *= 2
+    return b
+
+
+def compile_constraints(
+    constraints: Sequence[Constraint],
+    vocab: AttrVocab,
+    datacenters: Optional[Sequence[str]] = None,
+    drivers: Optional[Sequence[str]] = None,
+    lut_bucket: int = 8,
+) -> CompiledConstraints:
+    """Compile constraints (+ datacenter membership + driver checks) into LUTs.
+
+    Datacenter filtering mirrors `readyNodesInDCs` (scheduler/util.go:233);
+    driver checks mirror `DriverChecker` (feasible.go:398) via the tensorizer's
+    `__driver.<name>` pseudo-key.
+    """
+    rows: List[Tuple[int, np.ndarray]] = []
+    needs_host: List[Constraint] = []
+    dh_job = False
+    dh_tg = False
+    dprop: List[Constraint] = []
+
+    width = _lut_width(vocab, lut_bucket)
+    miss = width - 1
+
+    def add_lut_row(key: str, fn) -> None:
+        k = vocab.intern_key(key)
+        kv = vocab.key_vocabs[k]
+        row = np.zeros(width, dtype=bool)
+        for tok, value in enumerate(kv.values):
+            row[tok] = fn(value, True)
+        row[miss] = fn(None, False)
+        rows.append((k, row))
+
+    if datacenters is not None:
+        dcs = set(datacenters)
+        add_lut_row("node.datacenter", lambda v, found: found and v in dcs)
+
+    for drv in drivers or ():
+        add_lut_row(f"__driver.{drv}", lambda v, found: found and v == "1")
+
+    for c in constraints:
+        if c.operand == CONSTRAINT_DISTINCT_HOSTS:
+            dh_job = True  # caller splits job vs tg level
+            continue
+        if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
+            dprop.append(c)
+            continue
+        key = target_to_key(c.ltarget)
+        rkey = target_to_key(c.rtarget)
+        if rkey is not None:
+            # Node-dependent RTarget: LUT over one key impossible — host path
+            needs_host.append(c)
+            continue
+        if key is None:
+            # Literal LTarget: constant verdict — fold in as a 0-or-all row
+            verdict = check_constraint(c.operand, c.ltarget, c.rtarget, True, True)
+            if not verdict:
+                # Constant-false: poison with an always-false row on a dummy key
+                k = vocab.intern_key("node.datacenter")
+                rows.append((k, np.zeros(width, dtype=bool)))
+            continue
+        if key == "__unresolvable__":
+            verdict = check_constraint(c.operand, None, c.rtarget, False, True)
+            if not verdict:
+                k = vocab.intern_key("node.datacenter")
+                rows.append((k, np.zeros(width, dtype=bool)))
+            continue
+        add_lut_row(
+            key,
+            lambda v, found, op=c.operand, r=c.rtarget: check_constraint(
+                op, v, r, found, True
+            ),
+        )
+
+    if rows:
+        key_idx = np.array([k for k, _ in rows], dtype=np.int32)
+        lut = np.stack([r for _, r in rows])
+    else:
+        key_idx = np.zeros(0, dtype=np.int32)
+        lut = np.zeros((0, width), dtype=bool)
+    return CompiledConstraints(
+        key_idx=key_idx,
+        lut=lut,
+        needs_host=needs_host,
+        distinct_hosts_job=dh_job,
+        distinct_property=dprop,
+    )
+
+
+def compile_affinities(
+    affinities: Sequence[Affinity],
+    vocab: AttrVocab,
+    lut_bucket: int = 8,
+) -> CompiledAffinities:
+    """Compile affinities into weight LUTs (reference `NodeAffinityIterator`,
+    scheduler/rank.go:589: normalized weighted sum of matches)."""
+    width = _lut_width(vocab, lut_bucket)
+    miss = width - 1
+    rows: List[Tuple[int, np.ndarray]] = []
+    needs_host: List[Affinity] = []
+    sum_abs = 0.0
+
+    for a in affinities:
+        sum_abs += abs(float(a.weight))
+        key = target_to_key(a.ltarget)
+        rkey = target_to_key(a.rtarget)
+        if rkey is not None:
+            needs_host.append(a)
+            continue
+        if key is None or key == "__unresolvable__":
+            lval = a.ltarget if key is None else None
+            lfound = key is None
+            verdict = check_affinity(a.operand, lval, a.rtarget, lfound, True)
+            row = np.full(width, float(a.weight) if verdict else 0.0, dtype=np.float32)
+            k = vocab.intern_key("node.datacenter")
+            rows.append((k, row))
+            continue
+        k = vocab.intern_key(key)
+        kv = vocab.key_vocabs[k]
+        row = np.zeros(width, dtype=np.float32)
+        for tok, value in enumerate(kv.values):
+            if check_affinity(a.operand, value, a.rtarget, True, True):
+                row[tok] = float(a.weight)
+        if check_affinity(a.operand, None, a.rtarget, False, True):
+            row[miss] = float(a.weight)
+        rows.append((k, row))
+
+    if rows:
+        key_idx = np.array([k for k, _ in rows], dtype=np.int32)
+        lut = np.stack([r for _, r in rows])
+    else:
+        key_idx = np.zeros(0, dtype=np.int32)
+        lut = np.zeros((0, width), dtype=np.float32)
+    return CompiledAffinities(
+        key_idx=key_idx,
+        lut=lut,
+        inv_sum_abs_weight=(1.0 / sum_abs) if sum_abs else 0.0,
+        needs_host=needs_host,
+    )

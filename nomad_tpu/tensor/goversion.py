@@ -1,0 +1,151 @@
+"""go-version–compatible version parsing and constraint checking.
+
+Behavioral reference: the reference depends on hashicorp/go-version for the
+`version` constraint operand and strict-semver mode for `semver`
+(`scheduler/feasible.go:1456` newVersionConstraintParser, :825
+checkVersionMatch). This module re-implements the comparison/constraint
+semantics needed for parity: segment-wise numeric compare, prerelease
+ordering, and the `=, !=, >, >=, <, <=, ~>` constraint grammar.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+_VERSION_RE = re.compile(
+    r"""^[vV]?
+        (?P<segments>\d+(?:\.\d+)*)
+        (?:-(?P<prerelease>[0-9A-Za-z\-~]+(?:\.[0-9A-Za-z\-~]+)*))?
+        (?:\+(?P<metadata>[0-9A-Za-z\-~]+(?:\.[0-9A-Za-z\-~]+)*))?
+        $""",
+    re.VERBOSE,
+)
+
+_CONSTRAINT_RE = re.compile(r"^\s*(<=|>=|!=|~>|[=<>])?\s*(.+?)\s*$")
+
+
+class Version:
+    """Parsed version (mirrors go-version `Version`)."""
+
+    __slots__ = ("segments", "prerelease", "metadata", "si")
+
+    def __init__(self, segments: List[int], prerelease: str, metadata: str, si: int):
+        self.segments = segments
+        self.prerelease = prerelease
+        self.metadata = metadata
+        self.si = si  # number of segments actually specified
+
+    @classmethod
+    def parse(cls, s: str, strict_semver: bool = False) -> Optional["Version"]:
+        m = _VERSION_RE.match(s.strip())
+        if m is None:
+            return None
+        segs = [int(x) for x in m.group("segments").split(".")]
+        if strict_semver and len(segs) != 3:
+            return None
+        si = len(segs)
+        while len(segs) < 3:
+            segs.append(0)
+        return cls(segs, m.group("prerelease") or "", m.group("metadata") or "", si)
+
+    def _cmp_prerelease(self, other: "Version") -> int:
+        a, b = self.prerelease, other.prerelease
+        if a == b:
+            return 0
+        if a == "":
+            return 1   # release > prerelease
+        if b == "":
+            return -1
+        # go-version compares prerelease identifiers dot-wise: numeric < alpha,
+        # numerics numerically, alphas lexically
+        pa, pb = a.split("."), b.split(".")
+        for xa, xb in zip(pa, pb):
+            na, nb = xa.isdigit(), xb.isdigit()
+            if na and nb:
+                ia, ib = int(xa), int(xb)
+                if ia != ib:
+                    return -1 if ia < ib else 1
+            elif na != nb:
+                return -1 if na else 1
+            elif xa != xb:
+                return -1 if xa < xb else 1
+        if len(pa) != len(pb):
+            return -1 if len(pa) < len(pb) else 1
+        return 0
+
+    def cmp(self, other: "Version") -> int:
+        n = max(len(self.segments), len(other.segments))
+        a = self.segments + [0] * (n - len(self.segments))
+        b = other.segments + [0] * (n - len(other.segments))
+        if a != b:
+            return -1 if a < b else 1
+        return self._cmp_prerelease(other)
+
+    def __repr__(self) -> str:
+        return ".".join(map(str, self.segments)) + (
+            f"-{self.prerelease}" if self.prerelease else ""
+        )
+
+
+def _check_one(op: str, v: Version, c: Version) -> bool:
+    r = v.cmp(c)
+    if op in ("", "="):
+        return r == 0
+    if op == "!=":
+        return r != 0
+    if op == ">":
+        return r > 0
+    if op == "<":
+        return r < 0
+    if op == ">=":
+        return r >= 0
+    if op == "<=":
+        return r <= 0
+    if op == "~>":
+        # Pessimistic: >= c, and segments up to c's specified precision − 1 equal
+        if v.cmp(c) < 0:
+            return False
+        if c.si <= 1:
+            # "~> 2" → >= 2, < 3
+            return v.segments[0] == c.segments[0]
+        prefix = c.si - 1
+        return v.segments[:prefix] == c.segments[:prefix]
+    return False
+
+
+class Constraints:
+    """A parsed comma-separated constraint set (go-version `Constraints`)."""
+
+    def __init__(self, parts: List[Tuple[str, Version]]):
+        self.parts = parts
+
+    @classmethod
+    def parse(cls, s: str, strict_semver: bool = False) -> Optional["Constraints"]:
+        parts: List[Tuple[str, Version]] = []
+        for chunk in s.split(","):
+            m = _CONSTRAINT_RE.match(chunk)
+            if m is None:
+                return None
+            op = m.group(1) or "="
+            ver = Version.parse(m.group(2), strict_semver=strict_semver)
+            if ver is None:
+                return None
+            parts.append((op, ver))
+        return cls(parts) if parts else None
+
+    def check(self, v: Version) -> bool:
+        return all(_check_one(op, v, c) for op, c in self.parts)
+
+
+def check_version_constraint(
+    lval: str, constraint_str: str, strict_semver: bool = False
+) -> bool:
+    """Reference `checkVersionMatch` (scheduler/feasible.go:825): parse lval as
+    a version, rval as constraints; False on any parse failure."""
+    v = Version.parse(str(lval), strict_semver=strict_semver)
+    if v is None:
+        return False
+    cons = Constraints.parse(constraint_str, strict_semver=strict_semver)
+    if cons is None:
+        return False
+    return cons.check(v)

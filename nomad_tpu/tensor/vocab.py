@@ -1,0 +1,103 @@
+"""Attribute tokenization.
+
+Node attributes/meta are string-valued hierarchical keys (reference
+`structs.Node.Attributes`, structs.go:1730). The TPU path tokenizes them into
+a dense `i32[N, K]` matrix: one column per interned key, one per-key vocabulary
+of observed values. Constraint evaluation then becomes LUT gathers
+(nomad_tpu/tensor/constraints.py) instead of the reference's per-node string
+comparisons (`scheduler/feasible.go:750`).
+
+Pseudo-key convention (mirrors `resolveTarget`, feasible.go:713):
+  "node.datacenter" / "node.class" / "node.unique.id" / "node.unique.name"
+  "attr.<key>"   node attributes
+  "meta.<key>"   node meta
+  "__driver.<name>"  driver health, written by the tensorizer
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+MISSING = -1
+
+
+class KeyVocab:
+    """Per-key value vocabulary: value string <-> dense token."""
+
+    __slots__ = ("values", "index")
+
+    def __init__(self) -> None:
+        self.values: List[str] = []
+        self.index: Dict[str, int] = {}
+
+    def intern(self, value: str) -> int:
+        tok = self.index.get(value)
+        if tok is None:
+            tok = len(self.values)
+            self.values.append(value)
+            self.index[value] = tok
+        return tok
+
+    def lookup(self, value: str) -> int:
+        return self.index.get(value, MISSING)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+class AttrVocab:
+    """Key registry + per-key value vocabularies."""
+
+    def __init__(self) -> None:
+        self.keys: List[str] = []
+        self.key_index: Dict[str, int] = {}
+        self.key_vocabs: List[KeyVocab] = []
+
+    def intern_key(self, key: str) -> int:
+        k = self.key_index.get(key)
+        if k is None:
+            k = len(self.keys)
+            self.keys.append(key)
+            self.key_index[key] = k
+            self.key_vocabs.append(KeyVocab())
+        return k
+
+    def lookup_key(self, key: str) -> int:
+        return self.key_index.get(key, MISSING)
+
+    def intern(self, key: str, value: str) -> tuple:
+        k = self.intern_key(key)
+        return k, self.key_vocabs[k].intern(value)
+
+    def vocab_for(self, key: str) -> Optional[KeyVocab]:
+        k = self.key_index.get(key)
+        return self.key_vocabs[k] if k is not None else None
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.keys)
+
+    @property
+    def max_vocab(self) -> int:
+        return max((len(v) for v in self.key_vocabs), default=0)
+
+
+def target_to_key(target: str) -> Optional[str]:
+    """Map a constraint LTarget interpolation to a tokenizer pseudo-key
+    (reference `resolveTarget`, scheduler/feasible.go:713). Returns None for
+    non-interpolated (literal) targets."""
+    if not target.startswith("${"):
+        return None
+    if target == "${node.unique.id}":
+        return "node.unique.id"
+    if target == "${node.datacenter}":
+        return "node.datacenter"
+    if target == "${node.unique.name}":
+        return "node.unique.name"
+    if target == "${node.class}":
+        return "node.class"
+    if target.startswith("${attr.") and target.endswith("}"):
+        return "attr." + target[len("${attr."):-1]
+    if target.startswith("${meta.") and target.endswith("}"):
+        return "meta." + target[len("${meta."):-1]
+    # Unknown interpolation resolves to (nil, false) in the reference
+    return "__unresolvable__"

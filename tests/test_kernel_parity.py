@@ -1,0 +1,241 @@
+"""Kernel-vs-oracle placement parity.
+
+The scalar oracle (nomad_tpu/scheduler/oracle.py) mirrors the reference
+iterator chain exactly; the TPU kernel must agree with it on node choice and
+normalized score (tolerance: float32 vs float64 rounding only) in exact mode.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.oracle import OracleContext, select_option
+from nomad_tpu.scheduler.stack import PlanContext, TPUStack
+from nomad_tpu.structs import (
+    Affinity,
+    Constraint,
+    Spread,
+    SpreadTarget,
+)
+from nomad_tpu.tensor.cluster import ClusterTensors
+
+SEED = 7
+
+
+def make_cluster(n_nodes, rng, dcs=("dc1",), classes=("", "c1", "c2")):
+    cl = ClusterTensors()
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.datacenter = rng.choice(dcs)
+        n.node_class = rng.choice(classes)
+        n.attributes["rack"] = f"r{rng.randrange(4)}"
+        n.attributes["zone"] = f"z{rng.randrange(3)}"
+        n.attributes["mem.totalbytes"] = str(rng.choice([8, 16, 32]) * 2**30)
+        n.node_resources.cpu = rng.choice([2000, 4000, 8000])
+        n.node_resources.memory_mb = rng.choice([4096, 8192, 16384])
+        n.reserved_resources.reserved_ports = ""
+        n.compute_class()
+        cl.upsert_node(n)
+        nodes.append(n)
+    return cl, nodes
+
+
+def seed_allocs(cl, nodes, jobs, rng, count):
+    allocs = []
+    for _ in range(count):
+        j = rng.choice(jobs)
+        n = rng.choice(nodes)
+        a = mock.alloc(job=j)
+        a.job_id = j.id
+        a.node_id = n.id
+        a.client_status = "running"
+        a.name = f"{j.id}.web[{rng.randrange(100)}]"
+        cl.upsert_alloc(a)
+        allocs.append(a)
+    return allocs
+
+
+def placed_alloc(job, tg, node_id):
+    """An alloc carrying exactly the group's ask (what the scheduler would
+    append to the plan)."""
+    from nomad_tpu.structs import NetworkResource
+
+    a = mock.alloc(job=job)
+    a.job_id = job.id
+    a.node_id = node_id
+    a.task_group = tg.name
+    res = job.combined_task_resources(tg)
+    bw = sum(nw.mbits for nw in tg.networks) + sum(
+        nw.mbits for t in tg.tasks for nw in t.resources.networks
+    )
+    a.allocated_resources = mock.alloc_resources(
+        cpu=res.cpu,
+        memory_mb=res.memory_mb,
+        disk_mb=res.disk_mb,
+        networks=[NetworkResource(device="eth0", mbits=bw)] if bw else [],
+    )
+    return a
+
+
+class TestKernelParity:
+    def _run_case(self, job, n_nodes=40, n_seed_allocs=30, n_place=3,
+                  mutate_nodes=None):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(n_nodes, rng)
+        if mutate_nodes:
+            mutate_nodes(nodes, cl)
+        other = mock.job()
+        seeded = seed_allocs(cl, nodes, [job, other], rng, n_seed_allocs)
+
+        allocs_by_node = {}
+        for a in seeded:
+            allocs_by_node.setdefault(a.node_id, []).append(a)
+
+        stack = TPUStack(cl)
+        tg = job.task_groups[0]
+        result = stack.select(job, tg, n_place)
+
+        ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
+        for i in range(n_place):
+            opt = select_option(ctx, job, tg)
+            got_node = result.node_ids[i]
+            if opt is None:
+                assert got_node is None, f"step {i}: kernel placed, oracle failed"
+                continue
+            assert got_node is not None, f"step {i}: oracle placed, kernel failed"
+            assert abs(result.scores[i] - opt.final_score) < 1e-4, (
+                f"step {i}: score mismatch kernel={result.scores[i]} "
+                f"oracle={opt.final_score} node={got_node} vs {opt.node.id}"
+            )
+            # Feed the oracle's plan with the KERNEL's choice so both see the
+            # same evolving plan state even if equal-score ties broke
+            # differently.
+            ctx.plan_node_alloc.setdefault(got_node, []).append(
+                placed_alloc(job, tg, got_node)
+            )
+
+    def test_basic_binpack(self):
+        job = mock.job()
+        self._run_case(job)
+
+    def test_equality_constraint(self):
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "r1", "=")
+        )
+        self._run_case(job)
+
+    def test_regexp_constraint(self):
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.zone}", "z[01]", "regexp")
+        )
+        self._run_case(job)
+
+    def test_version_constraint(self):
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.nomad.version}", ">= 0.4.0", "version")
+        )
+        self._run_case(job)
+
+    def test_infeasible_constraint(self):
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.zone}", "does-not-exist", "=")
+        )
+        self._run_case(job)
+
+    def test_datacenter_filter(self):
+        job = mock.job()
+        job.datacenters = ["dc2"]
+
+        def mutate(nodes, cl):
+            for n in nodes[:7]:
+                n.datacenter = "dc2"
+                cl.upsert_node(n)
+
+        self._run_case(job, mutate_nodes=mutate)
+
+    def test_distinct_hosts(self):
+        job = mock.job()
+        job.constraints.append(Constraint("", "", "distinct_hosts"))
+        self._run_case(job, n_nodes=20, n_place=5)
+
+    def test_affinity(self):
+        job = mock.job()
+        job.affinities.append(Affinity("${attr.rack}", "r2", "=", 70))
+        job.affinities.append(Affinity("${attr.zone}", "z0", "=", -30))
+        self._run_case(job)
+
+    def test_spread_targets(self):
+        job = mock.job()
+        job.spreads.append(
+            Spread(
+                attribute="${attr.zone}",
+                weight=100,
+                spread_target=[
+                    SpreadTarget("z0", 50),
+                    SpreadTarget("z1", 30),
+                    SpreadTarget("z2", 20),
+                ],
+            )
+        )
+        self._run_case(job, n_place=6)
+
+    def test_even_spread(self):
+        job = mock.job()
+        job.spreads.append(Spread(attribute="${attr.rack}", weight=50))
+        self._run_case(job, n_place=6)
+
+    def test_node_ineligible(self):
+        job = mock.job()
+
+        def mutate(nodes, cl):
+            for n in nodes[::3]:
+                n.scheduling_eligibility = "ineligible"
+                cl.upsert_node(n)
+
+        self._run_case(job, mutate_nodes=mutate)
+
+    def test_resource_exhaustion(self):
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.cpu = 3500
+
+        def mutate(nodes, cl):
+            for n in nodes:
+                n.node_resources.cpu = 4000
+                cl.upsert_node(n)
+
+        self._run_case(job, n_place=4, mutate_nodes=mutate)
+
+    def test_lexical_constraint(self):
+        job = mock.job()
+        job.constraints.append(Constraint("${attr.rack}", "r2", "<"))
+        self._run_case(job)
+
+    def test_set_contains(self):
+        job = mock.job()
+
+        def mutate(nodes, cl):
+            for i, n in enumerate(nodes):
+                n.attributes["features"] = "a,b,c" if i % 2 else "a,c"
+                cl.upsert_node(n)
+
+        job.constraints.append(
+            Constraint("${attr.features}", "a,b", "set_contains")
+        )
+        self._run_case(job, mutate_nodes=mutate)
+
+    def test_is_set(self):
+        job = mock.job()
+
+        def mutate(nodes, cl):
+            for n in nodes[:11]:
+                n.attributes["special"] = "yes"
+                cl.upsert_node(n)
+
+        job.constraints.append(Constraint("${attr.special}", "", "is_set"))
+        self._run_case(job, mutate_nodes=mutate)
