@@ -60,8 +60,9 @@ class TGParams(NamedTuple):
     aff_key_idx: jax.Array       # i32[A]
     aff_lut: jax.Array           # f32[A, V]
     aff_inv_sum: jax.Array       # f32
-    # per-eval dense vectors
-    penalty: jax.Array           # bool[N] — reschedule-penalty nodes
+    # per-step sparse vectors (rows beyond n_place are padding)
+    penalty_idx: jax.Array       # i32[M, P] — reschedule-penalty node rows, −1 pad
+    preferred_idx: jax.Array     # i32[M] — preferred node row (sticky disk), −1 none
     extra_mask: jax.Array        # bool[N] — host-evaluated checks (CSI, …)
     distinct_hosts: jax.Array    # bool — job or tg has distinct_hosts
     job_count0: jax.Array        # f32[N] — proposed allocs of job per node
@@ -181,9 +182,13 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
     nodes_feasible = jnp.sum(feas.astype(jnp.int32))
 
-    def step(carry, i):
+    def step(carry, xs):
+        i, pen_idx, pref_idx = xs
         used, job_cnt, tg_cnt, scounts = carry
         active = i < p.n_place
+
+        # per-step reschedule penalty nodes (rank.go:570 SetPenaltyNodes)
+        penalty = jnp.zeros(n, dtype=bool).at[pen_idx].set(True, mode="drop")
 
         util = used + p.ask[None, :]                       # [N, R]
         fits = jnp.all(util <= cap, axis=1)
@@ -208,8 +213,8 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         ssum = ssum + jnp.where(collide, anti, 0.0)
         scnt = scnt + collide
 
-        ssum = ssum + jnp.where(p.penalty, -1.0, 0.0)
-        scnt = scnt + p.penalty
+        ssum = ssum + jnp.where(penalty, -1.0, 0.0)
+        scnt = scnt + penalty
 
         inc_aff = aff_score != 0.0
         ssum = ssum + jnp.where(inc_aff, aff_score, 0.0)
@@ -223,7 +228,11 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         final = ssum / scnt
         masked = jnp.where(ok, final, NEG_INF)
 
-        idx = jnp.argmax(masked)
+        # Preferred node (sticky ephemeral disk / prev-node rescheduling:
+        # generic_sched.go findPreferredNode + stack SelectPreferringNodes)
+        best = jnp.argmax(masked)
+        pref_ok = (pref_idx >= 0) & ok[jnp.maximum(pref_idx, 0)]
+        idx = jnp.where(pref_ok, jnp.maximum(pref_idx, 0), best)
         found = ok[idx] & active
         sel = jnp.where(found, idx, -1)
 
@@ -250,8 +259,9 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         )
 
     init = (used0, p.job_count0, p.jobtg_count0, p.spread_counts0)
+    xs = (jnp.arange(max_allocs), p.penalty_idx, p.preferred_idx)
     (used_f, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
-        step, init, jnp.arange(max_allocs)
+        step, init, xs
     )
     return PlacementResult(
         sel_idx=sels.astype(jnp.int32),

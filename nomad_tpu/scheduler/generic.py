@@ -1,0 +1,430 @@
+"""GenericScheduler — service & batch scheduling.
+
+Behavioral reference: `scheduler/generic_sched.go` (GenericScheduler :58,
+Process :125, process :216, computeJobAllocs :332, computePlacements :468,
+findPreferredNode :637, selectOptions/penalty nodes :622).
+
+TPU-first restructuring: placements are grouped per task group and dispatched
+as ONE kernel call per group (the lax.scan places every missing alloc of the
+group); the reference's per-alloc stack.Select loop disappears. Plan-relative
+state (stops, earlier groups' placements) rides into the kernel as sparse
+deltas (PlanContext).
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..structs import (
+    ALLOC_CLIENT_PENDING,
+    ALLOC_DESIRED_RUN,
+    AllocatedResources,
+    AllocatedSharedResources,
+    AllocatedTaskResources,
+    AllocDeploymentStatus,
+    AllocMetric,
+    Allocation,
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+    Evaluation,
+    Job,
+    NetworkIndex,
+    Plan,
+    PlanResult,
+    TaskGroup,
+)
+from ..structs.evaluation import (
+    TRIGGER_MAX_PLANS,
+)
+from ..tensor.cluster import ClusterTensors
+from .reconcile import (
+    AllocDestructiveResult,
+    AllocPlaceResult,
+    AllocReconciler,
+    ReconcileResults,
+    ALLOC_UPDATING,
+)
+from .stack import PlanContext, TPUStack
+from .util import (
+    Planner,
+    SetStatusError,
+    State,
+    adjust_queued_allocations,
+    progress_made,
+    ready_nodes_in_dcs,
+    retry_max,
+    tainted_nodes,
+    update_non_terminal_allocs_to_lost,
+    update_reschedule_tracker,
+)
+
+MAX_SERVICE_ATTEMPTS = 5   # reference generic_sched.go:18
+MAX_BATCH_ATTEMPTS = 2     # reference generic_sched.go:22
+
+BLOCKED_EVAL_MAX_PLAN_DESC = (
+    "created due to placement conflicts"  # reference generic_sched.go:44
+)
+BLOCKED_EVAL_FAILED_PLACEMENTS = (
+    "created to place remaining allocations"  # reference generic_sched.go:48
+)
+
+
+class GenericScheduler:
+    """Reference GenericScheduler (generic_sched.go:58)."""
+
+    def __init__(self, state: State, planner: Planner, cluster: ClusterTensors,
+                 is_batch: bool = False) -> None:
+        self.state = state
+        self.planner = planner
+        self.cluster = cluster
+        self.batch = is_batch
+        self.eval: Optional[Evaluation] = None
+        self.job: Optional[Job] = None
+        self.plan: Optional[Plan] = None
+        self.plan_result: Optional[PlanResult] = None
+        self.deployment = None
+        self.blocked: Optional[Evaluation] = None
+        self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        self.queued_allocs: Dict[str, int] = {}
+        self.follow_up_evals: List[Evaluation] = []
+
+    # ---- entry point ----
+
+    def process(self, eval: Evaluation) -> None:
+        """Reference Process (generic_sched.go:125)."""
+        self.eval = eval
+        limit = MAX_BATCH_ATTEMPTS if self.batch else MAX_SERVICE_ATTEMPTS
+        err = retry_max(
+            limit, self._process, lambda: progress_made(self.plan_result)
+        )
+        if err is not None:
+            if isinstance(err, SetStatusError):
+                self._create_blocked_eval(plan_failure=True)
+                self._set_status(EVAL_STATUS_FAILED, str(err))
+                return
+            raise err
+
+        if eval.status == EVAL_STATUS_BLOCKED and self.failed_tg_allocs:
+            new_eval = Evaluation(**{**eval.__dict__})
+            self.planner.reblock_eval(new_eval)
+            return
+        self._set_status(EVAL_STATUS_COMPLETE, "")
+
+    def _set_status(self, status: str, desc: str) -> None:
+        """Reference setStatus (util.go:730)."""
+        ev = self.eval
+        updated = Evaluation(**{**ev.__dict__})
+        updated.status = status
+        updated.status_description = desc
+        updated.failed_tg_allocs = dict(self.failed_tg_allocs)
+        if self.blocked is not None:
+            updated.blocked_eval = self.blocked.id
+        updated.queued_allocations = dict(self.queued_allocs)
+        if self.deployment is not None:
+            updated.deployment_id = self.deployment.id
+        self.planner.update_eval(updated)
+
+    def _create_blocked_eval(self, plan_failure: bool = False) -> None:
+        """Reference createBlockedEval (generic_sched.go:192)."""
+        self.blocked = self.eval.create_blocked_eval({}, True, "")
+        if plan_failure:
+            self.blocked.triggered_by = TRIGGER_MAX_PLANS
+            self.blocked.status_description = BLOCKED_EVAL_MAX_PLAN_DESC
+        else:
+            self.blocked.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS
+        self.planner.create_eval(self.blocked)
+
+    # ---- one attempt ----
+
+    def _process(self) -> Tuple[bool, Optional[Exception]]:
+        """Reference process (generic_sched.go:216)."""
+        ev = self.eval
+        self.job = self.state.job_by_id(ev.namespace, ev.job_id)
+        self.queued_allocs = {}
+        self.follow_up_evals = []
+        self.plan = ev.make_plan(self.job)
+        self.failed_tg_allocs = {}
+        if not self.batch:
+            self.deployment = self.state.latest_deployment_by_job(
+                ev.namespace, ev.job_id
+            )
+
+        config = self.state.scheduler_config()
+        self.stack = TPUStack(self.cluster, algorithm=config.scheduler_algorithm)
+
+        err = self._compute_job_allocs()
+        if err is not None:
+            return False, err
+
+        delay_instead = bool(self.follow_up_evals) and not ev.wait_until
+
+        if (
+            ev.status != EVAL_STATUS_BLOCKED
+            and self.failed_tg_allocs
+            and self.blocked is None
+            and not delay_instead
+        ):
+            self._create_blocked_eval(plan_failure=False)
+
+        if self.plan.is_no_op() and not ev.annotate_plan:
+            return True, None
+
+        if delay_instead:
+            for fe in self.follow_up_evals:
+                fe.previous_eval = ev.id
+                self.planner.create_eval(fe)
+
+        result, new_state = self.planner.submit_plan(self.plan)
+        self.plan_result = result
+
+        adjust_queued_allocations(result, self.queued_allocs)
+
+        if new_state is not None:
+            self.state = new_state
+            return False, None
+
+        full, expected, actual = result.full_commit(self.plan)
+        if not full:
+            return False, Exception(
+                f"plan not fully committed and no refresh ({actual}/{expected})"
+            )
+        return True, None
+
+    # ---- reconcile + place ----
+
+    def _compute_job_allocs(self) -> Optional[Exception]:
+        """Reference computeJobAllocs (generic_sched.go:332)."""
+        ev = self.eval
+        allocs = self.state.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+
+        reconciler = AllocReconciler(
+            job=self.job,
+            job_id=ev.job_id,
+            is_batch=self.batch,
+            existing_allocs=allocs,
+            tainted_nodes=tainted,
+            eval_id=ev.id,
+            deployment=self.deployment,
+        )
+        results = reconciler.compute()
+
+        if ev.annotate_plan:
+            from ..structs import PlanAnnotations
+
+            self.plan.annotations = PlanAnnotations(
+                desired_tg_updates=results.desired_tg_updates
+            )
+
+        self.plan.deployment = results.deployment
+        self.plan.deployment_updates = results.deployment_updates
+
+        for evs in results.desired_followup_evals.values():
+            self.follow_up_evals.extend(evs)
+        if results.deployment is not None:
+            self.deployment = results.deployment
+
+        for stop in results.stop:
+            self.plan.append_stopped_alloc(
+                stop.alloc, stop.status_description, stop.client_status
+            )
+
+        dep_id = self.deployment.id if self.deployment is not None else ""
+        for update in results.inplace_update:
+            if update.deployment_id != dep_id:
+                update.deployment_id = dep_id
+                update.deployment_status = None
+            self.plan.append_alloc(update)
+
+        for update in results.attribute_updates.values():
+            self.plan.append_alloc(update)
+
+        if not results.place and not results.destructive_update:
+            if self.job is not None:
+                for tg in self.job.task_groups:
+                    self.queued_allocs[tg.name] = 0
+            return None
+
+        for p in results.place:
+            self.queued_allocs[p.task_group.name] = (
+                self.queued_allocs.get(p.task_group.name, 0) + 1
+            )
+        for d in results.destructive_update:
+            self.queued_allocs[d.place_task_group.name] = (
+                self.queued_allocs.get(d.place_task_group.name, 0) + 1
+            )
+
+        return self._compute_placements(
+            results.destructive_update, results.place
+        )
+
+    def _compute_placements(
+        self,
+        destructive: List[AllocDestructiveResult],
+        place: List[AllocPlaceResult],
+    ) -> Optional[Exception]:
+        """Reference computePlacements (generic_sched.go:468), restructured:
+        one kernel dispatch per task group covering all its missing allocs."""
+        _nodes, by_dc = ready_nodes_in_dcs(self.state, self.job.datacenters)
+        dep_id = ""
+        if self.deployment is not None and self.deployment.active():
+            dep_id = self.deployment.id
+        now = time.time()
+
+        # Destructive updates stop their previous alloc first (frees resources)
+        missing: List[Tuple[TaskGroup, AllocPlaceResult, Optional[Allocation], bool]] = []
+        for d in destructive:
+            self.plan.append_stopped_alloc(d.stop_alloc, ALLOC_UPDATING)
+            missing.append(
+                (
+                    d.place_task_group,
+                    AllocPlaceResult(
+                        name=d.place_name,
+                        task_group=d.place_task_group,
+                        previous_alloc=d.stop_alloc,
+                    ),
+                    d.stop_alloc,
+                    True,
+                )
+            )
+        for p in place:
+            missing.append((p.task_group, p, p.previous_alloc, False))
+
+        # Group by task group, preserving order (destructive first)
+        groups: Dict[str, List[Tuple[AllocPlaceResult, Optional[Allocation], bool]]] = {}
+        tg_by_name: Dict[str, TaskGroup] = {}
+        for tg, p, prev, _dest in missing:
+            groups.setdefault(tg.name, []).append((p, prev, _dest))
+            tg_by_name[tg.name] = tg
+
+        for tg_name, entries in groups.items():
+            tg = tg_by_name[tg_name]
+            plan_ctx = self._plan_context_for(tg, entries)
+            result = self.stack.select(self.job, tg, len(entries), plan_ctx)
+
+            for i, (p, prev, _dest) in enumerate(entries):
+                node_id = result.node_ids[i]
+                metrics = AllocMetric()
+                metrics.nodes_evaluated = len(_nodes)
+                metrics.nodes_available = dict(by_dc)
+                if node_id is None:
+                    # Failed placement (generic_sched.go:620 failedTGAllocs)
+                    existing = self.failed_tg_allocs.get(tg.name)
+                    if existing is not None:
+                        existing.coalesced_failures += 1
+                    else:
+                        metrics.nodes_filtered = (
+                            len(_nodes) - result.nodes_feasible
+                        )
+                        metrics.nodes_exhausted = (
+                            result.nodes_feasible - result.nodes_fit[i]
+                            if i < len(result.nodes_fit) else 0
+                        )
+                        self.failed_tg_allocs[tg.name] = metrics
+                    continue
+
+                node = self.state.node_by_id(node_id)
+                alloc = Allocation(
+                    id=str(uuid.uuid4()),
+                    namespace=self.job.namespace,
+                    eval_id=self.eval.id,
+                    name=p.name,
+                    job_id=self.job.id,
+                    job=self.job,
+                    task_group=tg.name,
+                    metrics=metrics,
+                    node_id=node_id,
+                    node_name=node.name if node else "",
+                    deployment_id=dep_id,
+                    allocated_resources=self._allocated_resources(tg, node),
+                    desired_status=ALLOC_DESIRED_RUN,
+                    client_status=ALLOC_CLIENT_PENDING,
+                    job_version=self.job.version,
+                )
+                alloc.metrics.score_node(node_id, "normalized-score",
+                                         result.scores[i])
+                if prev is not None:
+                    alloc.previous_allocation = prev.id
+                    if p.reschedule:
+                        update_reschedule_tracker(alloc, prev, now)
+                if p.canary and self.deployment is not None:
+                    alloc.deployment_status = AllocDeploymentStatus(canary=True)
+                    ds = self.deployment.task_groups.get(tg.name)
+                    if ds is not None:
+                        ds.placed_canaries.append(alloc.id)
+                self.plan.append_alloc(alloc)
+        return None
+
+    def _plan_context_for(
+        self, tg: TaskGroup,
+        entries: List[Tuple[AllocPlaceResult, Optional[Allocation], bool]],
+    ) -> PlanContext:
+        """Assemble plan-relative deltas for the kernel: in-plan stops release
+        resources; per-step penalty/preferred nodes mirror getSelectOptions +
+        findPreferredNode (generic_sched.go:622,637)."""
+        ctx = PlanContext()
+        for node_id, stops in self.plan.node_update.items():
+            ctx.stopped_allocs.extend(stops)
+        for node_id, pres in self.plan.node_preemptions.items():
+            ctx.preempted_allocs.extend(pres)
+        # in-plan placements from earlier groups of this eval
+        for node_id, placements in self.plan.node_allocation.items():
+            for a in placements:
+                if a.create_index:
+                    continue  # in-place updates already counted in state
+                usage = self.cluster.usage_row(a)
+                ctx.placed.append((node_id, a.task_group, usage))
+
+        sticky = tg.ephemeral_disk.sticky
+        for p, prev, _dest in entries:
+            penalties = set()
+            preferred = None
+            if prev is not None and p.reschedule:
+                penalties.add(prev.node_id)
+                if prev.reschedule_tracker is not None:
+                    for ev in prev.reschedule_tracker.events:
+                        if ev.prev_node_id:
+                            penalties.add(ev.prev_node_id)
+            if prev is not None and sticky and not p.reschedule:
+                preferred = prev.node_id
+            ctx.penalty_node_ids.append(frozenset(penalties))
+            ctx.preferred_node_ids.append(preferred)
+        return ctx
+
+    def _allocated_resources(self, tg: TaskGroup, node) -> AllocatedResources:
+        """Grant resources + assign ports for the placement (reference:
+        BinPackIterator's per-task network/port assignment, rank.go:231-320).
+        Port assignment happens host-side against the node's NetworkIndex."""
+        tasks: Dict[str, AllocatedTaskResources] = {}
+        shared = AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb)
+
+        net_idx: Optional[NetworkIndex] = None
+        if node is not None:
+            net_idx = NetworkIndex()
+            net_idx.set_node(node)
+            net_idx.add_allocs(self.state.allocs_by_node(node.id))
+
+        for t in tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu=t.resources.cpu, memory_mb=t.resources.memory_mb
+            )
+            for ask in t.resources.networks:
+                if net_idx is not None:
+                    offer, err = net_idx.assign_network(ask)
+                    if offer is not None:
+                        net_idx.add_reserved(offer)
+                        tr.networks.append(offer)
+            tasks[t.name] = tr
+
+        for ask in tg.networks:
+            if net_idx is not None:
+                offer, err = net_idx.assign_network(ask)
+                if offer is not None:
+                    net_idx.add_reserved(offer)
+                    shared.networks.append(offer)
+        return AllocatedResources(tasks=tasks, shared=shared)

@@ -1,0 +1,234 @@
+"""Scheduler test harness.
+
+Behavioral reference: `scheduler/testing.go:43` — a fake Planner capturing
+Plans/CreateEvals/ReblockEvals against a real in-memory state, applying plans
+directly via UpsertPlanResults (:173). This is the keystone of the reference's
+scheduler test strategy (SURVEY.md §4.2) and doubles as the bench driver's
+state backend.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import (
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    Node,
+    Plan,
+    PlanResult,
+)
+from ..tensor.cluster import ClusterTensors
+from .generic import GenericScheduler
+from .system import SystemScheduler
+from .util import Planner, SchedulerConfiguration, State
+
+
+class InMemState:
+    """In-memory state store with the read API schedulers need (mirrors the
+    reference's `state.StateStore` usage from the scheduler package; the full
+    MVCC store lives in nomad_tpu/state)."""
+
+    def __init__(self) -> None:
+        self._nodes: Dict[str, Node] = {}
+        self._jobs: Dict[Tuple[str, str], Job] = {}
+        self._job_versions: Dict[Tuple[str, str, int], Job] = {}
+        self._allocs: Dict[str, Allocation] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], Dict[str, Allocation]] = {}
+        self._allocs_by_node: Dict[str, Dict[str, Allocation]] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        self._evals: Dict[str, Evaluation] = {}
+        self._config = SchedulerConfiguration()
+        self.index = itertools.count(1)
+        self.cluster = ClusterTensors()
+
+    # ---- write API ----
+
+    def upsert_node(self, node: Node) -> None:
+        node.modify_index = next(self.index)
+        if not node.create_index:
+            node.create_index = node.modify_index
+        self._nodes[node.id] = node
+        self.cluster.upsert_node(node)
+
+    def delete_node(self, node_id: str) -> None:
+        self._nodes.pop(node_id, None)
+        self.cluster.remove_node(node_id)
+
+    def upsert_job(self, job: Job) -> None:
+        job.modify_index = next(self.index)
+        if not job.create_index:
+            job.create_index = job.modify_index
+            job.job_modify_index = job.modify_index
+        self._jobs[(job.namespace, job.id)] = job
+        self._job_versions[(job.namespace, job.id, job.version)] = job
+
+    def upsert_alloc(self, alloc: Allocation) -> None:
+        alloc.modify_index = next(self.index)
+        if not alloc.create_index:
+            alloc.create_index = alloc.modify_index
+        prev = self._allocs.get(alloc.id)
+        if prev is not None and prev.node_id != alloc.node_id:
+            self._allocs_by_node.get(prev.node_id, {}).pop(alloc.id, None)
+        self._allocs[alloc.id] = alloc
+        self._allocs_by_job.setdefault(
+            (alloc.namespace, alloc.job_id), {}
+        )[alloc.id] = alloc
+        self._allocs_by_node.setdefault(alloc.node_id, {})[alloc.id] = alloc
+        self.cluster.upsert_alloc(alloc)
+
+    def upsert_deployment(self, d: Deployment) -> None:
+        d.modify_index = next(self.index)
+        if not d.create_index:
+            d.create_index = d.modify_index
+        self._deployments[d.id] = d
+
+    def upsert_eval(self, e: Evaluation) -> None:
+        e.modify_index = next(self.index)
+        if not e.create_index:
+            e.create_index = e.modify_index
+        self._evals[e.id] = e
+
+    def upsert_plan_results(self, plan: Plan, result: PlanResult) -> None:
+        """Apply a committed plan (reference state.UpsertPlanResults,
+        state_store.go:240): stops, preemptions, then placements."""
+        for allocs in result.node_update.values():
+            for a in allocs:
+                existing = self._allocs.get(a.id)
+                if existing is not None:
+                    merged = copy.copy(existing)
+                    merged.desired_status = a.desired_status
+                    merged.desired_description = a.desired_description
+                    if a.client_status:
+                        merged.client_status = a.client_status
+                    self.upsert_alloc(merged)
+        for allocs in result.node_preemptions.values():
+            for a in allocs:
+                existing = self._allocs.get(a.id)
+                if existing is not None:
+                    merged = copy.copy(existing)
+                    merged.desired_status = a.desired_status
+                    merged.desired_description = a.desired_description
+                    merged.preempted_by_allocation = a.preempted_by_allocation
+                    self.upsert_alloc(merged)
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                if a.job is None:
+                    a.job = self._jobs.get((a.namespace, a.job_id))
+                self.upsert_alloc(a)
+        if result.deployment is not None:
+            self.upsert_deployment(result.deployment)
+        for du in result.deployment_updates:
+            d = self._deployments.get(du.deployment_id)
+            if d is not None:
+                d.status = du.status
+                d.status_description = du.status_description
+
+    # ---- read API (scheduler State protocol) ----
+
+    def nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._nodes.get(node_id)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._jobs.get((namespace, job_id))
+
+    def job_by_id_and_version(self, namespace: str, job_id: str, version: int
+                              ) -> Optional[Job]:
+        return self._job_versions.get((namespace, job_id, version))
+
+    def allocs_by_job(self, namespace: str, job_id: str,
+                      any_create_index: bool = True) -> List[Allocation]:
+        return list(self._allocs_by_job.get((namespace, job_id), {}).values())
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        return list(self._allocs_by_node.get(node_id, {}).values())
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._allocs.get(alloc_id)
+
+    def latest_deployment_by_job(self, namespace: str, job_id: str
+                                 ) -> Optional[Deployment]:
+        best = None
+        for d in self._deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._evals.get(eval_id)
+
+    def scheduler_config(self) -> SchedulerConfiguration:
+        return self._config
+
+    def set_scheduler_config(self, config: SchedulerConfiguration) -> None:
+        self._config = config
+
+
+class Harness:
+    """Reference Harness (scheduler/testing.go:43): captures submitted plans
+    and eval updates; optionally applies plans to state."""
+
+    def __init__(self, state: Optional[InMemState] = None) -> None:
+        self.state = state or InMemState()
+        self.plans: List[Plan] = []
+        self.evals: List[Evaluation] = []
+        self.create_evals: List[Evaluation] = []
+        self.reblock_evals: List[Evaluation] = []
+        self.reject_plan = False
+        self._lock = threading.Lock()
+
+    # ---- Planner protocol ----
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[State]]:
+        """Reference SubmitPlan (testing.go:130): reject or apply fully."""
+        with self._lock:
+            self.plans.append(plan)
+            if self.reject_plan:
+                # Rejection returns a refreshed state (testing.go:18 RejectPlan)
+                return PlanResult(), self.state
+            result = PlanResult(
+                node_update=plan.node_update,
+                node_allocation=plan.node_allocation,
+                node_preemptions=plan.node_preemptions,
+                deployment=plan.deployment,
+                deployment_updates=plan.deployment_updates,
+                alloc_index=next(self.state.index),
+            )
+            self.state.upsert_plan_results(plan, result)
+            return result, None
+
+    def update_eval(self, e: Evaluation) -> None:
+        with self._lock:
+            self.evals.append(e)
+
+    def create_eval(self, e: Evaluation) -> None:
+        with self._lock:
+            self.create_evals.append(e)
+            self.state.upsert_eval(e)
+
+    def reblock_eval(self, e: Evaluation) -> None:
+        with self._lock:
+            self.reblock_evals.append(e)
+
+    # ---- convenience ----
+
+    def scheduler_for(self, eval: Evaluation):
+        """Reference scheduler factory (scheduler.go:34 NewScheduler)."""
+        if eval.type == "system":
+            return SystemScheduler(self.state, self, self.state.cluster)
+        return GenericScheduler(
+            self.state, self, self.state.cluster, is_batch=(eval.type == "batch")
+        )
+
+    def process(self, eval: Evaluation) -> None:
+        self.scheduler_for(eval).process(eval)

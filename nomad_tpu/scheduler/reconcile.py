@@ -1,0 +1,883 @@
+"""Allocation reconciler.
+
+Behavioral reference: `scheduler/reconcile.go` (allocReconciler :39, Compute
+:184, computeGroup :341, computeStop :753, computePlacements :712,
+computeUpdates :864, delayed-reschedule batching :888) and
+`scheduler/reconcile_util.go` (allocSet filters :211-363, allocNameIndex
+:413-580).
+
+Pure host-side set arithmetic: given the job, existing allocs, tainted nodes
+and deployment state, produce (place, stop, inplace, destructive, migrate,
+follow-up evals, deployment changes). No tensor work — this is the control
+logic that feeds the placement kernels.
+"""
+from __future__ import annotations
+
+import copy
+import time as _time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..structs import (
+    ALLOC_CLIENT_COMPLETE,
+    ALLOC_CLIENT_FAILED,
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_EVICT,
+    ALLOC_DESIRED_RUN,
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Deployment,
+    DeploymentState,
+    DeploymentStatusUpdate,
+    DesiredUpdates,
+    Evaluation,
+    Job,
+    Node,
+    TaskGroup,
+    new_deployment,
+)
+from ..structs.deployment import (
+    DEPLOYMENT_DESC_NEWER_JOB,
+    DEPLOYMENT_DESC_SUCCESSFUL,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+)
+from ..structs.evaluation import (
+    EVAL_STATUS_PENDING,
+    TRIGGER_RETRY_FAILED_ALLOC,
+)
+
+# Stop/update descriptions (reference scheduler/generic_sched.go:28-60)
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_UPDATING = "alloc is being updated due to job update"
+ALLOC_LOST = "alloc is lost since its node is down"
+ALLOC_IN_PLACE = "alloc updating in-place"
+ALLOC_RESCHEDULED = "alloc was rescheduled because it failed"
+
+# reference reconcile.go:24-37
+BATCHED_FAILED_ALLOC_WINDOW_S = 5.0
+RESCHEDULE_WINDOW_S = 5.0
+
+AllocSet = Dict[str, Allocation]
+
+
+def alloc_name(job_id: str, group: str, idx: int) -> str:
+    """Reference structs.AllocName (structs.go:8931)."""
+    return f"{job_id}.{group}[{idx}]"
+
+
+@dataclass
+class AllocStopResult:
+    alloc: Allocation
+    client_status: str = ""
+    status_description: str = ""
+    followup_eval_id: str = ""
+
+
+@dataclass
+class AllocPlaceResult:
+    name: str
+    task_group: TaskGroup
+    previous_alloc: Optional[Allocation] = None
+    reschedule: bool = False
+    canary: bool = False
+    downgrade_non_canary: bool = False
+    min_job_version: int = 0
+
+
+@dataclass
+class AllocDestructiveResult:
+    place_name: str
+    place_task_group: TaskGroup
+    stop_alloc: Allocation
+    stop_status_description: str = ALLOC_UPDATING
+
+
+@dataclass
+class ReconcileResults:
+    """Reference reconcileResults (reconcile.go:90)."""
+
+    deployment: Optional[Deployment] = None
+    deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
+    place: List[AllocPlaceResult] = field(default_factory=list)
+    destructive_update: List[AllocDestructiveResult] = field(default_factory=list)
+    inplace_update: List[Allocation] = field(default_factory=list)
+    stop: List[AllocStopResult] = field(default_factory=list)
+    attribute_updates: Dict[str, Allocation] = field(default_factory=dict)
+    desired_tg_updates: Dict[str, DesiredUpdates] = field(default_factory=dict)
+    desired_followup_evals: Dict[str, List[Evaluation]] = field(default_factory=dict)
+
+
+# allocUpdateFn: (alloc, new_job, new_tg) -> (ignore, destructive, inplace_alloc)
+AllocUpdateFn = Callable[
+    [Allocation, Job, TaskGroup], Tuple[bool, bool, Optional[Allocation]]
+]
+
+
+def filter_by_tainted(
+    allocs: AllocSet, tainted: Dict[str, Optional[Node]]
+) -> Tuple[AllocSet, AllocSet, AllocSet]:
+    """(untainted, migrate, lost) — reference reconcile_util.go:211."""
+    untainted: AllocSet = {}
+    migrate: AllocSet = {}
+    lost: AllocSet = {}
+    for a in allocs.values():
+        if a.terminal_status():
+            untainted[a.id] = a
+            continue
+        if a.desired_transition.should_migrate():
+            migrate[a.id] = a
+            continue
+        if a.node_id not in tainted:
+            untainted[a.id] = a
+            continue
+        n = tainted[a.node_id]
+        if n is None or n.terminal_status():
+            lost[a.id] = a
+            continue
+        untainted[a.id] = a
+    return untainted, migrate, lost
+
+
+def _should_filter(alloc: Allocation, is_batch: bool) -> Tuple[bool, bool]:
+    """(untainted, ignore) — reference reconcile_util.go:299."""
+    if is_batch:
+        if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+            if _ran_successfully(alloc):
+                return True, False
+            return False, True
+        if alloc.client_status != ALLOC_CLIENT_FAILED:
+            return True, False
+        return False, False
+    if alloc.desired_status in (ALLOC_DESIRED_STOP, ALLOC_DESIRED_EVICT):
+        return False, True
+    if alloc.client_status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_LOST):
+        return False, True
+    return False, False
+
+
+def _ran_successfully(alloc: Allocation) -> bool:
+    """Reference Allocation.RanSuccessfully (structs.go:8874): all task states
+    finished successfully (client complete)."""
+    return alloc.client_status == ALLOC_CLIENT_COMPLETE
+
+
+@dataclass
+class DelayedRescheduleInfo:
+    alloc_id: str
+    alloc: Allocation
+    reschedule_time: float
+
+
+def _update_by_reschedulable(
+    alloc: Allocation, now: float, eval_id: str, d: Optional[Deployment]
+) -> Tuple[bool, bool, float]:
+    """(now, later, time) — reference reconcile_util.go:339."""
+    if (
+        d is not None
+        and alloc.deployment_id == d.id
+        and d.active()
+        and not alloc.desired_transition.should_reschedule()
+    ):
+        return False, False, 0.0
+    # Only failed allocs are reschedulable (reference Allocation.ShouldReschedule,
+    # structs.go:8753: client status must be failed)
+    if alloc.client_status != ALLOC_CLIENT_FAILED or alloc.desired_status != ALLOC_DESIRED_RUN:
+        return False, False, 0.0
+    policy = None
+    if alloc.job is not None:
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        if tg is not None:
+            policy = tg.reschedule_policy
+    fail_time = _last_event_time(alloc, now)
+    rtime, eligible = alloc.next_reschedule_time(policy, fail_time)
+    if eligible and (alloc.follow_up_eval_id == eval_id or rtime - now <= RESCHEDULE_WINDOW_S):
+        return True, False, rtime
+    if eligible and not alloc.follow_up_eval_id:
+        return False, True, rtime
+    return False, False, 0.0
+
+
+def _last_event_time(alloc: Allocation, default: float) -> float:
+    if alloc.modify_time:
+        return alloc.modify_time
+    return default
+
+
+def filter_by_rescheduleable(
+    allocs: AllocSet,
+    is_batch: bool,
+    now: float,
+    eval_id: str,
+    deployment: Optional[Deployment],
+) -> Tuple[AllocSet, AllocSet, List[DelayedRescheduleInfo]]:
+    """(untainted, reschedule_now, reschedule_later) — reference
+    reconcile_util.go:251."""
+    untainted: AllocSet = {}
+    reschedule_now: AllocSet = {}
+    reschedule_later: List[DelayedRescheduleInfo] = []
+    for a in allocs.values():
+        if a.next_allocation and a.terminal_status():
+            continue
+        is_untainted, ignore = _should_filter(a, is_batch)
+        if is_untainted:
+            untainted[a.id] = a
+        if is_untainted or ignore:
+            continue
+        now_ok, later_ok, rtime = _update_by_reschedulable(a, now, eval_id, deployment)
+        if not now_ok:
+            untainted[a.id] = a
+            if later_ok:
+                reschedule_later.append(DelayedRescheduleInfo(a.id, a, rtime))
+        else:
+            reschedule_now[a.id] = a
+    return untainted, reschedule_now, reschedule_later
+
+
+def filter_by_terminal(allocs: AllocSet) -> AllocSet:
+    return {i: a for i, a in allocs.items() if not a.terminal_status()}
+
+
+class AllocNameIndex:
+    """Reference allocNameIndex (reconcile_util.go:413): bitmap of used alloc
+    name indexes for a (job, group)."""
+
+    def __init__(self, job_id: str, group: str, count: int, in_set: AllocSet):
+        self.job_id = job_id
+        self.group = group
+        self.count = count
+        self.used = {a.index() for a in in_set.values() if a.index() >= 0}
+
+    def highest(self, n: int) -> set:
+        out = set()
+        for idx in sorted(self.used, reverse=True):
+            if len(out) >= n:
+                break
+            self.used.discard(idx)
+            out.add(alloc_name(self.job_id, self.group, idx))
+        return out
+
+    def unset_index(self, idx: int) -> None:
+        self.used.discard(idx)
+
+    def next(self, n: int) -> List[str]:
+        out: List[str] = []
+        for idx in range(self.count):
+            if len(out) == n:
+                return out
+            if idx not in self.used:
+                self.used.add(idx)
+                out.append(alloc_name(self.job_id, self.group, idx))
+        idx = self.count
+        while len(out) < n:
+            if idx not in self.used:
+                self.used.add(idx)
+                out.append(alloc_name(self.job_id, self.group, idx))
+            idx += 1
+        return out
+
+    def next_canaries(self, n: int, existing: AllocSet, destructive: AllocSet
+                      ) -> List[str]:
+        """Reference reconcile_util.go:513."""
+        out: List[str] = []
+        existing_names = {a.name for a in existing.values()}
+        dest_idx = sorted(
+            {a.index() for a in destructive.values() if 0 <= a.index() < self.count}
+        )
+        for idx in dest_idx:
+            name = alloc_name(self.job_id, self.group, idx)
+            if name not in existing_names:
+                out.append(name)
+                self.used.add(idx)
+                if len(out) == n:
+                    return out
+        for idx in range(self.count):
+            if idx in self.used:
+                continue
+            name = alloc_name(self.job_id, self.group, idx)
+            if name not in existing_names:
+                out.append(name)
+                self.used.add(idx)
+                if len(out) == n:
+                    return out
+        i = self.count
+        while len(out) < n:
+            out.append(alloc_name(self.job_id, self.group, i))
+            i += 1
+        return out
+
+
+def default_alloc_update_fn(alloc: Allocation, job: Job, tg: TaskGroup
+                            ) -> Tuple[bool, bool, Optional[Allocation]]:
+    """Simplified genericAllocUpdateFn (scheduler/util.go:849): same job
+    version → ignore; otherwise destructive (the in-place fast path — same
+    resources, changed non-destructive fields — is refined in
+    scheduler/util.py)."""
+    if alloc.job is not None and alloc.job.version == job.version:
+        return True, False, None
+    return False, True, None
+
+
+class AllocReconciler:
+    """Reference allocReconciler (reconcile.go:39)."""
+
+    def __init__(
+        self,
+        job: Optional[Job],
+        job_id: str,
+        is_batch: bool,
+        existing_allocs: List[Allocation],
+        tainted_nodes: Dict[str, Optional[Node]],
+        eval_id: str = "",
+        deployment: Optional[Deployment] = None,
+        alloc_update_fn: AllocUpdateFn = default_alloc_update_fn,
+        now: Optional[float] = None,
+    ) -> None:
+        self.job = job
+        self.job_id = job_id
+        self.batch = is_batch
+        self.existing = existing_allocs
+        self.tainted = tainted_nodes
+        self.eval_id = eval_id
+        self.deployment = copy.deepcopy(deployment)
+        self.old_deployment: Optional[Deployment] = None
+        self.deployment_paused = False
+        self.deployment_failed = False
+        self.alloc_update_fn = alloc_update_fn
+        self.now = now if now is not None else _time.time()
+        self.result = ReconcileResults()
+
+    # ---- main entry ----
+
+    def compute(self) -> ReconcileResults:
+        """Reference Compute (reconcile.go:184)."""
+        matrix: Dict[str, AllocSet] = {}
+        for a in self.existing:
+            matrix.setdefault(a.task_group, {})[a.id] = a
+        if self.job is not None:
+            for tg in self.job.task_groups:
+                matrix.setdefault(tg.name, {})
+
+        self._cancel_deployments()
+
+        if self.job is None or self.job.stopped():
+            self._handle_stop(matrix)
+            return self.result
+
+        if self.deployment is not None:
+            self.deployment_paused = self.deployment.status == DEPLOYMENT_STATUS_PAUSED
+            self.deployment_failed = self.deployment.status == DEPLOYMENT_STATUS_FAILED
+
+        complete = True
+        for group, allocs in matrix.items():
+            complete = self._compute_group(group, allocs) and complete
+
+        if self.deployment is not None and complete:
+            self.result.deployment_updates.append(
+                DeploymentStatusUpdate(
+                    deployment_id=self.deployment.id,
+                    status=DEPLOYMENT_STATUS_SUCCESSFUL,
+                    status_description=DEPLOYMENT_DESC_SUCCESSFUL,
+                )
+            )
+        return self.result
+
+    # ---- deployment management ----
+
+    def _cancel_deployments(self) -> None:
+        """Reference cancelDeployments (reconcile.go:257)."""
+        if self.job is None or self.job.stopped():
+            if self.deployment is not None and self.deployment.active():
+                self.result.deployment_updates.append(
+                    DeploymentStatusUpdate(
+                        deployment_id=self.deployment.id,
+                        status=DEPLOYMENT_STATUS_CANCELLED,
+                        status_description="Cancelled because job is stopped",
+                    )
+                )
+            self.old_deployment = self.deployment
+            self.deployment = None
+            return
+        d = self.deployment
+        if d is None:
+            return
+        if d.job_create_index != self.job.create_index or d.job_version != self.job.version:
+            if d.active():
+                self.result.deployment_updates.append(
+                    DeploymentStatusUpdate(
+                        deployment_id=d.id,
+                        status=DEPLOYMENT_STATUS_CANCELLED,
+                        status_description=DEPLOYMENT_DESC_NEWER_JOB,
+                    )
+                )
+            self.old_deployment = d
+            self.deployment = None
+        elif d.status == DEPLOYMENT_STATUS_SUCCESSFUL:
+            self.old_deployment = d
+            self.deployment = None
+
+    def _handle_stop(self, matrix: Dict[str, AllocSet]) -> None:
+        """Reference handleStop (reconcile.go:303)."""
+        for group, allocs in matrix.items():
+            allocs = filter_by_terminal(allocs)
+            untainted, migrate, lost = filter_by_tainted(allocs, self.tainted)
+            self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
+            du = DesiredUpdates(stop=len(allocs))
+            self.result.desired_tg_updates[group] = du
+
+    def _mark_stop(self, allocs: AllocSet, client_status: str, desc: str,
+                   followups: Optional[Dict[str, str]] = None) -> None:
+        for a in allocs.values():
+            self.result.stop.append(
+                AllocStopResult(
+                    alloc=a,
+                    client_status=client_status,
+                    status_description=desc,
+                    followup_eval_id=(followups or {}).get(a.id, ""),
+                )
+            )
+
+    # ---- per-group reconciliation ----
+
+    def _compute_group(self, group: str, all_set: AllocSet) -> bool:
+        """Reference computeGroup (reconcile.go:341)."""
+        desired = DesiredUpdates()
+        self.result.desired_tg_updates[group] = desired
+
+        tg = self.job.lookup_task_group(group)
+        if tg is None:
+            untainted, migrate, lost = filter_by_tainted(all_set, self.tainted)
+            self._mark_stop(untainted, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(migrate, "", ALLOC_NOT_NEEDED)
+            self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
+            desired.stop = len(untainted) + len(migrate) + len(lost)
+            return True
+
+        dstate: Optional[DeploymentState] = None
+        existing_deployment = False
+        if self.deployment is not None:
+            dstate = self.deployment.task_groups.get(group)
+            existing_deployment = dstate is not None
+        if not existing_deployment:
+            dstate = DeploymentState()
+            if tg.update is not None:
+                dstate.auto_revert = tg.update.auto_revert
+                dstate.auto_promote = tg.update.auto_promote
+                dstate.progress_deadline_s = tg.update.progress_deadline_s
+
+        all_set, ignore = self._filter_old_terminal_allocs(all_set)
+        desired.ignore += len(ignore)
+
+        canaries, all_set = self._handle_group_canaries(all_set, desired)
+
+        untainted, migrate, lost = filter_by_tainted(all_set, self.tainted)
+
+        untainted, reschedule_now, reschedule_later = filter_by_rescheduleable(
+            untainted, self.batch, self.now, self.eval_id, self.deployment
+        )
+
+        lost_later_evals = self._handle_delayed_lost([], all_set, tg.name)
+        followup_evals = self._handle_delayed_reschedules(
+            reschedule_later, all_set, tg.name
+        )
+
+        name_index = AllocNameIndex(
+            self.job_id, group, tg.count,
+            {**untainted, **migrate, **reschedule_now},
+        )
+
+        canary_state = (
+            dstate is not None and dstate.desired_canaries != 0 and not dstate.promoted
+        )
+        stop = self._compute_stop(
+            tg, name_index, untainted, migrate, lost, canaries, canary_state,
+            lost_later_evals,
+        )
+        desired.stop += len(stop)
+        untainted = {i: a for i, a in untainted.items() if i not in stop}
+
+        ignore2, inplace, destructive = self._compute_updates(tg, untainted)
+        desired.ignore += len(ignore2)
+        desired.in_place_update += len(inplace)
+        if not existing_deployment:
+            dstate.desired_total += len(destructive) + len(inplace)
+
+        if canary_state:
+            untainted = {i: a for i, a in untainted.items() if i not in canaries}
+
+        strategy = tg.update
+        canaries_promoted = dstate is not None and dstate.promoted
+        require_canary = (
+            len(destructive) != 0
+            and strategy is not None
+            and len(canaries) < strategy.canary
+            and not canaries_promoted
+        )
+        if require_canary:
+            dstate.desired_canaries = strategy.canary
+        if require_canary and not self.deployment_paused and not self.deployment_failed:
+            number = strategy.canary - len(canaries)
+            desired.canary += number
+            for name in name_index.next_canaries(number, canaries, destructive):
+                self.result.place.append(
+                    AllocPlaceResult(name=name, canary=True, task_group=tg)
+                )
+
+        canary_state = (
+            dstate is not None and dstate.desired_canaries != 0 and not dstate.promoted
+        )
+        limit = self._compute_limit(tg, untainted, destructive, migrate, canary_state)
+
+        place = self._compute_placements(
+            tg, name_index, untainted, migrate, reschedule_now, canary_state
+        )
+        if not existing_deployment:
+            dstate.desired_total += len(place)
+
+        deployment_place_ready = (
+            not self.deployment_paused and not self.deployment_failed and not canary_state
+        )
+        if deployment_place_ready:
+            desired.place += len(place)
+            self.result.place.extend(place)
+            self._mark_stop(reschedule_now, "", ALLOC_RESCHEDULED)
+            desired.stop += len(reschedule_now)
+            limit -= min(len(place), limit)
+        else:
+            if lost:
+                allowed = min(len(lost), len(place))
+                desired.place += allowed
+                self.result.place.extend(place[:allowed])
+            if reschedule_now:
+                for p in place:
+                    prev = p.previous_alloc
+                    if p.reschedule and not (
+                        self.deployment_failed
+                        and prev is not None
+                        and self.deployment is not None
+                        and self.deployment.id == prev.deployment_id
+                    ):
+                        self.result.place.append(p)
+                        desired.place += 1
+                        self.result.stop.append(
+                            AllocStopResult(
+                                alloc=prev, status_description=ALLOC_RESCHEDULED
+                            )
+                        )
+                        desired.stop += 1
+
+        if deployment_place_ready:
+            n = min(len(destructive), limit)
+            desired.destructive_update += n
+            desired.ignore += len(destructive) - n
+            for a in sorted(destructive.values(), key=lambda x: x.name)[:n]:
+                self.result.destructive_update.append(
+                    AllocDestructiveResult(
+                        place_name=a.name, place_task_group=tg, stop_alloc=a
+                    )
+                )
+        else:
+            desired.ignore += len(destructive)
+
+        desired.migrate += len(migrate)
+        for a in sorted(migrate.values(), key=lambda x: x.name):
+            self.result.stop.append(
+                AllocStopResult(alloc=a, status_description=ALLOC_MIGRATING)
+            )
+            self.result.place.append(
+                AllocPlaceResult(
+                    name=a.name,
+                    canary=a.deployment_status.canary if a.deployment_status else False,
+                    task_group=tg,
+                    previous_alloc=a,
+                    min_job_version=a.job_version,
+                )
+            )
+
+        # Create a new deployment if necessary (reference reconcile.go:545)
+        updating_spec = len(destructive) != 0 or len(self.result.inplace_update) != 0
+        had_running = any(
+            a.job is not None
+            and a.job.version == self.job.version
+            and a.job.create_index == self.job.create_index
+            for a in all_set.values()
+        )
+        if (
+            not existing_deployment
+            and strategy is not None
+            and dstate.desired_total != 0
+            and (not had_running or updating_spec)
+        ):
+            if self.deployment is None:
+                self.deployment = new_deployment(self.job)
+                self.result.deployment = self.deployment
+            self.deployment.task_groups[group] = dstate
+
+        deployment_complete = (
+            len(destructive) + len(inplace) + len(place) + len(migrate)
+            + len(reschedule_now) + len(reschedule_later) == 0
+            and not require_canary
+        )
+        if deployment_complete and self.deployment is not None:
+            ds = self.deployment.task_groups.get(group)
+            if ds is not None:
+                if ds.healthy_allocs < max(ds.desired_total, ds.desired_canaries) or (
+                    ds.desired_canaries > 0 and not ds.promoted
+                ):
+                    deployment_complete = False
+        return deployment_complete
+
+    def _filter_old_terminal_allocs(self, all_set: AllocSet
+                                    ) -> Tuple[AllocSet, AllocSet]:
+        """Reference filterOldTerminalAllocs (reconcile.go:592)."""
+        if not self.batch:
+            return all_set, {}
+        filtered: AllocSet = {}
+        ignored: AllocSet = {}
+        for i, a in all_set.items():
+            older = a.job is not None and (
+                a.job.version < self.job.version
+                or a.job.create_index < self.job.create_index
+            )
+            if older and a.terminal_status():
+                ignored[i] = a
+            else:
+                filtered[i] = a
+        return filtered, ignored
+
+    def _handle_group_canaries(self, all_set: AllocSet, desired: DesiredUpdates
+                               ) -> Tuple[AllocSet, AllocSet]:
+        """Reference handleGroupCanaries (reconcile.go:617)."""
+        stop_ids: List[str] = []
+        if self.old_deployment is not None:
+            for ds in self.old_deployment.task_groups.values():
+                if not ds.promoted:
+                    stop_ids.extend(ds.placed_canaries)
+        if self.deployment is not None and self.deployment.status == DEPLOYMENT_STATUS_FAILED:
+            for ds in self.deployment.task_groups.values():
+                if not ds.promoted:
+                    stop_ids.extend(ds.placed_canaries)
+        stop_set = {i: all_set[i] for i in stop_ids if i in all_set}
+        self._mark_stop(stop_set, "", ALLOC_NOT_NEEDED)
+        desired.stop += len(stop_set)
+        all_set = {i: a for i, a in all_set.items() if i not in stop_set}
+
+        canaries: AllocSet = {}
+        if self.deployment is not None:
+            canary_ids: List[str] = []
+            for ds in self.deployment.task_groups.values():
+                canary_ids.extend(ds.placed_canaries)
+            canaries = {i: all_set[i] for i in canary_ids if i in all_set}
+            untainted, migrate, lost = filter_by_tainted(canaries, self.tainted)
+            self._mark_stop(migrate, "", ALLOC_MIGRATING)
+            self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST)
+            canaries = untainted
+            all_set = {
+                i: a for i, a in all_set.items()
+                if i not in migrate and i not in lost
+            }
+        return canaries, all_set
+
+    def _compute_limit(self, tg: TaskGroup, untainted: AllocSet,
+                       destructive: AllocSet, migrate: AllocSet,
+                       canary_state: bool) -> int:
+        """Reference computeLimit (reconcile.go:668)."""
+        if tg.update is None or len(destructive) + len(migrate) == 0:
+            return tg.count
+        if self.deployment_paused or self.deployment_failed:
+            return 0
+        if canary_state:
+            return 0
+        limit = tg.update.max_parallel
+        if self.deployment is not None:
+            for a in untainted.values():
+                if a.deployment_id != self.deployment.id:
+                    continue
+                if a.deployment_status is not None and a.deployment_status.is_unhealthy():
+                    return 0
+                if a.deployment_status is None or not a.deployment_status.is_healthy():
+                    limit -= 1
+        return max(limit, 0)
+
+    def _compute_placements(self, tg: TaskGroup, name_index: AllocNameIndex,
+                            untainted: AllocSet, migrate: AllocSet,
+                            reschedule: AllocSet, canary_state: bool
+                            ) -> List[AllocPlaceResult]:
+        """Reference computePlacements (reconcile.go:712)."""
+        place: List[AllocPlaceResult] = []
+        for a in reschedule.values():
+            place.append(
+                AllocPlaceResult(
+                    name=a.name,
+                    task_group=tg,
+                    previous_alloc=a,
+                    reschedule=True,
+                    canary=a.deployment_status.canary if a.deployment_status else False,
+                    downgrade_non_canary=canary_state
+                    and not (a.deployment_status and a.deployment_status.canary),
+                    min_job_version=a.job_version,
+                )
+            )
+        existing = len(untainted) + len(migrate) + len(reschedule)
+        if existing < tg.count:
+            for name in name_index.next(tg.count - existing):
+                place.append(
+                    AllocPlaceResult(
+                        name=name, task_group=tg, downgrade_non_canary=canary_state
+                    )
+                )
+        return place
+
+    def _compute_stop(self, tg: TaskGroup, name_index: AllocNameIndex,
+                      untainted: AllocSet, migrate: AllocSet, lost: AllocSet,
+                      canaries: AllocSet, canary_state: bool,
+                      followup_evals: Dict[str, str]) -> AllocSet:
+        """Reference computeStop (reconcile.go:753)."""
+        stop: AllocSet = dict(lost)
+        self._mark_stop(lost, ALLOC_CLIENT_LOST, ALLOC_LOST, followup_evals)
+
+        if canary_state:
+            untainted = {i: a for i, a in untainted.items() if i not in canaries}
+
+        remove = len(untainted) + len(migrate) - tg.count
+        if remove <= 0:
+            return stop
+
+        untainted = filter_by_terminal(untainted)
+
+        if not canary_state and canaries:
+            canary_names = {a.name for a in canaries.values()}
+            for i, a in list(untainted.items()):
+                if i in canaries:
+                    continue
+                if a.name in canary_names:
+                    stop[i] = a
+                    self.result.stop.append(
+                        AllocStopResult(alloc=a, status_description=ALLOC_NOT_NEEDED)
+                    )
+                    del untainted[i]
+                    remove -= 1
+                    if remove == 0:
+                        return stop
+
+        if migrate:
+            m_names = AllocNameIndex(self.job_id, tg.name, tg.count, migrate)
+            remove_names = m_names.highest(remove)
+            for i, a in list(migrate.items()):
+                if a.name not in remove_names:
+                    continue
+                self.result.stop.append(
+                    AllocStopResult(alloc=a, status_description=ALLOC_NOT_NEEDED)
+                )
+                del migrate[i]
+                stop[i] = a
+                name_index.unset_index(a.index())
+                remove -= 1
+                if remove == 0:
+                    return stop
+
+        remove_names = name_index.highest(remove)
+        for i, a in list(untainted.items()):
+            if a.name in remove_names:
+                stop[i] = a
+                self.result.stop.append(
+                    AllocStopResult(alloc=a, status_description=ALLOC_NOT_NEEDED)
+                )
+                del untainted[i]
+                remove -= 1
+                if remove == 0:
+                    return stop
+
+        for i, a in list(untainted.items()):
+            stop[i] = a
+            self.result.stop.append(
+                AllocStopResult(alloc=a, status_description=ALLOC_NOT_NEEDED)
+            )
+            del untainted[i]
+            remove -= 1
+            if remove == 0:
+                return stop
+        return stop
+
+    def _compute_updates(self, tg: TaskGroup, untainted: AllocSet
+                         ) -> Tuple[AllocSet, AllocSet, AllocSet]:
+        """Reference computeUpdates (reconcile.go:864)."""
+        ignore: AllocSet = {}
+        inplace: AllocSet = {}
+        destructive: AllocSet = {}
+        for i, a in untainted.items():
+            ignore_change, destructive_change, inplace_alloc = self.alloc_update_fn(
+                a, self.job, tg
+            )
+            if ignore_change:
+                ignore[i] = a
+            elif destructive_change:
+                destructive[i] = a
+            else:
+                inplace[i] = a
+                self.result.inplace_update.append(inplace_alloc or a)
+        return ignore, inplace, destructive
+
+    def _handle_delayed_reschedules(
+        self, later: List[DelayedRescheduleInfo], all_set: AllocSet, tg_name: str
+    ) -> Dict[str, str]:
+        """Reference handleDelayedReschedules (reconcile.go:888)."""
+        mapping = self._handle_delayed_lost(later, all_set, tg_name)
+        for alloc_id, eval_id in mapping.items():
+            existing = all_set.get(alloc_id)
+            if existing is None:
+                continue
+            updated = copy.copy(existing)
+            updated.follow_up_eval_id = eval_id
+            self.result.attribute_updates[alloc_id] = updated
+        return mapping
+
+    def _handle_delayed_lost(
+        self, later: List[DelayedRescheduleInfo], all_set: AllocSet, tg_name: str
+    ) -> Dict[str, str]:
+        """Reference handleDelayedLost (reconcile.go:909): batch follow-up
+        evals in 5s windows."""
+        if not later:
+            return {}
+        later = sorted(later, key=lambda x: x.reschedule_time)
+        evals: List[Evaluation] = []
+        next_time = later[0].reschedule_time
+        mapping: Dict[str, str] = {}
+        ev = Evaluation(
+            id=str(uuid.uuid4()),
+            namespace=self.job.namespace,
+            priority=self.job.priority,
+            type=self.job.type,
+            triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+            job_id=self.job.id,
+            job_modify_index=self.job.modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait_until=next_time,
+        )
+        evals.append(ev)
+        for info in later:
+            if info.reschedule_time - next_time < BATCHED_FAILED_ALLOC_WINDOW_S:
+                mapping[info.alloc_id] = ev.id
+            else:
+                next_time = info.reschedule_time
+                ev = Evaluation(
+                    id=str(uuid.uuid4()),
+                    namespace=self.job.namespace,
+                    priority=self.job.priority,
+                    type=self.job.type,
+                    triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=self.job.id,
+                    job_modify_index=self.job.modify_index,
+                    status=EVAL_STATUS_PENDING,
+                    wait_until=next_time,
+                )
+                evals.append(ev)
+                mapping[info.alloc_id] = ev.id
+        self.result.desired_followup_evals[tg_name] = evals
+        return mapping

@@ -45,7 +45,8 @@ class PlanContext:
     preempted_allocs: List[Allocation] = field(default_factory=list)
     placed: List[Tuple[str, str, np.ndarray]] = field(default_factory=list)
     # (node_id, task_group, usage_row) for in-plan placements of this job
-    penalty_node_ids: frozenset = frozenset()
+    penalty_node_ids: List[frozenset] = field(default_factory=list)  # per step
+    preferred_node_ids: List[Optional[str]] = field(default_factory=list)  # per step
 
 
 @dataclass
@@ -175,12 +176,23 @@ class TPUStack:
             delta_idx[i] = row
             delta_res[i] = usage
 
-        # penalty vector
-        penalty = np.zeros(n, dtype=bool)
-        for nid in plan.penalty_node_ids:
-            row = cl.row_of.get(nid)
-            if row is not None:
-                penalty[row] = True
+        m = max_allocs if max_allocs is not None else _bucket(max(n_place, 1))
+
+        # per-step penalty / preferred node rows
+        p_max = max((len(s) for s in plan.penalty_node_ids), default=0)
+        p_bucket = _bucket(max(p_max, 1))
+        penalty_idx = np.full((m, p_bucket), -1, dtype=np.int32)
+        for i, nids in enumerate(plan.penalty_node_ids[:m]):
+            for j, nid in enumerate(sorted(nids)[:p_bucket]):
+                row = cl.row_of.get(nid)
+                if row is not None:
+                    penalty_idx[i, j] = row
+        preferred_idx = np.full(m, -1, dtype=np.int32)
+        for i, nid in enumerate(plan.preferred_node_ids[:m]):
+            if nid is not None:
+                row = cl.row_of.get(nid)
+                if row is not None:
+                    preferred_idx[i] = row
 
         # ask vector
         ask = np.zeros(R_TOTAL, dtype=np.float32)
@@ -199,7 +211,6 @@ class TPUStack:
         spreads = list(tg.spreads) + list(job.spreads)
         sp = self._compile_spreads(job, tg, spreads, plan, v)
 
-        m = max_allocs if max_allocs is not None else _bucket(max(n_place, 1))
         params = TGParams(
             ask=ask,
             n_place=np.int32(n_place),
@@ -210,7 +221,8 @@ class TPUStack:
             aff_key_idx=ca.key_idx,
             aff_lut=aff_lut,
             aff_inv_sum=np.float32(ca.inv_sum_abs_weight),
-            penalty=penalty,
+            penalty_idx=penalty_idx,
+            preferred_idx=preferred_idx,
             extra_mask=extra,
             distinct_hosts=np.bool_(distinct),
             job_count0=dh_counts,

@@ -1,0 +1,196 @@
+"""Scheduler utilities + interfaces.
+
+Behavioral reference: `scheduler/scheduler.go` (Scheduler/State/Planner ifaces
+:54/:65/:112) and `scheduler/util.go` (readyNodesInDCs :233, taintedNodes
+:312, retryMax :277, progressMade :864, updateNonTerminalAllocsToLost :821,
+adjustQueuedAllocations :792, updateRescheduleTracker :666 in
+generic_sched.go).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from ..structs import (
+    ALLOC_CLIENT_LOST,
+    ALLOC_DESIRED_STOP,
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    Node,
+    Plan,
+    PlanResult,
+    RescheduleEvent,
+    RescheduleTracker,
+)
+
+
+class State(Protocol):
+    """Read-only snapshot consumed by schedulers (reference scheduler.go:65)."""
+
+    def nodes(self) -> List[Node]: ...
+    def node_by_id(self, node_id: str) -> Optional[Node]: ...
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]: ...
+    def allocs_by_job(self, namespace: str, job_id: str, anyCreateIndex: bool = True
+                      ) -> List[Allocation]: ...
+    def allocs_by_node(self, node_id: str) -> List[Allocation]: ...
+    def latest_deployment_by_job(self, namespace: str, job_id: str
+                                 ) -> Optional[Deployment]: ...
+    def scheduler_config(self) -> "SchedulerConfiguration": ...
+
+
+class Planner(Protocol):
+    """Plan submission interface (reference scheduler.go:112)."""
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[State]]: ...
+    def update_eval(self, eval: Evaluation) -> None: ...
+    def create_eval(self, eval: Evaluation) -> None: ...
+    def reblock_eval(self, eval: Evaluation) -> None: ...
+
+
+class SchedulerConfiguration:
+    """Cluster-wide scheduler config (reference structs SchedulerConfiguration,
+    stored in state schema.go:657; algorithm + preemption toggles)."""
+
+    def __init__(self, algorithm: str = "binpack",
+                 preemption_system: bool = True,
+                 preemption_service: bool = False,
+                 preemption_batch: bool = False):
+        self.scheduler_algorithm = algorithm
+        self.preemption_system_enabled = preemption_system
+        self.preemption_service_enabled = preemption_service
+        self.preemption_batch_enabled = preemption_batch
+
+
+def ready_nodes_in_dcs(state: State, datacenters: List[str]
+                       ) -> Tuple[List[Node], Dict[str, int]]:
+    """Reference readyNodesInDCs (util.go:233): ready nodes in the job's DCs
+    plus per-DC availability counts."""
+    dcs = set(datacenters)
+    out: List[Node] = []
+    by_dc: Dict[str, int] = {}
+    for n in state.nodes():
+        if not n.ready():
+            continue
+        if n.datacenter in dcs:
+            out.append(n)
+            by_dc[n.datacenter] = by_dc.get(n.datacenter, 0) + 1
+    return out, by_dc
+
+
+def tainted_nodes(state: State, allocs: List[Allocation]
+                  ) -> Dict[str, Optional[Node]]:
+    """Reference taintedNodes (util.go:312): nodes referenced by allocs that
+    are down/draining/ineligible; nil entries for GC'd nodes."""
+    out: Dict[str, Optional[Node]] = {}
+    for a in allocs:
+        if a.node_id in out:
+            continue
+        n = state.node_by_id(a.node_id)
+        if n is None:
+            out[a.node_id] = None
+            continue
+        if n.terminal_status() or n.drain is not None or (
+            n.scheduling_eligibility != "eligible"
+        ):
+            out[a.node_id] = n
+    return out
+
+
+def update_non_terminal_allocs_to_lost(
+    plan: Plan, tainted: Dict[str, Optional[Node]], allocs: List[Allocation]
+) -> None:
+    """Reference updateNonTerminalAllocsToLost (util.go:821): mark allocs on
+    down nodes as lost in the plan if desired stop/evict."""
+    for a in allocs:
+        if a.node_id not in tainted:
+            continue
+        node = tainted[a.node_id]
+        if node is not None and not node.terminal_status():
+            continue
+        if a.desired_status in (ALLOC_DESIRED_STOP, "evict") and a.client_status in (
+            "running",
+            "pending",
+        ):
+            plan.append_stopped_alloc(
+                a, "alloc is lost since its node is down", ALLOC_CLIENT_LOST
+            )
+
+
+def retry_max(limit: int, fn: Callable[[], Tuple[bool, Optional[Exception]]],
+              reset_fn: Optional[Callable[[], bool]] = None) -> Optional[Exception]:
+    """Reference retryMax (util.go:277): run fn up to limit times, resetting
+    the budget when reset_fn reports progress."""
+    attempts = 0
+    while attempts < limit:
+        done, err = fn()
+        if err is not None:
+            return err
+        if done:
+            return None
+        if reset_fn is not None and reset_fn():
+            attempts = 0
+        else:
+            attempts += 1
+    return SetStatusError("failed", f"maximum attempts reached ({limit})")
+
+
+class SetStatusError(Exception):
+    def __init__(self, eval_status: str, msg: str):
+        super().__init__(msg)
+        self.eval_status = eval_status
+
+
+def progress_made(result: Optional[PlanResult]) -> bool:
+    """Reference progressMade (util.go:864)."""
+    return result is not None and (
+        bool(result.node_update)
+        or bool(result.node_allocation)
+        or result.deployment is not None
+        or bool(result.deployment_updates)
+    )
+
+
+def adjust_queued_allocations(result: Optional[PlanResult],
+                              queued: Dict[str, int]) -> None:
+    """Reference adjustQueuedAllocations (util.go:792): decrement queued
+    counts by successfully-placed allocs."""
+    if result is None:
+        return
+    for allocs in result.node_allocation.values():
+        for a in allocs:
+            if a.create_index and a.create_index != a.modify_index:
+                continue  # in-place updates don't count
+            if a.task_group in queued:
+                queued[a.task_group] -= 1
+
+
+def update_reschedule_tracker(alloc: Allocation, prev: Allocation,
+                              now: Optional[float] = None) -> None:
+    """Reference updateRescheduleTracker (generic_sched.go:666): carry reschedule
+    events within the policy interval onto the replacement alloc."""
+    now = now if now is not None else time.time()
+    policy = None
+    if prev.job is not None:
+        tg = prev.job.lookup_task_group(prev.task_group)
+        if tg is not None:
+            policy = tg.reschedule_policy
+    events: List[RescheduleEvent] = []
+    if policy is not None and prev.reschedule_tracker is not None:
+        interval = policy.interval_s
+        for ev in prev.reschedule_tracker.events:
+            if policy.unlimited or (interval > 0 and ev.reschedule_time > now - interval):
+                events.append(ev)
+    events.append(
+        RescheduleEvent(
+            reschedule_time=now,
+            prev_alloc_id=prev.id,
+            prev_node_id=prev.node_id,
+        )
+    )
+    # Keep bounded history (reference keeps events within interval; cap at 5
+    # for unlimited policies per structs.go:8750)
+    if policy is not None and policy.unlimited and len(events) > 5:
+        events = events[-5:]
+    alloc.reschedule_tracker = RescheduleTracker(events=events)

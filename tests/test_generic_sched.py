@@ -1,0 +1,266 @@
+"""End-to-end scheduler tests through the Harness.
+
+Mirrors the core cases of reference `scheduler/generic_sched_test.go`
+(TestServiceSched_JobRegister*, _JobModify, _NodeDown, …) and
+`system_sched_test.go`.
+"""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.structs import (
+    Constraint,
+    Evaluation,
+)
+
+
+def register_nodes(h, n, **overrides):
+    nodes = []
+    for _ in range(n):
+        node = mock.node(**overrides)
+        h.state.upsert_node(node)
+        nodes.append(node)
+    return nodes
+
+
+def eval_for(job, **kw):
+    e = mock.eval_(job_id=job.id, type=job.type, priority=job.priority, **kw)
+    return e
+
+
+class TestServiceSchedJobRegister:
+    def test_place_all(self):
+        h = Harness()
+        register_nodes(h, 10)
+        job = mock.job()
+        h.state.upsert_job(job)
+        ev = eval_for(job)
+        h.process(ev)
+
+        assert len(h.plans) == 1
+        plan = h.plans[0]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 10
+        # status update marked complete
+        assert h.evals[-1].status == "complete"
+        # allocs landed in state
+        out = h.state.allocs_by_job("default", job.id)
+        assert len(out) == 10
+        # names unique, indexes 0..9
+        names = sorted(a.name for a in out)
+        assert names == sorted(f"{job.id}.web[{i}]" for i in range(10))
+
+    def test_spread_across_nodes(self):
+        """Default even distribution: with 10 nodes and 10 allocs, job
+        anti-affinity should avoid stacking everything on one node."""
+        h = Harness()
+        register_nodes(h, 10)
+        job = mock.job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        out = h.state.allocs_by_job("default", job.id)
+        used_nodes = {a.node_id for a in out}
+        assert len(used_nodes) > 1
+
+    def test_exhausted_creates_blocked_eval(self):
+        h = Harness()
+        register_nodes(h, 2)
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.cpu = 3000  # 2 nodes × 3900 usable
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        out = h.state.allocs_by_job("default", job.id)
+        assert 0 < len(out) < 10
+        # blocked eval created for the remainder
+        assert len(h.create_evals) == 1
+        assert h.create_evals[0].status == "blocked"
+        # failed TG allocs recorded on the eval update
+        assert h.evals[-1].failed_tg_allocs.get("web") is not None
+
+    def test_infeasible_constraint_blocks_all(self):
+        h = Harness()
+        register_nodes(h, 5)
+        job = mock.job()
+        job.constraints.append(Constraint("${attr.kernel.name}", "windows", "="))
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        out = h.state.allocs_by_job("default", job.id)
+        assert len(out) == 0
+        assert len(h.create_evals) == 1
+
+    def test_no_nodes(self):
+        h = Harness()
+        job = mock.job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        assert len(h.state.allocs_by_job("default", job.id)) == 0
+
+    def test_annotate_plan(self):
+        h = Harness()
+        register_nodes(h, 5)
+        job = mock.job()
+        h.state.upsert_job(job)
+        ev = eval_for(job)
+        ev.annotate_plan = True
+        h.process(ev)
+        plan = h.plans[0]
+        assert plan.annotations is not None
+        assert plan.annotations.desired_tg_updates["web"].place == 10
+
+
+class TestServiceSchedJobModify:
+    def _setup_running(self, h, n_nodes=10):
+        nodes = register_nodes(h, n_nodes)
+        job = mock.job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        for a in h.state.allocs_by_job("default", job.id):
+            a.client_status = "running"
+            h.state.upsert_alloc(a)
+        return job, nodes
+
+    def test_count_up(self):
+        h = Harness()
+        job, _ = self._setup_running(h)
+        job2 = mock.job(id=job.id)
+        job2.task_groups[0].count = 15
+        job2.version = job.version  # same spec, just scaled
+        h.state.upsert_job(job2)
+        h.process(eval_for(job2))
+        live = [
+            a for a in h.state.allocs_by_job("default", job.id)
+            if not a.terminal_status()
+        ]
+        assert len(live) == 15
+
+    def test_count_down(self):
+        h = Harness()
+        job, _ = self._setup_running(h)
+        job2 = mock.job(id=job.id)
+        job2.task_groups[0].count = 4
+        job2.version = job.version
+        h.state.upsert_job(job2)
+        h.process(eval_for(job2))
+        live = [
+            a for a in h.state.allocs_by_job("default", job.id)
+            if not a.terminal_status()
+        ]
+        assert len(live) == 4
+        # highest indexes removed first (reconcile_util.go Highest)
+        names = sorted(a.name for a in live)
+        assert names == sorted(f"{job.id}.web[{i}]" for i in range(4))
+
+    def test_destructive_update(self):
+        h = Harness()
+        job, _ = self._setup_running(h)
+        job2 = mock.job(id=job.id)
+        job2.version = job.version + 1
+        job2.create_index = job.create_index
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+        h.state.upsert_job(job2)
+        h.process(eval_for(job2))
+        plan = h.plans[-1]
+        stops = [a for allocs in plan.node_update.values() for a in allocs]
+        places = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(stops) == 10
+        assert len(places) == 10
+
+    def test_job_deregister(self):
+        h = Harness()
+        job, _ = self._setup_running(h)
+        job.stop = True
+        h.state.upsert_job(job)
+        h.process(eval_for(job, triggered_by="job-deregister"))
+        live = [
+            a for a in h.state.allocs_by_job("default", job.id)
+            if not a.terminal_status()
+        ]
+        assert len(live) == 0
+
+
+class TestServiceSchedNodeDown:
+    def test_node_down_reschedules(self):
+        h = Harness()
+        nodes = register_nodes(h, 5)
+        job = mock.job()
+        job.task_groups[0].count = 5
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        for a in h.state.allocs_by_job("default", job.id):
+            a.client_status = "running"
+            h.state.upsert_alloc(a)
+
+        # Kill one node that has allocs
+        victim_id = next(
+            a.node_id for a in h.state.allocs_by_job("default", job.id)
+        )
+        victim = h.state.node_by_id(victim_id)
+        victim.status = "down"
+        h.state.upsert_node(victim)
+
+        h.process(eval_for(job, triggered_by="node-update"))
+        allocs = h.state.allocs_by_job("default", job.id)
+        lost = [a for a in allocs if a.client_status == "lost"]
+        assert len(lost) >= 1
+        live = [a for a in allocs if not a.terminal_status()]
+        assert len(live) == 5
+        assert all(a.node_id != victim_id for a in live)
+
+
+class TestSystemSched:
+    def test_place_on_all_nodes(self):
+        h = Harness()
+        register_nodes(h, 8)
+        job = mock.system_job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        out = h.state.allocs_by_job("default", job.id)
+        assert len(out) == 8
+        assert len({a.node_id for a in out}) == 8
+
+    def test_new_node_gets_alloc(self):
+        h = Harness()
+        register_nodes(h, 4)
+        job = mock.system_job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        assert len(h.state.allocs_by_job("default", job.id)) == 4
+
+        register_nodes(h, 1)
+        h.process(eval_for(job, triggered_by="node-update"))
+        assert len(h.state.allocs_by_job("default", job.id)) == 5
+
+    def test_constraint_filters_nodes(self):
+        h = Harness()
+        register_nodes(h, 4)
+        bad = mock.node()
+        bad.attributes = dict(bad.attributes, **{"kernel.name": "darwin"})
+        h.state.upsert_node(bad)
+        job = mock.system_job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        out = h.state.allocs_by_job("default", job.id)
+        assert len(out) == 4
+        assert all(a.node_id != bad.id for a in out)
+
+
+class TestBatchSched:
+    def test_batch_complete_not_replaced(self):
+        h = Harness()
+        register_nodes(h, 3)
+        job = mock.batch_job()
+        job.task_groups[0].count = 2
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        allocs = h.state.allocs_by_job("default", job.id)
+        assert len(allocs) == 2
+        # complete batch allocs are not rescheduled
+        for a in allocs:
+            a.client_status = "complete"
+            h.state.upsert_alloc(a)
+        h.process(eval_for(job, triggered_by="job-register"))
+        live = [
+            a for a in h.state.allocs_by_job("default", job.id)
+            if not a.client_terminal_status()
+        ]
+        assert len(live) == 0
