@@ -1,0 +1,155 @@
+"""Benchmark: evaluation throughput of the TPU placement backend.
+
+Workload (BASELINE.json): synthetic cluster, default 10K nodes / 100K running
+allocs; each evaluation places 8 allocations of a fresh 1-task-group service
+job (CPU+MiB bin-pack, mixed affinity/spread stanzas). The TPU path batches
+evaluations (vmap) through the fused placement kernel; the baseline is the
+scalar oracle (`nomad_tpu/scheduler/oracle.py`), a faithful Python
+re-implementation of the reference's Go iterator chain
+(`scheduler/stack.go:116`, `rank.go:188`, `feasible.go`) in exact (full-scan)
+mode. No Go toolchain exists in this image, so the Go scheduler itself cannot
+be timed here; the oracle is the measured stand-in (see BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "evals/s", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+import uuid
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build(n_nodes: int, n_allocs: int, n_evals: int, count: int, seed: int = 11):
+    from nomad_tpu.scheduler.stack import TPUStack
+    from nomad_tpu.synth import build_synthetic_state, synth_service_job
+
+    t0 = time.time()
+    state, nodes = build_synthetic_state(n_nodes, n_allocs, seed=seed)
+    rng = random.Random(seed + 1)
+    jobs = []
+    for i in range(n_evals):
+        job = synth_service_job(
+            rng, count=count,
+            with_affinity=(i % 2 == 0), with_spread=(i % 3 == 0),
+        )
+        state.upsert_job(job)
+        jobs.append(job)
+    stack = TPUStack(state.cluster)
+    log(f"build: {n_nodes} nodes / {n_allocs} allocs / {n_evals} eval jobs "
+        f"in {time.time() - t0:.1f}s")
+    return state, nodes, jobs, stack
+
+
+def bench_tpu(state, jobs, stack, count: int, batch: int) -> float:
+    """Batched kernel path: per-eval program compile (host, numpy) + one
+    vmapped device dispatch per batch of evaluations."""
+    import jax
+
+    from nomad_tpu.kernels.placement import place_task_group_batch
+    from nomad_tpu.parallel import stack_params
+
+    def run_batch(job_batch):
+        params = [
+            stack.compile_tg(j, j.task_groups[0], count)[0] for j in job_batch
+        ]
+        batched, m = stack_params(params)
+        arrays = stack.device_arrays()
+        result = place_task_group_batch(arrays, batched, m)
+        jax.block_until_ready(result)
+        import numpy as np
+
+        return np.asarray(result.sel_idx)
+
+    # Warmup / compile
+    t0 = time.time()
+    sel = run_batch(jobs[:batch])
+    log(f"tpu: compile+warmup {time.time() - t0:.1f}s; "
+        f"warmup placed {(sel >= 0).sum()}/{sel.size}")
+
+    t0 = time.time()
+    total = 0
+    placed = 0
+    for i in range(0, len(jobs), batch):
+        job_batch = jobs[i : i + batch]
+        if len(job_batch) < batch:
+            break
+        sel = run_batch(job_batch)
+        placed += int((sel >= 0).sum())
+        total += len(job_batch)
+    dt = time.time() - t0
+    rate = total / dt
+    log(f"tpu: {total} evals in {dt:.2f}s = {rate:.1f} evals/s "
+        f"({placed}/{total * sel.shape[1]} allocs placed)")
+    return rate
+
+
+def bench_oracle(state, nodes, jobs, count: int, n_evals: int) -> float:
+    """Scalar oracle path (the measured baseline): full-node-scan Select per
+    alloc, sequential, exactly the per-node math of the reference chain."""
+    from nomad_tpu.mock import alloc_resources
+    from nomad_tpu.scheduler.oracle import OracleContext, select_option
+    from nomad_tpu.structs import Allocation
+
+    allocs_by_node = {
+        nid: list(d.values()) for nid, d in state._allocs_by_node.items()
+    }
+    t0 = time.time()
+    total = 0
+    for job in jobs[:n_evals]:
+        ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
+        tg = job.task_groups[0]
+        res = job.combined_task_resources(tg)
+        for _ in range(count):
+            opt = select_option(ctx, job, tg)
+            if opt is None:
+                continue
+            fake = Allocation(
+                id=uuid.uuid4().hex, namespace="default", job_id=job.id,
+                job=job, task_group=tg.name, node_id=opt.node.id,
+                allocated_resources=alloc_resources(
+                    cpu=res.cpu, memory_mb=res.memory_mb, disk_mb=res.disk_mb
+                ),
+                desired_status="run", client_status="pending",
+            )
+            ctx.plan_node_alloc.setdefault(opt.node.id, []).append(fake)
+        total += 1
+    dt = time.time() - t0
+    rate = total / dt
+    log(f"oracle: {total} evals in {dt:.2f}s = {rate:.3f} evals/s")
+    return rate
+
+
+def main() -> None:
+    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
+    n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_ALLOCS", 100_000))
+    n_evals = int(os.environ.get("NOMAD_TPU_BENCH_EVALS", 1024))
+    batch = int(os.environ.get("NOMAD_TPU_BENCH_BATCH", 128))
+    count = int(os.environ.get("NOMAD_TPU_BENCH_COUNT", 8))
+    oracle_evals = int(os.environ.get("NOMAD_TPU_BENCH_ORACLE_EVALS", 3))
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    state, nodes, jobs, stack = build(n_nodes, n_allocs, n_evals + batch, count)
+
+    tpu_rate = bench_tpu(state, jobs, stack, count, batch)
+    oracle_rate = bench_oracle(state, nodes, jobs, count, oracle_evals)
+
+    print(json.dumps({
+        "metric": f"service_evals_per_sec_{n_nodes}_nodes",
+        "value": round(tpu_rate, 2),
+        "unit": "evals/s",
+        "vs_baseline": round(tpu_rate / oracle_rate, 2) if oracle_rate else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Multi-chip parallelism: device mesh construction and sharded dispatch of
+the placement kernels (SURVEY.md §2.7/§2.8 — the node axis is this domain's
+sequence axis; evals are the batch axis)."""
+from .mesh import (
+    cluster_sharding,
+    make_mesh,
+    params_sharding,
+    place_batch_sharded,
+    scheduler_step,
+    shard_cluster,
+    stack_params,
+)
+
+__all__ = [
+    "make_mesh",
+    "cluster_sharding",
+    "params_sharding",
+    "shard_cluster",
+    "stack_params",
+    "place_batch_sharded",
+    "scheduler_step",
+]

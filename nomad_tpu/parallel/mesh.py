@@ -1,0 +1,207 @@
+"""Device mesh + sharding layout for the scheduling kernels.
+
+The reference scales scheduling with N worker goroutines racing on MVCC
+snapshots (`nomad/server.go:1419`, `nomad/worker.go:105`) and bounds per-eval
+work with log₂(n) candidate sampling (`scheduler/stack.go:77-89`). The TPU
+build replaces both with SPMD over a 2-D mesh:
+
+  axis "batch" — independent pending evaluations (the domain's data
+                 parallelism; the broker already serializes per-JobID,
+                 `nomad/structs/structs.go:9524`, so a dequeued batch is safe)
+  axis "nodes" — the cluster's node axis (the domain's sequence/context
+                 parallelism; full-width masks instead of sampling)
+
+Shardings are annotated with `jax.sharding.NamedSharding`; XLA GSPMD inserts
+the collectives (the global argmax over the sharded node axis becomes a
+local argmax + all-reduce over ICI).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..kernels.placement import ClusterArrays, TGParams, place_task_group
+from ..utils import bucket as _bucket, widen_lut as _widen_v
+
+BATCH_AXIS = "batch"
+NODE_AXIS = "nodes"
+
+# TGParams fields that carry the node axis (leading axis after batching is
+# the eval batch; the node axis is axis -1 for these vectors).
+_NODE_AXIS_FIELDS = frozenset({"extra_mask", "job_count0", "jobtg_count0"})
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              batch: Optional[int] = None) -> Mesh:
+    """Build a ("batch", "nodes") mesh over the first `n_devices` devices."""
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    devices = devices[:n]
+    if batch is None:
+        # Node-axis size must divide the cluster row bucket (a power of two ≥
+        # 64), so give NODE_AXIS the largest power-of-two divisor of n and put
+        # the remainder on the eval-batch axis; with a pure power of two,
+        # still keep a batch axis of 2 to exercise both parallelism forms.
+        node = 1
+        while n % (node * 2) == 0:
+            node *= 2
+        batch = n // node
+        if batch == 1 and node >= 4:
+            batch = 2
+    assert n % batch == 0, f"{n} devices not divisible by batch={batch}"
+    nodes_dim = n // batch
+    assert nodes_dim & (nodes_dim - 1) == 0, (
+        f"node axis {nodes_dim} must be a power of two to divide row buckets"
+    )
+    arr = np.asarray(devices).reshape(batch, nodes_dim)
+    return Mesh(arr, (BATCH_AXIS, NODE_AXIS))
+
+
+def cluster_sharding(mesh: Mesh) -> ClusterArrays:
+    """Shardings for the cluster snapshot: node axis split over NODE_AXIS,
+    replicated over the eval batch."""
+    row = NamedSharding(mesh, P(NODE_AXIS))
+    mat = NamedSharding(mesh, P(NODE_AXIS, None))
+    return ClusterArrays(capacity=mat, used=mat, node_ok=row, attrs=mat)
+
+
+def params_sharding(mesh: Mesh, batched: bool = True) -> TGParams:
+    """Shardings for (batched) TGParams: batch axis over BATCH_AXIS; the three
+    node-axis vectors additionally split over NODE_AXIS; everything else
+    replicated across the node ring."""
+    lead = (BATCH_AXIS,) if batched else ()
+    out = {}
+    for name in TGParams._fields:
+        if name in _NODE_AXIS_FIELDS:
+            spec = P(*lead, NODE_AXIS)
+        else:
+            spec = P(*lead)
+        out[name] = NamedSharding(mesh, spec)
+    return TGParams(**out)
+
+
+def shard_cluster(arrays: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    shardings = cluster_sharding(mesh)
+    return ClusterArrays(
+        *[jax.device_put(a, s) for a, s in zip(arrays, shardings)]
+    )
+
+
+def _pad_rows(a: np.ndarray, n: int, fill) -> np.ndarray:
+    """Pad axis 0 to n rows with a constant."""
+    if a.shape[0] == n:
+        return a
+    pad = np.full((n - a.shape[0],) + a.shape[1:], fill, dtype=a.dtype)
+    return np.concatenate([a, pad], axis=0)
+
+
+def pad_params(params_list: Sequence[TGParams]
+               ) -> Tuple[Tuple[TGParams, ...], int]:
+    """Bucket-pad heterogeneous per-eval placement programs to common shapes
+    so they batch along one leading axis (SURVEY §7 hard-part (d): variable
+    shapes → bucketed padding + masking, avoiding recompiles).
+
+    Padding is semantically inert: extra constraint rows are all-true LUTs,
+    extra affinity/spread rows carry zero weight / inactive flags, extra
+    penalty/preferred/delta rows are −1 (dropped scatters), and extra scan
+    steps sit beyond `n_place`. Returns (padded params, common scan length).
+    """
+    ps = [TGParams(*[np.asarray(x) for x in p]) for p in params_list]
+    v = _bucket(max(max(p.lut.shape[1] if p.lut.size else 2,
+                        p.aff_lut.shape[1] if p.aff_lut.size else 2,
+                        p.spread_desired.shape[1]) for p in ps), lo=2)
+    c = _bucket(max(p.key_idx.shape[0] for p in ps))
+    a_n = _bucket(max(p.aff_key_idx.shape[0] for p in ps))
+    m = _bucket(max(p.penalty_idx.shape[0] for p in ps))
+    p_n = _bucket(max(p.penalty_idx.shape[1] for p in ps))
+    d_n = _bucket(max(p.delta_idx.shape[0] for p in ps))
+    s_n = _bucket(max(p.spread_key_idx.shape[0] for p in ps))
+
+    out = []
+    for p in ps:
+        lut = _pad_rows(_widen_v(p.lut, v, False) if p.lut.size
+                        else np.zeros((0, v), np.bool_), c, True)
+        key_idx = _pad_rows(p.key_idx, c, 0)
+        aff_lut = _pad_rows(_widen_v(p.aff_lut, v, 0.0) if p.aff_lut.size
+                            else np.zeros((0, v), np.float32), a_n, 0.0)
+        aff_key_idx = _pad_rows(p.aff_key_idx, a_n, 0)
+        pen = _pad_rows(p.penalty_idx, m, -1)
+        if pen.shape[1] != p_n:
+            wide = np.full((m, p_n), -1, dtype=pen.dtype)
+            wide[:, : pen.shape[1]] = pen
+            pen = wide
+        out.append(p._replace(
+            key_idx=key_idx, lut=lut,
+            aff_key_idx=aff_key_idx, aff_lut=aff_lut,
+            penalty_idx=pen,
+            preferred_idx=_pad_rows(p.preferred_idx, m, -1),
+            delta_idx=_pad_rows(p.delta_idx, d_n, -1),
+            delta_res=_pad_rows(p.delta_res, d_n, 0.0),
+            spread_key_idx=_pad_rows(p.spread_key_idx, s_n, 0),
+            spread_weight=_pad_rows(p.spread_weight, s_n, 0.0),
+            spread_has_targets=_pad_rows(p.spread_has_targets, s_n, False),
+            spread_desired=_pad_rows(_widen_v(p.spread_desired, v, -1.0),
+                                     s_n, -1.0),
+            spread_counts0=_pad_rows(_widen_v(p.spread_counts0, v, 0.0),
+                                     s_n, 0.0),
+            spread_active=_pad_rows(p.spread_active, s_n, False),
+        ))
+    return tuple(out), m
+
+
+def stack_params(params_list: Sequence[TGParams]) -> Tuple[TGParams, int]:
+    """Bucket-pad then stack per-eval TGParams along a new batch axis.
+    Returns (batched params, common max_allocs scan length)."""
+    padded, m = pad_params(params_list)
+    batched = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *padded
+    )
+    return batched, m
+
+
+def _batch_place(cluster: ClusterArrays, batch: TGParams, max_allocs: int):
+    fn = functools.partial(place_task_group, max_allocs=max_allocs)
+    return jax.vmap(fn, in_axes=(None, 0))(cluster, batch)
+
+
+def place_batch_sharded(mesh: Mesh, max_allocs: int):
+    """A jitted batched placement dispatch with mesh shardings annotated on
+    the inputs; XLA GSPMD partitions the scan body and inserts the argmax
+    all-reduce over the node ring."""
+    in_shardings = (cluster_sharding(mesh), params_sharding(mesh, batched=True))
+    return jax.jit(
+        functools.partial(_batch_place, max_allocs=max_allocs),
+        in_shardings=in_shardings,
+    )
+
+
+def _step(cluster: ClusterArrays, batch: TGParams, max_allocs: int):
+    """One full scheduler step: batched placement + state fold-in.
+
+    The fold-in (sum of per-eval used deltas) is the device-side analog of
+    the leader's plan-apply commit (`nomad/plan_apply.go:204`): each eval's
+    placements consume capacity in the shared snapshot for the next round.
+    Conflicts (overcommit) are detected host-side exactly as the reference's
+    `evaluateNodePlan` does; this step only advances the optimistic view.
+    """
+    result = _batch_place(cluster, batch, max_allocs)
+    delta = jnp.sum(result.new_used - cluster.used[None, :, :], axis=0)
+    new_cluster = cluster._replace(used=cluster.used + delta)
+    return new_cluster, result
+
+
+def scheduler_step(mesh: Mesh, max_allocs: int):
+    """Jitted full step (placement + snapshot advance) under mesh shardings.
+    This is the function `__graft_entry__.dryrun_multichip` compiles."""
+    cs = cluster_sharding(mesh)
+    in_shardings = (cs, params_sharding(mesh, batched=True))
+    return jax.jit(
+        functools.partial(_step, max_allocs=max_allocs),
+        in_shardings=in_shardings,
+        out_shardings=(cs, None),
+    )

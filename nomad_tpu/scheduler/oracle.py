@@ -141,6 +141,9 @@ def select_option(
     spreads = list(tg.spreads) + list(job.spreads)
 
     best: Optional[OracleOption] = None
+    # Per-select spread use maps (reference propertySet counts are maintained
+    # incrementally, propertyset.go:132; build once per Select, not per node)
+    spread_use_maps: Optional[List[Dict[str, int]]] = None
     candidates = ctx.nodes if sampled is None else ctx.nodes[:sampled]
     for node in candidates:
         if not node.ready():
@@ -223,7 +226,13 @@ def select_option(
 
         # Spread (spread.go:120)
         if spreads:
-            sboost = _spread_score(ctx, job, tg, spreads, node)
+            if spread_use_maps is None:
+                spread_use_maps = [
+                    _spread_use_map(ctx, job, tg,
+                                    target_to_key(s.attribute) or s.attribute)
+                    for s in spreads
+                ]
+            sboost = _spread_score(spreads, spread_use_maps, tg, node)
             if sboost != 0.0:
                 scores.append(sboost)
 
@@ -233,8 +242,26 @@ def select_option(
     return best
 
 
+def _spread_use_map(ctx: OracleContext, job: Job, tg: TaskGroup, key: str
+                    ) -> Dict[str, int]:
+    """Combined property-value use map for this task group over proposed
+    allocs (reference propertyset.go:132,160)."""
+    use: Dict[str, int] = {}
+    for n2 in ctx.nodes:
+        props = ctx.proposed_allocs(n2.id)
+        cnt = sum(
+            1 for a in props
+            if a.job_id == job.id and a.task_group == tg.name
+        )
+        if cnt:
+            val, ok = _node_property(n2, key)
+            if ok:
+                use[val] = use.get(val, 0) + cnt
+    return use
+
+
 def _spread_score(
-    ctx: OracleContext, job: Job, tg: TaskGroup, spreads, node: Node
+    spreads, use_maps: List[Dict[str, int]], tg: TaskGroup, node: Node
 ) -> float:
     """Reference SpreadIterator.Next (spread.go:110) + evenSpreadScoreBoost
     (:178). Property counts include existing (non-terminal) allocs of the job's
@@ -242,21 +269,8 @@ def _spread_score(
     each alloc's node (propertyset.go:132,160)."""
     sum_weights = sum(s.weight for s in spreads)
     total = 0.0
-    nodes_by_id = {n.id: n for n in ctx.nodes}
-    for spread in spreads:
+    for spread, use in zip(spreads, use_maps):
         key = target_to_key(spread.attribute) or spread.attribute
-        # Build combined use map for this tg over proposed allocs
-        use: Dict[str, int] = {}
-        for n2 in ctx.nodes:
-            props = ctx.proposed_allocs(n2.id)
-            cnt = sum(
-                1 for a in props
-                if a.job_id == job.id and a.task_group == tg.name
-            )
-            if cnt:
-                val, ok = _node_property(n2, key)
-                if ok:
-                    use[val] = use.get(val, 0) + cnt
         nval, ok = _node_property(node, key)
         if not ok:
             total -= 1.0

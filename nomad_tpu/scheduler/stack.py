@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..kernels.placement import ClusterArrays, PlacementResult, TGParams
+from ..utils import bucket as _shared_bucket, widen_lut
 from ..structs import Allocation, Job, TaskGroup
 from ..structs.job import CONSTRAINT_DISTINCT_HOSTS
 from ..tensor.cluster import R_TOTAL, ClusterTensors
@@ -30,10 +31,7 @@ from .oracle import OracleContext, driver_ok, meets_constraints
 
 
 def _bucket(n: int, lo: int = 1) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+    return _shared_bucket(n, lo)
 
 
 @dataclass

@@ -1,0 +1,27 @@
+"""Shared shape/bucketing helpers used by the program compiler and the
+multi-chip batching layer. The power-of-two bucketing policy lives here ONCE:
+it controls jit recompilation behavior, and the per-eval compiler
+(`scheduler/stack.py`) and the batch padder (`parallel/mesh.py`) must agree.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def bucket(n: int, lo: int = 1) -> int:
+    """Smallest power of two ≥ n (and ≥ lo)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def widen_lut(a: np.ndarray, v: int, fill) -> np.ndarray:
+    """Widen a [*, V] LUT-style array to V=v columns, keeping the
+    missing-token slot in the LAST column (kernels map token −1 → V−1)."""
+    if a.shape[-1] == v:
+        return a
+    out = np.full(a.shape[:-1] + (v,), fill, dtype=a.dtype)
+    out[..., : a.shape[-1] - 1] = a[..., : a.shape[-1] - 1]
+    out[..., -1] = a[..., -1]
+    return out

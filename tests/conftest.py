@@ -11,4 +11,13 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon sitecustomize re-selects "axon,cpu" via jax.config at interpreter
+# start, overriding JAX_PLATFORMS — force cpu back explicitly. Set
+# NOMAD_TPU_TEST_PLATFORM to run the suite on real hardware instead.
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_platforms", os.environ.get("NOMAD_TPU_TEST_PLATFORM", "cpu")
+)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
