@@ -55,6 +55,7 @@ from .util import (
     State,
     adjust_queued_allocations,
     progress_made,
+    proposed_allocs,
     ready_nodes_in_dcs,
     retry_max,
     tainted_nodes,
@@ -397,34 +398,41 @@ class GenericScheduler:
         return ctx
 
     def _allocated_resources(self, tg: TaskGroup, node) -> AllocatedResources:
-        """Grant resources + assign ports for the placement (reference:
-        BinPackIterator's per-task network/port assignment, rank.go:231-320).
-        Port assignment happens host-side against the node's NetworkIndex."""
-        tasks: Dict[str, AllocatedTaskResources] = {}
-        shared = AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb)
+        return allocated_resources(self.state, self.plan, tg, node)
 
-        net_idx: Optional[NetworkIndex] = None
-        if node is not None:
-            net_idx = NetworkIndex()
-            net_idx.set_node(node)
-            net_idx.add_allocs(self.state.allocs_by_node(node.id))
 
-        for t in tg.tasks:
-            tr = AllocatedTaskResources(
-                cpu=t.resources.cpu, memory_mb=t.resources.memory_mb
-            )
-            for ask in t.resources.networks:
-                if net_idx is not None:
-                    offer, err = net_idx.assign_network(ask)
-                    if offer is not None:
-                        net_idx.add_reserved(offer)
-                        tr.networks.append(offer)
-            tasks[t.name] = tr
+def allocated_resources(state: State, plan: Plan, tg: TaskGroup, node
+                        ) -> AllocatedResources:
+    """Grant resources + assign ports for a placement (reference:
+    BinPackIterator's per-task network/port assignment, rank.go:231-320).
+    Port assignment happens host-side against the node's NetworkIndex built
+    from plan-relative proposed allocs — otherwise two allocs of one eval on
+    one node double-book dynamic ports and the plan applier rejects it."""
+    tasks: Dict[str, AllocatedTaskResources] = {}
+    shared = AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb)
 
-        for ask in tg.networks:
+    net_idx: Optional[NetworkIndex] = None
+    if node is not None:
+        net_idx = NetworkIndex()
+        net_idx.set_node(node)
+        net_idx.add_allocs(proposed_allocs(state, plan, node.id))
+
+    for t in tg.tasks:
+        tr = AllocatedTaskResources(
+            cpu=t.resources.cpu, memory_mb=t.resources.memory_mb
+        )
+        for ask in t.resources.networks:
             if net_idx is not None:
                 offer, err = net_idx.assign_network(ask)
                 if offer is not None:
                     net_idx.add_reserved(offer)
-                    shared.networks.append(offer)
-        return AllocatedResources(tasks=tasks, shared=shared)
+                    tr.networks.append(offer)
+        tasks[t.name] = tr
+
+    for ask in tg.networks:
+        if net_idx is not None:
+            offer, err = net_idx.assign_network(ask)
+            if offer is not None:
+                net_idx.add_reserved(offer)
+                shared.networks.append(offer)
+    return AllocatedResources(tasks=tasks, shared=shared)

@@ -32,7 +32,7 @@ from ..structs import (
     filter_terminal_allocs,
 )
 from ..tensor.cluster import ClusterTensors
-from .generic import GenericScheduler
+from .generic import allocated_resources
 from .reconcile import ALLOC_LOST, ALLOC_NOT_NEEDED, ALLOC_UPDATING
 from .stack import PlanContext, TPUStack
 from .util import (
@@ -248,9 +248,6 @@ class SystemScheduler:
                         self.failed_tg_allocs[tg.name] = metrics
                     continue
                 node = self.state.node_by_id(node_id)
-                # Reuse the generic resource-granting path
-                gs = GenericScheduler.__new__(GenericScheduler)
-                gs.state = self.state
                 alloc = Allocation(
                     id=str(uuid.uuid4()),
                     namespace=self.job.namespace,
@@ -262,8 +259,8 @@ class SystemScheduler:
                     metrics=metrics,
                     node_id=node_id,
                     node_name=node.name if node else "",
-                    allocated_resources=GenericScheduler._allocated_resources(
-                        gs, tg, node
+                    allocated_resources=allocated_resources(
+                        self.state, self.plan, tg, node
                     ),
                     desired_status=ALLOC_DESIRED_RUN,
                     client_status=ALLOC_CLIENT_PENDING,

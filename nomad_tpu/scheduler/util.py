@@ -63,6 +63,26 @@ class SchedulerConfiguration:
         self.preemption_batch_enabled = preemption_batch
 
 
+def proposed_allocs(state: State, plan: Plan, node_id: str) -> List[Allocation]:
+    """Plan-relative proposed allocations on a node (reference
+    EvalContext.ProposedAllocs, scheduler/context.go:120): non-terminal state
+    allocs − in-plan stops/preemptions + in-plan placements, deduped by id
+    (in-place updates appear in both state and plan)."""
+    removed = {
+        a.id
+        for a in plan.node_update.get(node_id, [])
+        + plan.node_preemptions.get(node_id, [])
+    }
+    by_id = {
+        a.id: a
+        for a in state.allocs_by_node(node_id)
+        if not a.terminal_status() and a.id not in removed
+    }
+    for a in plan.node_allocation.get(node_id, []):
+        by_id[a.id] = a
+    return list(by_id.values())
+
+
 def ready_nodes_in_dcs(state: State, datacenters: List[str]
                        ) -> Tuple[List[Node], Dict[str, int]]:
     """Reference readyNodesInDCs (util.go:233): ready nodes in the job's DCs

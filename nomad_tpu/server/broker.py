@@ -1,0 +1,290 @@
+"""EvalBroker — priority queue of pending evaluations with at-least-once
+delivery.
+
+Behavioral reference: `nomad/eval_broker.go` (EvalBroker :47, Enqueue :181,
+Dequeue :329, Ack :531, Nack :595, runDelayedEvalsWatcher :751):
+
+- per-scheduler-type priority heaps of ready evals
+- per-(namespace, job) serialization: only one eval of a job outstanding at a
+  time; later evals for the same job wait in a per-job pending heap and are
+  released on Ack (structs.go:9524 contract — this is what makes whole
+  dequeued batches safe to schedule concurrently)
+- ack/nack with a nack timeout (auto-requeue on worker death) and a delivery
+  limit, after which the eval lands in a `failed-queue` served last
+- delayed evals (`wait_until`) sit in a time-ordered heap drained by a
+  watcher thread
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..structs import Evaluation
+
+FAILED_QUEUE = "_failed"
+DEFAULT_NACK_TIMEOUT = 5.0
+DEFAULT_DELIVERY_LIMIT = 3
+
+
+class _Unack:
+    __slots__ = ("eval", "token", "timer", "dequeues")
+
+    def __init__(self, eval: Evaluation, token: str, dequeues: int) -> None:
+        self.eval = eval
+        self.token = token
+        self.timer: Optional[threading.Timer] = None
+        self.dequeues = dequeues
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT) -> None:
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._enabled = False
+        self._seq = itertools.count()
+        # scheduler type -> heap of (-priority, seq, eval)
+        self._ready: Dict[str, List[Tuple[int, int, Evaluation]]] = {}
+        self._unack: Dict[str, _Unack] = {}
+        # (namespace, job_id) -> outstanding eval id
+        self._job_outstanding: Dict[Tuple[str, str], str] = {}
+        # (namespace, job_id) -> pending heap (evals waiting on serialization)
+        self._job_pending: Dict[Tuple[str, str], List[Tuple[int, int, Evaluation]]] = {}
+        self._dequeues: Dict[str, int] = {}  # eval id -> delivery count
+        # delayed evals: (wait_until, seq, eval)
+        self._delayed: List[Tuple[float, int, Evaluation]] = []
+        self._delay_thread: Optional[threading.Thread] = None
+        self._shutdown = False
+        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0,
+                      "failed": 0}
+
+    # ---- lifecycle ----
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Leader gate (reference SetEnabled, eval_broker.go:131): flush on
+        disable."""
+        with self._cv:
+            self._enabled = enabled
+            if not enabled:
+                self._ready.clear()
+                self._unack.clear()
+                self._job_outstanding.clear()
+                self._job_pending.clear()
+                self._dequeues.clear()
+                self._delayed.clear()
+            else:
+                if self._delay_thread is None:
+                    self._delay_thread = threading.Thread(
+                        target=self._run_delayed_watcher, daemon=True
+                    )
+                    self._delay_thread.start()
+            self._cv.notify_all()
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    # ---- enqueue ----
+
+    def enqueue(self, eval: Evaluation) -> None:
+        with self._cv:
+            self._enqueue_locked(eval, token="")
+
+    def enqueue_all(self, evals: Dict[Evaluation, str]) -> None:
+        """Reference EnqueueAll (eval_broker.go:198): enqueue with tokens —
+        used for requeueing an updated eval while it is still outstanding."""
+        with self._cv:
+            for eval, token in evals.items():
+                self._process_waiting_locked(eval, token)
+                self._enqueue_locked(eval, token)
+
+    def _process_waiting_locked(self, eval: Evaluation, token: str) -> None:
+        # If outstanding under the same token, drop the outstanding slot so
+        # the requeued eval can be dequeued again after Ack.
+        un = self._unack.get(eval.id)
+        if un is not None and (not token or un.token == token):
+            if un.timer is not None:
+                un.timer.cancel()
+            self._unack.pop(eval.id, None)
+            self._job_outstanding.pop((eval.namespace, eval.job_id), None)
+
+    def _enqueue_locked(self, eval: Evaluation, token: str) -> None:
+        if not self._enabled:
+            return
+        now = time.time()
+        if eval.wait_until and eval.wait_until > now:
+            heapq.heappush(
+                self._delayed, (eval.wait_until, next(self._seq), eval)
+            )
+            self._cv.notify_all()
+            return
+        jk = (eval.namespace, eval.job_id)
+        outstanding = self._job_outstanding.get(jk)
+        if outstanding is not None and outstanding != eval.id:
+            heapq.heappush(
+                self._job_pending.setdefault(jk, []),
+                (-eval.priority, next(self._seq), eval),
+            )
+            return
+        queue = FAILED_QUEUE if self._dequeues.get(eval.id, 0) >= self.delivery_limit \
+            else eval.type
+        heapq.heappush(
+            self._ready.setdefault(queue, []),
+            (-eval.priority, next(self._seq), eval),
+        )
+        self.stats["enqueued"] += 1
+        self._cv.notify_all()
+
+    # ---- dequeue ----
+
+    def dequeue(self, schedulers: Sequence[str], timeout: Optional[float] = None
+                ) -> Tuple[Optional[Evaluation], str]:
+        """Blocking dequeue of the highest-priority ready eval for any of the
+        given scheduler types (reference Dequeue, eval_broker.go:329). The
+        failed-queue is eligible for every scheduler (served when nothing
+        else is ready)."""
+        deadline = time.time() + timeout if timeout is not None else None
+        with self._cv:
+            while True:
+                if self._shutdown:
+                    return None, ""
+                pick = self._pick_locked(schedulers)
+                if pick is not None:
+                    eval = pick
+                    token = str(uuid.uuid4())
+                    count = self._dequeues.get(eval.id, 0) + 1
+                    self._dequeues[eval.id] = count
+                    un = _Unack(eval, token, count)
+                    self._unack[eval.id] = un
+                    self._job_outstanding[(eval.namespace, eval.job_id)] = eval.id
+                    if self.nack_timeout > 0:
+                        un.timer = threading.Timer(
+                            self.nack_timeout, self._nack_timeout, (eval.id, token)
+                        )
+                        un.timer.daemon = True
+                        un.timer.start()
+                    self.stats["dequeued"] += 1
+                    return eval, token
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None, ""
+                self._cv.wait(remaining if remaining is not None else 1.0)
+
+    def _pick_locked(self, schedulers: Sequence[str]) -> Optional[Evaluation]:
+        best_q, best = None, None
+        for q in list(schedulers) + [FAILED_QUEUE]:
+            heap = self._ready.get(q)
+            # A copy of an eval that is currently outstanding cannot be
+            # delivered now, but the signal must not be lost — park it in the
+            # per-job pending queue; Ack releases it.
+            while heap and heap[0][2].id in self._unack:
+                stale = heapq.heappop(heap)
+                jk = (stale[2].namespace, stale[2].job_id)
+                heapq.heappush(self._job_pending.setdefault(jk, []), stale)
+            if not heap:
+                continue
+            cand = heap[0]
+            jk = (cand[2].namespace, cand[2].job_id)
+            out = self._job_outstanding.get(jk)
+            if out is not None and out != cand[2].id:
+                # Should not happen (serialized at enqueue) — requeue pending.
+                heapq.heappop(heap)
+                heapq.heappush(self._job_pending.setdefault(jk, []), cand)
+                continue
+            if best is None or cand[0] < best[0]:
+                best_q, best = q, cand
+        if best is None:
+            return None
+        heapq.heappop(self._ready[best_q])
+        return best[2]
+
+    # ---- ack / nack ----
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._cv:
+            un = self._unack.get(eval_id)
+            if un is None or un.token != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            if un.timer is not None:
+                un.timer.cancel()
+            del self._unack[eval_id]
+            self._dequeues.pop(eval_id, None)
+            jk = (un.eval.namespace, un.eval.job_id)
+            if self._job_outstanding.get(jk) == eval_id:
+                del self._job_outstanding[jk]
+            self.stats["acked"] += 1
+            # Release the next pending eval of this job (eval_broker.go:560)
+            pending = self._job_pending.get(jk)
+            if pending:
+                _, _, nxt = heapq.heappop(pending)
+                if not pending:
+                    del self._job_pending[jk]
+                self._enqueue_locked(nxt, token="")
+            self._cv.notify_all()
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._cv:
+            un = self._unack.get(eval_id)
+            if un is None or un.token != token:
+                raise ValueError(f"token mismatch for eval {eval_id}")
+            if un.timer is not None:
+                un.timer.cancel()
+            del self._unack[eval_id]
+            jk = (un.eval.namespace, un.eval.job_id)
+            if self._job_outstanding.get(jk) == eval_id:
+                del self._job_outstanding[jk]
+            self.stats["nacked"] += 1
+            if self._dequeues.get(eval_id, 0) >= self.delivery_limit:
+                self.stats["failed"] += 1
+            self._enqueue_locked(un.eval, token="")
+            self._cv.notify_all()
+
+    def _nack_timeout(self, eval_id: str, token: str) -> None:
+        try:
+            self.nack(eval_id, token)
+        except ValueError:
+            pass  # already acked/nacked
+
+    # ---- delayed evals ----
+
+    def _run_delayed_watcher(self) -> None:
+        """Reference runDelayedEvalsWatcher (eval_broker.go:751)."""
+        while True:
+            with self._cv:
+                if self._shutdown:
+                    return
+                now = time.time()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, eval = heapq.heappop(self._delayed)
+                    eval.wait_until = 0.0
+                    self._enqueue_locked(eval, token="")
+                wait = 1.0
+                if self._delayed:
+                    wait = max(min(self._delayed[0][0] - now, 1.0), 0.01)
+            time.sleep(wait)
+
+    # ---- introspection ----
+
+    def outstanding(self, eval_id: str, token: str) -> bool:
+        """Is this (eval, token) the current outstanding delivery? (reference
+        OutstandingReset, eval_broker.go — the plan applier's stale-plan gate)."""
+        with self._lock:
+            un = self._unack.get(eval_id)
+            return un is not None and un.token == token
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(len(h) for h in self._ready.values())
+
+    def unacked_count(self) -> int:
+        with self._lock:
+            return len(self._unack)

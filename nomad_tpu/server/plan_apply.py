@@ -1,0 +1,216 @@
+"""PlanQueue + plan applier — serialized optimistic verification of plans.
+
+Behavioral reference: `nomad/plan_queue.go` (:29, Enqueue :95, Dequeue :126)
+and `nomad/plan_apply.go` (planApply :71, applyPlan :204, evaluatePlan :400,
+evaluatePlanPlacements :437, evaluateNodePlan :629):
+
+- workers enqueue plans with a future; a single applier thread dequeues by
+  priority and verifies each touched node against the LATEST state (the
+  commit point of the optimistic concurrency scheme)
+- a node fails verification if its proposed alloc set (state allocs − plan
+  stops/preemptions + plan placements) does not fit → that node's placements
+  (and dependent preemptions) are dropped and the result is a partial commit
+  with `refresh_index` set, telling the worker to retry on fresher state
+- committed results are applied to the store in one indexed write
+  (`UpsertPlanResults`, the FSM `ApplyPlanResultsRequest` analog)
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..scheduler.util import proposed_allocs
+from ..structs import Allocation, Node, Plan, PlanResult, allocs_fit
+from .state import StateStore
+
+
+class _Future:
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+        self.result: Optional[PlanResult] = None
+        self.error: Optional[Exception] = None
+
+    def set(self, result: Optional[PlanResult], error: Optional[Exception] = None
+            ) -> None:
+        self.result = result
+        self.error = error
+        self._ev.set()
+
+    def wait(self, timeout: Optional[float] = None) -> PlanResult:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("plan apply timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class PlanQueue:
+    """Priority queue of pending plans (reference plan_queue.go:29)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._heap: List[Tuple[int, int, Plan, _Future]] = []
+        self._seq = itertools.count()
+        self._enabled = False
+        self._shutdown = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._cv:
+            self._enabled = enabled
+            if not enabled:
+                for _, _, _, fut in self._heap:
+                    fut.set(None, RuntimeError("plan queue disabled"))
+                self._heap.clear()
+            self._cv.notify_all()
+
+    def enqueue(self, plan: Plan) -> _Future:
+        fut = _Future()
+        with self._cv:
+            if not self._enabled:
+                fut.set(None, RuntimeError("plan queue disabled"))
+                return fut
+            heapq.heappush(
+                self._heap, (-plan.priority, next(self._seq), plan, fut)
+            )
+            self._cv.notify_all()
+        return fut
+
+    def dequeue(self, timeout: Optional[float] = None
+                ) -> Optional[Tuple[Plan, _Future]]:
+        import time
+
+        deadline = time.time() + timeout if timeout is not None else None
+        with self._cv:
+            while True:
+                if self._shutdown:
+                    return None
+                if self._heap:
+                    _, _, plan, fut = heapq.heappop(self._heap)
+                    return plan, fut
+                remaining = 1.0
+                if deadline is not None:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        return None
+                self._cv.wait(min(remaining, 1.0))
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            for _, _, _, fut in self._heap:
+                fut.set(None, RuntimeError("plan queue shutdown"))
+            self._heap.clear()
+            self._cv.notify_all()
+
+
+def evaluate_node_plan(state, plan: Plan, node_id: str) -> Tuple[bool, str]:
+    """Can this node accommodate the plan? (reference plan_apply.go:629)."""
+    has_update = bool(plan.node_update.get(node_id)) or bool(
+        plan.node_preemptions.get(node_id)
+    )
+    node = state.node_by_id(node_id)
+    if node is None:
+        return has_update and not plan.node_allocation.get(node_id), "node missing"
+    if has_update and not plan.node_allocation.get(node_id):
+        return True, ""  # evictions always apply
+    if node.terminal_status():
+        return False, "node is down"
+    if node.drain is not None or node.scheduling_eligibility != "eligible":
+        return False, "node is not eligible"
+
+    proposed = proposed_allocs(state, plan, node_id)
+    fit, dim, _util = allocs_fit(node, proposed)
+    return fit, dim
+
+
+class PlanApplier:
+    """Single-threaded plan verification + commit loop (plan_apply.go:71)."""
+
+    def __init__(self, state: StateStore, queue: PlanQueue,
+                 broker=None) -> None:
+        self.state = state
+        self.queue = queue
+        self.broker = broker
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.stats = {"applied": 0, "partial": 0, "rejected_nodes": 0,
+                      "stale_token": 0}
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            item = self.queue.dequeue(timeout=0.5)
+            if item is None:
+                continue
+            plan, fut = item
+            try:
+                result = self.apply(plan)
+                fut.set(result)
+            except Exception as e:  # noqa: BLE001 — fail the waiting worker
+                fut.set(None, e)
+
+    def apply(self, plan: Plan) -> PlanResult:
+        """Verify against latest state, commit what fits (plan_apply.go:400)."""
+        # Token check (reference: the leader validates the worker still owns
+        # the eval before accepting its plan — Plan.Submit → evalBroker token
+        # validation, nomad/plan_endpoint.go:31). A nack-timeout redelivery
+        # must not let two workers commit plans for the same eval.
+        if self.broker is not None and plan.eval_token:
+            if not self.broker.outstanding(plan.eval_id, plan.eval_token):
+                self.stats["stale_token"] += 1
+                raise ValueError(
+                    f"plan for eval {plan.eval_id} has a stale token"
+                )
+        snap = self.state.snapshot()
+        result = PlanResult(
+            node_update={k: list(v) for k, v in plan.node_update.items()},
+            node_allocation={},
+            node_preemptions={},
+            deployment=plan.deployment,
+            deployment_updates=list(plan.deployment_updates),
+        )
+        partial = False
+        touched = set(plan.node_allocation) | set(plan.node_preemptions)
+        for node_id in touched:
+            fit, reason = evaluate_node_plan(snap, plan, node_id)
+            if fit:
+                if node_id in plan.node_allocation:
+                    result.node_allocation[node_id] = list(
+                        plan.node_allocation[node_id]
+                    )
+                if node_id in plan.node_preemptions:
+                    result.node_preemptions[node_id] = list(
+                        plan.node_preemptions[node_id]
+                    )
+            else:
+                partial = True
+                self.stats["rejected_nodes"] += 1
+        if partial and plan.all_at_once:
+            # all-at-once plans commit nothing on any failure — including the
+            # stops, or destructive updates would halt services with no
+            # replacement (plan_apply.go:486)
+            result.node_update.clear()
+            result.node_allocation.clear()
+            result.node_preemptions.clear()
+            result.deployment = None
+            result.deployment_updates = []
+
+        self.state.upsert_plan_results(plan, result)
+        result.alloc_index = self.state.index.value
+        if partial:
+            result.refresh_index = self.state.index.value
+            self.stats["partial"] += 1
+        self.stats["applied"] += 1
+        return result

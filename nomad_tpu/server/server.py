@@ -1,0 +1,312 @@
+"""Server — the single-process control plane wiring every leader subsystem.
+
+Behavioral reference: `nomad/server.go` (NewServer :289, setupWorkers :1419)
+and `nomad/leader.go` (establishLeadership :222 — broker/plan-queue/blocked
+enablement, restoreEvals :352). Raft replication is out of scope for the
+single-process build (the StateStore write path stands in for the FSM; its
+index is the Raft-index analog) — multi-server durability rides behind the
+same `apply_*` seams.
+
+Endpoint behaviors implemented as methods (HTTP layer calls these):
+- Job.Register/Deregister (`nomad/job_endpoint.go:79,772`)
+- Node.Register/UpdateStatus/UpdateDrain/Heartbeat (`nomad/node_endpoint.go`)
+- Node.UpdateAlloc — client status pushes creating reschedule evals
+  (`node_endpoint.go:1105`)
+- Eval.Ack/Nack/Dequeue pass-through (`nomad/eval_endpoint.go`)
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import Allocation, Evaluation, Job, Node
+from ..structs.evaluation import (
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_CANCELLED,
+    EVAL_STATUS_PENDING,
+    TRIGGER_ALLOC_STOP,
+    TRIGGER_JOB_DEREGISTER,
+    TRIGGER_JOB_REGISTER,
+    TRIGGER_NODE_UPDATE,
+    TRIGGER_RETRY_FAILED_ALLOC,
+)
+from ..structs.node import NODE_STATUS_DOWN, NODE_STATUS_READY
+from .blocked import BlockedEvals
+from .broker import EvalBroker
+from .heartbeat import HeartbeatTracker
+from .plan_apply import PlanApplier, PlanQueue
+from .state import StateStore
+from .worker import Worker
+
+
+class ServerConfig:
+    def __init__(self, num_schedulers: int = 1, heartbeat_ttl: float = 10.0,
+                 nack_timeout: float = 60.0):
+        self.num_schedulers = num_schedulers
+        self.heartbeat_ttl = heartbeat_ttl
+        self.nack_timeout = nack_timeout
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None) -> None:
+        self.config = config or ServerConfig()
+        self.state = StateStore()
+        self.broker = EvalBroker(nack_timeout=self.config.nack_timeout)
+        self.blocked = BlockedEvals(self.broker)
+        self.plan_queue = PlanQueue()
+        self.planner = PlanApplier(self.state, self.plan_queue,
+                                   broker=self.broker)
+        self.workers: List[Worker] = [
+            Worker(self, i) for i in range(self.config.num_schedulers)
+        ]
+        self.heartbeater = HeartbeatTracker(
+            ttl=self.config.heartbeat_ttl, on_expire=self._heartbeat_expired
+        )
+        self._running = False
+
+    # ---- lifecycle (leader.go:222 establishLeadership) ----
+
+    def start(self) -> None:
+        self.broker.set_enabled(True)
+        self.blocked.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self.planner.start()
+        for w in self.workers:
+            w.start()
+        self.heartbeater.start()
+        # Arm TTL timers for nodes already in state (reference
+        # initializeHeartbeatTimers on establishLeadership, heartbeat.go:24)
+        for node in self.state.nodes():
+            if not node.terminal_status():
+                self.heartbeater.reset(node.id)
+        self._running = True
+
+    def shutdown(self) -> None:
+        self._running = False
+        self.heartbeater.shutdown()
+        for w in self.workers:
+            w.shutdown()
+        self.planner.shutdown()
+        self.broker.shutdown()
+        for w in self.workers:
+            w.join()
+
+    # ---- eval application (FSM upsertEvals analog, fsm.go:692) ----
+
+    def apply_eval_update(self, eval: Evaluation, reblock: bool = False) -> None:
+        self.state.upsert_eval(eval)
+        if reblock or eval.should_block():
+            self.blocked.block(eval)
+            for dup in self.blocked.duplicates():
+                dup.status = EVAL_STATUS_CANCELLED
+                dup.status_description = "cancelled due to duplicate blocked eval"
+                self.state.upsert_eval(dup)
+        elif eval.should_enqueue():
+            self.broker.enqueue(eval)
+
+    def _create_eval(self, **kwargs) -> Evaluation:
+        eval = Evaluation(**kwargs)
+        eval.create_time = eval.modify_time = time.time()
+        self.apply_eval_update(eval)
+        return eval
+
+    # ---- Job endpoint (job_endpoint.go:79) ----
+
+    def job_register(self, job: Job) -> Evaluation:
+        err = job.validate() if hasattr(job, "validate") else None
+        if err:
+            raise ValueError(err)
+        existing = self.state.job_by_id(job.namespace, job.id)
+        if existing is not None and existing.job_modify_index:
+            job.version = existing.version + 1
+        self.state.upsert_job(job)
+        return self._create_eval(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_REGISTER,
+            job_id=job.id,
+            job_modify_index=job.modify_index,
+            status=EVAL_STATUS_PENDING,
+        )
+
+    def job_deregister(self, namespace: str, job_id: str) -> Optional[Evaluation]:
+        import copy
+
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        job = copy.copy(job)  # snapshots keep the pre-stop view
+        job.stop = True
+        self.state.upsert_job(job)
+        return self._create_eval(
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            job_modify_index=job.modify_index,
+            status=EVAL_STATUS_PENDING,
+        )
+
+    # ---- Node endpoint (node_endpoint.go) ----
+
+    def node_register(self, node: Node) -> None:
+        if not node.computed_class:
+            node.compute_class()
+        was = self.state.node_by_id(node.id)
+        self.state.upsert_node(node)
+        self.heartbeater.reset(node.id)
+        if node.status == NODE_STATUS_READY:
+            # capacity may have appeared (node_endpoint.go:270)
+            self.blocked.unblock(node.computed_class, self.state.index.value)
+            if was is None or not was.ready():
+                self._create_node_evals_for_system_jobs(node)
+
+    def node_heartbeat(self, node_id: str) -> bool:
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return False
+        self.heartbeater.reset(node_id)
+        return True
+
+    def _heartbeat_expired(self, node_id: str) -> None:
+        """TTL missed → mark down + create evals (heartbeat.go:135)."""
+        self.node_update_status(node_id, NODE_STATUS_DOWN,
+                                "heartbeat missed")
+
+    def node_update_status(self, node_id: str, status: str,
+                           description: str = "") -> List[Evaluation]:
+        import copy
+
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return []
+        node = copy.copy(node)
+        node.status = status
+        node.status_description = description
+        self.state.upsert_node(node)
+        evals = []
+        if status == NODE_STATUS_DOWN:
+            self.heartbeater.remove(node_id)
+            evals = self._create_node_evals(node_id)
+        elif status == NODE_STATUS_READY:
+            self.heartbeater.reset(node_id)
+            self.blocked.unblock(node.computed_class, self.state.index.value)
+            self.blocked.unblock_node(node_id, self.state.index.value)
+        return evals
+
+    def node_update_drain(self, node_id: str, drain) -> List[Evaluation]:
+        import copy
+
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return []
+        node = copy.copy(node)
+        node.drain = drain
+        self.state.upsert_node(node)
+        return self._create_node_evals(node_id)
+
+    def node_update_eligibility(self, node_id: str, eligibility: str) -> None:
+        import copy
+
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return
+        node = copy.copy(node)
+        node.scheduling_eligibility = eligibility
+        self.state.upsert_node(node)
+        if eligibility == "eligible":
+            self.blocked.unblock(node.computed_class, self.state.index.value)
+
+    def _create_node_evals(self, node_id: str) -> List[Evaluation]:
+        """One eval per job with allocs on the node (node_endpoint.go:178)."""
+        jobs = {}
+        for a in self.state.allocs_by_node(node_id):
+            if a.job is not None:
+                jobs[(a.namespace, a.job_id)] = a.job
+        evals = []
+        for (ns, job_id), job in jobs.items():
+            evals.append(self._create_eval(
+                namespace=ns,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=TRIGGER_NODE_UPDATE,
+                job_id=job_id,
+                node_id=node_id,
+                node_modify_index=self.state.index.value,
+                status=EVAL_STATUS_PENDING,
+            ))
+        return evals
+
+    def _create_node_evals_for_system_jobs(self, node: Node) -> None:
+        """New ready node → evaluate system jobs (node_endpoint.go:178 path)."""
+        for (ns, job_id), job in list(self.state._jobs.items()):
+            if job.type == "system" and node.datacenter in job.datacenters:
+                self._create_eval(
+                    namespace=ns,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=TRIGGER_NODE_UPDATE,
+                    job_id=job_id,
+                    node_id=node.id,
+                    status=EVAL_STATUS_PENDING,
+                )
+
+    def node_update_allocs(self, updates: List[Allocation]) -> None:
+        """Client pushes alloc status (node_endpoint.go:1013 UpdateAlloc):
+        merge; terminal allocs free capacity (unblock) and failed allocs
+        trigger reschedule evals."""
+        jobs_to_eval: Dict[Tuple[str, str], Job] = {}
+        for up in updates:
+            merged = self.state.update_alloc_from_client(up)
+            if merged is None:
+                continue
+            if merged.terminal_status():
+                node = self.state.node_by_id(merged.node_id)
+                if node is not None:
+                    self.blocked.unblock(
+                        node.computed_class, self.state.index.value
+                    )
+                    self.blocked.unblock_node(node.id, self.state.index.value)
+                if merged.client_status == "failed" and merged.job is not None:
+                    jobs_to_eval[(merged.namespace, merged.job_id)] = merged.job
+        for (ns, job_id), job in jobs_to_eval.items():
+            self._create_eval(
+                namespace=ns,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=TRIGGER_RETRY_FAILED_ALLOC,
+                job_id=job_id,
+                status=EVAL_STATUS_PENDING,
+            )
+
+    # ---- test/ops helpers ----
+
+    def wait_for_eval(self, eval_id: str, statuses=("complete", "failed"),
+                      timeout: float = 10.0) -> Optional[Evaluation]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ev = self.state.eval_by_id(eval_id)
+            if ev is not None and ev.status in statuses:
+                return ev
+            time.sleep(0.02)
+        return None
+
+    def wait_for_allocs(self, namespace: str, job_id: str, n: int,
+                        timeout: float = 10.0) -> List[Allocation]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            allocs = [
+                a for a in self.state.allocs_by_job(namespace, job_id)
+                if not a.terminal_status()
+            ]
+            if len(allocs) >= n:
+                return allocs
+            time.sleep(0.02)
+        return [
+            a for a in self.state.allocs_by_job(namespace, job_id)
+            if not a.terminal_status()
+        ]

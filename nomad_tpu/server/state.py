@@ -1,0 +1,167 @@
+"""StateStore — the server's authoritative state with MVCC-style snapshots
+and blocking queries.
+
+Behavioral reference: `nomad/state/state_store.go` (StateStore :57,
+SnapshotMinIndex :127, BlockingQuery :201, UpsertPlanResults :240). The
+reference uses go-memdb immutable-radix trees for O(1) snapshots; here
+snapshots shallow-copy the table maps under the store lock (alloc inner maps
+are copy-on-write in the mutators so a snapshot's views never see in-place
+mutation). The cluster tensor view (`ClusterTensors`) is intentionally shared
+live: kernels may read slightly-stale rows, and the plan applier re-verifies
+every touched node (`evaluateNodePlan`) exactly as the reference's optimistic
+concurrency does (`nomad/plan_apply.go:629`).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..scheduler.harness import InMemState
+from ..structs import Allocation, Node
+
+
+class _IndexCounter:
+    """next()-able Raft-index analog that remembers the last value."""
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def __next__(self) -> int:
+        self.value += 1
+        return self.value
+
+
+class StateSnapshot(InMemState):
+    """A point-in-time read view implementing the scheduler `State` protocol.
+    Never mutate a snapshot."""
+
+    def __init__(self, store: "StateStore") -> None:  # noqa: D401
+        # Deliberately no super().__init__: share/copy the store's tables.
+        self._nodes = dict(store._nodes)
+        self._jobs = dict(store._jobs)
+        self._job_versions = dict(store._job_versions)
+        self._allocs = dict(store._allocs)
+        self._allocs_by_job = dict(store._allocs_by_job)
+        self._allocs_by_node = dict(store._allocs_by_node)
+        self._deployments = dict(store._deployments)
+        self._evals = dict(store._evals)
+        self._config = store._config
+        self.index = store.index
+        self.cluster = store.cluster
+        self.index_at = store.index.value
+
+
+class StateStore(InMemState):
+    """Thread-safe store with index watching (blocking queries)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.index = _IndexCounter()
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+
+    # -- copy-on-write alloc indexes so snapshots are iteration-safe --
+
+    def upsert_alloc(self, alloc: Allocation) -> None:
+        with self._cv:
+            jk = (alloc.namespace, alloc.job_id)
+            prev = self._allocs.get(alloc.id)
+            if prev is not None and prev.node_id != alloc.node_id:
+                old = dict(self._allocs_by_node.get(prev.node_id, {}))
+                old.pop(alloc.id, None)
+                self._allocs_by_node[prev.node_id] = old
+            self._allocs[alloc.id] = alloc
+            alloc.modify_index = next(self.index)
+            if not alloc.create_index:
+                alloc.create_index = alloc.modify_index
+            by_job = dict(self._allocs_by_job.get(jk, {}))
+            by_job[alloc.id] = alloc
+            self._allocs_by_job[jk] = by_job
+            by_node = dict(self._allocs_by_node.get(alloc.node_id, {}))
+            by_node[alloc.id] = alloc
+            self._allocs_by_node[alloc.node_id] = by_node
+            self.cluster.upsert_alloc(alloc)
+            self._cv.notify_all()
+
+    # -- locked mutators --
+
+    def _locked(name):  # noqa: N805 — decorator factory over parent methods
+        parent = getattr(InMemState, name)
+
+        def method(self, *args, **kwargs):
+            with self._cv:
+                out = parent(self, *args, **kwargs)
+                self._cv.notify_all()
+                return out
+
+        method.__name__ = name
+        return method
+
+    upsert_node = _locked("upsert_node")
+    delete_node = _locked("delete_node")
+    upsert_job = _locked("upsert_job")
+    upsert_deployment = _locked("upsert_deployment")
+    upsert_eval = _locked("upsert_eval")
+    upsert_plan_results = _locked("upsert_plan_results")
+    del _locked
+
+    def update_alloc_from_client(self, update: Allocation) -> Optional[Allocation]:
+        """Client status push (reference `Node.UpdateAlloc` →
+        `state.UpdateAllocsFromClient`, state_store.go:2380): merge client
+        fields onto the server's copy."""
+        import copy
+
+        with self._cv:
+            existing = self._allocs.get(update.id)
+            if existing is None:
+                return None
+            merged = copy.copy(existing)
+            merged.client_status = update.client_status
+            merged.client_description = getattr(update, "client_description", "")
+            merged.task_states = dict(update.task_states)
+            merged.deployment_status = update.deployment_status or merged.deployment_status
+            self.upsert_alloc(merged)
+            self._cv.notify_all()
+            return merged
+
+    # -- snapshots & blocking --
+
+    def snapshot(self) -> StateSnapshot:
+        with self._lock:
+            return StateSnapshot(self)
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0
+                           ) -> Optional[StateSnapshot]:
+        """Reference SnapshotMinIndex (state_store.go:127): wait until the
+        store has applied at least `index`, then snapshot."""
+        deadline = None
+        with self._cv:
+            import time
+
+            deadline = time.time() + timeout
+            while self.index.value < index:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return StateSnapshot(self)
+
+    def blocking_query(self, fetch: Callable[[StateSnapshot], Tuple[int, object]],
+                       min_index: int = 0, timeout: float = 30.0):
+        """Reference blocking query (state_store.go:201 / http helpers): run
+        `fetch` on a snapshot; if its reported index ≤ min_index, wait for a
+        write and re-run until timeout."""
+        import time
+
+        deadline = time.time() + timeout
+        while True:
+            snap = self.snapshot()
+            idx, result = fetch(snap)
+            if idx > min_index:
+                return idx, result
+            with self._cv:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return idx, result
+                if self.index.value == snap.index_at:
+                    self._cv.wait(min(remaining, 1.0))

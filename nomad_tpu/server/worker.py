@@ -1,0 +1,135 @@
+"""Worker — dequeue evaluations, run the scheduler, submit plans.
+
+Behavioral reference: `nomad/worker.go` (Worker :54, run :105,
+dequeueEvaluation :142, snapshotMinIndex :228, invokeScheduler :244,
+SubmitPlan :277, UpdateEval :346, CreateEval :378, ReblockEval :410).
+
+The TPU twist: workers exist for lifecycle/ack semantics, but heavy lifting
+happens in the placement kernels, so a single worker with batched dispatch
+is the intended steady state (the eval-batch axis replaces the reference's
+NumCPU worker goroutines).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from ..scheduler.generic import GenericScheduler
+from ..scheduler.system import SystemScheduler
+from ..structs import Evaluation, Plan, PlanResult
+from ..structs.evaluation import EVAL_STATUS_BLOCKED
+
+SCHEDULER_TYPES = ("service", "batch", "system")
+
+
+class Worker:
+    """One scheduling worker thread implementing the Planner protocol."""
+
+    def __init__(self, server, worker_id: int = 0) -> None:
+        self.server = server
+        self.id = worker_id
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # per-eval context
+        self._eval: Optional[Evaluation] = None
+        self._token: str = ""
+        self._snapshot = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"worker-{self.id}", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 2.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            eval, token = self.server.broker.dequeue(
+                SCHEDULER_TYPES, timeout=0.5
+            )
+            if eval is None:
+                continue
+            self.process_one(eval, token)
+
+    # ---- one evaluation ----
+
+    def process_one(self, eval: Evaluation, token: str) -> None:
+        """dequeue → wait-for-index → schedule → ack/nack (worker.go:105)."""
+        broker = self.server.broker
+        try:
+            snap = self.server.state.snapshot_min_index(
+                max(eval.modify_index, eval.job_modify_index), timeout=5.0
+            )
+            if snap is None:
+                broker.nack(eval.id, token)
+                return
+            self._eval = eval
+            self._token = token
+            self._snapshot = snap
+            eval.snapshot_index = snap.index_at
+            sched = self._make_scheduler(eval, snap)
+            sched.process(eval)
+            broker.ack(eval.id, token)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            try:
+                broker.nack(eval.id, token)
+            except ValueError:
+                pass
+        finally:
+            self._eval = None
+            self._token = ""
+            self._snapshot = None
+
+    def _make_scheduler(self, eval: Evaluation, snap):
+        """Reference scheduler.NewScheduler factory (scheduler.go:34)."""
+        if eval.type == "system":
+            return SystemScheduler(snap, self, snap.cluster)
+        return GenericScheduler(
+            snap, self, snap.cluster, is_batch=(eval.type == "batch")
+        )
+
+    # ---- Planner protocol (worker.go:277-438) ----
+
+    def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
+        plan.eval_token = self._token
+        plan.snapshot_index = self._snapshot.index_at if self._snapshot else 0
+        fut = self.server.plan_queue.enqueue(plan)
+        result = fut.wait(timeout=10.0)
+        if result is None:
+            raise RuntimeError("plan apply failed")
+        if result.refresh_index:
+            # Partial commit: hand the scheduler a fresher snapshot
+            # (worker.go:318-330).
+            new_snap = self.server.state.snapshot_min_index(
+                result.refresh_index, timeout=5.0
+            )
+            self._snapshot = new_snap
+            return result, new_snap
+        return result, None
+
+    def update_eval(self, eval: Evaluation) -> None:
+        self.server.apply_eval_update(eval)
+
+    def create_eval(self, eval: Evaluation) -> None:
+        # Stamp the snapshot the eval was created from (worker.go:378) —
+        # BlockedEvals.missed_unblock depends on it.
+        if not eval.snapshot_index and self._snapshot is not None:
+            eval.snapshot_index = self._snapshot.index_at
+        self.server.apply_eval_update(eval)
+
+    def reblock_eval(self, eval: Evaluation) -> None:
+        """Reference ReblockEval (worker.go:410): re-capture an already-blocked
+        eval with an updated snapshot index."""
+        eval.snapshot_index = self._snapshot.index_at if self._snapshot else 0
+        self.server.apply_eval_update(eval, reblock=True)

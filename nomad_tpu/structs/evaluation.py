@@ -90,6 +90,7 @@ class Evaluation:
             eval_id=self.id,
             priority=priority,
             job=job,
+            all_at_once=job.all_at_once if job is not None else False,
         )
 
     def create_blocked_eval(self, class_eligibility: Dict[str, bool], escaped: bool,
