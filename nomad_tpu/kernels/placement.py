@@ -28,7 +28,7 @@ mean normalization) mirror `scheduler/rank.go`: binpack :440-447 (always,
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -291,10 +291,12 @@ def place_task_group_batch(cluster: ClusterArrays, batch: TGParams,
 
 
 @jax.jit
-def system_feasibility(cluster: ClusterArrays, p: TGParams) -> jax.Array:
-    """System-scheduler mask: which nodes can run one alloc of this group
-    (reference `scheduler/system_sched.go:268` — per-node feasibility+fit,
-    no ranking across nodes)."""
+def system_feasibility(cluster: ClusterArrays, p: TGParams
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """System-scheduler masks: (constraint-feasible, feasible-and-fits) per
+    node (reference `scheduler/system_sched.go:268` — per-node
+    feasibility+fit, no ranking across nodes). The gap between the two masks
+    is the preemption-candidate set."""
     feas_c = _lut_gather(p.lut, p.key_idx, cluster.attrs)
     feas = cluster.node_ok & p.extra_mask & jnp.all(feas_c, axis=1)
     used = cluster.used
@@ -302,4 +304,4 @@ def system_feasibility(cluster: ClusterArrays, p: TGParams) -> jax.Array:
         used = used.at[p.delta_idx].add(-p.delta_res, mode="drop")
     util = used + p.ask[None, :]
     fits = jnp.all(util <= cluster.capacity, axis=1)
-    return feas & fits
+    return feas, feas & fits

@@ -1,0 +1,125 @@
+"""Preemption candidate-ranking kernel.
+
+Reference behavior being re-expressed: when normal bin-packing finds no node
+with room, `rank.go:228-448` retries each candidate with eviction enabled —
+a scalar per-node loop calling the greedy Preemptor. Here the *search over
+nodes* is one dense kernel: per node, sort that node's preemptible allocs by
+job priority ascending, prefix-scan the released resources, and find the
+minimal victim prefix whose release admits the ask. Scoring mirrors the
+reference's combination of bin-pack fit (after eviction, `funcs.go:175`) and
+the logistic net-priority preemption score (`rank.go:747-783`), mean-combined
+as ScoreNormalization does.
+
+The winning node's exact victim set is then refined host-side by the faithful
+greedy `scheduler/preemption.py` Preemptor (distance scoring + superset
+filter) — only the O(N·A) node scan belongs on the VPU.
+
+Shapes: N nodes × A candidate-alloc slots (bucketed). Ineligible slots
+(padding, priority delta < 10, same job) carry priority +INF so the sort
+pushes them past every real candidate and the cumulative-eligibility mask
+cuts any prefix that would include them.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .placement import ClusterArrays, TGParams, _lut_gather
+
+NEG_INF = -1e30
+INF_PRIO = 1e9
+
+PREEMPTION_SCORE_RATE = 0.0048
+PREEMPTION_SCORE_ORIGIN = 2048.0
+
+
+class PreemptionCandidates(NamedTuple):
+    """Per-node candidate-alloc table (host-built, device-resident)."""
+
+    prio: jax.Array    # f32[N, A] — victim job priority; +INF = ineligible/pad
+    usage: jax.Array   # f32[N, A, R] — per-alloc resource rows
+
+
+class PreemptionResult(NamedTuple):
+    best_row: jax.Array     # i32 — chosen node row, −1 if none feasible
+    best_k: jax.Array       # i32 — victims in the minimal prefix on that node
+    best_score: jax.Array   # f32 — combined normalized score
+    order: jax.Array        # i32[N, A] — priority-ascending sort permutation
+    feasible: jax.Array     # bool[N] — admits the ask after some eviction
+    scores: jax.Array       # f32[N] — per-node combined score (−inf infeasible)
+
+
+def preempt_rank(cluster: ClusterArrays, p: TGParams,
+                 cand: PreemptionCandidates) -> PreemptionResult:
+    cap = cluster.capacity
+    n, a = cand.prio.shape
+
+    # Constraint feasibility is identical to the placement kernel's.
+    feas_c = _lut_gather(p.lut, p.key_idx, cluster.attrs)
+    feas = cluster.node_ok & p.extra_mask & jnp.all(feas_c, axis=1)
+
+    used = cluster.used
+    if p.delta_idx.shape[0]:
+        used = used.at[p.delta_idx].add(-p.delta_res, mode="drop")
+
+    # Sort each node's candidates by priority ascending (victims cheapest
+    # first — reference filterAndGroupPreemptibleAllocs order).
+    order = jnp.argsort(cand.prio, axis=1)                      # i32[N, A]
+    prio_s = jnp.take_along_axis(cand.prio, order, axis=1)      # [N, A]
+    usage_s = jnp.take_along_axis(
+        cand.usage, order[:, :, None], axis=1
+    )                                                           # [N, A, R]
+
+    eligible = prio_s < INF_PRIO                                # [N, A]
+    # A prefix is valid only while every slot in it is eligible.
+    prefix_ok = jnp.cumprod(eligible.astype(jnp.int32), axis=1).astype(bool)
+
+    release = jnp.cumsum(usage_s, axis=1)                       # [N, A, R]
+    util_k = used[:, None, :] - release + p.ask[None, None, :]  # [N, A, R]
+    fits_k = jnp.all(util_k <= cap[:, None, :], axis=2) & prefix_ok
+
+    any_fit = jnp.any(fits_k, axis=1) & feas                    # [N]
+    # Minimal prefix: first k (1-based) where evicting k allocs admits ask.
+    k_idx = jnp.argmax(fits_k, axis=1)                          # [N] 0-based
+    k = k_idx + 1
+
+    # net priority of the minimal prefix (rank.go:747 netPriority).
+    psum = jnp.cumsum(jnp.where(eligible, prio_s, 0.0), axis=1)  # [N, A]
+    rows = jnp.arange(n)
+    max_p = prio_s[rows, k_idx]            # sorted ascending ⇒ last = max
+    sum_p = psum[rows, k_idx]
+    net_prio = jnp.where(max_p > 0, max_p + sum_p / jnp.maximum(max_p, 1.0),
+                         0.0)
+    pre_score = 1.0 / (
+        1.0 + jnp.exp(PREEMPTION_SCORE_RATE *
+                      (net_prio - PREEMPTION_SCORE_ORIGIN))
+    )
+
+    # Bin-pack score at the post-eviction utilization (funcs.go:175).
+    util_sel = util_k[rows, k_idx]                              # [N, R]
+    free_cpu = 1.0 - util_sel[:, 0] / jnp.maximum(cap[:, 0], 1.0)
+    free_ram = 1.0 - util_sel[:, 1] / jnp.maximum(cap[:, 1], 1.0)
+    total = jnp.exp2(free_cpu * 3.321928094887362) + jnp.exp2(
+        free_ram * 3.321928094887362
+    )
+    binpack = jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
+
+    combined = (binpack + pre_score) / 2.0
+    scores = jnp.where(any_fit, combined, NEG_INF)
+
+    best = jnp.argmax(scores)
+    found = scores[best] > NEG_INF
+    return PreemptionResult(
+        best_row=jnp.where(found, best, -1).astype(jnp.int32),
+        best_k=jnp.where(found, k[best], 0).astype(jnp.int32),
+        best_score=jnp.where(found, scores[best], 0.0),
+        order=order.astype(jnp.int32),
+        feasible=any_fit,
+        scores=scores,
+    )
+
+
+preempt_rank_jit = jax.jit(preempt_rank)

@@ -156,6 +156,10 @@ class GenericScheduler:
 
         config = self.state.scheduler_config()
         self.stack = TPUStack(self.cluster, algorithm=config.scheduler_algorithm)
+        self.preemption_enabled = (
+            config.preemption_batch_enabled if self.batch
+            else config.preemption_service_enabled
+        )
 
         err = self._compute_job_allocs()
         if err is not None:
@@ -310,9 +314,25 @@ class GenericScheduler:
 
             for i, (p, prev, _dest) in enumerate(entries):
                 node_id = result.node_ids[i]
+                score = result.scores[i]
+                victims: List[Allocation] = []
                 metrics = AllocMetric()
                 metrics.nodes_evaluated = len(_nodes)
                 metrics.nodes_available = dict(by_dc)
+                if node_id is None and self.preemption_enabled:
+                    # Second pass with eviction enabled (reference
+                    # selectNextOption, generic_sched.go:720-738)
+                    from .preemption import find_preemption_placement
+
+                    params, _m = self.stack.compile_tg(
+                        self.job, tg, 1, self._plan_context_for(tg, [(p, prev, _dest)])
+                    )
+                    found = find_preemption_placement(
+                        self.state, self.cluster, self.job, tg, params,
+                        self.plan,
+                    )
+                    if found is not None:
+                        node_id, victims, score = found
                 if node_id is None:
                     # Failed placement (generic_sched.go:620 failedTGAllocs)
                     existing = self.failed_tg_allocs.get(tg.name)
@@ -330,8 +350,16 @@ class GenericScheduler:
                     continue
 
                 node = self.state.node_by_id(node_id)
+                alloc_id = str(uuid.uuid4())
+                if victims:
+                    # Victims must enter the plan BEFORE allocated_resources
+                    # builds the NetworkIndex, so the new alloc can claim the
+                    # ports/bandwidth they release (handlePreemptions,
+                    # generic_sched.go:742).
+                    for v in victims:
+                        self.plan.append_preempted_alloc(v, alloc_id)
                 alloc = Allocation(
-                    id=str(uuid.uuid4()),
+                    id=alloc_id,
                     namespace=self.job.namespace,
                     eval_id=self.eval.id,
                     name=p.name,
@@ -347,8 +375,9 @@ class GenericScheduler:
                     client_status=ALLOC_CLIENT_PENDING,
                     job_version=self.job.version,
                 )
-                alloc.metrics.score_node(node_id, "normalized-score",
-                                         result.scores[i])
+                alloc.metrics.score_node(node_id, "normalized-score", score)
+                if victims:
+                    alloc.preempted_allocations = [v.id for v in victims]
                 if prev is not None:
                     alloc.previous_allocation = prev.id
                     if p.reschedule:

@@ -141,6 +141,9 @@ class InMemState:
     def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
         return self._jobs.get((namespace, job_id))
 
+    def jobs(self) -> List[Job]:
+        return list(self._jobs.values())
+
     def job_by_id_and_version(self, namespace: str, job_id: str, version: int
                               ) -> Optional[Job]:
         return self._job_versions.get((namespace, job_id, version))

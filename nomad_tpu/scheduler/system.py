@@ -108,6 +108,7 @@ class SystemScheduler:
         self.plan = ev.make_plan(self.job)
         config = self.state.scheduler_config()
         self.stack = TPUStack(self.cluster, algorithm=config.scheduler_algorithm)
+        self.preemption_enabled = config.preemption_system_enabled
 
         err = self._compute_job_allocs()
         if err is not None:
@@ -231,11 +232,27 @@ class SystemScheduler:
                 plan_ctx.stopped_allocs.extend(stops)
             params, _m = self.stack.compile_tg(self.job, tg, len(entries), plan_ctx)
             arrays = self.stack.device_arrays()
-            mask = np.asarray(system_feasibility(arrays, _to_device(params)))
+            feas_mask, mask = system_feasibility(arrays, _to_device(params))
+            feas_mask, mask = np.asarray(feas_mask), np.asarray(mask)
 
             for node_id, prev in entries:
                 row = self.cluster.row_of.get(node_id)
                 ok = row is not None and bool(mask[row])
+                victims: List[Allocation] = []
+                if (
+                    not ok
+                    and row is not None
+                    and bool(feas_mask[row])
+                    and self.preemption_enabled
+                ):
+                    # Feasible but exhausted → evict lower-priority allocs
+                    # (system jobs preempt by default, stack.go:256-263)
+                    from .preemption import preempt_on_node
+
+                    victims = preempt_on_node(
+                        self.state, self.job, tg, node_id, self.plan
+                    )
+                    ok = bool(victims)
                 metrics = AllocMetric()
                 metrics.nodes_evaluated = 1
                 metrics.nodes_available = dict(self.nodes_by_dc)
@@ -248,8 +265,14 @@ class SystemScheduler:
                         self.failed_tg_allocs[tg.name] = metrics
                     continue
                 node = self.state.node_by_id(node_id)
+                alloc_id = str(uuid.uuid4())
+                if victims:
+                    # Same ordering contract as the generic scheduler: plan
+                    # preemptions precede the NetworkIndex build.
+                    for v in victims:
+                        self.plan.append_preempted_alloc(v, alloc_id)
                 alloc = Allocation(
-                    id=str(uuid.uuid4()),
+                    id=alloc_id,
                     namespace=self.job.namespace,
                     eval_id=self.eval.id,
                     name=f"{self.job.id}.{tg.name}[0]",
@@ -266,6 +289,8 @@ class SystemScheduler:
                     client_status=ALLOC_CLIENT_PENDING,
                     job_version=self.job.version,
                 )
+                if victims:
+                    alloc.preempted_allocations = [v.id for v in victims]
                 if prev is not None:
                     alloc.previous_allocation = prev.id
                 self.plan.append_alloc(alloc)

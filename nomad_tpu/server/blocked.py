@@ -68,6 +68,10 @@ class BlockedEvals:
                     existing, None
                 )
                 if old is not None:
+                    if old.node_id and old.node_id in self._system_by_node:
+                        self._system_by_node[old.node_id].pop(existing, None)
+                        if not self._system_by_node[old.node_id]:
+                            del self._system_by_node[old.node_id]
                     self._duplicates.append(old)
             self._jobs[jk] = eval.id
 
@@ -148,7 +152,11 @@ class BlockedEvals:
 
     def _requeue_locked(self, evals: List[Evaluation]) -> None:
         for ev in evals:
-            self._jobs.pop((ev.namespace, ev.job_id), None)
+            # Only clear the per-job dedup slot if it still points at this
+            # eval — a newer blocked eval may own the key now.
+            jk = (ev.namespace, ev.job_id)
+            if self._jobs.get(jk) == ev.id:
+                del self._jobs[jk]
             requeued = Evaluation(**{**ev.__dict__})
             requeued.status = EVAL_STATUS_PENDING
             requeued.status_description = ""

@@ -37,7 +37,7 @@ class HeartbeatTracker:
             old = self._timers.pop(node_id, None)
             if old is not None:
                 old.cancel()
-            t = threading.Timer(self.ttl, self._expire, (node_id,))
+            t = threading.Timer(self.ttl, lambda: self._expire(node_id, t))
             t.daemon = True
             self._timers[node_id] = t
             t.start()
@@ -48,9 +48,12 @@ class HeartbeatTracker:
             if old is not None:
                 old.cancel()
 
-    def _expire(self, node_id: str) -> None:
+    def _expire(self, node_id: str, timer: threading.Timer) -> None:
         with self._lock:
-            if not self._enabled or node_id not in self._timers:
+            # Identity check: a reset racing this expiry may have installed a
+            # fresh timer under the same node — only the timer that is still
+            # registered may declare the node down.
+            if not self._enabled or self._timers.get(node_id) is not timer:
                 return
             del self._timers[node_id]
         self.on_expire(node_id)

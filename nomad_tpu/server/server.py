@@ -120,7 +120,13 @@ class Server:
             raise ValueError(err)
         existing = self.state.job_by_id(job.namespace, job.id)
         if existing is not None and existing.job_modify_index:
-            job.version = existing.version + 1
+            if not job.spec_changed(existing):
+                # Idempotent re-register: keep the version so the reconciler
+                # doesn't treat every alloc as a destructive update
+                # (reference job_endpoint.go Register + Job.SpecChanged).
+                job.version = existing.version
+            else:
+                job.version = existing.version + 1
         self.state.upsert_job(job)
         return self._create_eval(
             namespace=job.namespace,
@@ -243,14 +249,14 @@ class Server:
 
     def _create_node_evals_for_system_jobs(self, node: Node) -> None:
         """New ready node → evaluate system jobs (node_endpoint.go:178 path)."""
-        for (ns, job_id), job in list(self.state._jobs.items()):
+        for job in self.state.jobs():
             if job.type == "system" and node.datacenter in job.datacenters:
                 self._create_eval(
-                    namespace=ns,
+                    namespace=job.namespace,
                     priority=job.priority,
                     type=job.type,
                     triggered_by=TRIGGER_NODE_UPDATE,
-                    job_id=job_id,
+                    job_id=job.id,
                     node_id=node.id,
                     status=EVAL_STATUS_PENDING,
                 )

@@ -103,6 +103,9 @@ class StateStore(InMemState):
     upsert_deployment = _locked("upsert_deployment")
     upsert_eval = _locked("upsert_eval")
     upsert_plan_results = _locked("upsert_plan_results")
+    # Iterating reads must hold the lock too — the table dicts mutate in place.
+    nodes = _locked("nodes")
+    jobs = _locked("jobs")
     del _locked
 
     def update_alloc_from_client(self, update: Allocation) -> Optional[Allocation]:

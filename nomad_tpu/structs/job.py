@@ -336,6 +336,23 @@ class Job:
     def namespaced_id(self) -> tuple:
         return (self.namespace, self.id)
 
+    def spec_changed(self, other: Optional["Job"]) -> bool:
+        """True when the user-authored spec differs from `other` (reference
+        `structs.Job.SpecChanged`, structs.go:3967 — bookkeeping fields are
+        ignored so an idempotent re-register is a no-op)."""
+        if other is None:
+            return True
+        import dataclasses
+
+        skip = {"status", "version", "stable", "submit_time", "create_index",
+                "modify_index", "job_modify_index"}
+        a = dataclasses.asdict(self)
+        b = dataclasses.asdict(other)
+        for k in skip:
+            a.pop(k, None)
+            b.pop(k, None)
+        return a != b
+
     def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
         for tg in self.task_groups:
             if tg.name == name:

@@ -1,0 +1,263 @@
+"""Preemption tests.
+
+Mirrors reference `scheduler/preemption_test.go` (TestPreemption,
+TestPreemptionMultiple, score helpers) and the scoring math of
+`scheduler/rank.go:747-783`.
+"""
+import math
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.scheduler.preemption import (
+    Preemptor,
+    basic_resource_distance,
+    filter_and_group_preemptible,
+    net_priority,
+    preemption_score,
+    score_for_task_group,
+)
+from nomad_tpu.scheduler.util import SchedulerConfiguration
+from nomad_tpu.structs import Allocation
+from nomad_tpu.structs.resources import ComparableResources
+
+
+def lowprio_job(priority=1, cpu=3200, memory_mb=7256, **kw):
+    j = mock.job(priority=priority, **kw)
+    j.task_groups[0].count = 1
+    j.task_groups[0].tasks[0].resources.cpu = cpu
+    j.task_groups[0].tasks[0].resources.memory_mb = memory_mb
+    j.task_groups[0].tasks[0].resources.networks = []
+    j.task_groups[0].networks = []
+    return j
+
+
+def running_alloc(job, node, cpu=3200, memory_mb=7256):
+    a = mock.alloc(
+        job=job,
+        node_id=node.id,
+        allocated_resources=mock.alloc_resources(
+            cpu=cpu, memory_mb=memory_mb, disk_mb=10, networks=[]
+        ),
+        client_status="running",
+    )
+    a.task_group = job.task_groups[0].name
+    a.name = f"{job.id}.{a.task_group}[0]"
+    return a
+
+
+class TestScoringMath:
+    def test_basic_resource_distance(self):
+        ask = ComparableResources(cpu=2048, memory_mb=512, disk_mb=4096)
+        used = ComparableResources(cpu=1024, memory_mb=256, disk_mb=1024)
+        d = basic_resource_distance(ask, used)
+        # coords: cpu .5, mem .5, disk .75
+        assert d == pytest.approx(math.sqrt(0.25 + 0.25 + 0.5625))
+
+    def test_preemption_score_logistic(self):
+        # rank.go:773 — netPriority 2048 → score 0.5
+        assert preemption_score(2048.0) == pytest.approx(0.5)
+        assert preemption_score(0.0) > 0.99
+        assert preemption_score(10000.0) < 0.01
+
+    def test_net_priority(self):
+        j1 = mock.job(priority=30)
+        j2 = mock.job(priority=70)
+        allocs = [mock.alloc(job=j1), mock.alloc(job=j2), mock.alloc(job=j1)]
+        # max 70 + (30+70+30)/70
+        assert net_priority(allocs) == pytest.approx(70 + 130 / 70)
+
+    def test_max_parallel_penalty(self):
+        ask = ComparableResources(cpu=100, memory_mb=100, disk_mb=0)
+        used = ComparableResources(cpu=100, memory_mb=100, disk_mb=0)
+        base = score_for_task_group(ask, used, 0, 5)
+        penalized = score_for_task_group(ask, used, 2, 2)
+        assert penalized == pytest.approx(base + 50.0)
+
+
+class TestFilterGroup:
+    def test_priority_delta_10(self):
+        """Victims must be ≥10 priority below (preemption.go:677)."""
+        mk = lambda p: mock.alloc(job=mock.job(priority=p))
+        allocs = [mk(5), mk(40), mk(45), mk(50), mk(89), mk(95)]
+        grouped = filter_and_group_preemptible(50, allocs)
+        prios = [p for p, _ in grouped]
+        assert prios == [5, 40]  # 45 within delta; ≥50 never
+
+    def test_groups_sorted_ascending(self):
+        mk = lambda p: mock.alloc(job=mock.job(priority=p))
+        grouped = filter_and_group_preemptible(100, [mk(70), mk(10), mk(40)])
+        assert [p for p, _ in grouped] == [10, 40, 70]
+
+
+class TestPreemptorTaskGroup:
+    def _preemptor(self, node, candidates, priority=100):
+        p = Preemptor(priority, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates(candidates)
+        p.set_preemptions([])
+        return p
+
+    def test_single_victim_frees_enough(self):
+        """One low-priority alloc fills the node; high-priority ask evicts it
+        (reference TestPreemption 'preempt only low priority alloc')."""
+        node = mock.node()
+        victim = running_alloc(lowprio_job(priority=1), node)
+        p = self._preemptor(node, [victim])
+        out = p.preempt_for_task_group(
+            ComparableResources(cpu=2000, memory_mb=2000, disk_mb=10)
+        )
+        assert [a.id for a in out] == [victim.id]
+
+    def test_no_eligible_victims(self):
+        node = mock.node()
+        victim = running_alloc(lowprio_job(priority=95), node)
+        p = self._preemptor(node, [victim], priority=100)
+        out = p.preempt_for_task_group(
+            ComparableResources(cpu=2000, memory_mb=2000, disk_mb=10)
+        )
+        assert out == []
+
+    def test_insufficient_even_after_all(self):
+        node = mock.node()
+        victim = running_alloc(lowprio_job(priority=1), node,
+                               cpu=100, memory_mb=100)
+        p = self._preemptor(node, [victim])
+        out = p.preempt_for_task_group(
+            ComparableResources(cpu=100000, memory_mb=100000, disk_mb=10)
+        )
+        assert out == []
+
+    def test_lowest_priority_preferred(self):
+        """Two half-node victims at different priorities: the lower priority
+        group is consumed first."""
+        node = mock.node()
+        j_lo, j_hi = lowprio_job(priority=1), lowprio_job(priority=40)
+        v1 = running_alloc(j_lo, node, cpu=1600, memory_mb=3600)
+        v2 = running_alloc(j_hi, node, cpu=1600, memory_mb=3600)
+        p = self._preemptor(node, [v1, v2])
+        out = p.preempt_for_task_group(
+            ComparableResources(cpu=1000, memory_mb=1000, disk_mb=10)
+        )
+        assert [a.id for a in out] == [v1.id]
+
+    def test_superset_filter_minimal_set(self):
+        """When a big victim alone covers the ask, smaller victims picked
+        earlier are dropped (reference filterSuperset)."""
+        node = mock.node()
+        small = running_alloc(lowprio_job(priority=1), node,
+                              cpu=200, memory_mb=256)
+        big = running_alloc(lowprio_job(priority=1), node,
+                            cpu=3000, memory_mb=6000)
+        p = self._preemptor(node, [small, big])
+        out = p.preempt_for_task_group(
+            ComparableResources(cpu=2500, memory_mb=2500, disk_mb=10)
+        )
+        assert [a.id for a in out] == [big.id]
+
+    def test_own_job_never_preempted(self):
+        node = mock.node()
+        j = lowprio_job(priority=1)
+        mine = running_alloc(j, node)
+        p = Preemptor(100, "default", j.id)
+        p.set_node(node)
+        p.set_candidates([mine])
+        p.set_preemptions([])
+        out = p.preempt_for_task_group(
+            ComparableResources(cpu=2000, memory_mb=2000, disk_mb=10)
+        )
+        assert out == []
+
+
+def _fill_cluster(h, n_nodes, victim_priority=1):
+    """n nodes, each filled by one low-priority alloc."""
+    nodes, victims = [], []
+    for _ in range(n_nodes):
+        node = mock.node()
+        h.state.upsert_node(node)
+        nodes.append(node)
+        j = lowprio_job(priority=victim_priority)
+        h.state.upsert_job(j)
+        a = running_alloc(j, node)
+        h.state.upsert_alloc(a)
+        victims.append(a)
+    return nodes, victims
+
+
+class TestServiceSchedPreemption:
+    def test_disabled_by_default(self):
+        h = Harness()
+        _fill_cluster(h, 3)
+        job = mock.job(priority=100)
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 2000
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type=job.type,
+                             priority=job.priority))
+        assert h.evals[-1].failed_tg_allocs  # blocked, no preemption
+
+    def test_service_preemption_end_to_end(self):
+        h = Harness()
+        h.state.set_scheduler_config(SchedulerConfiguration(preemption_service=True))
+        _nodes, victims = _fill_cluster(h, 3)
+        job = mock.job(priority=100)
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 2000
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.task_groups[0].networks = []
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type=job.type,
+                             priority=job.priority))
+
+        plan = h.plans[-1]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 1
+        assert placed[0].preempted_allocations
+        victim_ids = {v.id for v in victims}
+        assert set(placed[0].preempted_allocations) <= victim_ids
+        # plan carries the eviction
+        evicted = [a for allocs in plan.node_preemptions.values()
+                   for a in allocs]
+        assert {a.id for a in evicted} == set(placed[0].preempted_allocations)
+        assert all(a.desired_status == "evict" for a in evicted)
+        assert all(
+            a.preempted_by_allocation == placed[0].id for a in evicted
+        )
+        # state reflects eviction after plan apply
+        merged = h.state.alloc_by_id(evicted[0].id)
+        assert merged.desired_status == "evict"
+
+    def test_higher_priority_not_preempted(self):
+        h = Harness()
+        h.state.set_scheduler_config(SchedulerConfiguration(preemption_service=True))
+        _fill_cluster(h, 3, victim_priority=95)
+        job = mock.job(priority=100)
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 2000
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type=job.type,
+                             priority=job.priority))
+        assert h.evals[-1].failed_tg_allocs
+
+
+class TestSystemSchedPreemption:
+    def test_system_preempts_by_default(self):
+        """System jobs preempt without opt-in (stack.go:256-263)."""
+        h = Harness()
+        _nodes, victims = _fill_cluster(h, 2)
+        job = mock.system_job(priority=100)
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 2000
+        job.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type="system",
+                             priority=job.priority))
+        plan = h.plans[-1]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 2  # one per node, both via preemption
+        for a in placed:
+            assert a.preempted_allocations
