@@ -88,6 +88,21 @@ class PlacementResult(NamedTuple):
     final_scores0: jax.Array  # f32[N] — first step's normalized score vector
 
 
+def fit_scores(util: jax.Array, cap: jax.Array
+               ) -> Tuple[jax.Array, jax.Array]:
+    """(binpack, spread) fit scores per node, each in [0, 1]
+    (reference funcs.go:175/:202, normalized by 18 per rank.go:11-13).
+    10^x computed as exp2(x·log₂10) — VPU-friendly."""
+    free_cpu = 1.0 - util[:, 0] / jnp.maximum(cap[:, 0], 1.0)
+    free_ram = 1.0 - util[:, 1] / jnp.maximum(cap[:, 1], 1.0)
+    total = jnp.exp2(free_cpu * 3.321928094887362) + jnp.exp2(
+        free_ram * 3.321928094887362
+    )
+    binpack = jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
+    spread = jnp.clip(total - 2.0, 0.0, 18.0) / 18.0
+    return binpack, spread
+
+
 def _lut_gather(lut: jax.Array, key_idx: jax.Array, attrs: jax.Array) -> jax.Array:
     """out[n, c] = lut[c, tok(n, key_idx[c])] with missing → last slot."""
     if lut.shape[0] == 0:
@@ -196,13 +211,7 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         ok = ok & ~(p.distinct_hosts & (job_cnt > 0))
 
         # ---- fused scoring (rank.go semantics) ----
-        free_cpu = 1.0 - util[:, 0] / jnp.maximum(cap[:, 0], 1.0)
-        free_ram = 1.0 - util[:, 1] / jnp.maximum(cap[:, 1], 1.0)
-        total = jnp.exp2(free_cpu * 3.321928094887362) + jnp.exp2(
-            free_ram * 3.321928094887362
-        )  # 10^x via exp2(x·log2 10) — VPU-friendly
-        binpack = jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
-        spreadfit = jnp.clip(total - 2.0, 0.0, 18.0) / 18.0
+        binpack, spreadfit = fit_scores(util, cap)
         fit_score = jnp.where(p.algorithm == 1, spreadfit, binpack)
 
         ssum = fit_score

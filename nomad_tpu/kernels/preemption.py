@@ -27,13 +27,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .placement import ClusterArrays, TGParams, _lut_gather
+from ..structs.funcs import PREEMPTION_SCORE_ORIGIN, PREEMPTION_SCORE_RATE
+from .placement import ClusterArrays, TGParams, _lut_gather, fit_scores
 
 NEG_INF = -1e30
 INF_PRIO = 1e9
-
-PREEMPTION_SCORE_RATE = 0.0048
-PREEMPTION_SCORE_ORIGIN = 2048.0
 
 
 class PreemptionCandidates(NamedTuple):
@@ -100,12 +98,7 @@ def preempt_rank(cluster: ClusterArrays, p: TGParams,
 
     # Bin-pack score at the post-eviction utilization (funcs.go:175).
     util_sel = util_k[rows, k_idx]                              # [N, R]
-    free_cpu = 1.0 - util_sel[:, 0] / jnp.maximum(cap[:, 0], 1.0)
-    free_ram = 1.0 - util_sel[:, 1] / jnp.maximum(cap[:, 1], 1.0)
-    total = jnp.exp2(free_cpu * 3.321928094887362) + jnp.exp2(
-        free_ram * 3.321928094887362
-    )
-    binpack = jnp.clip(20.0 - total, 0.0, 18.0) / 18.0
+    binpack, _ = fit_scores(util_sel, cap)
 
     combined = (binpack + pre_score) / 2.0
     scores = jnp.where(any_fit, combined, NEG_INF)

@@ -144,6 +144,14 @@ class InMemState:
     def jobs(self) -> List[Job]:
         return list(self._jobs.values())
 
+    def transact(self):
+        """Atomic read-modify-write scope. The plain in-memory state is
+        single-threaded (scheduler tests); the server StateStore overrides
+        this with its lock."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
     def job_by_id_and_version(self, namespace: str, job_id: str, version: int
                               ) -> Optional[Job]:
         return self._job_versions.get((namespace, job_id, version))
@@ -157,6 +165,36 @@ class InMemState:
 
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
         return self._allocs.get(alloc_id)
+
+    def deployments(self) -> List[Deployment]:
+        return list(self._deployments.values())
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self._deployments.get(deployment_id)
+
+    def latest_stable_job(self, namespace: str, job_id: str,
+                          below_version: Optional[int] = None
+                          ) -> Optional[Job]:
+        """Latest job version marked stable (reference
+        state.JobVersionsByID + deployment_watcher latestStableJob)."""
+        best = None
+        for (ns, jid, ver), job in self._job_versions.items():
+            if (ns, jid) != (namespace, job_id) or not job.stable:
+                continue
+            if below_version is not None and ver >= below_version:
+                continue
+            if best is None or ver > best.version:
+                best = job
+        return best
+
+    def mark_job_stable(self, namespace: str, job_id: str, version: int
+                        ) -> None:
+        job = self._job_versions.get((namespace, job_id, version))
+        if job is not None:
+            job.stable = True
+        cur = self._jobs.get((namespace, job_id))
+        if cur is not None and cur.version == version:
+            cur.stable = True
 
     def latest_deployment_by_job(self, namespace: str, job_id: str
                                  ) -> Optional[Deployment]:

@@ -19,6 +19,11 @@ import math
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import Allocation, NetworkResource
+from ..structs.funcs import (  # noqa: F401 — re-exported parity anchors
+    PREEMPTION_SCORE_ORIGIN,
+    PREEMPTION_SCORE_RATE,
+    preemption_score,
+)
 from ..structs.resources import ComparableResources
 
 # Score penalty applied per already-preempted alloc of the same job/tg beyond
@@ -28,10 +33,6 @@ MAX_PARALLEL_PENALTY = 50.0
 # Minimum priority delta between the preempting job and a victim
 # (reference preemption.go:677 "within a delta of 10").
 PRIORITY_DELTA = 10
-
-# Logistic score constants (reference rank.go:775-782).
-PREEMPTION_SCORE_RATE = 0.0048
-PREEMPTION_SCORE_ORIGIN = 2048.0
 
 
 def basic_resource_distance(ask: ComparableResources,
@@ -88,12 +89,6 @@ def net_priority(allocs: List[Allocation]) -> float:
     if mx == 0.0:
         return 0.0
     return mx + total / mx
-
-
-def preemption_score(net_prio: float) -> float:
-    """Logistic in [0, 1], inflection at 2048 (rank.go:773)."""
-    return 1.0 / (1.0 + math.exp(PREEMPTION_SCORE_RATE *
-                                 (net_prio - PREEMPTION_SCORE_ORIGIN)))
 
 
 def _alloc_priority(alloc: Allocation) -> int:
@@ -248,7 +243,15 @@ class Preemptor:
             # Reserved ports held by non-preemptible allocs block the device.
             if reserved_needed & filtered_ports.get(device, set()):
                 continue
-            used_ports: set = set()
+            # Ports held by preemptible allocs on this device: each needed
+            # reserved port must end up released (held by a chosen victim) or
+            # never held at all.
+            held_by: Dict[int, set] = {}
+            for a in allocs:
+                net = self._alloc_networks(a)[0]
+                for port in list(net.reserved_ports) + list(net.dynamic_ports):
+                    held_by.setdefault(port.value, set()).add(a.id)
+            released: set = set()
             mbits_freed = 0
             chosen: List[Allocation] = []
             allocs = sorted(
@@ -263,10 +266,12 @@ class Preemptor:
                 net = self._alloc_networks(a)[0]
                 chosen.append(a)
                 mbits_freed += net.mbits
-                used_ports.update(p.value for p in net.reserved_ports)
-                used_ports.update(p.value for p in net.dynamic_ports)
-                ports_ok = reserved_needed <= used_ports or not (
-                    reserved_needed - self._free_ports(net_idx, device)
+                released.update(p.value for p in net.reserved_ports)
+                released.update(p.value for p in net.dynamic_ports)
+                chosen_ids = {c.id for c in chosen}
+                ports_ok = all(
+                    port in released or not (held_by.get(port, set()) - chosen_ids)
+                    for port in reserved_needed
                 )
                 if free_mbits + mbits_freed >= ask.mbits and ports_ok:
                     return self._filter_superset_network(
@@ -305,10 +310,6 @@ class Preemptor:
         avail = net_idx.avail_bandwidth.get(device, 0)
         used = net_idx.used_bandwidth.get(device, 0)
         return max(avail - used, 0)
-
-    @staticmethod
-    def _free_ports(net_idx, device: str) -> set:
-        return set()
 
     # -- device preemption (reference PreemptForDevice :472) --
 
@@ -425,6 +426,8 @@ def find_preemption_placement(state, cluster, job, tg, params, plan
             prio[row, i] = _alloc_priority(a)
             usage[row, i] = cluster.usage_row(a)
 
+    from .stack import _to_device
+
     snap = cluster.snapshot()
     arrays = ClusterArrays(
         capacity=jnp.asarray(snap.capacity),
@@ -432,7 +435,7 @@ def find_preemption_placement(state, cluster, job, tg, params, plan
         node_ok=jnp.asarray(snap.node_ok),
         attrs=jnp.asarray(snap.attrs),
     )
-    dev_params = type(params)(*[jnp.asarray(x) for x in params])
+    dev_params = _to_device(params)
     result = preempt_rank_jit(
         arrays, dev_params,
         PreemptionCandidates(prio=jnp.asarray(prio), usage=jnp.asarray(usage)),

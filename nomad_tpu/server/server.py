@@ -64,6 +64,9 @@ class Server:
         self.heartbeater = HeartbeatTracker(
             ttl=self.config.heartbeat_ttl, on_expire=self._heartbeat_expired
         )
+        from .deployments import DeploymentsWatcher
+
+        self.deployments_watcher = DeploymentsWatcher(self)
         self._running = False
 
     # ---- lifecycle (leader.go:222 establishLeadership) ----
@@ -76,6 +79,7 @@ class Server:
         for w in self.workers:
             w.start()
         self.heartbeater.start()
+        self.deployments_watcher.start()
         # Arm TTL timers for nodes already in state (reference
         # initializeHeartbeatTimers on establishLeadership, heartbeat.go:24)
         for node in self.state.nodes():
@@ -85,6 +89,7 @@ class Server:
 
     def shutdown(self) -> None:
         self._running = False
+        self.deployments_watcher.shutdown()
         self.heartbeater.shutdown()
         for w in self.workers:
             w.shutdown()
@@ -121,10 +126,13 @@ class Server:
         existing = self.state.job_by_id(job.namespace, job.id)
         if existing is not None and existing.job_modify_index:
             if not job.spec_changed(existing):
-                # Idempotent re-register: keep the version so the reconciler
-                # doesn't treat every alloc as a destructive update
-                # (reference job_endpoint.go Register + Job.SpecChanged).
+                # Idempotent re-register: keep the version AND the version's
+                # bookkeeping (stable flag feeds auto-revert) so the
+                # reconciler doesn't treat every alloc as a destructive
+                # update (reference job_endpoint.go Register + SpecChanged).
                 job.version = existing.version
+                job.stable = existing.stable
+                job.status = existing.status
             else:
                 job.version = existing.version + 1
         self.state.upsert_job(job)
@@ -288,6 +296,36 @@ class Server:
                 job_id=job_id,
                 status=EVAL_STATUS_PENDING,
             )
+
+    # ---- Deployment endpoint (nomad/deployment_endpoint.go) ----
+
+    def deployment_promote(self, deployment_id: str, groups=None):
+        return self.deployments_watcher.promote(deployment_id, groups)
+
+    def deployment_fail(self, deployment_id: str):
+        return self.deployments_watcher.fail(deployment_id)
+
+    def deployment_pause(self, deployment_id: str, pause: bool) -> None:
+        self.deployments_watcher.pause(deployment_id, pause)
+
+    def update_alloc_health(self, alloc_id: str, healthy: bool) -> None:
+        """Client (alloc health watcher) reports deployment health
+        (reference Deployment.SetAllocHealth / client allochealth push)."""
+        import copy as _copy
+
+        from ..structs import AllocDeploymentStatus
+
+        existing = self.state.alloc_by_id(alloc_id)
+        if existing is None:
+            return
+        merged = _copy.copy(existing)
+        ds = merged.deployment_status or AllocDeploymentStatus()
+        ds = _copy.copy(ds)
+        ds.healthy = healthy
+        ds.timestamp = time.time()
+        merged.deployment_status = ds
+        self.state.upsert_alloc(merged)
+        self.deployments_watcher.notify()
 
     # ---- test/ops helpers ----
 

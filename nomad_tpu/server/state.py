@@ -106,6 +106,9 @@ class StateStore(InMemState):
     # Iterating reads must hold the lock too — the table dicts mutate in place.
     nodes = _locked("nodes")
     jobs = _locked("jobs")
+    deployments = _locked("deployments")
+    latest_stable_job = _locked("latest_stable_job")
+    mark_job_stable = _locked("mark_job_stable")
     del _locked
 
     def update_alloc_from_client(self, update: Allocation) -> Optional[Allocation]:
@@ -126,6 +129,11 @@ class StateStore(InMemState):
             self.upsert_alloc(merged)
             self._cv.notify_all()
             return merged
+
+    def transact(self):
+        """Hold the store lock across a read-modify-write (the RLock makes
+        nested mutators from inside the scope safe)."""
+        return self._cv
 
     # -- snapshots & blocking --
 

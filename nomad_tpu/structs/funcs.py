@@ -125,3 +125,15 @@ def score_fit(algorithm: str, node: Node, util: ComparableResources) -> float:
     if algorithm == "spread":
         return score_fit_spread(node, util)
     return score_fit_binpack(node, util)
+
+
+# Logistic preemption score (reference rank.go:775-782). Single source of
+# truth — the host Preemptor and the device kernel must stay in exact parity.
+PREEMPTION_SCORE_RATE = 0.0048
+PREEMPTION_SCORE_ORIGIN = 2048.0
+
+
+def preemption_score(net_prio: float) -> float:
+    """Score in [0, 1]; inflection at net priority 2048 (rank.go:773)."""
+    return 1.0 / (1.0 + math.exp(PREEMPTION_SCORE_RATE *
+                                 (net_prio - PREEMPTION_SCORE_ORIGIN)))

@@ -1,0 +1,246 @@
+"""Deployment lifecycle tests.
+
+Mirrors reference `nomad/deploymentwatcher/deployments_watcher_test.go` core
+transitions (healthy rollout → successful; unhealthy → failed + auto-revert;
+canary promotion; progress deadline) through the in-process Server.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs.deployment import (
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+)
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+@pytest.fixture()
+def server():
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _cluster(server, n=3):
+    return [server.node_register(mock.node()) or None for _ in range(n)]
+
+
+def _update_job(count=3, **update_kw):
+    job = mock.job()
+    job.task_groups[0].count = count
+    job.task_groups[0].update = UpdateStrategy(
+        max_parallel=count, min_healthy_time_s=0.0, **update_kw
+    )
+    job.update = job.task_groups[0].update
+    return job
+
+
+def _wait(cond, timeout=8.0, every=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(every)
+    return cond()
+
+
+def _register_v0_running(server, job):
+    """Register v0 and mark all its allocs healthy/running."""
+    ev = server.job_register(job)
+    assert server.wait_for_eval(ev.id) is not None
+    allocs = server.wait_for_allocs(job.namespace, job.id, job.task_groups[0].count)
+    for a in allocs:
+        a2 = type(a)(**{**a.__dict__})
+        a2.client_status = "running"
+        server.state.update_alloc_from_client(a2)
+    return allocs
+
+
+def test_new_version_creates_deployment(server):
+    _cluster(server)
+    job = _update_job()
+    _register_v0_running(server, job)
+
+    job2 = _update_job()
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"v": "2"}
+    ev = server.job_register(job2)
+    assert server.wait_for_eval(ev.id) is not None
+
+    d = _wait(lambda: server.state.latest_deployment_by_job("default", job.id))
+    assert d is not None
+    assert d.job_version == 1
+    assert d.status == DEPLOYMENT_STATUS_RUNNING
+
+
+def test_healthy_rollout_succeeds_and_marks_stable(server):
+    _cluster(server)
+    job = _update_job()
+    _register_v0_running(server, job)
+
+    job2 = _update_job()
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"v": "2"}
+    ev = server.job_register(job2)
+    assert server.wait_for_eval(ev.id) is not None
+    d = _wait(lambda: server.state.latest_deployment_by_job("default", job.id))
+    assert d is not None
+
+    # Mark every v1 alloc healthy as the client health watcher would.
+    def new_allocs():
+        return [
+            a for a in server.state.allocs_by_job("default", job.id)
+            if a.deployment_id == d.id and not a.terminal_status()
+        ]
+
+    allocs = _wait(lambda: new_allocs() if len(new_allocs()) >= 3 else None)
+    for a in allocs:
+        server.update_alloc_health(a.id, True)
+
+    final = _wait(
+        lambda: (
+            server.state.deployment_by_id(d.id)
+            if server.state.deployment_by_id(d.id).status
+            == DEPLOYMENT_STATUS_SUCCESSFUL else None
+        )
+    )
+    assert final.status == DEPLOYMENT_STATUS_SUCCESSFUL
+    # job version marked stable
+    stable = server.state.latest_stable_job("default", job.id)
+    assert stable is not None and stable.version == 1
+
+
+def test_unhealthy_alloc_fails_deployment_and_auto_reverts(server):
+    _cluster(server)
+    job = _update_job(auto_revert=True)
+    _register_v0_running(server, job)
+    # v0 must be stable to be a revert target
+    server.state.mark_job_stable("default", job.id, 0)
+
+    job2 = _update_job(auto_revert=True)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"v": "2"}
+    ev = server.job_register(job2)
+    assert server.wait_for_eval(ev.id) is not None
+    d = _wait(lambda: server.state.latest_deployment_by_job("default", job.id))
+    assert d is not None
+
+    bad = _wait(lambda: next(
+        (a for a in server.state.allocs_by_job("default", job.id)
+         if a.deployment_id == d.id), None,
+    ))
+    server.update_alloc_health(bad.id, False)
+
+    failed = _wait(
+        lambda: (
+            server.state.deployment_by_id(d.id)
+            if server.state.deployment_by_id(d.id).status
+            == DEPLOYMENT_STATUS_FAILED else None
+        )
+    )
+    assert failed.status == DEPLOYMENT_STATUS_FAILED
+    # auto-revert re-registered the stable spec as a new version
+    reverted = _wait(
+        lambda: (
+            server.state.job_by_id("default", job.id)
+            if server.state.job_by_id("default", job.id).version > 1 else None
+        )
+    )
+    assert reverted.spec_changed(job2)
+    assert not reverted.spec_changed(job)
+
+
+def test_canary_requires_promotion(server):
+    _cluster(server)
+    job = _update_job()
+    _register_v0_running(server, job)
+
+    job2 = _update_job(canary=1)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"v": "2"}
+    ev = server.job_register(job2)
+    assert server.wait_for_eval(ev.id) is not None
+    d = _wait(lambda: server.state.latest_deployment_by_job("default", job.id))
+    assert d is not None
+    ds = d.task_groups["web"]
+    assert ds.desired_canaries == 1
+
+    canaries = _wait(lambda: [
+        a for a in server.state.allocs_by_job("default", job.id)
+        if a.deployment_id == d.id
+    ])
+    assert len(canaries) == 1  # only the canary placed before promotion
+    server.update_alloc_health(canaries[0].id, True)
+
+    # Not promoted → deployment must NOT complete on its own.
+    time.sleep(0.6)
+    assert server.state.deployment_by_id(d.id).status == DEPLOYMENT_STATUS_RUNNING
+
+    server.deployment_promote(d.id)
+    # Promotion triggers the remaining placements.
+    rest = _wait(lambda: (
+        [a for a in server.state.allocs_by_job("default", job.id)
+         if a.deployment_id == d.id and not a.terminal_status()]
+        if len([a for a in server.state.allocs_by_job("default", job.id)
+                if a.deployment_id == d.id and not a.terminal_status()]) >= 3
+        else None
+    ))
+    for a in rest:
+        server.update_alloc_health(a.id, True)
+    final = _wait(
+        lambda: (
+            server.state.deployment_by_id(d.id)
+            if server.state.deployment_by_id(d.id).status
+            == DEPLOYMENT_STATUS_SUCCESSFUL else None
+        )
+    )
+    assert final.status == DEPLOYMENT_STATUS_SUCCESSFUL
+
+
+def test_promote_rejects_unhealthy_canaries(server):
+    _cluster(server)
+    job = _update_job()
+    _register_v0_running(server, job)
+    job2 = _update_job(canary=1)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"v": "2"}
+    ev = server.job_register(job2)
+    assert server.wait_for_eval(ev.id) is not None
+    d = _wait(lambda: server.state.latest_deployment_by_job("default", job.id))
+    _wait(lambda: [
+        a for a in server.state.allocs_by_job("default", job.id)
+        if a.deployment_id == d.id
+    ])
+    with pytest.raises(ValueError):
+        server.deployment_promote(d.id)
+
+
+def test_auto_promote(server):
+    _cluster(server)
+    job = _update_job()
+    _register_v0_running(server, job)
+    job2 = _update_job(canary=1, auto_promote=True)
+    job2.id = job.id
+    job2.task_groups[0].tasks[0].env = {"v": "2"}
+    ev = server.job_register(job2)
+    assert server.wait_for_eval(ev.id) is not None
+    d = _wait(lambda: server.state.latest_deployment_by_job("default", job.id))
+    canaries = _wait(lambda: [
+        a for a in server.state.allocs_by_job("default", job.id)
+        if a.deployment_id == d.id
+    ])
+    server.update_alloc_health(canaries[0].id, True)
+    promoted = _wait(
+        lambda: (
+            server.state.deployment_by_id(d.id)
+            if server.state.deployment_by_id(d.id).task_groups["web"].promoted
+            else None
+        )
+    )
+    assert promoted.task_groups["web"].promoted

@@ -170,6 +170,135 @@ class TestPreemptorTaskGroup:
         assert out == []
 
 
+class TestPreemptorNetwork:
+    """Reference TestPreemption network cases (preemption_test.go)."""
+
+    def _net_alloc(self, job, node, mbits, reserved=(), dynamic=()):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        net = NetworkResource(
+            device="eth0", ip="192.168.0.100", mbits=mbits,
+            reserved_ports=[Port(label=f"r{p}", value=p) for p in reserved],
+            dynamic_ports=[Port(label=f"d{p}", value=p) for p in dynamic],
+        )
+        a = mock.alloc(
+            job=job, node_id=node.id,
+            allocated_resources=mock.alloc_resources(
+                cpu=200, memory_mb=256, disk_mb=10, networks=[net]
+            ),
+            client_status="running",
+        )
+        return a
+
+    def _net_idx(self, node):
+        from nomad_tpu.structs import NetworkIndex
+
+        idx = NetworkIndex()
+        idx.set_node(node)
+        return idx
+
+    def test_preempt_for_bandwidth(self):
+        from nomad_tpu.structs import NetworkResource
+
+        node = mock.node()  # 1000 mbit eth0
+        hog = self._net_alloc(lowprio_job(priority=1), node, mbits=900)
+        p = Preemptor(100, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([hog])
+        p.set_preemptions([])
+        idx = self._net_idx(node)
+        idx.add_allocs([hog])
+        out = p.preempt_for_network(NetworkResource(mbits=500), idx)
+        assert [a.id for a in out] == [hog.id]
+
+    def test_reserved_port_held_by_high_priority_blocks(self):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        node = mock.node()
+        holder = self._net_alloc(mock.job(priority=95), node, mbits=100,
+                                 reserved=(8080,))
+        hog = self._net_alloc(lowprio_job(priority=1), node, mbits=800)
+        p = Preemptor(100, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([holder, hog])
+        p.set_preemptions([])
+        idx = self._net_idx(node)
+        idx.add_allocs([holder, hog])
+        ask = NetworkResource(
+            mbits=500,
+            reserved_ports=[Port(label="http", value=8080)],
+        )
+        assert p.preempt_for_network(ask, idx) == []
+
+    def test_reserved_port_released_by_victim(self):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        node = mock.node()
+        hog = self._net_alloc(lowprio_job(priority=1), node, mbits=900,
+                              reserved=(8080,))
+        p = Preemptor(100, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([hog])
+        p.set_preemptions([])
+        idx = self._net_idx(node)
+        idx.add_allocs([hog])
+        ask = NetworkResource(
+            mbits=500,
+            reserved_ports=[Port(label="http", value=8080)],
+        )
+        out = p.preempt_for_network(ask, idx)
+        assert [a.id for a in out] == [hog.id]
+
+
+class TestPreemptorDevice:
+    def _gpu_alloc(self, job, node, n_gpus):
+        from nomad_tpu.structs import (
+            AllocatedDeviceResource,
+            AllocatedResources,
+            AllocatedSharedResources,
+            AllocatedTaskResources,
+        )
+
+        a = mock.alloc(
+            job=job, node_id=node.id,
+            allocated_resources=AllocatedResources(
+                tasks={
+                    "web": AllocatedTaskResources(
+                        cpu=100, memory_mb=100,
+                        devices=[AllocatedDeviceResource(
+                            vendor="nvidia", type="gpu", name="1080ti",
+                            device_ids=[f"g{i}" for i in range(n_gpus)],
+                        )],
+                    )
+                },
+                shared=AllocatedSharedResources(disk_mb=10),
+            ),
+            client_status="running",
+        )
+        return a
+
+    def test_preempt_for_device_count(self):
+        node = mock.nvidia_node()
+        v1 = self._gpu_alloc(lowprio_job(priority=1), node, 1)
+        v2 = self._gpu_alloc(lowprio_job(priority=1), node, 2)
+        p = Preemptor(100, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([v1, v2])
+        p.set_preemptions([])
+        # need 2, none free → the 2-GPU victim alone suffices
+        out = p.preempt_for_device("nvidia/gpu/1080ti", 2, 0)
+        assert [a.id for a in out] == [v2.id]
+
+    def test_device_insufficient(self):
+        node = mock.nvidia_node()
+        v1 = self._gpu_alloc(lowprio_job(priority=1), node, 1)
+        p = Preemptor(100, "default", "new-job")
+        p.set_node(node)
+        p.set_candidates([v1])
+        p.set_preemptions([])
+        assert p.preempt_for_device("nvidia/gpu/1080ti", 4, 0) == []
+
+
 def _fill_cluster(h, n_nodes, victim_priority=1):
     """n nodes, each filled by one low-priority alloc."""
     nodes, victims = [], []
