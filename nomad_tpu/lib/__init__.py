@@ -1,0 +1,17 @@
+"""Shared low-level primitives mirroring the reference's `lib/` package:
+
+- `DelayHeap`  — time-ordered heap of named waiters (ref `lib/delayheap/delay_heap.go`);
+  consumers: eval-broker delayed evals, node-drainer deadlines.
+- `KHeap`      — bounded top-K min-heap by score (ref `lib/kheap/score_heap.go`);
+  consumer: `AllocMetric.PopulateScoreMetaData`.
+- `CircBufWriter` — fixed-size circular write buffer with non-blocking flush
+  (ref `lib/circbufwriter/writer.go`); consumer: task log capture (logmon).
+- `TimeTable`  — wall-clock ↔ state-index mapping for GC thresholds
+  (ref `nomad/timetable.go:14`); consumer: core GC scheduler.
+"""
+from .delayheap import DelayHeap, WaitItem
+from .kheap import KHeap
+from .circbuf import CircBufWriter
+from .timetable import TimeTable
+
+__all__ = ["DelayHeap", "WaitItem", "KHeap", "CircBufWriter", "TimeTable"]

@@ -1,0 +1,38 @@
+"""Bounded top-K collection by score.
+
+Behavioral reference: `lib/kheap/score_heap.go` — a capacity-K min-heap of
+`HeapItem`s; pushing onto a full heap replaces the minimum iff the new score
+is higher. `GetItemsReverse` yields descending order. Consumer:
+`AllocMetric.PopulateScoreMetaData` (`nomad/structs/structs.go:9172` area).
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Any, List, Tuple
+
+
+class KHeap:
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._heap: List[Tuple[float, int, Any]] = []
+        self._seq = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def push(self, score: float, item: Any) -> None:
+        self._seq += 1
+        if len(self._heap) < self.capacity:
+            heapq.heappush(self._heap, (score, self._seq, item))
+        elif score > self._heap[0][0]:
+            heapq.heapreplace(self._heap, (score, self._seq, item))
+
+    def items_desc(self) -> List[Any]:
+        """Items in descending score order (ref GetItemsReverse)."""
+        return [it for _, _, it in sorted(self._heap,
+                                          key=lambda t: (-t[0], t[1]))]
+
+    def min_score(self) -> float:
+        return self._heap[0][0] if self._heap else float("-inf")

@@ -376,6 +376,7 @@ class GenericScheduler:
                     job_version=self.job.version,
                 )
                 alloc.metrics.score_node(node_id, "normalized-score", score)
+                alloc.metrics.populate_score_meta()
                 if victims:
                     alloc.preempted_allocations = [v.id for v in victims]
                 if prev is not None:

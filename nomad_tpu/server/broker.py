@@ -23,6 +23,7 @@ import time
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..lib import DelayHeap
 from ..structs import Evaluation
 
 FAILED_QUEUE = "_failed"
@@ -57,8 +58,9 @@ class EvalBroker:
         # (namespace, job_id) -> pending heap (evals waiting on serialization)
         self._job_pending: Dict[Tuple[str, str], List[Tuple[int, int, Evaluation]]] = {}
         self._dequeues: Dict[str, int] = {}  # eval id -> delivery count
-        # delayed evals: (wait_until, seq, eval)
-        self._delayed: List[Tuple[float, int, Evaluation]] = []
+        # delayed evals, keyed by eval id (reference lib/delayheap via
+        # eval_broker.go:751)
+        self._delayed = DelayHeap()
         self._delay_thread: Optional[threading.Thread] = None
         self._shutdown = False
         self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0,
@@ -77,7 +79,7 @@ class EvalBroker:
                 self._job_outstanding.clear()
                 self._job_pending.clear()
                 self._dequeues.clear()
-                self._delayed.clear()
+                self._delayed = DelayHeap()
             else:
                 if self._delay_thread is None:
                     self._delay_thread = threading.Thread(
@@ -120,9 +122,8 @@ class EvalBroker:
             return
         now = time.time()
         if eval.wait_until and eval.wait_until > now:
-            heapq.heappush(
-                self._delayed, (eval.wait_until, next(self._seq), eval)
-            )
+            if not self._delayed.push(eval.id, eval.wait_until, eval):
+                self._delayed.update(eval.id, eval.wait_until, eval)
             self._cv.notify_all()
             return
         jk = (eval.namespace, eval.job_id)
@@ -263,13 +264,14 @@ class EvalBroker:
                 if self._shutdown:
                     return
                 now = time.time()
-                while self._delayed and self._delayed[0][0] <= now:
-                    _, _, eval = heapq.heappop(self._delayed)
+                for item in self._delayed.pop_expired(now):
+                    eval = item.data
                     eval.wait_until = 0.0
                     self._enqueue_locked(eval, token="")
                 wait = 1.0
-                if self._delayed:
-                    wait = max(min(self._delayed[0][0] - now, 1.0), 0.01)
+                head = self._delayed.peek()
+                if head is not None:
+                    wait = max(min(head.wait_until - now, 1.0), 0.01)
             time.sleep(wait)
 
     # ---- introspection ----

@@ -110,14 +110,29 @@ class AllocMetric:
             self.dimension_exhausted[dimension] = self.dimension_exhausted.get(dimension, 0) + 1
 
     def score_node(self, node_id: str, name: str, score: float) -> None:
-        # Top-K retention mirrors AllocMetric.PopulateScoreMetaData (lib/kheap);
-        # kept simple here: bounded list, trimmed by the scheduler.
         for sm in self.score_meta:
             if sm.node_id == node_id:
                 sm.scores[name] = score
                 return
         sm = NodeScoreMeta(node_id=node_id, scores={name: score})
         self.score_meta.append(sm)
+
+    def populate_score_meta(self, k: int = 5) -> None:
+        """Derive each node's norm_score from its "normalized-score" entry,
+        then retain only the top-K nodes, descending (reference
+        `AllocMetric.PopulateScoreMetaData` via `lib/kheap`)."""
+        for sm in self.score_meta:
+            if "normalized-score" in sm.scores:
+                sm.norm_score = sm.scores["normalized-score"]
+        if len(self.score_meta) <= k:
+            self.score_meta.sort(key=lambda sm: -sm.norm_score)
+            return
+        from ..lib import KHeap
+
+        h = KHeap(k)
+        for sm in self.score_meta:
+            h.push(sm.norm_score, sm)
+        self.score_meta = h.items_desc()
 
 
 @dataclass

@@ -1,0 +1,144 @@
+"""Unit tests for nomad_tpu.lib (reference: lib/delayheap, lib/kheap,
+lib/circbufwriter, nomad/timetable.go test suites)."""
+import threading
+import time
+
+from nomad_tpu.lib import CircBufWriter, DelayHeap, KHeap, TimeTable
+
+
+class TestDelayHeap:
+    def test_push_pop_order(self):
+        h = DelayHeap()
+        assert h.push("b", 2.0, "B")
+        assert h.push("a", 1.0, "A")
+        assert h.push("c", 3.0, "C")
+        assert len(h) == 3
+        assert h.peek().key == "a"
+        out = h.pop_expired(2.5)
+        assert [i.key for i in out] == ["a", "b"]
+        assert len(h) == 1
+        assert h.pop_expired(2.5) == []
+
+    def test_duplicate_push_rejected(self):
+        h = DelayHeap()
+        assert h.push("x", 1.0)
+        assert not h.push("x", 2.0)
+
+    def test_update_reschedules(self):
+        h = DelayHeap()
+        h.push("x", 1.0)
+        h.push("y", 2.0)
+        assert h.update("x", 5.0)
+        assert h.peek().key == "y"
+        out = h.pop_expired(10.0)
+        assert sorted(i.key for i in out) == ["x", "y"]
+        assert len([i for i in out if i.key == "x"]) == 1  # no stale dup
+
+    def test_remove(self):
+        h = DelayHeap()
+        h.push("x", 1.0)
+        assert h.remove("x")
+        assert not h.remove("x")
+        assert h.peek() is None
+        assert h.pop_expired(99.0) == []
+
+    def test_contains(self):
+        h = DelayHeap()
+        h.push("x", 1.0)
+        assert "x" in h and "y" not in h
+
+
+class TestKHeap:
+    def test_top_k_desc(self):
+        h = KHeap(3)
+        for s in [1.0, 5.0, 3.0, 4.0, 2.0]:
+            h.push(s, s)
+        assert h.items_desc() == [5.0, 4.0, 3.0]
+        assert len(h) == 3
+
+    def test_under_capacity(self):
+        h = KHeap(10)
+        h.push(2.0, "b")
+        h.push(1.0, "a")
+        assert h.items_desc() == ["b", "a"]
+
+    def test_equal_scores_keep_earliest(self):
+        h = KHeap(2)
+        h.push(1.0, "first")
+        h.push(1.0, "second")
+        h.push(1.0, "third")  # not better than min — dropped
+        assert h.items_desc() == ["first", "second"]
+
+
+class TestCircBufWriter:
+    def test_passthrough(self):
+        got = []
+        w = CircBufWriter(lambda b: got.append(b), size=1024)
+        w.write(b"hello ")
+        w.write(b"world")
+        w.close()
+        assert b"".join(got) == b"hello world"
+
+    def test_overrun_drops_oldest(self):
+        got = []
+        block = threading.Event()
+
+        def sink(b):
+            block.wait(5)
+            got.append(b)
+
+        w = CircBufWriter(sink, size=8, flush_interval=0.01)
+        w.write(b"0123456789abcdef")  # 16 bytes into 8-byte ring
+        block.set()
+        w.close()
+        data = b"".join(got)
+        assert data.endswith(b"abcdef")
+        assert len(data) <= 8 + 16  # oldest dropped, never more than written
+        assert w.dropped_bytes >= 8
+
+    def test_write_after_close_raises(self):
+        w = CircBufWriter(lambda b: None)
+        w.close()
+        try:
+            w.write(b"x")
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
+
+
+class TestTimeTable:
+    def test_nearest_index_and_time(self):
+        tt = TimeTable(granularity=0.0)
+        tt.witness(10, 100.0)
+        tt.witness(20, 200.0)
+        tt.witness(30, 300.0)
+        assert tt.nearest_index(250.0) == 20
+        assert tt.nearest_index(99.0) == 0
+        assert tt.nearest_index(1000.0) == 30
+        assert tt.nearest_time(15) == 100.0
+        assert tt.nearest_time(31) == 300.0
+        assert tt.nearest_time(5) == 0.0
+
+    def test_granularity_suppresses(self):
+        tt = TimeTable(granularity=10.0)
+        tt.witness(1, 100.0)
+        tt.witness(2, 105.0)  # within granularity — dropped
+        tt.witness(3, 111.0)
+        assert tt.nearest_index(106.0) == 1
+        assert tt.nearest_index(112.0) == 3
+
+    def test_limit_trims(self):
+        tt = TimeTable(granularity=0.0, limit=50.0)
+        tt.witness(1, 100.0)
+        tt.witness(2, 200.0)  # 100 is now older than limit
+        assert tt.nearest_index(150.0) == 0  # trimmed away
+
+
+def test_alloc_metric_populate_score_meta():
+    from nomad_tpu.structs.alloc import AllocMetric
+
+    m = AllocMetric()
+    for i in range(10):
+        m.score_node(f"n{i}", "normalized-score", float(i))
+    m.populate_score_meta(k=3)
+    assert [sm.node_id for sm in m.score_meta] == ["n9", "n8", "n7"]
