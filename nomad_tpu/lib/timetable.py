@@ -9,15 +9,18 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import List, Tuple
 
 
 class TimeTable:
-    def __init__(self, granularity: float = 1.0, limit: float = 72 * 3600.0):
+    def __init__(self, granularity: float = 300.0, limit: float = 72 * 3600.0):
+        # Reference defaults (nomad/server.go): 5-minute granularity,
+        # 72h retention → ~864 witnesses; linear scans stay cheap.
         self.granularity = granularity
         self.limit = limit
         self._lock = threading.Lock()
-        self._witnesses: List[Tuple[int, float]] = []  # ascending index
+        self._witnesses: deque = deque()  # (index, time), ascending index
 
     def witness(self, index: int, when: float = None) -> None:
         when = time.time() if when is None else when
@@ -28,7 +31,7 @@ class TimeTable:
             self._witnesses.append((index, when))
             cutoff = when - self.limit
             while len(self._witnesses) > 1 and self._witnesses[0][1] < cutoff:
-                self._witnesses.pop(0)
+                self._witnesses.popleft()
 
     def nearest_index(self, when: float) -> int:
         """Largest witnessed index at or before `when` (0 if none)."""

@@ -208,6 +208,36 @@ class InMemState:
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
         return self._evals.get(eval_id)
 
+    def evals(self) -> List[Evaluation]:
+        return list(self._evals.values())
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        return [e for e in self._evals.values()
+                if e.namespace == namespace and e.job_id == job_id]
+
+    # ---- deletion (GC write API; reference state_store.go DeleteEval,
+    # DeleteJob, DeleteNode, DeleteDeployment) ----
+
+    def delete_eval(self, eval_id: str) -> None:
+        self._evals.pop(eval_id, None)
+
+    def delete_alloc(self, alloc_id: str) -> None:
+        a = self._allocs.pop(alloc_id, None)
+        if a is None:
+            return
+        self._allocs_by_job.get((a.namespace, a.job_id), {}).pop(alloc_id, None)
+        self._allocs_by_node.get(a.node_id, {}).pop(alloc_id, None)
+        self.cluster.remove_alloc(alloc_id, a.job_id)
+
+    def delete_job(self, namespace: str, job_id: str) -> None:
+        self._jobs.pop((namespace, job_id), None)
+        for key in [k for k in self._job_versions
+                    if k[0] == namespace and k[1] == job_id]:
+            del self._job_versions[key]
+
+    def delete_deployment(self, deployment_id: str) -> None:
+        self._deployments.pop(deployment_id, None)
+
     def scheduler_config(self) -> SchedulerConfiguration:
         return self._config
 

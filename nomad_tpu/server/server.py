@@ -43,10 +43,13 @@ from .worker import Worker
 
 class ServerConfig:
     def __init__(self, num_schedulers: int = 1, heartbeat_ttl: float = 10.0,
-                 nack_timeout: float = 60.0):
+                 nack_timeout: float = 60.0, gc_interval: float = 60.0,
+                 gc=None):
         self.num_schedulers = num_schedulers
         self.heartbeat_ttl = heartbeat_ttl
         self.nack_timeout = nack_timeout
+        self.gc_interval = gc_interval
+        self.gc = gc  # GCConfig | None (core_sched.py defaults)
 
 
 class Server:
@@ -64,9 +67,17 @@ class Server:
         self.heartbeater = HeartbeatTracker(
             ttl=self.config.heartbeat_ttl, on_expire=self._heartbeat_expired
         )
+        from ..lib import TimeTable
         from .deployments import DeploymentsWatcher
+        from .drainer import NodeDrainer
+        from .periodic import PeriodicDispatch
 
         self.deployments_watcher = DeploymentsWatcher(self)
+        self.drainer = NodeDrainer(self)
+        self.periodic = PeriodicDispatch(self)
+        self.timetable = TimeTable()
+        self._gc_thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
         self._running = False
 
     # ---- lifecycle (leader.go:222 establishLeadership) ----
@@ -80,6 +91,13 @@ class Server:
             w.start()
         self.heartbeater.start()
         self.deployments_watcher.start()
+        self.drainer.start()
+        self.periodic.start()
+        self.timetable.witness(self.state.index.value)
+        self._stop_event.clear()
+        self._gc_thread = threading.Thread(target=self._run_gc_ticker,
+                                           name="core-gc", daemon=True)
+        self._gc_thread.start()
         # Arm TTL timers for nodes already in state (reference
         # initializeHeartbeatTimers on establishLeadership, heartbeat.go:24)
         for node in self.state.nodes():
@@ -89,6 +107,9 @@ class Server:
 
     def shutdown(self) -> None:
         self._running = False
+        self._stop_event.set()
+        self.periodic.shutdown()
+        self.drainer.shutdown()
         self.deployments_watcher.shutdown()
         self.heartbeater.shutdown()
         for w in self.workers:
@@ -97,6 +118,43 @@ class Server:
         self.broker.shutdown()
         for w in self.workers:
             w.join()
+
+    # ---- core GC (leader.go schedulePeriodic + core_sched.go) ----
+
+    def _run_gc_ticker(self) -> None:
+        from .core_sched import (CORE_JOB_DEPLOYMENT_GC, CORE_JOB_EVAL_GC,
+                                 CORE_JOB_JOB_GC, CORE_JOB_NODE_GC)
+
+        while not self._stop_event.wait(min(self.config.gc_interval, 1.0)):
+            self.timetable.witness(self.state.index.value)
+            now = time.time()
+            if now - getattr(self, "_last_gc", 0.0) < self.config.gc_interval:
+                continue
+            self._last_gc = now
+            for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
+                         CORE_JOB_DEPLOYMENT_GC):
+                self.enqueue_core_eval(kind)
+
+    def enqueue_core_eval(self, kind: str) -> Evaluation:
+        """Create a `_core` eval routed to CoreScheduler (leader.go
+        coreJobEval)."""
+        from ..structs.job import JOB_TYPE_CORE
+
+        return self._create_eval(
+            namespace="-",
+            priority=100,  # JobMaxPriority (core_sched.go coreJobEval)
+            type=JOB_TYPE_CORE,
+            triggered_by="scheduled",
+            job_id=f"{kind}:{uuid.uuid4()}",
+            status=EVAL_STATUS_PENDING,
+        )
+
+    def run_gc(self, kind: str = "force-gc") -> None:
+        """Synchronous GC (the `System.GarbageCollect` RPC path)."""
+        from .core_sched import CoreScheduler
+
+        ev = Evaluation(job_id=f"{kind}:{uuid.uuid4()}")
+        CoreScheduler(self).process(ev)
 
     # ---- eval application (FSM upsertEvals analog, fsm.go:692) ----
 
@@ -119,10 +177,16 @@ class Server:
 
     # ---- Job endpoint (job_endpoint.go:79) ----
 
-    def job_register(self, job: Job) -> Evaluation:
+    def job_register(self, job: Job) -> Optional[Evaluation]:
         err = job.validate() if hasattr(job, "validate") else None
         if err:
             raise ValueError(err)
+        if job.is_periodic() and job.periodic.spec_type == "cron":
+            # Reject a bad cron spec BEFORE the job reaches state
+            # (job_endpoint.go Register → Job.Validate → PeriodicConfig).
+            from .periodic import CronExpr
+
+            CronExpr.parse(job.periodic.spec)
         existing = self.state.job_by_id(job.namespace, job.id)
         if existing is not None and existing.job_modify_index:
             if not job.spec_changed(existing):
@@ -136,6 +200,13 @@ class Server:
             else:
                 job.version = existing.version + 1
         self.state.upsert_job(job)
+        if job.is_periodic() or job.is_parameterized():
+            # Periodic/parameterized jobs produce no eval at register time:
+            # the dispatcher (or Job.Dispatch) creates child jobs later
+            # (job_endpoint.go:79 Register → periodicDispatcher.Add).
+            if job.is_periodic():
+                self.periodic.add(job)
+            return None
         return self._create_eval(
             namespace=job.namespace,
             priority=job.priority,
@@ -155,6 +226,8 @@ class Server:
         job = copy.copy(job)  # snapshots keep the pre-stop view
         job.stop = True
         self.state.upsert_job(job)
+        if job.is_periodic():
+            self.periodic.remove(namespace, job_id)
         return self._create_eval(
             namespace=namespace,
             priority=job.priority,
@@ -220,7 +293,13 @@ class Server:
             return []
         node = copy.copy(node)
         node.drain = drain
+        # Draining nodes are never placement targets; a cancelled drain
+        # restores eligibility (node_endpoint.go:505 UpdateDrain).
+        node.scheduling_eligibility = (
+            "ineligible" if drain is not None else "eligible"
+        )
         self.state.upsert_node(node)
+        self.drainer.update(node)
         return self._create_node_evals(node_id)
 
     def node_update_eligibility(self, node_id: str, eligibility: str) -> None:

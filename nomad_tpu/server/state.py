@@ -100,16 +100,38 @@ class StateStore(InMemState):
     upsert_node = _locked("upsert_node")
     delete_node = _locked("delete_node")
     upsert_job = _locked("upsert_job")
+    delete_job = _locked("delete_job")
     upsert_deployment = _locked("upsert_deployment")
+    delete_deployment = _locked("delete_deployment")
     upsert_eval = _locked("upsert_eval")
+    delete_eval = _locked("delete_eval")
     upsert_plan_results = _locked("upsert_plan_results")
     # Iterating reads must hold the lock too — the table dicts mutate in place.
     nodes = _locked("nodes")
     jobs = _locked("jobs")
+    evals = _locked("evals")
+    evals_by_job = _locked("evals_by_job")
     deployments = _locked("deployments")
     latest_stable_job = _locked("latest_stable_job")
     mark_job_stable = _locked("mark_job_stable")
     del _locked
+
+    def delete_alloc(self, alloc_id: str) -> None:
+        # Copy-on-write variant of InMemState.delete_alloc: snapshots hold
+        # references to the inner per-job/per-node maps.
+        with self._cv:
+            a = self._allocs.pop(alloc_id, None)
+            if a is None:
+                return
+            jk = (a.namespace, a.job_id)
+            by_job = dict(self._allocs_by_job.get(jk, {}))
+            by_job.pop(alloc_id, None)
+            self._allocs_by_job[jk] = by_job
+            by_node = dict(self._allocs_by_node.get(a.node_id, {}))
+            by_node.pop(alloc_id, None)
+            self._allocs_by_node[a.node_id] = by_node
+            self.cluster.remove_alloc(alloc_id, a.job_id)
+            self._cv.notify_all()
 
     def update_alloc_from_client(self, update: Allocation) -> Optional[Allocation]:
         """Client status push (reference `Node.UpdateAlloc` →

@@ -19,7 +19,7 @@ from ..scheduler.system import SystemScheduler
 from ..structs import Evaluation, Plan, PlanResult
 from ..structs.evaluation import EVAL_STATUS_BLOCKED
 
-SCHEDULER_TYPES = ("service", "batch", "system")
+SCHEDULER_TYPES = ("service", "batch", "system", "_core")
 
 
 class Worker:
@@ -77,6 +77,14 @@ class Worker:
             eval.snapshot_index = snap.index_at
             sched = self._make_scheduler(eval, snap)
             sched.process(eval)
+            if eval.type == "_core":
+                # Core schedulers don't drive update_eval themselves —
+                # a successful pass completes the eval here.
+                import copy
+
+                done = copy.copy(eval)
+                done.status = "complete"
+                self.server.state.upsert_eval(done)
             broker.ack(eval.id, token)
         except Exception:
             import traceback
@@ -93,6 +101,10 @@ class Worker:
 
     def _make_scheduler(self, eval: Evaluation, snap):
         """Reference scheduler.NewScheduler factory (scheduler.go:34)."""
+        if eval.type == "_core":
+            from .core_sched import CoreScheduler
+
+            return CoreScheduler(self.server, snap)
         if eval.type == "system":
             return SystemScheduler(snap, self, snap.cluster)
         return GenericScheduler(

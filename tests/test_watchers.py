@@ -1,0 +1,322 @@
+"""Node drain, periodic dispatch, and core GC (reference test models:
+nomad/drainer/*_test.go, nomad/periodic_test.go, nomad/core_sched_test.go)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.core_sched import CoreScheduler, GCConfig
+from nomad_tpu.server.periodic import CronExpr, PeriodicDispatch
+from nomad_tpu.structs import Evaluation
+from nomad_tpu.structs.job import PeriodicConfig
+from nomad_tpu.structs.node import DrainStrategy, NODE_STATUS_DOWN
+
+
+@pytest.fixture()
+def server():
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0,
+                            gc_interval=3600.0))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _wait(cond, timeout=10.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+def _settle(server, job, count):
+    """Register, wait for eval, mark allocs running on the client side."""
+    ev = server.job_register(job)
+    done = server.wait_for_eval(ev.id)
+    assert done is not None and done.status == "complete"
+    allocs = server.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == count
+    for a in allocs:
+        import copy
+
+        upd = copy.copy(a)
+        upd.client_status = "running"
+        server.state.update_alloc_from_client(upd)
+    return allocs
+
+
+class TestNodeDrain:
+    def test_drain_migrates_allocs_and_completes(self, server):
+        n1, n2 = mock.node(), mock.node()
+        server.node_register(n1)
+        server.node_register(n2)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        _settle(server, job, 2)
+
+        drained = [n for n in (n1, n2)
+                   if server.state.allocs_by_node(n.id)]
+        target = drained[0]
+        server.node_update_drain(target.id, DrainStrategy(deadline_s=30.0))
+        node = server.state.node_by_id(target.id)
+        assert node.scheduling_eligibility == "ineligible"
+
+        # All allocs migrate off the drained node; replacements placed
+        def drained_clean():
+            allocs = [a for a in server.state.allocs_by_node(target.id)
+                      if not a.terminal_status()]
+            placed = [a for a in server.state.allocs_by_job("default", job.id)
+                      if not a.terminal_status() and a.node_id != target.id]
+            # client acks stops + runs replacements
+            for a in server.state.allocs_by_job("default", job.id):
+                import copy
+
+                if a.desired_status == "stop" and a.client_status == "running":
+                    upd = copy.copy(a)
+                    upd.client_status = "complete"
+                    server.state.update_alloc_from_client(upd)
+                elif a.desired_status == "run" and a.client_status == "pending":
+                    upd = copy.copy(a)
+                    upd.client_status = "running"
+                    server.state.update_alloc_from_client(upd)
+            return not allocs and len(placed) == 2
+
+        assert _wait(drained_clean), "drain never migrated all allocs"
+        # Drain completes: strategy cleared, node stays ineligible
+        assert _wait(lambda: server.state.node_by_id(target.id).drain is None)
+        assert server.state.node_by_id(
+            target.id).scheduling_eligibility == "ineligible"
+
+    def test_cancel_drain_restores_eligibility(self, server):
+        node = mock.node()
+        server.node_register(node)
+        server.node_update_drain(node.id, DrainStrategy(deadline_s=60.0))
+        assert server.state.node_by_id(
+            node.id).scheduling_eligibility == "ineligible"
+        server.node_update_drain(node.id, None)
+        got = server.state.node_by_id(node.id)
+        assert got.drain is None and got.scheduling_eligibility == "eligible"
+
+    def test_max_parallel_batching(self, server):
+        # Single draining node, 4 allocs, max_parallel=1 → the first tick
+        # marks exactly one alloc for migration.
+        node, other = mock.node(), mock.node()
+        server.node_register(node)
+        server.node_register(other)
+        # stop background drainer so we can observe a single tick
+        server.drainer.shutdown()
+        job = mock.job()
+        job.task_groups[0].count = 4
+        from nomad_tpu.structs.job import MigrateStrategy
+
+        job.task_groups[0].migrate_strategy = MigrateStrategy(max_parallel=1)
+        _settle(server, job, 4)
+        on_node = server.state.allocs_by_node(node.id)
+        if not on_node:
+            node = other
+            on_node = server.state.allocs_by_node(node.id)
+        server.state.node_by_id(node.id)
+        import copy
+
+        upd = copy.copy(server.state.node_by_id(node.id))
+        upd.drain = DrainStrategy(deadline_s=600.0)
+        upd.scheduling_eligibility = "ineligible"
+        server.state.upsert_node(upd)
+        server.drainer._track(upd)
+        server.drainer.tick()
+        marked = [a for a in server.state.allocs_by_node(node.id)
+                  if a.desired_transition.should_migrate()]
+        assert len(marked) == 1
+
+    def test_deadline_forces_all(self, server):
+        node = mock.node()
+        server.node_register(node)
+        server.drainer.shutdown()
+        job = mock.job()
+        job.task_groups[0].count = 3
+        _settle(server, job, 3)
+        import copy
+
+        upd = copy.copy(server.state.node_by_id(node.id))
+        upd.drain = DrainStrategy(deadline_s=-1)  # force immediately
+        upd.scheduling_eligibility = "ineligible"
+        server.state.upsert_node(upd)
+        server.drainer._track(upd)
+        server.drainer.tick()
+        marked = [a for a in server.state.allocs_by_node(node.id)
+                  if a.desired_transition.should_migrate()]
+        assert len(marked) == 3
+
+
+class TestCron:
+    def test_every_five_minutes(self):
+        e = CronExpr.parse("*/5 * * * *")
+        # 2026-01-01 10:02:30 UTC
+        import datetime as dt
+
+        ts = dt.datetime(2026, 1, 1, 10, 2, 30,
+                         tzinfo=dt.timezone.utc).timestamp()
+        nxt = e.next_after(ts)
+        got = dt.datetime.fromtimestamp(nxt, dt.timezone.utc)
+        assert (got.hour, got.minute) == (10, 5)
+
+    def test_strictly_after(self):
+        import datetime as dt
+
+        e = CronExpr.parse("0 * * * *")
+        ts = dt.datetime(2026, 1, 1, 10, 0, 0,
+                         tzinfo=dt.timezone.utc).timestamp()
+        got = dt.datetime.fromtimestamp(e.next_after(ts), dt.timezone.utc)
+        assert (got.hour, got.minute) == (11, 0)
+
+    def test_daily_at_time(self):
+        import datetime as dt
+
+        e = CronExpr.parse("30 6 * * *")
+        ts = dt.datetime(2026, 3, 10, 7, 0, 0,
+                         tzinfo=dt.timezone.utc).timestamp()
+        got = dt.datetime.fromtimestamp(e.next_after(ts), dt.timezone.utc)
+        assert (got.day, got.hour, got.minute) == (11, 6, 30)
+
+    def test_dow_restriction(self):
+        import datetime as dt
+
+        e = CronExpr.parse("0 12 * * 0")  # Sundays noon
+        # 2026-01-01 is a Thursday; next Sunday is Jan 4
+        ts = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc).timestamp()
+        got = dt.datetime.fromtimestamp(e.next_after(ts), dt.timezone.utc)
+        assert (got.month, got.day, got.hour) == (1, 4, 12)
+
+    def test_bad_specs_rejected(self):
+        for spec in ("* * * *", "61 * * * *", "* * 32 * *", "*/0 * * * *",
+                     "* * * * 8"):
+            with pytest.raises(ValueError):
+                CronExpr.parse(spec)
+
+    def test_dow_seven_is_sunday(self):
+        import datetime as dt
+
+        e = CronExpr.parse("0 12 * * 7")
+        ts = dt.datetime(2026, 1, 1, tzinfo=dt.timezone.utc).timestamp()
+        got = dt.datetime.fromtimestamp(e.next_after(ts), dt.timezone.utc)
+        assert (got.month, got.day, got.hour) == (1, 4, 12)  # Sunday Jan 4
+
+    def test_bad_periodic_spec_rejected_at_register(self):
+        from nomad_tpu.server import Server, ServerConfig
+
+        s = Server(ServerConfig())
+        try:
+            s.start()
+            job = mock.job()
+            job.periodic = PeriodicConfig(spec="not a cron")
+            with pytest.raises(ValueError):
+                s.job_register(job)
+            assert s.state.job_by_id(job.namespace, job.id) is None
+        finally:
+            s.shutdown()
+
+
+class TestPeriodicDispatch:
+    def test_register_tracks_no_eval(self, server):
+        job = mock.job()
+        job.periodic = PeriodicConfig(spec="*/5 * * * *")
+        out = server.job_register(job)
+        assert out is None  # no eval at register time
+        assert any(j.id == job.id for j in server.periodic.tracked())
+
+    def test_force_launches_child(self, server):
+        server.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.periodic = PeriodicConfig(spec="0 0 1 1 *")  # yearly; never fires
+        server.job_register(job)
+        ev = server.periodic.force(job.namespace, job.id)
+        assert ev is not None
+        child = server.state.job_by_id(job.namespace, ev.job_id)
+        assert child is not None
+        assert child.parent_id == job.id
+        assert child.id.startswith(job.id + "/periodic-")
+        assert child.periodic is None
+        done = server.wait_for_eval(ev.id)
+        assert done.status == "complete"
+        assert len(server.state.allocs_by_job(job.namespace, child.id)) == 1
+
+    def test_prohibit_overlap_skips(self, server):
+        server.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.periodic = PeriodicConfig(spec="0 0 1 1 *", prohibit_overlap=True)
+        server.job_register(job)
+        ev1 = server.periodic.force(job.namespace, job.id)
+        assert ev1 is not None
+        server.wait_for_eval(ev1.id)
+        # first child's alloc still pending/running → second launch skipped
+        ev2 = server.periodic.force(job.namespace, job.id)
+        assert ev2 is None
+
+    def test_deregister_untracks(self, server):
+        job = mock.job()
+        job.periodic = PeriodicConfig(spec="*/5 * * * *")
+        server.job_register(job)
+        server.job_deregister(job.namespace, job.id)
+        assert not any(j.id == job.id for j in server.periodic.tracked())
+
+
+class TestCoreGC:
+    def test_force_gc_reaps_terminal_eval_and_allocs(self, server):
+        server.node_register(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        # stop the job and mark allocs complete
+        server.job_deregister(job.namespace, job.id)
+        _wait(lambda: all(
+            a.desired_status == "stop"
+            for a in server.state.allocs_by_job("default", job.id)))
+        import copy
+
+        for a in server.state.allocs_by_job("default", job.id):
+            upd = copy.copy(a)
+            upd.client_status = "complete"
+            server.state.update_alloc_from_client(upd)
+        _wait(lambda: all(
+            e.status in ("complete", "failed", "cancelled")
+            for e in server.state.evals()))
+        server.run_gc("force-gc")
+        assert server.state.evals_by_job("default", job.id) == []
+        assert server.state.allocs_by_job("default", job.id) == []
+        assert server.state.job_by_id("default", job.id) is None
+
+    def test_eval_gc_skips_young_and_nonterminal(self, server):
+        ev = Evaluation(id="e1", namespace="default", job_id="j",
+                        type="service", status="pending")
+        server.state.upsert_eval(ev)
+        cs = CoreScheduler(server, server.state.snapshot())
+        assert cs.eval_gc(force=True) == 0  # non-terminal: kept
+        ev2 = Evaluation(id="e2", namespace="default", job_id="j2",
+                         type="service", status="complete")
+        server.state.upsert_eval(ev2)
+        # Young (timetable has no old witness) → kept without force
+        assert cs.eval_gc(force=False) == 0
+        assert cs.eval_gc(force=True) == 1
+        assert server.state.eval_by_id("e2") is None
+
+    def test_node_gc_only_down_and_empty(self, server):
+        node = mock.node()
+        server.node_register(node)
+        cs = CoreScheduler(server, server.state.snapshot())
+        assert cs.node_gc(force=True) == 0  # ready node kept
+        server.node_update_status(node.id, NODE_STATUS_DOWN)
+        assert cs.node_gc(force=True) == 1
+        assert server.state.node_by_id(node.id) is None
+
+    def test_core_eval_routed_through_worker(self, server):
+        ev2 = Evaluation(id="gce", namespace="-", job_id="x",
+                         type="service", status="complete")
+        server.state.upsert_eval(ev2)
+        core = server.enqueue_core_eval("eval-gc")
+        done = server.wait_for_eval(core.id)
+        assert done is not None and done.status == "complete"
