@@ -9,6 +9,7 @@ generic_sched.go).
 from __future__ import annotations
 
 import time
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 from ..structs import (
@@ -49,18 +50,16 @@ class Planner(Protocol):
     def reblock_eval(self, eval: Evaluation) -> None: ...
 
 
+@dataclass
 class SchedulerConfiguration:
     """Cluster-wide scheduler config (reference structs SchedulerConfiguration,
-    stored in state schema.go:657; algorithm + preemption toggles)."""
+    stored in state schema.go:657; algorithm + preemption toggles). A
+    dataclass so the wire codec (structs/codec.py) can journal it."""
 
-    def __init__(self, algorithm: str = "binpack",
-                 preemption_system: bool = True,
-                 preemption_service: bool = False,
-                 preemption_batch: bool = False):
-        self.scheduler_algorithm = algorithm
-        self.preemption_system_enabled = preemption_system
-        self.preemption_service_enabled = preemption_service
-        self.preemption_batch_enabled = preemption_batch
+    scheduler_algorithm: str = "binpack"
+    preemption_system_enabled: bool = True
+    preemption_service_enabled: bool = False
+    preemption_batch_enabled: bool = False
 
 
 def proposed_allocs(state: State, plan: Plan, node_id: str) -> List[Allocation]:

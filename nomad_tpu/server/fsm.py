@@ -1,0 +1,95 @@
+"""FSM — typed log entries applied to the StateStore, plus full-state
+snapshot encode/decode.
+
+Behavioral reference: `nomad/fsm.go` (nomadFSM :74, Apply :180 dispatching
+~40 message types to StateStore mutations, Snapshot :1242, Restore :1256).
+The entry stream here is exactly the state-store write API: each server
+endpoint records the operation it performs, and replaying the stream
+through `FSM.apply` reproduces the state byte-for-byte (including the
+index counter, which advances in the mutators themselves). The same entry
+encoding rides the Raft transport for multi-server replication.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from ..structs.codec import from_wire, to_wire
+
+# Log-entry op names ARE the state-store write API (the fsm.go message-type
+# table collapses to this whitelist; each op maps 1:1 onto a mutator).
+ALLOWED_OPS = frozenset({
+    "upsert_node", "delete_node",
+    "upsert_job", "delete_job",
+    "upsert_eval", "delete_eval",
+    "upsert_alloc", "delete_alloc", "update_alloc_from_client",
+    "upsert_deployment", "delete_deployment",
+    "upsert_plan_results", "mark_job_stable", "set_scheduler_config",
+})
+
+
+class FSM:
+    """Applies decoded log entries to a StateStore (fsm.go Apply :180)."""
+
+    def __init__(self, state) -> None:
+        self.state = state
+
+    def apply(self, entry: Dict[str, Any]) -> None:
+        op = entry["op"]
+        if op not in ALLOWED_OPS:
+            raise ValueError(f"unknown FSM op {op!r}")
+        args = [from_wire(a) for a in entry["args"]]
+        getattr(self.state, op)(*args)
+
+
+# ---- snapshot (fsm.go Snapshot :1242 / Restore :1256) ----
+
+def snapshot_state(state) -> Dict[str, Any]:
+    """Full-state snapshot as a msgpack-ready tree. Caller must hold the
+    store quiescent (the server pauses appends around this)."""
+    return {
+        "index": state.index.value,
+        "nodes": [to_wire(n) for n in state.nodes()],
+        "jobs": [to_wire(j) for j in state.jobs()],
+        "job_versions": [
+            [ns, jid, ver, to_wire(job)]
+            for (ns, jid, ver), job in state._job_versions.items()
+        ],
+        "allocs": [to_wire(a) for a in state._allocs.values()],
+        "evals": [to_wire(e) for e in state.evals()],
+        "deployments": [to_wire(d) for d in state.deployments()],
+        "scheduler_config": to_wire(state.scheduler_config()),
+    }
+
+
+def _upsert_preserving_indexes(mutator, obj) -> None:
+    # The normal mutators stamp a fresh modify_index; a restore must keep
+    # the persisted one (GC thresholds and blocking queries depend on it).
+    ci, mi = obj.create_index, obj.modify_index
+    mutator(obj)
+    obj.create_index, obj.modify_index = ci, mi
+
+
+def restore_state(state, snap: Dict[str, Any]) -> None:
+    """Rebuild a StateStore from a snapshot tree. Runs through the normal
+    mutators so derived structures (alloc indexes, cluster tensors) are
+    rebuilt, then pins the index counter to the snapshot's value."""
+    for tree in snap["nodes"]:
+        _upsert_preserving_indexes(state.upsert_node, from_wire(tree))
+    for tree in snap["jobs"]:
+        job = from_wire(tree)
+        jmi = job.job_modify_index
+        _upsert_preserving_indexes(state.upsert_job, job)
+        job.job_modify_index = jmi
+    for ns, jid, ver, tree in snap.get("job_versions", []):
+        job = from_wire(tree)
+        state._job_versions[(ns, jid, ver)] = job
+    for tree in snap["allocs"]:
+        _upsert_preserving_indexes(state.upsert_alloc, from_wire(tree))
+    for tree in snap["evals"]:
+        _upsert_preserving_indexes(state.upsert_eval, from_wire(tree))
+    for tree in snap["deployments"]:
+        _upsert_preserving_indexes(state.upsert_deployment, from_wire(tree))
+    cfg = snap.get("scheduler_config")
+    if cfg is not None:
+        state.set_scheduler_config(from_wire(cfg))
+    state.index.value = snap["index"]

@@ -44,18 +44,31 @@ from .worker import Worker
 class ServerConfig:
     def __init__(self, num_schedulers: int = 1, heartbeat_ttl: float = 10.0,
                  nack_timeout: float = 60.0, gc_interval: float = 60.0,
-                 gc=None):
+                 gc=None, data_dir: Optional[str] = None,
+                 fsync: bool = False, snapshot_threshold: int = 8192):
         self.num_schedulers = num_schedulers
         self.heartbeat_ttl = heartbeat_ttl
         self.nack_timeout = nack_timeout
         self.gc_interval = gc_interval
         self.gc = gc  # GCConfig | None (core_sched.py defaults)
+        self.data_dir = data_dir  # None → in-memory only (dev agent mode)
+        self.fsync = fsync
+        self.snapshot_threshold = snapshot_threshold
 
 
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None) -> None:
         self.config = config or ServerConfig()
-        self.state = StateStore()
+        if self.config.data_dir:
+            from .wal import DurableStateStore, Wal
+
+            self.state = DurableStateStore(
+                Wal(self.config.data_dir, fsync=self.config.fsync),
+                snapshot_threshold=self.config.snapshot_threshold,
+            )
+            self.state.restore()
+        else:
+            self.state = StateStore()
         self.broker = EvalBroker(nack_timeout=self.config.nack_timeout)
         self.blocked = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
@@ -86,6 +99,7 @@ class Server:
         self.broker.set_enabled(True)
         self.blocked.set_enabled(True)
         self.plan_queue.set_enabled(True)
+        self._restore_evals()
         self.planner.start()
         for w in self.workers:
             w.start()
@@ -105,6 +119,22 @@ class Server:
                 self.heartbeater.reset(node.id)
         self._running = True
 
+    def _restore_evals(self) -> None:
+        """Re-enqueue non-terminal evals from state into the broker/blocked
+        tracker (reference restoreEvals, leader.go:352 — eval state must
+        survive restart/leader failover)."""
+        for e in self.state.evals():
+            if e.should_enqueue():
+                self.broker.enqueue(e)
+            elif e.should_block():
+                self.blocked.block(e)
+
+    def snapshot_save(self) -> None:
+        """`operator snapshot save` (helper/snapshot) — durable mode only."""
+        save = getattr(self.state, "snapshot_save", None)
+        if save is not None:
+            save()
+
     def shutdown(self) -> None:
         self._running = False
         self._stop_event.set()
@@ -118,6 +148,9 @@ class Server:
         self.broker.shutdown()
         for w in self.workers:
             w.join()
+        wal = getattr(self.state, "wal", None)
+        if wal is not None:
+            wal.close()
 
     # ---- core GC (leader.go schedulePeriodic + core_sched.go) ----
 

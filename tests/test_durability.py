@@ -1,0 +1,191 @@
+"""Codec round-trip + WAL/snapshot checkpoint-resume (reference test models:
+nomad/fsm_test.go, helper/snapshot tests; restoreEvals leader_test.go)."""
+import copy
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.wal import DurableStateStore, Wal
+from nomad_tpu.structs import Allocation, Evaluation
+from nomad_tpu.structs.codec import from_wire, to_wire
+from nomad_tpu.structs.node import DrainStrategy
+
+
+class TestCodec:
+    def test_node_round_trip(self):
+        n = mock.node()
+        n.drain = DrainStrategy(deadline_s=5.0, ignore_system_jobs=True)
+        out = from_wire(to_wire(n))
+        assert out == n and out is not n
+
+    def test_job_round_trip(self):
+        from nomad_tpu.structs.job import MigrateStrategy, PeriodicConfig
+
+        j = mock.job()
+        j.periodic = PeriodicConfig(spec="*/5 * * * *")
+        j.task_groups[0].migrate_strategy = MigrateStrategy(max_parallel=2)
+        j.meta["team"] = "infra"
+        assert from_wire(to_wire(j)) == j
+
+    def test_alloc_with_embedded_job(self):
+        a = mock.alloc()
+        out = from_wire(to_wire(a))
+        assert out == a
+        assert out.job == a.job
+
+    def test_eval_and_deployment(self):
+        e = Evaluation(id="e1", namespace="default", job_id="j",
+                       type="service", priority=70, status="blocked",
+                       wait_until=123.5)
+        assert from_wire(to_wire(e)) == e
+        from nomad_tpu.structs.deployment import new_deployment
+
+        d = new_deployment(mock.job())
+        assert from_wire(to_wire(d)) == d
+
+    def test_msgpack_safe(self):
+        import msgpack
+
+        j = mock.job()
+        j.payload = b"\x00\x01binary"
+        packed = msgpack.packb(to_wire(j), use_bin_type=True)
+        out = from_wire(msgpack.unpackb(packed, raw=False,
+                                        strict_map_key=False))
+        assert out == j
+
+
+class TestWal:
+    def test_append_load(self, tmp_path):
+        w = Wal(str(tmp_path))
+        w.append("upsert_node", [to_wire(mock.node())])
+        w.append("delete_node", ["abc"])
+        w.close()
+        w2 = Wal(str(tmp_path))
+        snap, entries = w2.load()
+        assert snap is None
+        assert [e["op"] for e in entries] == ["upsert_node", "delete_node"]
+        assert w2.seq == 2
+
+    def test_torn_tail_recovery(self, tmp_path):
+        w = Wal(str(tmp_path))
+        w.append("delete_node", ["a"])
+        w.append("delete_node", ["b"])
+        w.close()
+        path = os.path.join(str(tmp_path), "wal.log")
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:-3])  # torn final frame
+        _, entries = Wal(str(tmp_path)).load()
+        assert [e["args"][0] for e in entries] == ["a"]
+
+    def test_torn_tail_then_append_survives_second_restart(self, tmp_path):
+        w = Wal(str(tmp_path))
+        w.append("delete_node", ["a"])
+        w.append("delete_node", ["b"])
+        w.close()
+        path = os.path.join(str(tmp_path), "wal.log")
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[:-3])  # crash mid-append
+        w2 = Wal(str(tmp_path))
+        _, entries = w2.load()  # truncates the torn frame
+        assert len(entries) == 1
+        w2.append("delete_node", ["c"])
+        w2.close()
+        _, entries = Wal(str(tmp_path)).load()
+        assert [e["args"][0] for e in entries] == ["a", "c"]
+
+    def test_snapshot_rotation(self, tmp_path):
+        store = DurableStateStore(Wal(str(tmp_path)), snapshot_threshold=5)
+        for i in range(7):
+            store.upsert_node(mock.node())
+        # threshold crossed → snapshot written, log truncated
+        assert os.path.exists(os.path.join(str(tmp_path), "snapshot.mp"))
+        store2 = DurableStateStore(Wal(str(tmp_path)))
+        store2.restore()
+        assert len(store2.nodes()) == 7
+        assert store2.index.value == store.index.value
+
+
+class TestServerResume:
+    def _mk(self, tmp_path, **kw):
+        s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0,
+                                data_dir=str(tmp_path), **kw))
+        s.start()
+        return s
+
+    def test_full_checkpoint_resume(self, tmp_path):
+        s1 = self._mk(tmp_path)
+        try:
+            for _ in range(3):
+                s1.node_register(mock.node())
+            job = mock.job()
+            job.task_groups[0].count = 4
+            ev = s1.job_register(job)
+            done = s1.wait_for_eval(ev.id)
+            assert done.status == "complete"
+            allocs1 = sorted(a.id for a in
+                             s1.state.allocs_by_job("default", job.id))
+            assert len(allocs1) == 4
+            idx1 = s1.state.index.value
+        finally:
+            s1.shutdown()
+
+        s2 = self._mk(tmp_path)
+        try:
+            assert len(s2.state.nodes()) == 3
+            assert s2.state.job_by_id("default", job.id) is not None
+            allocs2 = sorted(a.id for a in
+                             s2.state.allocs_by_job("default", job.id))
+            assert allocs2 == allocs1
+            assert s2.state.index.value == idx1
+            # cluster tensors rebuilt: a new job can still be placed
+            job2 = mock.job()
+            ev2 = s2.job_register(job2)
+            done2 = s2.wait_for_eval(ev2.id)
+            assert done2.status == "complete"
+        finally:
+            s2.shutdown()
+
+    def test_pending_evals_requeued_on_restart(self, tmp_path):
+        s1 = self._mk(tmp_path)
+        try:
+            # No nodes: eval completes but leaves a blocked eval; ALSO park a
+            # pending eval directly in state to model a crash before dequeue.
+            job = mock.job()
+            ev = s1.job_register(job)
+            s1.wait_for_eval(ev.id)
+            assert s1.blocked.blocked_count() == 1
+            parked = Evaluation(id="parked", namespace="default",
+                                job_id=job.id, type="service",
+                                priority=50, status="pending",
+                                triggered_by="job-register")
+            s1.state.upsert_eval(parked)
+        finally:
+            s1.shutdown()
+
+        s2 = self._mk(tmp_path)
+        try:
+            # blocked eval restored into the blocked tracker
+            assert s2.blocked.blocked_count() >= 1
+            # the parked pending eval was re-enqueued and processed
+            done = s2.wait_for_eval("parked", timeout=5.0)
+            assert done is not None and done.status in ("complete", "blocked")
+        finally:
+            s2.shutdown()
+
+    def test_operator_snapshot_save(self, tmp_path):
+        s1 = self._mk(tmp_path)
+        try:
+            s1.node_register(mock.node())
+            s1.snapshot_save()
+            assert os.path.exists(os.path.join(str(tmp_path), "snapshot.mp"))
+            # log truncated; state restorable from snapshot alone
+        finally:
+            s1.shutdown()
+        s2 = self._mk(tmp_path)
+        try:
+            assert len(s2.state.nodes()) == 1
+        finally:
+            s2.shutdown()

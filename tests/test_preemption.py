@@ -329,7 +329,7 @@ class TestServiceSchedPreemption:
 
     def test_service_preemption_end_to_end(self):
         h = Harness()
-        h.state.set_scheduler_config(SchedulerConfiguration(preemption_service=True))
+        h.state.set_scheduler_config(SchedulerConfiguration(preemption_service_enabled=True))
         _nodes, victims = _fill_cluster(h, 3)
         job = mock.job(priority=100)
         job.task_groups[0].count = 1
@@ -361,7 +361,7 @@ class TestServiceSchedPreemption:
 
     def test_higher_priority_not_preempted(self):
         h = Harness()
-        h.state.set_scheduler_config(SchedulerConfiguration(preemption_service=True))
+        h.state.set_scheduler_config(SchedulerConfiguration(preemption_service_enabled=True))
         _fill_cluster(h, 3, victim_priority=95)
         job = mock.job(priority=100)
         job.task_groups[0].count = 1
