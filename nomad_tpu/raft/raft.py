@@ -1,0 +1,466 @@
+"""A compact, correct Raft: leader election, log replication, commit.
+
+Behavioral reference: the reference embeds hashicorp/raft (consumed at
+`nomad/server.go:1198-1360`; FSM contract `nomad/fsm.go:74`); this module
+implements the protocol itself (Raft §5, Ongaro & Ousterhout) because no
+consensus library is vendored here:
+
+- RequestVote with the log-up-to-dateness check (§5.4.1)
+- AppendEntries with prev-log matching + conflict truncation (§5.3)
+- commitIndex advancement only for current-term entries (§5.4.2)
+- randomized election timeouts, leader heartbeats
+- optional on-disk persistence of (term, votedFor, log) — the raft-boltdb
+  analog — via msgpack frames
+
+Threading model: one ticker thread (election/heartbeat), one applier
+thread (feeds committed entries to the FSM apply_fn in order), replication
+performed per-peer on heartbeat ticks and on demand after an append.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+HEARTBEAT_INTERVAL = 0.05
+ELECTION_TIMEOUT = (0.15, 0.30)
+MAX_APPEND_BATCH = 512
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_id: Optional[str] = None) -> None:
+        super().__init__(f"not leader (leader={leader_id})")
+        self.leader_id = leader_id
+
+
+class _Log:
+    """1-indexed in-memory log with optional append-only file journal."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.entries: List[Dict[str, Any]] = []  # {"term": t, "data": ...}
+        self._path = path
+        self._fh = None
+        if path is not None and os.path.exists(path):
+            with open(path, "rb") as fh:
+                unpacker = msgpack.Unpacker(fh, raw=False,
+                                            strict_map_key=False)
+                try:
+                    for rec in unpacker:
+                        if rec.get("op") == "trunc":
+                            del self.entries[rec["from"] - 1:]
+                        else:
+                            self.entries.append(rec)
+                except Exception:
+                    pass  # torn tail
+
+    def _journal(self, rec: Dict[str, Any]) -> None:
+        if self._path is None:
+            return
+        if self._fh is None:
+            self._fh = open(self._path, "ab")
+        self._fh.write(msgpack.packb(rec, use_bin_type=True))
+        self._fh.flush()
+
+    def last_index(self) -> int:
+        return len(self.entries)
+
+    def term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        return self.entries[index - 1]["term"]
+
+    def append(self, term: int, data: Any) -> int:
+        entry = {"term": term, "data": data}
+        self.entries.append(entry)
+        self._journal(entry)
+        return len(self.entries)
+
+    def truncate_from(self, index: int) -> None:
+        """Drop entries[index:] (1-indexed, inclusive)."""
+        if index <= len(self.entries):
+            del self.entries[index - 1:]
+            self._journal({"op": "trunc", "from": index})
+
+    def slice(self, start: int, limit: int = MAX_APPEND_BATCH
+              ) -> List[Dict[str, Any]]:
+        """Entries from 1-indexed `start`."""
+        return self.entries[start - 1: start - 1 + limit]
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class RaftNode:
+    """One consensus participant.
+
+    peers: {node_id: (host, port)} including self. `rpc_server` must be an
+    RpcServer this node registers its Raft.* handlers on; `pool` a ConnPool
+    for outbound calls. `apply_fn(data)` receives committed entries in log
+    order on every node (leader and followers alike).
+    """
+
+    def __init__(self, node_id: str, peers: Dict[str, Tuple[str, int]],
+                 rpc_server, pool, apply_fn: Callable[[Any], None],
+                 data_dir: Optional[str] = None,
+                 heartbeat_interval: float = HEARTBEAT_INTERVAL,
+                 election_timeout: Tuple[float, float] = ELECTION_TIMEOUT,
+                 on_leadership_change: Optional[Callable[[bool], None]] = None,
+                 ) -> None:
+        self.id = node_id
+        self.peers = dict(peers)
+        self.pool = pool
+        self.apply_fn = apply_fn
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.on_leadership_change = on_leadership_change
+
+        self._lock = threading.RLock()
+        self._commit_cv = threading.Condition(self._lock)
+
+        self._meta_path = None
+        log_path = None
+        if data_dir is not None:
+            os.makedirs(data_dir, exist_ok=True)
+            self._meta_path = os.path.join(data_dir, "raft_meta.mp")
+            log_path = os.path.join(data_dir, "raft_log.mp")
+        self.log = _Log(log_path)
+
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self._load_meta()
+
+        self.state = FOLLOWER
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._last_heard = time.monotonic()
+        self._timeout = self._rand_timeout()
+        self._stop = threading.Event()
+        # futures: log index -> (event, [result])
+        self._waiters: Dict[int, threading.Event] = {}
+
+        rpc_server.register("Raft.RequestVote", self._handle_request_vote)
+        rpc_server.register("Raft.AppendEntries", self._handle_append_entries)
+
+        self._ticker = threading.Thread(target=self._run_ticker,
+                                        name=f"raft-tick-{node_id}",
+                                        daemon=True)
+        self._applier = threading.Thread(target=self._run_applier,
+                                         name=f"raft-apply-{node_id}",
+                                         daemon=True)
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._ticker.start()
+        self._applier.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._commit_cv:
+            self._commit_cv.notify_all()
+        self.log.close()
+
+    # ---- persistence of (term, votedFor) ----
+
+    def _load_meta(self) -> None:
+        if self._meta_path is None or not os.path.exists(self._meta_path):
+            return
+        with open(self._meta_path, "rb") as fh:
+            meta = msgpack.unpackb(fh.read(), raw=False)
+        self.term = meta.get("term", 0)
+        self.voted_for = meta.get("voted_for")
+
+    def _save_meta(self) -> None:
+        if self._meta_path is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb(
+                {"term": self.term, "voted_for": self.voted_for}))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._meta_path)
+
+    def _rand_timeout(self) -> float:
+        return random.uniform(*self.election_timeout)
+
+    # ---- role transitions (hold lock) ----
+
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+        was_leader = self.state == LEADER
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._save_meta()
+        self.state = FOLLOWER
+        if leader is not None:
+            self.leader_id = leader
+        self._last_heard = time.monotonic()
+        self._timeout = self._rand_timeout()
+        if was_leader:
+            # Fail in-flight apply() futures — their entries may be
+            # overwritten by the new leader; apply() re-checks term+commit.
+            waiters, self._waiters = self._waiters, {}
+            for ev in waiters.values():
+                ev.set()
+            self._notify_leadership(False)
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.id
+        nxt = self.log.last_index() + 1
+        self._next_index = {p: nxt for p in self.peers if p != self.id}
+        self._match_index = {p: 0 for p in self.peers if p != self.id}
+        self._notify_leadership(True)
+
+    def _notify_leadership(self, is_leader: bool) -> None:
+        if self.on_leadership_change is not None:
+            cb = self.on_leadership_change
+            threading.Thread(target=cb, args=(is_leader,),
+                             daemon=True).start()
+
+    # ---- public API ----
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader(self) -> Optional[str]:
+        with self._lock:
+            return self.leader_id
+
+    def apply(self, data: Any, timeout: float = 10.0) -> int:
+        """Leader-only: append, replicate, wait for commit. Returns the
+        entry's log index (hashicorp/raft Apply future)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            append_term = self.term
+            idx = self.log.append(append_term, data)
+            ev = threading.Event()
+            self._waiters[idx] = ev
+        self._replicate_all()
+        if not ev.wait(timeout):
+            with self._lock:
+                self._waiters.pop(idx, None)
+            raise TimeoutError("raft apply timed out (no quorum?)")
+        with self._lock:
+            if (self.commit_index >= idx
+                    and self.log.last_index() >= idx
+                    and self.log.term_at(idx) == append_term):
+                return idx
+        raise NotLeaderError(self.leader_id)  # lost leadership mid-apply
+
+    def barrier(self, timeout: float = 10.0) -> None:
+        """Commit a no-op to flush the pipeline (hashicorp/raft Barrier)."""
+        self.apply({"op": "__noop__"}, timeout=timeout)
+
+    # ---- ticker ----
+
+    def _run_ticker(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval / 2):
+            with self._lock:
+                state = self.state
+                overdue = (time.monotonic() - self._last_heard
+                           > self._timeout)
+            if state == LEADER:
+                self._replicate_all()
+            elif overdue:
+                self._run_election()
+
+    # ---- election ----
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.term += 1
+            self.voted_for = self.id
+            self._save_meta()
+            term = self.term
+            self._last_heard = time.monotonic()
+            self._timeout = self._rand_timeout()
+            last_idx = self.log.last_index()
+            last_term = self.log.term_at(last_idx)
+        votes = {self.id}
+        vote_lock = threading.Lock()
+        majority = len(self.peers) // 2 + 1
+        done = threading.Event()
+
+        def ask(peer_id: str, addr) -> None:
+            try:
+                res = self.pool.call(addr, "Raft.RequestVote", term, self.id,
+                                     last_idx, last_term, timeout=1.0)
+            except Exception:
+                return
+            with self._lock:
+                if res["term"] > self.term:
+                    self._become_follower(res["term"], None)
+                    done.set()
+                    return
+                if (self.state != CANDIDATE or self.term != term
+                        or not res["granted"]):
+                    return
+            with vote_lock:
+                votes.add(peer_id)
+                if len(votes) >= majority:
+                    done.set()
+
+        threads = []
+        for pid, addr in self.peers.items():
+            if pid == self.id:
+                continue
+            t = threading.Thread(target=ask, args=(pid, addr), daemon=True)
+            t.start()
+            threads.append(t)
+        done.wait(self.election_timeout[0])
+        with self._lock:
+            if (self.state == CANDIDATE and self.term == term
+                    and len(votes) >= majority):
+                self._become_leader()
+        if self.is_leader():
+            self._replicate_all()
+
+    def _handle_request_vote(self, term: int, candidate: str,
+                             last_log_index: int, last_log_term: int) -> dict:
+        with self._lock:
+            if term > self.term:
+                self._become_follower(term, None)
+            granted = False
+            if term == self.term and self.voted_for in (None, candidate):
+                my_last = self.log.last_index()
+                my_term = self.log.term_at(my_last)
+                up_to_date = (last_log_term, last_log_index) >= (my_term,
+                                                                 my_last)
+                if up_to_date:
+                    granted = True
+                    self.voted_for = candidate
+                    self._save_meta()
+                    self._last_heard = time.monotonic()
+            return {"term": self.term, "granted": granted}
+
+    # ---- replication ----
+
+    def _replicate_all(self) -> None:
+        for pid, addr in self.peers.items():
+            if pid != self.id:
+                threading.Thread(target=self._replicate_one,
+                                 args=(pid, addr), daemon=True).start()
+
+    def _replicate_one(self, peer_id: str, addr) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.term
+            next_idx = self._next_index.get(peer_id, 1)
+            prev_idx = next_idx - 1
+            prev_term = self.log.term_at(prev_idx)
+            entries = self.log.slice(next_idx)
+            commit = self.commit_index
+        try:
+            res = self.pool.call(addr, "Raft.AppendEntries", term, self.id,
+                                 prev_idx, prev_term, entries, commit,
+                                 timeout=2.0)
+        except Exception:
+            return
+        with self._lock:
+            if res["term"] > self.term:
+                self._become_follower(res["term"], None)
+                return
+            if self.state != LEADER or self.term != term:
+                return
+            if res["success"]:
+                match = prev_idx + len(entries)
+                if match > self._match_index.get(peer_id, 0):
+                    self._match_index[peer_id] = match
+                self._next_index[peer_id] = match + 1
+                self._advance_commit()
+            else:
+                # back off (conflict hint if provided)
+                hint = res.get("conflict_index")
+                self._next_index[peer_id] = max(
+                    1, hint if hint else next_idx - 1)
+
+    def _advance_commit(self) -> None:
+        """Majority-match rule, current-term restriction (§5.4.2)."""
+        for n in range(self.log.last_index(), self.commit_index, -1):
+            if self.log.term_at(n) != self.term:
+                break
+            count = 1 + sum(1 for m in self._match_index.values() if m >= n)
+            if count >= len(self.peers) // 2 + 1:
+                self.commit_index = n
+                self._commit_cv.notify_all()
+                break
+
+    def _handle_append_entries(self, term: int, leader: str, prev_idx: int,
+                               prev_term: int, entries: List[dict],
+                               leader_commit: int) -> dict:
+        with self._lock:
+            if term < self.term:
+                return {"term": self.term, "success": False}
+            self._become_follower(term, leader)
+            if prev_idx > self.log.last_index():
+                return {"term": self.term, "success": False,
+                        "conflict_index": self.log.last_index() + 1}
+            if prev_idx > 0 and self.log.term_at(prev_idx) != prev_term:
+                # walk back past the conflicting term (§5.3 fast backup)
+                t = self.log.term_at(prev_idx)
+                i = prev_idx
+                while i > 1 and self.log.term_at(i - 1) == t:
+                    i -= 1
+                return {"term": self.term, "success": False,
+                        "conflict_index": i}
+            # append/overwrite
+            idx = prev_idx
+            for e in entries:
+                idx += 1
+                if idx <= self.log.last_index():
+                    if self.log.term_at(idx) == e["term"]:
+                        continue
+                    self.log.truncate_from(idx)
+                self.log.append(e["term"], e["data"])
+            if leader_commit > self.commit_index:
+                self.commit_index = min(leader_commit, self.log.last_index())
+                self._commit_cv.notify_all()
+            return {"term": self.term, "success": True}
+
+    # ---- applier ----
+
+    def _run_applier(self) -> None:
+        while not self._stop.is_set():
+            with self._commit_cv:
+                while (self.last_applied >= self.commit_index
+                       and not self._stop.is_set()):
+                    self._commit_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                start = self.last_applied + 1
+                end = self.commit_index
+                batch = [(i, self.log.entries[i - 1]["data"])
+                         for i in range(start, end + 1)]
+                self.last_applied = end
+                waiters = [self._waiters.pop(i) for i in range(start, end + 1)
+                           if i in self._waiters]
+            for _, data in batch:
+                if isinstance(data, dict) and data.get("op") == "__noop__":
+                    continue
+                try:
+                    self.apply_fn(data)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            for ev in waiters:
+                ev.set()

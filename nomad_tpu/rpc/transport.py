@@ -1,0 +1,255 @@
+"""TCP transport: length-prefixed msgpack frames, seq-matched pipelining.
+
+Behavioral reference: `nomad/rpc.go` (listener/dispatch :104,253),
+`helper/pool/pool.go` (msgpack codecs :23-28, conn pool :130). Frames are
+`uint32 big-endian length + msgpack body`:
+
+  request : {"t": "req", "seq": N, "method": "Job.Register", "args": [...]}
+  response: {"t": "res", "seq": N, "ok": bool, "result": ..., "error": str}
+
+Handlers are registered by dotted method name exactly like the reference's
+`<Endpoint>.<Method>` msgpack-RPC convention. The server answers requests
+on a connection concurrently (one worker per request) so a slow RPC —
+e.g. a blocking query — doesn't head-of-line-block Raft heartbeats sharing
+the address (the reference gets this from yamux streams + goroutines).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote error string."""
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def read_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_read_exact(sock, 4))
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {length}")
+    return msgpack.unpackb(_read_exact(sock, length), raw=False,
+                           strict_map_key=False)
+
+
+def write_frame(sock: socket.socket, obj: Any,
+                lock: Optional[threading.Lock] = None) -> None:
+    body = msgpack.packb(obj, use_bin_type=True)
+    frame = _LEN.pack(len(body)) + body
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+class RpcServer:
+    """Listens on (host, port); dispatches requests to named handlers."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._handlers: Dict[str, Callable] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.addr: Tuple[str, int] = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, method: str, handler: Callable) -> None:
+        self._handlers[method] = handler
+
+    def register_endpoint(self, name: str, obj: Any) -> None:
+        """Register every public method of `obj` as `Name.method`
+        (the reference's per-noun endpoint structs, nomad/server.go
+        setupRpcServer)."""
+        for attr in dir(obj):
+            if attr.startswith("_"):
+                continue
+            fn = getattr(obj, attr)
+            if callable(fn):
+                self.register(f"{name}.{attr}", fn)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="rpc-accept", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                msg = read_frame(conn)
+                threading.Thread(
+                    target=self._handle_one, args=(conn, wlock, msg),
+                    daemon=True,
+                ).start()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_one(self, conn, wlock, msg) -> None:
+        res = {"t": "res", "seq": msg.get("seq")}
+        handler = self._handlers.get(msg.get("method", ""))
+        try:
+            if handler is None:
+                raise RpcError(f"unknown method {msg.get('method')!r}")
+            res["ok"] = True
+            res["result"] = handler(*msg.get("args", []))
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            res["ok"] = False
+            res["error"] = f"{type(e).__name__}: {e}"
+        try:
+            write_frame(conn, res, wlock)
+        except (ConnectionError, OSError):
+            pass
+
+
+class _Pending:
+    __slots__ = ("event", "msg")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.msg: Optional[dict] = None
+
+
+class RpcClient:
+    """One pipelined connection to a peer; thread-safe call()."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout: float = 5.0) -> None:
+        self.addr = (host, port)
+        self._sock = socket.create_connection(self.addr,
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._seq = 0
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="rpc-reader", daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = read_frame(self._sock)
+                with self._plock:
+                    p = self._pending.pop(msg.get("seq"), None)
+                if p is not None:
+                    p.msg = msg
+                    p.event.set()
+        except (ConnectionError, OSError):
+            self._fail_all()
+
+    def _fail_all(self) -> None:
+        self._closed = True
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for p in pending.values():
+            p.event.set()
+
+    def call(self, method: str, *args: Any,
+             timeout: Optional[float] = 10.0) -> Any:
+        if self._closed:
+            raise ConnectionError("client closed")
+        with self._plock:
+            self._seq += 1
+            seq = self._seq
+            p = _Pending()
+            self._pending[seq] = p
+        try:
+            write_frame(self._sock,
+                        {"t": "req", "seq": seq, "method": method,
+                         "args": list(args)}, self._wlock)
+        except (ConnectionError, OSError):
+            self._fail_all()
+            raise ConnectionError("send failed")
+        if not p.event.wait(timeout):
+            with self._plock:
+                self._pending.pop(seq, None)
+            raise TimeoutError(f"rpc {method} timed out")
+        if p.msg is None:
+            raise ConnectionError("connection lost")
+        if not p.msg.get("ok"):
+            raise RpcError(p.msg.get("error", "unknown remote error"))
+        return p.msg.get("result")
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ConnPool:
+    """Shared RpcClient per address with reconnect-on-failure
+    (helper/pool/pool.go:130)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._conns: Dict[Tuple[str, int], RpcClient] = {}
+
+    def _get(self, addr: Tuple[str, int]) -> RpcClient:
+        with self._lock:
+            c = self._conns.get(addr)
+            if c is None or c._closed:
+                c = RpcClient(addr[0], addr[1])
+                self._conns[addr] = c
+            return c
+
+    def call(self, addr: Tuple[str, int], method: str, *args: Any,
+             timeout: Optional[float] = 10.0) -> Any:
+        try:
+            return self._get(tuple(addr)).call(method, *args, timeout=timeout)
+        except (ConnectionError, OSError):
+            # one reconnect attempt (pool.go reconnect semantics)
+            with self._lock:
+                self._conns.pop(tuple(addr), None)
+            return self._get(tuple(addr)).call(method, *args, timeout=timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()

@@ -120,7 +120,13 @@ class InMemState:
         for allocs in result.node_allocation.values():
             for a in allocs:
                 if a.job is None:
-                    a.job = self._jobs.get((a.namespace, a.job_id))
+                    # WAL replay strips the embedded job; reattach the
+                    # VERSION the alloc was placed with, not the current
+                    # table head — the reconciler's in-place/destructive
+                    # classification compares alloc.job.version.
+                    a.job = (self._job_versions.get(
+                        (a.namespace, a.job_id, a.job_version))
+                        or self._jobs.get((a.namespace, a.job_id)))
                 self.upsert_alloc(a)
         if result.deployment is not None:
             self.upsert_deployment(result.deployment)

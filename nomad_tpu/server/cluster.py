@@ -1,0 +1,268 @@
+"""Multi-server control plane: Raft-replicated state + leader forwarding.
+
+Behavioral reference: `nomad/server.go` (setupRaft :1198, setupRPC :1068),
+`nomad/leader.go` (monitorLeadership/establishLeadership :222 —
+broker/plan-queue/watchers enabled on the leader only, revoked on loss),
+`nomad/rpc.go` forward() — follower endpoints forward writes to the leader.
+
+Pieces:
+- `RaftStateStore` — the StateStore whose write API routes every mutation
+  through `RaftNode.apply`; the committed entry fires the FSM on EVERY
+  server (leader included), which performs the actual mutation through the
+  direct (non-routing) mutators. A leader write blocks until the entry is
+  committed and locally applied, so read-your-writes holds on the leader
+  exactly as the reference's raftApply does.
+- `ClusterServer` — one agent: RpcServer (one port for Raft + forwarded
+  endpoint RPCs, like the reference's multiplexed 4647), ConnPool, Server
+  wired on a RaftStateStore, RaftNode, and leadership-gated subsystems.
+
+Reads are local and may be stale on followers (the reference's default
+consistency for scheduling snapshots); writes on non-leaders raise and the
+endpoint wrapper forwards them.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..raft import NotLeaderError, RaftNode
+from ..rpc import ConnPool, RpcError, RpcServer
+from ..structs.codec import from_wire, to_wire
+from .fsm import ALLOWED_OPS, FSM
+from .server import Server, ServerConfig
+from .state import StateStore
+from .wal import _encode_args
+
+
+class _DirectView:
+    """Unrouted mutator access for the FSM applier (the fsm.go Apply path
+    writes straight to memdb, never back through raftApply). Marks the
+    calling thread as in-FSM-apply so NESTED mutator calls made by the
+    store itself (upsert_plan_results → self.upsert_alloc) also go direct
+    instead of re-entering raft — which would self-deadlock the applier."""
+
+    def __init__(self, store: "RaftStateStore") -> None:
+        self._store = store
+
+    def __getattr__(self, name: str):
+        fn = getattr(StateStore, name, None)
+        if fn is None:
+            raise AttributeError(name)
+        store = self._store
+
+        def call(*args):
+            prev = getattr(store._local, "direct", False)
+            store._local.direct = True
+            try:
+                return fn(store, *args)
+            finally:
+                store._local.direct = prev
+
+        call.__name__ = name
+        return call
+
+
+class RaftStateStore(StateStore):
+    """StateStore whose mutations are Raft-replicated before being applied."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.raft: Optional[RaftNode] = None  # attached by ClusterServer
+        self._intent_lock = threading.RLock()
+        self._local = threading.local()
+
+    def direct(self) -> _DirectView:
+        return _DirectView(self)
+
+    def transact(self):
+        """Serializes watcher read-modify-write sections against each other
+        only. Raft-committed mutations land from the applier thread under
+        the store lock — holding that lock across a blocking apply would
+        deadlock, and the reference has the same relaxed contract (watcher
+        RMWs race the plan applier through Raft; ModifyIndex checks and
+        plan re-verification absorb it)."""
+        return self._intent_lock
+
+    # After a routed upsert the FSM mutated a DECODED COPY, not the caller's
+    # object; callers read bookkeeping off their local object (e.g.
+    # job_register stamps the eval with job.modify_index), so the stored
+    # copy's indexes are synced back onto the argument post-commit.
+    _LOOKUP = {
+        "upsert_node": lambda s, a: s.node_by_id(a.id),
+        "upsert_job": lambda s, a: s.job_by_id(a.namespace, a.id),
+        "upsert_eval": lambda s, a: s.eval_by_id(a.id),
+        "upsert_alloc": lambda s, a: s.alloc_by_id(a.id),
+        "upsert_deployment": lambda s, a: s.deployment_by_id(a.id),
+        "update_alloc_from_client": lambda s, a: s.alloc_by_id(a.id),
+    }
+
+    def _route(name):  # noqa: N805
+        def method(self, *args):
+            if self.raft is None or getattr(self._local, "direct", False):
+                # bootstrap (pre-raft attach) or nested call under an
+                # FSM apply: mutate directly
+                return getattr(StateStore, name)(self, *args)
+            self.raft.apply({"op": name, "args": _encode_args(name, args)})
+            # The committed entry has been applied locally (apply blocks
+            # until last_applied covers it); reads now see the write.
+            look = self._LOOKUP.get(name)
+            if look is None:
+                return None
+            stored = look(self, args[0])
+            if stored is None:
+                return None
+            if name == "update_alloc_from_client":
+                return stored
+            for f in ("create_index", "modify_index", "job_modify_index",
+                      "alloc_modify_index"):
+                if hasattr(stored, f):
+                    setattr(args[0], f, getattr(stored, f))
+            return None
+
+        method.__name__ = name
+        return method
+
+    for _name in sorted(ALLOWED_OPS):
+        locals()[_name] = _route(_name)
+    del _name, _route
+
+
+class ClusterServerConfig(ServerConfig):
+    def __init__(self, node_id: str = "node", host: str = "127.0.0.1",
+                 port: int = 0, **kw):
+        super().__init__(**kw)
+        self.node_id = node_id
+        self.host = host
+        self.port = port
+
+
+#: endpoint methods a follower forwards to the leader (write RPCs; the
+#: reference forwards in each endpoint via rpc.go forward()).
+FORWARDED = (
+    "job_register", "job_deregister", "node_register", "node_update_status",
+    "node_update_drain", "node_update_eligibility", "node_heartbeat",
+    "update_alloc_from_client", "run_gc",
+)
+
+
+class ClusterServer:
+    """One server agent of a Raft-replicated region."""
+
+    def __init__(self, config: ClusterServerConfig,
+                 peers: Optional[Dict[str, Tuple[str, int]]] = None) -> None:
+        self.config = config
+        self.rpc = RpcServer(config.host, config.port)
+        self.pool = ConnPool()
+        self.addr = self.rpc.addr
+        self.peers = dict(peers) if peers else {config.node_id: self.addr}
+
+        state = RaftStateStore()
+        srv_cfg = ServerConfig(
+            num_schedulers=config.num_schedulers,
+            heartbeat_ttl=config.heartbeat_ttl,
+            nack_timeout=config.nack_timeout,
+            gc_interval=config.gc_interval, gc=config.gc,
+        )
+        self.server = Server(srv_cfg, state=state)
+        self.state = state
+
+        fsm = FSM(state.direct())
+        raft_dir = None
+        if config.data_dir:
+            raft_dir = config.data_dir
+        self.raft = RaftNode(
+            config.node_id, self.peers, self.rpc, self.pool,
+            apply_fn=fsm.apply, data_dir=raft_dir,
+            on_leadership_change=self._on_leadership_change,
+        )
+        state.raft = self.raft
+        self._srv_cfg = srv_cfg
+        self._register_endpoints()
+        self._leader_enabled = False
+        self._server_used = False
+        self._leader_lock = threading.Lock()
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self.rpc.start()
+        self.raft.start()
+
+    def shutdown(self) -> None:
+        with self._leader_lock:
+            if self._leader_enabled:
+                self._leader_enabled = False
+                self.server.shutdown()
+        self.raft.shutdown()
+        self.rpc.shutdown()
+        self.pool.close()
+
+    # ---- leadership (leader.go monitorLeadership) ----
+
+    def _on_leadership_change(self, is_leader: bool) -> None:
+        with self._leader_lock:
+            if is_leader and not self._leader_enabled:
+                if self._server_used:
+                    # Subsystem threads/brokers are single-shot; regaining
+                    # leadership rebuilds them over the same replicated
+                    # state (reference re-runs establishLeadership).
+                    self.server = Server(self._srv_cfg, state=self.state)
+                self._leader_enabled = True
+                self._server_used = True
+                self.server.start()
+            elif not is_leader and self._leader_enabled:
+                self._leader_enabled = False
+                self.server.shutdown()
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    # ---- endpoint RPC surface (Server.* methods, forwarded) ----
+
+    def _register_endpoints(self) -> None:
+        for m in FORWARDED:
+            self.rpc.register(f"Server.{m}", self._make_handler(m))
+
+    def _make_handler(self, method: str):
+        def handler(*wire_args):
+            out = self._invoke_local(method, wire_args)
+            return to_wire(out) if _is_struct(out) else _wire_result(out)
+
+        handler.__name__ = method
+        return handler
+
+    def _invoke_local(self, method: str, wire_args):
+        args = [from_wire(a) for a in wire_args]
+        if method == "update_alloc_from_client":
+            return self.state.update_alloc_from_client(*args)
+        return getattr(self.server, method)(*args)
+
+    # ---- client-facing call (forwarding; rpc.go forward()) ----
+
+    def call(self, method: str, *args, timeout: float = 10.0):
+        """Invoke an endpoint, forwarding to the leader when needed."""
+        if method not in FORWARDED:
+            raise ValueError(f"unknown endpoint {method!r}")
+        wire_args = [to_wire(a) if _is_struct(a) else a for a in args]
+        if self.is_leader():
+            out = self._invoke_local(method, wire_args)
+            return out
+        leader = self.raft.leader()
+        if leader is None or leader not in self.peers:
+            raise NotLeaderError(leader)
+        res = self.pool.call(self.peers[leader], f"Server.{method}",
+                             *wire_args, timeout=timeout)
+        return from_wire(res)
+
+
+def _is_struct(v) -> bool:
+    import dataclasses
+
+    return dataclasses.is_dataclass(v) and not isinstance(v, type)
+
+
+def _wire_result(v):
+    if isinstance(v, list):
+        return [to_wire(x) if _is_struct(x) else x for x in v]
+    return v

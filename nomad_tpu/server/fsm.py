@@ -76,10 +76,7 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
     for tree in snap["nodes"]:
         _upsert_preserving_indexes(state.upsert_node, from_wire(tree))
     for tree in snap["jobs"]:
-        job = from_wire(tree)
-        jmi = job.job_modify_index
-        _upsert_preserving_indexes(state.upsert_job, job)
-        job.job_modify_index = jmi
+        _upsert_preserving_indexes(state.upsert_job, from_wire(tree))
     for ns, jid, ver, tree in snap.get("job_versions", []):
         job = from_wire(tree)
         state._job_versions[(ns, jid, ver)] = job

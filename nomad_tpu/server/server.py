@@ -57,9 +57,13 @@ class ServerConfig:
 
 
 class Server:
-    def __init__(self, config: Optional[ServerConfig] = None) -> None:
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 state: Optional[StateStore] = None) -> None:
         self.config = config or ServerConfig()
-        if self.config.data_dir:
+        if state is not None:
+            # Injected store (the cluster agent passes a RaftStateStore)
+            self.state = state
+        elif self.config.data_dir:
             from .wal import DurableStateStore, Wal
 
             self.state = DurableStateStore(

@@ -133,6 +133,12 @@ def _encode_args(op: str, args) -> List[Any]:
                 for a in allocs:
                     a["job"] = None
         return [None, wire]
+    if op == "update_alloc_from_client":
+        # Replay copies only the client-status fields; the embedded Job
+        # tree would bloat the hottest durable write for nothing.
+        wire = to_wire(args[0])
+        wire["job"] = None
+        return [wire]
     return [to_wire(a) if not isinstance(
         a, (str, int, float, bool, bytes, type(None))) else a for a in args]
 

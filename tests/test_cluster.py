@@ -1,0 +1,131 @@
+"""Multi-server control plane: raft-replicated state, leader-only
+subsystems, follower forwarding, leader failover (reference test model:
+nomad/leader_test.go — several in-process servers joined on localhost)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.cluster import ClusterServer, ClusterServerConfig
+
+
+def _wait(cond, timeout=15.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+def make_cluster(n=3):
+    configs = [ClusterServerConfig(node_id=f"s{i}", num_schedulers=1,
+                                   heartbeat_ttl=60.0, gc_interval=3600.0)
+               for i in range(n)]
+    # two-phase: allocate ports first, then share the peer map
+    agents = []
+    peers = {}
+    for cfg in configs:
+        a = ClusterServer(cfg)
+        peers[cfg.node_id] = a.addr
+        agents.append(a)
+    for a in agents:
+        a.peers.clear()
+        a.peers.update(peers)
+        a.raft.peers = dict(peers)
+    for a in agents:
+        a.start()
+    return agents
+
+
+@pytest.fixture()
+def cluster():
+    agents = make_cluster(3)
+    yield agents
+    for a in agents:
+        a.shutdown()
+
+
+def leader_of(agents):
+    for a in agents:
+        if a.is_leader():
+            return a
+    return None
+
+
+class TestCluster:
+    def test_leader_elected_and_subsystems_enabled(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        assert leader.server._running
+        followers = [a for a in cluster if a is not leader]
+        assert all(not f.server._running for f in followers)
+
+    def test_write_replicates_to_all(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        node = mock.node()
+        leader.call("node_register", node)
+        for a in cluster:
+            assert _wait(lambda a=a: a.state.node_by_id(node.id) is not None)
+            got = a.state.node_by_id(node.id)
+            assert got.name == node.name
+
+    def test_follower_forwards_job_and_scheduler_places(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        follower = next(a for a in cluster if a is not leader)
+        for _ in range(2):
+            follower.call("node_register", mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        ev = follower.call("job_register", job)
+        assert ev is not None
+        done = leader.server.wait_for_eval(ev.id, timeout=15.0)
+        assert done is not None and done.status == "complete"
+        # placements replicated everywhere
+        for a in cluster:
+            assert _wait(lambda a=a: len(
+                a.state.allocs_by_job("default", job.id)) == 3), \
+                f"{a.config.node_id} missing allocs"
+
+    def test_leader_failover_new_leader_schedules(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        survivors = [a for a in cluster if a is not leader]
+        survivors[0].call("node_register", mock.node())
+        leader.shutdown()
+
+        assert _wait(lambda: leader_of(survivors) is not None, 15.0), \
+            "no new leader"
+        new_leader = leader_of(survivors)
+        assert _wait(lambda: new_leader.server._running)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        ev = new_leader.call("job_register", job)
+        done = new_leader.server.wait_for_eval(ev.id, timeout=15.0)
+        assert done is not None and done.status == "complete"
+        allocs = new_leader.state.allocs_by_job("default", job.id)
+        assert len(allocs) == job.task_groups[0].count
+
+    def test_client_status_update_via_follower(self, cluster):
+        import copy
+
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        follower = next(a for a in cluster if a is not leader)
+        follower.call("node_register", mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        ev = follower.call("job_register", job)
+        leader.server.wait_for_eval(ev.id, timeout=15.0)
+        assert _wait(lambda: follower.state.allocs_by_job(
+            "default", job.id) != [])
+        a0 = follower.state.allocs_by_job("default", job.id)[0]
+        upd = copy.copy(a0)
+        upd.client_status = "running"
+        merged = follower.call("update_alloc_from_client", upd)
+        assert merged is not None and merged.client_status == "running"
+        for a in cluster:
+            assert _wait(lambda a=a: a.state.alloc_by_id(
+                a0.id).client_status == "running")
