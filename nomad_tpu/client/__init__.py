@@ -1,0 +1,6 @@
+"""Client agent (reference `client/` — SURVEY §2.3): fingerprinting,
+alloc/task runners with hook pipelines, drivers, log capture, state
+persistence, and the pull-mode sync loops against the server."""
+from .client import Client, ClientConfig, InProcConn, RpcConn, ServerConn
+
+__all__ = ["Client", "ClientConfig", "InProcConn", "RpcConn", "ServerConn"]

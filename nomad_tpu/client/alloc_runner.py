@@ -1,0 +1,207 @@
+"""AllocRunner — per-allocation lifecycle: hooks, task fan-out, status.
+
+Behavioral reference: `client/allocrunner/alloc_runner.go` (:35, Run :276,
+task-state fan-in handleTaskStateUpdates :443, update chan :732,
+destroy/GC :807-943) and the hook chain `alloc_runner_hooks.go:129`
+(allocDir → ... → health watcher). Client status derivation mirrors
+`Allocation.ClientStatus` aggregation: failed if any task failed, running
+while any task runs, complete when all tasks exited cleanly.
+
+Lifecycle ordering honors `lifecycle{hook="prestart"}` tasks: non-sidecar
+prestart tasks must exit successfully before main tasks launch
+(taskrunner lifecycle gating).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs import (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
+                       ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING,
+                       TASK_STATE_DEAD, Allocation, TaskState)
+from .allocdir import AllocDir
+from .task_runner import TaskRunner
+
+
+class AllocRunner:
+    def __init__(self, alloc: Allocation, base_dir: str, node=None,
+                 on_update: Optional[Callable[[Allocation], None]] = None
+                 ) -> None:
+        self.alloc = alloc
+        self.node = node
+        self.on_update = on_update
+        self.alloc_dir = AllocDir(base_dir, alloc.id)
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self.task_states: Dict[str, TaskState] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._destroyed = False
+        self._shutting_down = False
+        self.client_status = ALLOC_CLIENT_PENDING
+
+    def _tasks(self):
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group)
+        return list(tg.tasks) if tg else []
+
+    # ---- lifecycle ----
+
+    def run(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"alloc-{self.alloc.id[:8]}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        tasks = self._tasks()
+        # allocDir hook (alloc_runner_hooks.go allocDirHook)
+        self.alloc_dir.build([t.name for t in tasks])
+
+        def hook(t):
+            return t.lifecycle.hook if t.lifecycle is not None else ""
+
+        prestart = [t for t in tasks if hook(t) == "prestart"
+                    and not t.lifecycle.sidecar]
+        sidecars = [t for t in tasks if t.lifecycle is not None
+                    and t.lifecycle.sidecar and hook(t) != "poststop"]
+        poststart = [t for t in tasks if hook(t) == "poststart"
+                     and not t.lifecycle.sidecar]
+        poststop = [t for t in tasks if hook(t) == "poststop"]
+        main = [t for t in tasks
+                if t not in prestart and t not in sidecars
+                and t not in poststart and t not in poststop]
+
+        # prestart tasks run to successful completion first (lifecycle
+        # gating, taskrunner lifecycle.go)
+        for t in prestart:
+            tr = self._spawn(t)
+            if not self._wait_dead([tr]):
+                return
+            if tr.state.failed:
+                self._recompute_status()
+                return
+        mains = [self._spawn(t) for t in sidecars + main]
+        # poststart tasks launch once every main task is running
+        if poststart:
+            while not self._halted() and any(
+                    tr.state.state == "pending" for tr in mains):
+                time.sleep(0.02)
+            if not self._halted():
+                mains += [self._spawn(t) for t in poststart]
+        # poststop tasks run after the main set is dead (cleanup phase)
+        if poststop:
+            if not self._wait_dead(mains):
+                return
+            for t in poststop:
+                tr = self._spawn(t)
+                if not self._wait_dead([tr]):
+                    return
+        self._recompute_status()
+
+    def _halted(self) -> bool:
+        return self._destroyed or self._shutting_down
+
+    def _wait_dead(self, runners) -> bool:
+        """Wait for runners to die; False when halted first."""
+        while any(tr.state.state != TASK_STATE_DEAD for tr in runners):
+            if self._halted():
+                return False
+            time.sleep(0.02)
+        return True
+
+    def _spawn(self, task) -> TaskRunner:
+        tr = TaskRunner(
+            self.alloc, task,
+            task_dir=self.alloc_dir.task_dir(task.name),
+            logs_dir=self.alloc_dir.logs_dir,
+            node=self.node,
+            on_state_change=self._task_state_changed,
+        )
+        with self._lock:
+            self.task_runners[task.name] = tr
+            self.task_states[task.name] = tr.state
+        tr.start()
+        return tr
+
+    # ---- fan-in (handleTaskStateUpdates :443) ----
+
+    def _task_state_changed(self, name: str, state: TaskState) -> None:
+        with self._lock:
+            self.task_states[name] = state
+            tr = self.task_runners.get(name)
+            runners = list(self.task_runners.values())
+        # leader task death kills the rest (task_runner leader semantics)
+        if (tr is not None and tr.task.leader
+                and state.state == TASK_STATE_DEAD):
+            for other in runners:
+                if other is not tr:
+                    other.kill()
+        self._recompute_status()
+
+    def _recompute_status(self) -> None:
+        with self._lock:
+            states = list(self.task_states.values())
+        if not states:
+            status = ALLOC_CLIENT_PENDING
+        elif any(s.failed for s in states):
+            status = ALLOC_CLIENT_FAILED
+        elif all(s.state == TASK_STATE_DEAD for s in states):
+            status = ALLOC_CLIENT_COMPLETE
+        elif any(s.state == "running" for s in states):
+            status = ALLOC_CLIENT_RUNNING
+        else:
+            status = ALLOC_CLIENT_PENDING
+        self.client_status = status
+        if self.on_update is not None and not self._shutting_down:
+            # Fires on every task-state transition (not just status flips):
+            # the server needs restart counts and events too; the client
+            # sync loop coalesces bursts.
+            self.on_update(self.snapshot_alloc())
+
+    def snapshot_alloc(self) -> Allocation:
+        """Client-side view for allocSync (client.go:1898)."""
+        import copy
+
+        with self._lock:
+            up = copy.copy(self.alloc)
+            up.client_status = self.client_status
+            up.task_states = {k: copy.deepcopy(v)
+                              for k, v in self.task_states.items()}
+        return up
+
+    # ---- server-driven updates (update chan :732) ----
+
+    def update(self, alloc: Allocation) -> None:
+        """Desired-state change pushed from the server."""
+        self.alloc = alloc
+        if alloc.server_terminal_status():
+            self.kill()
+
+    def kill(self) -> None:
+        with self._lock:
+            runners = list(self.task_runners.values())
+        for tr in runners:
+            tr.kill()
+
+    def shutdown(self) -> None:
+        """Client process exit: stop tasks WITHOUT reporting terminal
+        state — the alloc must restore as live on restart (alloc_runner.go
+        Shutdown vs Destroy distinction)."""
+        self._shutting_down = True
+        self.kill()
+
+    def destroy(self) -> None:
+        self._destroyed = True
+        self.kill()
+        for tr in list(self.task_runners.values()):
+            tr.join(timeout=5.0)
+        self.alloc_dir.destroy()
+
+    def wait(self, timeout: float = 10.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                states = list(self.task_states.values())
+            if states and all(s.state == TASK_STATE_DEAD for s in states):
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,56 @@
+"""Per-allocation directory tree.
+
+Behavioral reference: `client/allocdir/alloc_dir.go` — layout:
+
+    <alloc_dir>/
+      alloc/            shared between tasks (data/, logs/, tmp/)
+      <task>/
+        local/          task-private scratch
+        secrets/        0700, intended for credentials
+        tmp/
+
+Task stdout/stderr land in alloc/logs/<task>.{stdout,stderr}.N (logmon).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List
+
+SHARED_ALLOC_DIR = "alloc"
+TASK_LOCAL = "local"
+TASK_SECRETS = "secrets"
+
+
+class AllocDir:
+    def __init__(self, base: str, alloc_id: str) -> None:
+        self.root = os.path.join(base, alloc_id)
+        self.shared_dir = os.path.join(self.root, SHARED_ALLOC_DIR)
+        self.logs_dir = os.path.join(self.shared_dir, "logs")
+
+    def build(self, task_names: List[str]) -> None:
+        for d in (self.root, self.shared_dir,
+                  os.path.join(self.shared_dir, "data"),
+                  os.path.join(self.shared_dir, "tmp"), self.logs_dir):
+            os.makedirs(d, exist_ok=True)
+        for name in task_names:
+            self.build_task_dir(name)
+
+    def build_task_dir(self, task: str) -> str:
+        td = self.task_dir(task)
+        os.makedirs(os.path.join(td, TASK_LOCAL), exist_ok=True)
+        os.makedirs(os.path.join(td, "tmp"), exist_ok=True)
+        secrets = os.path.join(td, TASK_SECRETS)
+        os.makedirs(secrets, exist_ok=True)
+        os.chmod(secrets, 0o700)
+        # tasks see the shared dir at <task>/alloc (the bind-mount analog)
+        link = os.path.join(td, SHARED_ALLOC_DIR)
+        if not os.path.islink(link) and not os.path.exists(link):
+            os.symlink(self.shared_dir, link)
+        return td
+
+    def task_dir(self, task: str) -> str:
+        return os.path.join(self.root, task)
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.root, ignore_errors=True)

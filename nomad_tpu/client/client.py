@@ -1,0 +1,277 @@
+"""Client agent core — registration, heartbeats, the alloc pull loop.
+
+Behavioral reference: `client/client.go` (Client :162, NewClient :309,
+registerAndHeartbeat :1519, watchAllocations :1961 — blocking
+Node.GetClientAllocs then per-alloc fetch; runAllocs diff :2183;
+allocSync batched status push :1898; restoreState :1048).
+
+The server connection is a protocol (`ServerConn`): `InProcConn` wraps a
+Server in the same process (the reference's single-binary agent mode);
+an RPC-backed implementation rides the msgpack fabric for real
+deployments (same call surface).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Protocol, Tuple
+
+from ..structs import Allocation, Node
+from ..structs.node import NODE_STATUS_READY
+from .alloc_runner import AllocRunner
+from .fingerprint import FingerprintManager
+from .state import ClientStateDB, MemClientStateDB
+
+
+class ServerConn(Protocol):
+    def node_register(self, node: Node) -> None: ...
+    def node_heartbeat(self, node_id: str) -> bool: ...
+    def node_get_client_allocs(self, node_id: str, min_index: int,
+                               timeout: float) -> Tuple[int, Dict[str, int]]: ...
+    def alloc_get(self, alloc_id: str) -> Optional[Allocation]: ...
+    def node_update_allocs(self, updates: List[Allocation]) -> None: ...
+
+
+class InProcConn:
+    """Same-process server (agent mode: server+client in one binary)."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def node_register(self, node):
+        return self.server.node_register(node)
+
+    def node_heartbeat(self, node_id):
+        return self.server.node_heartbeat(node_id)
+
+    def node_get_client_allocs(self, node_id, min_index, timeout):
+        return self.server.node_get_client_allocs(node_id, min_index, timeout)
+
+    def alloc_get(self, alloc_id):
+        return self.server.alloc_get(alloc_id)
+
+    def node_update_allocs(self, updates):
+        return self.server.node_update_allocs(updates)
+
+
+class RpcConn:
+    """Server connection over the msgpack-RPC fabric with failover across
+    the configured server list (client/rpc.go + client/servers/)."""
+
+    def __init__(self, addrs, pool=None, rpc_timeout: float = 10.0) -> None:
+        from ..rpc import ConnPool
+
+        self.addrs = [tuple(a) for a in addrs]
+        self.pool = pool or ConnPool()
+        self.rpc_timeout = rpc_timeout
+
+    def _call(self, method, *args, timeout=None):
+        from ..structs.codec import from_wire, to_wire
+
+        wire = [to_wire(a) for a in args]
+        last_err = None
+        for addr in self.addrs:  # failover rotation (client/servers/)
+            try:
+                res = self.pool.call(addr, f"Server.{method}", *wire,
+                                     timeout=timeout or self.rpc_timeout)
+                return from_wire(res)
+            except Exception as e:  # noqa: BLE001 — try the next server
+                last_err = e
+        raise last_err if last_err else ConnectionError("no servers")
+
+    def node_register(self, node):
+        return self._call("node_register", node)
+
+    def node_heartbeat(self, node_id):
+        return self._call("node_heartbeat", node_id)
+
+    def node_get_client_allocs(self, node_id, min_index, timeout):
+        idx, allocs = self._call("node_get_client_allocs", node_id,
+                                 min_index, timeout,
+                                 timeout=timeout + self.rpc_timeout)
+        return idx, allocs
+
+    def alloc_get(self, alloc_id):
+        return self._call("alloc_get", alloc_id)
+
+    def node_update_allocs(self, updates):
+        return self._call("node_update_allocs", updates)
+
+
+class ClientConfig:
+    def __init__(self, data_dir: Optional[str] = None,
+                 node: Optional[Node] = None,
+                 heartbeat_interval: float = 3.0,
+                 sync_interval: float = 0.2,
+                 watch_timeout: float = 5.0,
+                 persist: bool = True) -> None:
+        self.data_dir = data_dir
+        self.node = node
+        self.heartbeat_interval = heartbeat_interval
+        self.sync_interval = sync_interval
+        self.watch_timeout = watch_timeout
+        self.persist = persist
+
+
+class Client:
+    def __init__(self, conn: ServerConn,
+                 config: Optional[ClientConfig] = None) -> None:
+        self.conn = conn
+        self.config = config or ClientConfig()
+        self.data_dir = self.config.data_dir or tempfile.mkdtemp(
+            prefix="nomad-client-")
+        self.alloc_dir_base = os.path.join(self.data_dir, "allocs")
+        self.state_db = (ClientStateDB(self.data_dir) if self.config.persist
+                         else MemClientStateDB())
+        self.node = self.config.node or Node(id=str(uuid.uuid4()))
+        if not self.node.id:
+            self.node.id = str(uuid.uuid4())
+        self.allocs: Dict[str, AllocRunner] = {}
+        self._known_index: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._dirty: Dict[str, Allocation] = {}
+        self._dirty_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        # fingerprint (client.go:401-408)
+        FingerprintManager().run(self.node)
+        self.node.status = NODE_STATUS_READY
+        self._restore()
+        self.conn.node_register(self.node)
+        for fn, name in ((self._run_heartbeat, "hb"),
+                         (self._run_watch, "watch"),
+                         (self._run_sync, "sync")):
+            t = threading.Thread(target=fn, name=f"client-{name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._dirty_cv:
+            self._dirty.clear()  # nothing more leaves this client
+            self._dirty_cv.notify_all()
+        for ar in list(self.allocs.values()):
+            # shutdown (not kill): tasks stop but the alloc is NOT reported
+            # terminal, so a restarted client restores it as live
+            ar.shutdown()
+
+    # ---- restore (client.go:1048) ----
+
+    def _restore(self) -> None:
+        for aid, rec in self.state_db.allocs().items():
+            alloc = rec["alloc"]
+            if alloc.server_terminal_status() \
+                    or alloc.client_terminal_status():
+                self.state_db.delete_alloc(aid)
+                continue
+            # re-run the alloc (driver handle re-attach is subsumed by
+            # restart: tasks restart under the restart policy)
+            self._add_alloc(alloc)
+
+    # ---- heartbeats (registerAndHeartbeat :1519) ----
+
+    def _run_heartbeat(self) -> None:
+        while not self._stop.wait(self.config.heartbeat_interval):
+            try:
+                ok = self.conn.node_heartbeat(self.node.id)
+                if not ok:  # server lost us: re-register (client.go:1605)
+                    self.conn.node_register(self.node)
+            except Exception:
+                pass  # retry next tick; server failover handled by conn
+
+    # ---- alloc watching (watchAllocations :1961) ----
+
+    def _run_watch(self) -> None:
+        min_index = 0
+        while not self._stop.is_set():
+            try:
+                idx, server_allocs = self.conn.node_get_client_allocs(
+                    self.node.id, min_index, self.config.watch_timeout)
+            except Exception:
+                if self._stop.wait(1.0):
+                    return
+                continue
+            min_index = max(min_index, idx)
+            self._run_allocs(server_allocs)
+
+    def _run_allocs(self, server_allocs: Dict[str, int]) -> None:
+        """Diff → add/update/remove (client.go runAllocs :2183)."""
+        with self._lock:
+            existing = dict(self._known_index)
+        # removed: server no longer lists the alloc → destroy local state
+        for aid in set(existing) - set(server_allocs):
+            self._remove_alloc(aid)
+        for aid, modify_index in server_allocs.items():
+            if existing.get(aid) == modify_index:
+                continue
+            alloc = self.conn.alloc_get(aid)
+            if alloc is None:
+                continue
+            with self._lock:
+                runner = self.allocs.get(aid)
+            if runner is None:
+                if not alloc.server_terminal_status():
+                    self._add_alloc(alloc)
+            else:
+                runner.update(alloc)
+            with self._lock:
+                self._known_index[aid] = modify_index
+
+    def _add_alloc(self, alloc: Allocation) -> None:
+        runner = AllocRunner(alloc, self.alloc_dir_base, node=self.node,
+                             on_update=self._alloc_updated)
+        with self._lock:
+            self.allocs[alloc.id] = runner
+            self._known_index[alloc.id] = alloc.modify_index
+        runner.run()
+
+    def _remove_alloc(self, alloc_id: str) -> None:
+        with self._lock:
+            runner = self.allocs.pop(alloc_id, None)
+            self._known_index.pop(alloc_id, None)
+        self.state_db.delete_alloc(alloc_id)
+        if runner is not None:
+            threading.Thread(target=runner.destroy, daemon=True).start()
+
+    # ---- status sync (allocSync :1898) ----
+
+    def _alloc_updated(self, alloc: Allocation) -> None:
+        self.state_db.put_alloc(alloc)
+        with self._dirty_cv:
+            self._dirty[alloc.id] = alloc
+            self._dirty_cv.notify_all()
+
+    def _run_sync(self) -> None:
+        while not self._stop.is_set():
+            with self._dirty_cv:
+                if not self._dirty:
+                    self._dirty_cv.wait(self.config.sync_interval)
+                batch, self._dirty = self._dirty, {}
+            if not batch:
+                continue
+            try:
+                self.conn.node_update_allocs(list(batch.values()))
+            except Exception:
+                with self._dirty_cv:  # retry next round
+                    for aid, a in batch.items():
+                        self._dirty.setdefault(aid, a)
+                if self._stop.wait(0.5):
+                    return
+
+    # ---- introspection ----
+
+    def alloc_runner(self, alloc_id: str) -> Optional[AllocRunner]:
+        with self._lock:
+            return self.allocs.get(alloc_id)
+
+    def num_allocs(self) -> int:
+        with self._lock:
+            return len(self.allocs)

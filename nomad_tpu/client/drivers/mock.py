@@ -1,0 +1,50 @@
+"""Mock driver — configurable fake for tests.
+
+Behavioral reference: `drivers/mock/driver.go` (:113 config knobs, :148
+task lifecycle): `run_for` seconds then exit `exit_code`; `start_error`
+fails StartTask; `start_block_for` delays start; `kill_after` ignores the
+stop signal for a while; `exit_signal`/`exit_err` shape the ExitResult.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
+
+
+class MockDriver(DriverPlugin):
+    name = "mock_driver"
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        rc = cfg.raw_config
+        if rc.get("start_error"):
+            raise RuntimeError(str(rc["start_error"]))
+        block = float(rc.get("start_block_for", 0) or 0)
+        if block:
+            time.sleep(block)
+        handle = TaskHandle(cfg.id, self.name)
+        handle._stop_requested = threading.Event()
+        run_for = float(rc.get("run_for", 0) or 0)
+        exit_code = int(rc.get("exit_code", 0) or 0)
+        exit_err = str(rc.get("exit_err", "") or "")
+        kill_after = float(rc.get("kill_after", 0) or 0)
+
+        def run():
+            deadline = time.monotonic() + run_for
+            while time.monotonic() < deadline:
+                if handle._stop_requested.wait(0.01):
+                    if kill_after:
+                        time.sleep(kill_after)
+                    handle.set_exit(ExitResult(exit_code=0, signal=15))
+                    return
+            handle.set_exit(ExitResult(exit_code=exit_code, err=exit_err))
+
+        threading.Thread(target=run, daemon=True).start()
+        return handle
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0,
+                  signal: str = "SIGTERM") -> None:
+        handle._stop_requested.set()
+        handle.wait(timeout_s if timeout_s > 0 else None)

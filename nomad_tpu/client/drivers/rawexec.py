@@ -1,0 +1,102 @@
+"""raw_exec driver — unisolated subprocess execution.
+
+Behavioral reference: `drivers/rawexec/driver.go`: `command` + `args`
+config, task env, working dir = task dir, stdout/stderr to the task log
+sinks, SIGTERM→SIGKILL stop with kill_timeout.
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+import subprocess
+import threading
+from typing import List, Optional
+
+from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
+
+
+class RawExecDriver(DriverPlugin):
+    name = "raw_exec"
+
+    # subclass hook (exec driver tightens this)
+    def _preexec(self, cfg: TaskConfig):
+        return os.setsid  # own process group so stop() can signal the tree
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        rc = cfg.raw_config
+        command = rc.get("command")
+        if not command:
+            raise ValueError("raw_exec requires config.command")
+        args: List[str] = [str(a) for a in rc.get("args", [])]
+
+        def out_target(sink, path):
+            if sink is not None:
+                return subprocess.PIPE
+            return open(path, "ab") if path else subprocess.DEVNULL
+
+        stdout = out_target(cfg.stdout_sink, cfg.stdout_path)
+        stderr = out_target(cfg.stderr_sink, cfg.stderr_path)
+        try:
+            proc = subprocess.Popen(
+                [str(command)] + args,
+                cwd=cfg.task_dir or None,
+                env={**os.environ, **cfg.env},
+                stdout=stdout, stderr=stderr,
+                preexec_fn=self._preexec(cfg),
+                start_new_session=False,
+            )
+        finally:
+            for fh in (stdout, stderr):
+                if hasattr(fh, "close"):
+                    fh.close()
+        handle = TaskHandle(cfg.id, self.name,
+                            {"pid": proc.pid})
+        handle._proc = proc
+
+        # pump piped output into the logmon sinks (rotation enforced there)
+        pumps = []
+        for stream, sink in ((proc.stdout, cfg.stdout_sink),
+                             (proc.stderr, cfg.stderr_sink)):
+            if stream is None or sink is None:
+                continue
+
+            def pump(stream=stream, sink=sink):
+                for chunk in iter(lambda: stream.read(8192), b""):
+                    try:
+                        sink(chunk)
+                    except Exception:
+                        break
+                stream.close()
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            pumps.append(t)
+
+        def reap():
+            code = proc.wait()
+            for t in pumps:
+                t.join(timeout=2.0)
+            if code < 0:
+                handle.set_exit(ExitResult(exit_code=0, signal=-code))
+            else:
+                handle.set_exit(ExitResult(exit_code=code))
+
+        threading.Thread(target=reap, daemon=True).start()
+        return handle
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0,
+                  signal: str = "SIGTERM") -> None:
+        proc = getattr(handle, "_proc", None)
+        if proc is None or not handle.is_running():
+            return
+        sig = getattr(_signal, signal, _signal.SIGTERM)
+        try:
+            os.killpg(proc.pid, sig)  # whole process group
+        except (ProcessLookupError, PermissionError):
+            pass
+        if handle.wait(timeout_s) is None:
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            handle.wait(2.0)

@@ -1,0 +1,174 @@
+"""Host fingerprinting — populate Node attributes/resources.
+
+Behavioral reference: `client/fingerprint/` (~20 fingerprinters composed
+by `fingerprint_manager.go:16,34`): arch, cpu, memory, storage, host,
+nomad, signal — plus the TPU-native replacement for the reference's
+NVML GPU fingerprinter (`devices/gpu/nvidia/`): `TPUFingerprint`
+publishes `tpu.count`/`tpu.type` from the JAX runtime, gated so hosts
+without an accelerator fingerprint cleanly.
+"""
+from __future__ import annotations
+
+import os
+import platform
+import shutil
+from typing import Callable, Dict, List, Tuple
+
+from ..structs import Node
+from ..structs.resources import NodeResources
+
+
+def arch_fingerprint(node: Node) -> None:
+    node.attributes["cpu.arch"] = platform.machine()
+
+
+def os_fingerprint(node: Node) -> None:
+    node.attributes["kernel.name"] = platform.system().lower()
+    node.attributes["kernel.version"] = platform.release()
+    node.attributes["os.name"] = platform.system().lower()
+    node.attributes["os.version"] = platform.version()
+
+
+def cpu_fingerprint(node: Node) -> None:
+    cores = os.cpu_count() or 1
+    node.attributes["cpu.numcores"] = str(cores)
+    mhz = 1000.0
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.lower().startswith("cpu mhz"):
+                    mhz = float(line.split(":")[1])
+                    break
+    except (OSError, ValueError):
+        pass
+    node.attributes["cpu.frequency"] = str(int(mhz))
+    total = int(cores * mhz)
+    node.attributes["cpu.totalcompute"] = str(total)
+    if node.node_resources.cpu == 0:
+        node.node_resources.cpu = total
+
+
+def memory_fingerprint(node: Node) -> None:
+    total_mb = 1024
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemTotal:"):
+                    total_mb = int(line.split()[1]) // 1024
+                    break
+    except (OSError, ValueError):
+        pass
+    node.attributes["memory.totalbytes"] = str(total_mb * 1024 * 1024)
+    if node.node_resources.memory_mb == 0:
+        node.node_resources.memory_mb = total_mb
+
+
+def storage_fingerprint(node: Node) -> None:
+    try:
+        usage = shutil.disk_usage("/")
+        free_mb = usage.free // (1024 * 1024)
+    except OSError:
+        free_mb = 1024
+    node.attributes["unique.storage.bytesfree"] = str(free_mb * 1024 * 1024)
+    if node.node_resources.disk_mb == 0:
+        node.node_resources.disk_mb = free_mb
+
+
+def network_fingerprint(node: Node) -> None:
+    """Default-interface detection (client/fingerprint/network.go): pick a
+    routable IP and publish a 1000-mbit link (speed detection is sysfs-
+    specific; the reference also defaults when unknown)."""
+    import socket
+
+    from ..structs.network import NetworkResource
+
+    ip = "127.0.0.1"
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))  # no traffic sent
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        pass
+    node.attributes["unique.network.ip-address"] = ip
+    if not node.node_resources.networks:
+        node.node_resources.networks = [NetworkResource(
+            device="eth0", cidr=f"{ip}/32", ip=ip, mbits=1000)]
+
+
+def host_fingerprint(node: Node) -> None:
+    node.attributes["unique.hostname"] = platform.node()
+    if not node.name:
+        node.name = platform.node()
+
+
+def nomad_fingerprint(node: Node) -> None:
+    from .. import __version__
+
+    node.attributes["nomad.version"] = __version__
+
+
+def signal_fingerprint(node: Node) -> None:
+    import signal as sig
+
+    names = sorted(s.name for s in sig.Signals
+                   if s.name.startswith("SIG") and "_" not in s.name)
+    node.attributes["os.signals"] = ",".join(names)
+
+
+def tpu_fingerprint(node: Node) -> None:
+    """TPU detection via the JAX runtime (the reference's NVML analog,
+    devices/gpu/nvidia/nvml/client.go:52-78). Gated: import failures or a
+    CPU-only platform leave the node un-annotated."""
+    if os.environ.get("NOMAD_TPU_SKIP_TPU_FINGERPRINT"):
+        return
+    try:
+        import jax
+
+        devs = [d for d in jax.devices() if d.platform != "cpu"]
+    except Exception:
+        return
+    if not devs:
+        return
+    node.attributes["tpu.count"] = str(len(devs))
+    node.attributes["tpu.type"] = getattr(devs[0], "device_kind",
+                                          devs[0].platform)
+    node.attributes["driver.tpu"] = "1"
+
+
+def driver_fingerprints(node: Node) -> None:
+    from .drivers import BUILTIN_DRIVERS
+
+    for name, cls in BUILTIN_DRIVERS.items():
+        try:
+            node.attributes.update(cls().fingerprint())
+        except Exception:
+            pass
+
+
+FINGERPRINTERS: List[Callable[[Node], None]] = [
+    arch_fingerprint, os_fingerprint, cpu_fingerprint, memory_fingerprint,
+    storage_fingerprint, network_fingerprint, host_fingerprint,
+    nomad_fingerprint, signal_fingerprint, tpu_fingerprint,
+    driver_fingerprints,
+]
+
+
+class FingerprintManager:
+    """Runs every fingerprinter over the node (fingerprint_manager.go)."""
+
+    def __init__(self, fingerprinters=None) -> None:
+        self.fingerprinters = fingerprinters or FINGERPRINTERS
+
+    def run(self, node: Node) -> Node:
+        if node.node_resources is None:
+            node.node_resources = NodeResources()
+        for fp in self.fingerprinters:
+            try:
+                fp(node)
+            except Exception:
+                pass  # a broken fingerprinter never blocks registration
+        node.compute_class()
+        return node

@@ -1,0 +1,128 @@
+"""Task log capture with size-based rotation.
+
+Behavioral reference: `client/logmon/` (logmon.go + logging/rotator.go):
+per-task stdout/stderr FIFOs feeding rotating files
+`<task>.{stdout,stderr}.N` under the alloc log dir, bounded by
+`LogConfig{max_files, max_file_size_mb}`. The reference runs logmon as an
+external plugin process so task output survives client restarts; here the
+writer rides in-process behind the same rotation contract, buffered
+through `lib.CircBufWriter` so a slow disk never backpressures the task.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..lib import CircBufWriter
+
+
+class FileRotator:
+    """Size-rotated file set `<prefix>.N` (logging/rotator.go)."""
+
+    def __init__(self, dir_: str, prefix: str, max_files: int = 10,
+                 max_file_size: int = 10 * 1024 * 1024) -> None:
+        self.dir = dir_
+        self.prefix = prefix
+        self.max_files = max(1, max_files)
+        self.max_file_size = max(1, max_file_size)
+        self._lock = threading.Lock()
+        self._idx = self._latest_index()
+        self._fh = None
+        self._size = 0
+
+    def _path(self, idx: int) -> str:
+        return os.path.join(self.dir, f"{self.prefix}.{idx}")
+
+    def _latest_index(self) -> int:
+        best = 0
+        try:
+            for name in os.listdir(self.dir):
+                if name.startswith(self.prefix + "."):
+                    try:
+                        best = max(best, int(name.rsplit(".", 1)[1]))
+                    except ValueError:
+                        pass
+        except FileNotFoundError:
+            pass
+        return best
+
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            while data:
+                if self._fh is None:
+                    path = self._path(self._idx)
+                    self._fh = open(path, "ab")
+                    self._size = self._fh.tell()
+                room = self.max_file_size - self._size
+                if room <= 0:
+                    self._rotate_locked()
+                    continue
+                chunk, data = data[:room], data[room:]
+                self._fh.write(chunk)
+                self._size += len(chunk)
+            self._fh.flush()
+
+    def _rotate_locked(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        self._idx += 1
+        self._size = 0
+        reap = self._idx - self.max_files
+        if reap >= 0:
+            try:
+                os.unlink(self._path(reap))
+            except FileNotFoundError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class LogMon:
+    """Per-task stdout+stderr capture (logmon.go). Returns the file paths
+    the driver should write into; `tail` reads back for the FS API."""
+
+    def __init__(self, logs_dir: str, task: str, max_files: int = 10,
+                 max_file_size_mb: int = 10) -> None:
+        self.logs_dir = logs_dir
+        self.task = task
+        size = max_file_size_mb * 1024 * 1024
+        self.stdout = FileRotator(logs_dir, f"{task}.stdout", max_files, size)
+        self.stderr = FileRotator(logs_dir, f"{task}.stderr", max_files, size)
+        self._stdout_buf = CircBufWriter(self.stdout.write)
+        self._stderr_buf = CircBufWriter(self.stderr.write)
+        # Drivers write straight to the current rotation target files
+        self.stdout_path = self.stdout._path(self.stdout._idx)
+        self.stderr_path = self.stderr._path(self.stderr._idx)
+
+    def write_stdout(self, data: bytes) -> None:
+        self._stdout_buf.write(data)
+
+    def write_stderr(self, data: bytes) -> None:
+        self._stderr_buf.write(data)
+
+    def tail(self, stream: str = "stdout", n: int = 4096) -> bytes:
+        rot = self.stdout if stream == "stdout" else self.stderr
+        path = rot._path(rot._idx)
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                size = fh.tell()
+                fh.seek(max(0, size - n))
+                return fh.read()
+        except FileNotFoundError:
+            return b""
+
+    def close(self) -> None:
+        for buf in (self._stdout_buf, self._stderr_buf):
+            try:
+                buf.close()
+            except Exception:
+                pass
+        self.stdout.close()
+        self.stderr.close()

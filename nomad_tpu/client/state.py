@@ -1,0 +1,67 @@
+"""Client-side state persistence for restarts.
+
+Behavioral reference: `client/state/state_database.go` — BoltDB records of
+alloc + task-runner state restored by `client.go:1048 restoreState`. Here:
+one msgpack file `client_state.mp` (atomic tmp+rename) mapping alloc_id →
+{alloc (wire), task_states (wire)}; in-memory and noop variants mirror
+`client/state/{memdb,noopdb}.go` for tests.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import msgpack
+
+from ..structs.codec import from_wire, to_wire
+
+STATE_FILE = "client_state.mp"
+
+
+class ClientStateDB:
+    def __init__(self, state_dir: str) -> None:
+        os.makedirs(state_dir, exist_ok=True)
+        self._path = os.path.join(state_dir, STATE_FILE)
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, Any]] = {}
+        if os.path.exists(self._path):
+            try:
+                with open(self._path, "rb") as fh:
+                    self._data = msgpack.unpackb(fh.read(), raw=False,
+                                                 strict_map_key=False)
+            except Exception:
+                self._data = {}
+
+    def put_alloc(self, alloc) -> None:
+        # task_states ride inside the alloc record itself
+        with self._lock:
+            self._data[alloc.id] = {"alloc": to_wire(alloc)}
+            self._flush()
+
+    def delete_alloc(self, alloc_id: str) -> None:
+        with self._lock:
+            if self._data.pop(alloc_id, None) is not None:
+                self._flush()
+
+    def allocs(self) -> Dict[str, Any]:
+        with self._lock:
+            return {aid: {"alloc": from_wire(rec["alloc"])}
+                    for aid, rec in self._data.items()}
+
+    def _flush(self) -> None:
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb(self._data, use_bin_type=True))
+        os.replace(tmp, self._path)
+
+
+class MemClientStateDB(ClientStateDB):
+    """client/state/memdb.go analog."""
+
+    def __init__(self) -> None:  # noqa: super-init-not-called
+        self._lock = threading.Lock()
+        self._data = {}
+
+    def _flush(self) -> None:
+        pass

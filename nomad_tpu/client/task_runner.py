@@ -1,0 +1,210 @@
+"""TaskRunner — per-task lifecycle state machine.
+
+Behavioral reference: `client/allocrunner/taskrunner/task_runner.go` (:62,
+Run :446: the `MAIN:` restart loop :494 with prestart/poststart/stop hook
+phases :505-529) and the restart policy tracker
+(`client/allocrunner/taskrunner/restarts/restarts.go`): `attempts` per
+`interval`, `delay`, mode `fail` (exhausted → task failed) or `delay`
+(wait out the interval and keep going).
+
+Hook pipeline here (initHooks analog): validate → taskDir → logmon →
+taskEnv/template interpolation → driver StartTask → wait → restart/exit.
+Events are appended to TaskState exactly like the reference emits
+TaskEvents (structs.go:7049 event types).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..structs import (TASK_STATE_DEAD, TASK_STATE_PENDING,
+                       TASK_STATE_RUNNING, Allocation, TaskEvent, TaskState)
+from ..structs.job import RestartPolicy, Task
+from .drivers import DriverPlugin, TaskConfig, new_driver
+from .logmon import LogMon
+from .taskenv import build_env, interpolate_config
+
+EVENT_RECEIVED = "Received"
+EVENT_TASK_SETUP = "Task Setup"
+EVENT_STARTED = "Started"
+EVENT_TERMINATED = "Terminated"
+EVENT_RESTARTING = "Restarting"
+EVENT_NOT_RESTARTING = "Not Restarting"
+EVENT_KILLING = "Killing"
+EVENT_KILLED = "Killed"
+EVENT_DRIVER_FAILURE = "Driver Failure"
+
+
+class RestartTracker:
+    """restarts.go: sliding-interval attempt counting."""
+
+    def __init__(self, policy: RestartPolicy) -> None:
+        self.policy = policy
+        self.count = 0
+        self.interval_start = 0.0
+
+    def next(self, now: float) -> Optional[float]:
+        """None → don't restart (fail); else delay seconds before restart."""
+        if self.interval_start == 0.0 \
+                or now - self.interval_start > self.policy.interval_s:
+            self.interval_start = now
+            self.count = 0
+        self.count += 1
+        if self.count <= self.policy.attempts:
+            return self.policy.delay_s
+        if self.policy.mode == "delay":
+            # wait until the interval rolls over, then a fresh budget
+            return max(self.policy.interval_s - (now - self.interval_start),
+                       self.policy.delay_s)
+        return None  # mode "fail"
+
+
+class TaskRunner:
+    def __init__(self, alloc: Allocation, task: Task, task_dir: str,
+                 logs_dir: str, node=None,
+                 on_state_change: Optional[Callable] = None,
+                 update_period: float = 0.0) -> None:
+        self.alloc = alloc
+        self.task = task
+        self.task_dir = task_dir
+        self.logs_dir = logs_dir
+        self.node = node
+        self.on_state_change = on_state_change
+        self.state = TaskState()
+        self.driver: DriverPlugin = new_driver(task.driver)
+        self.restart_tracker = RestartTracker(self._restart_policy())
+        self.logmon: Optional[LogMon] = None
+        self.handle = None
+        self._kill = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _restart_policy(self) -> RestartPolicy:
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        return tg.restart_policy if tg else RestartPolicy()
+
+    # ---- events/state ----
+
+    def _event(self, type_: str, message: str = "") -> None:
+        self.state.events.append(TaskEvent(type=type_, time=time.time(),
+                                           message=message))
+
+    def _set_state(self, state: str, failed: Optional[bool] = None) -> None:
+        self.state.state = state
+        if failed is not None:
+            self.state.failed = failed
+        if state == TASK_STATE_RUNNING and not self.state.started_at:
+            self.state.started_at = time.time()
+        if state == TASK_STATE_DEAD:
+            self.state.finished_at = time.time()
+        if self.on_state_change is not None:
+            self.on_state_change(self.task.name, self.state)
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"task-{self.task.name}", daemon=True)
+        self._thread.start()
+
+    def run(self) -> None:
+        """The MAIN restart loop (task_runner.go:494)."""
+        self._event(EVENT_RECEIVED)
+        try:
+            self._prestart()
+        except Exception as e:
+            self._event(EVENT_DRIVER_FAILURE, str(e))
+            self._set_state(TASK_STATE_DEAD, failed=True)
+            return
+        while not self._kill.is_set():
+            try:
+                cfg = self._task_config()
+                self.handle = self.driver.start_task(cfg)
+            except Exception as e:
+                self._event(EVENT_DRIVER_FAILURE, str(e))
+                if not self._maybe_restart(failed=True):
+                    return
+                continue
+            self._event(EVENT_STARTED)
+            self._set_state(TASK_STATE_RUNNING)
+            result = None
+            while result is None and not self._kill.is_set():
+                result = self.driver.wait_task(self.handle, timeout=0.1)
+            if self._kill.is_set():
+                if result is None:
+                    self._event(EVENT_KILLING)
+                    self.driver.stop_task(self.handle,
+                                          self.task.kill_timeout_s)
+                    self._event(EVENT_KILLED)
+                self._set_state(TASK_STATE_DEAD, failed=False)
+                return
+            ok = result.successful()
+            self._event(EVENT_TERMINATED,
+                        f"Exit Code: {result.exit_code}"
+                        + (f", Err: {result.err}" if result.err else ""))
+            if ok:
+                self._set_state(TASK_STATE_DEAD, failed=False)
+                return
+            if not self._maybe_restart(failed=True):
+                return
+
+    def _maybe_restart(self, failed: bool) -> bool:
+        delay = self.restart_tracker.next(time.time())
+        if delay is None:
+            self._event(EVENT_NOT_RESTARTING, "Exceeded allowed attempts")
+            self._set_state(TASK_STATE_DEAD, failed=failed)
+            return False
+        self.state.restarts += 1
+        self.state.last_restart = time.time()
+        self._event(EVENT_RESTARTING, f"Task restarting in {delay:.1f}s")
+        self._set_state(TASK_STATE_PENDING)
+        if self._kill.wait(delay):
+            self._set_state(TASK_STATE_DEAD, failed=False)
+            return False
+        return True
+
+    # ---- hooks ----
+
+    def _prestart(self) -> None:
+        self._event(EVENT_TASK_SETUP)
+        # logmon hook (logmon_hook.go)
+        self.logmon = LogMon(
+            self.logs_dir, self.task.name,
+            max_files=self.task.log_config.max_files,
+            max_file_size_mb=self.task.log_config.max_file_size_mb,
+        )
+        # template hook (template/template.go, minimal: render env-style
+        # templates into files was out of scope; env assembled below)
+
+    def _task_config(self) -> TaskConfig:
+        env = build_env(
+            self.alloc, self.task, self.node,
+            task_dir=self.task_dir,
+            shared_dir=f"{self.task_dir}/alloc",
+        )
+        raw = interpolate_config(dict(self.task.config), env, self.node)
+        return TaskConfig(
+            id=f"{self.alloc.id}/{self.task.name}",
+            name=self.task.name,
+            env=env,
+            user=self.task.user,
+            task_dir=self.task_dir,
+            stdout_path=self.logmon.stdout_path if self.logmon else "",
+            stderr_path=self.logmon.stderr_path if self.logmon else "",
+            stdout_sink=self.logmon.write_stdout if self.logmon else None,
+            stderr_sink=self.logmon.write_stderr if self.logmon else None,
+            raw_config=raw,
+            cpu_mhz=self.task.resources.cpu,
+            memory_mb=self.task.resources.memory_mb,
+            kill_timeout_s=self.task.kill_timeout_s,
+        )
+
+    def kill(self) -> None:
+        self._kill.set()
+
+    def join(self, timeout: float = 10.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self.logmon is not None:
+            self.logmon.close()

@@ -1,0 +1,102 @@
+"""Task environment construction + runtime interpolation.
+
+Behavioral reference: `client/taskenv/env.go` — the `NOMAD_*` variable set
+(alloc/task identity, resources, dir paths, meta) and `${...}` template
+interpolation over node attributes (`${node.attr...}`, `${attr...}`,
+`${meta...}`, `${NOMAD_*}`, `${env.*}`).
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from ..structs import Allocation, Node
+from ..structs.job import Task
+
+_VAR = re.compile(r"\$\{([^}]+)\}")
+
+
+def build_env(alloc: Allocation, task: Task, node: Optional[Node],
+              task_dir: str = "", shared_dir: str = "",
+              secrets_dir: str = "") -> Dict[str, str]:
+    env: Dict[str, str] = {}
+    env["NOMAD_ALLOC_ID"] = alloc.id
+    env["NOMAD_ALLOC_NAME"] = alloc.name
+    env["NOMAD_ALLOC_INDEX"] = str(_alloc_index(alloc.name))
+    env["NOMAD_GROUP_NAME"] = alloc.task_group
+    env["NOMAD_TASK_NAME"] = task.name
+    env["NOMAD_JOB_ID"] = alloc.job_id
+    env["NOMAD_JOB_NAME"] = alloc.job.name if alloc.job else alloc.job_id
+    env["NOMAD_NAMESPACE"] = alloc.namespace
+    env["NOMAD_DC"] = node.datacenter if node else ""
+    env["NOMAD_REGION"] = alloc.job.region if alloc.job else "global"
+    if task_dir:
+        env["NOMAD_TASK_DIR"] = f"{task_dir}/local"
+        env["NOMAD_SECRETS_DIR"] = secrets_dir or f"{task_dir}/secrets"
+    if shared_dir:
+        env["NOMAD_ALLOC_DIR"] = shared_dir
+    r = task.resources
+    env["NOMAD_CPU_LIMIT"] = str(r.cpu)
+    env["NOMAD_MEMORY_LIMIT"] = str(r.memory_mb)
+    # job/group/task meta, most-specific wins (taskenv meta precedence)
+    meta: Dict[str, str] = {}
+    if alloc.job is not None:
+        meta.update(alloc.job.meta)
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        if tg is not None:
+            meta.update(tg.meta)
+    meta.update(task.meta)
+    for k, v in meta.items():
+        env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = str(v)
+    for k, v in task.env.items():
+        env[k] = str(v)
+    return env
+
+
+def _alloc_index(name: str) -> int:
+    # "<job>.<group>[<index>]"
+    m = re.search(r"\[(\d+)\]$", name)
+    return int(m.group(1)) if m else 0
+
+
+def interpolate(s: str, env: Dict[str, str],
+                node: Optional[Node] = None) -> str:
+    """`${...}` expansion over NOMAD env, node attributes and meta
+    (taskenv.ReplaceEnv)."""
+
+    def repl(m: re.Match) -> str:
+        key = m.group(1).strip()
+        if key in env:
+            return env[key]
+        if key.startswith("env."):
+            return env.get(key[4:], "")
+        if node is not None:
+            if key in ("node.unique.id", "node.id"):
+                return node.id
+            if key in ("node.unique.name", "node.name"):
+                return node.name
+            if key == "node.datacenter":
+                return node.datacenter
+            if key == "node.class":
+                return node.node_class
+            for prefix in ("attr.", "node.attr."):
+                if key.startswith(prefix):
+                    return str(node.attributes.get(key[len(prefix):], ""))
+            for prefix in ("meta.", "node.meta."):
+                if key.startswith(prefix):
+                    return str(node.meta.get(key[len(prefix):], ""))
+        return m.group(0)  # unknown: leave verbatim (reference behavior)
+
+    return _VAR.sub(repl, s)
+
+
+def interpolate_config(cfg, env: Dict[str, str],
+                       node: Optional[Node] = None):
+    """Deep-interpolate a driver config tree."""
+    if isinstance(cfg, str):
+        return interpolate(cfg, env, node)
+    if isinstance(cfg, dict):
+        return {k: interpolate_config(v, env, node) for k, v in cfg.items()}
+    if isinstance(cfg, list):
+        return [interpolate_config(v, env, node) for v in cfg]
+    return cfg

@@ -137,12 +137,14 @@ class ClusterServerConfig(ServerConfig):
         self.port = port
 
 
-#: endpoint methods a follower forwards to the leader (write RPCs; the
-#: reference forwards in each endpoint via rpc.go forward()).
+#: endpoint methods a follower forwards to the leader (write RPCs plus the
+#: client pull loop; the reference forwards in each endpoint via rpc.go
+#: forward()). node_update_allocs — not the raw state merge — is the
+#: status-push route so reschedule evals and unblocking fire.
 FORWARDED = (
     "job_register", "job_deregister", "node_register", "node_update_status",
     "node_update_drain", "node_update_eligibility", "node_heartbeat",
-    "update_alloc_from_client", "run_gc",
+    "node_update_allocs", "node_get_client_allocs", "alloc_get", "run_gc",
 )
 
 
@@ -226,16 +228,13 @@ class ClusterServer:
 
     def _make_handler(self, method: str):
         def handler(*wire_args):
-            out = self._invoke_local(method, wire_args)
-            return to_wire(out) if _is_struct(out) else _wire_result(out)
+            return to_wire(self._invoke_local(method, wire_args))
 
         handler.__name__ = method
         return handler
 
     def _invoke_local(self, method: str, wire_args):
         args = [from_wire(a) for a in wire_args]
-        if method == "update_alloc_from_client":
-            return self.state.update_alloc_from_client(*args)
         return getattr(self.server, method)(*args)
 
     # ---- client-facing call (forwarding; rpc.go forward()) ----
@@ -244,25 +243,12 @@ class ClusterServer:
         """Invoke an endpoint, forwarding to the leader when needed."""
         if method not in FORWARDED:
             raise ValueError(f"unknown endpoint {method!r}")
-        wire_args = [to_wire(a) if _is_struct(a) else a for a in args]
+        wire_args = [to_wire(a) for a in args]
         if self.is_leader():
-            out = self._invoke_local(method, wire_args)
-            return out
+            return self._invoke_local(method, wire_args)
         leader = self.raft.leader()
         if leader is None or leader not in self.peers:
             raise NotLeaderError(leader)
         res = self.pool.call(self.peers[leader], f"Server.{method}",
                              *wire_args, timeout=timeout)
         return from_wire(res)
-
-
-def _is_struct(v) -> bool:
-    import dataclasses
-
-    return dataclasses.is_dataclass(v) and not isinstance(v, type)
-
-
-def _wire_result(v):
-    if isinstance(v, list):
-        return [to_wire(x) if _is_struct(x) else x for x in v]
-    return v

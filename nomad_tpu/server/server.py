@@ -385,6 +385,25 @@ class Server:
                     status=EVAL_STATUS_PENDING,
                 )
 
+    def node_get_client_allocs(self, node_id: str, min_index: int = 0,
+                               timeout: float = 30.0
+                               ) -> Tuple[int, Dict[str, int]]:
+        """Blocking query for a client's alloc set (node_endpoint.go:926
+        GetClientAllocs): returns (index, {alloc_id: alloc_modify_index}).
+        Unblocks when any alloc on the node changes."""
+
+        def fetch(snap):
+            allocs = snap.allocs_by_node(node_id)
+            idx = max([a.modify_index for a in allocs], default=0)
+            return idx, {a.id: a.modify_index for a in allocs}
+
+        return self.state.blocking_query(fetch, min_index=min_index,
+                                         timeout=timeout)
+
+    def alloc_get(self, alloc_id: str) -> Optional[Allocation]:
+        """Alloc fetch for the client pull loop (alloc_endpoint.go GetAlloc)."""
+        return self.state.alloc_by_id(alloc_id)
+
     def node_update_allocs(self, updates: List[Allocation]) -> None:
         """Client pushes alloc status (node_endpoint.go:1013 UpdateAlloc):
         merge; terminal allocs free capacity (unblock) and failed allocs

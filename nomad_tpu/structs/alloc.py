@@ -76,6 +76,38 @@ class NodeScoreMeta:
     norm_score: float = 0.0
 
 
+TASK_STATE_PENDING = "pending"
+TASK_STATE_RUNNING = "running"
+TASK_STATE_DEAD = "dead"
+
+
+@dataclass
+class TaskEvent:
+    """Reference `structs.TaskEvent` (structs.go:7049): typed lifecycle
+    event with display message."""
+
+    type: str = ""
+    time: float = 0.0
+    message: str = ""
+    details: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TaskState:
+    """Reference `structs.TaskState` (structs.go:6920)."""
+
+    state: str = TASK_STATE_PENDING
+    failed: bool = False
+    restarts: int = 0
+    last_restart: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    events: List[TaskEvent] = field(default_factory=list)
+
+    def successful(self) -> bool:
+        return self.state == TASK_STATE_DEAD and not self.failed
+
+
 @dataclass
 class AllocMetric:
     """Placement metrics (reference `structs.AllocMetric`, structs.go:9172):

@@ -1,0 +1,328 @@
+"""Client agent tests (reference models: client/client_test.go with mock
+driver, taskrunner tests, allocrunner tests — in-process client against an
+in-process server, SURVEY §4.3)."""
+import copy
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig, InProcConn
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.state import ClientStateDB
+from nomad_tpu.client.drivers import MockDriver, RawExecDriver, TaskConfig
+from nomad_tpu.client.taskenv import build_env, interpolate
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import Node
+from nomad_tpu.structs.job import RestartPolicy, Task, TaskLifecycle
+
+
+def _wait(cond, timeout=15.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+class TestDrivers:
+    def test_mock_driver_runs_and_exits(self):
+        d = MockDriver()
+        h = d.start_task(TaskConfig(id="t1", raw_config={"run_for": 0.05}))
+        res = d.wait_task(h, timeout=5.0)
+        assert res is not None and res.successful()
+
+    def test_mock_driver_failure(self):
+        d = MockDriver()
+        h = d.start_task(TaskConfig(id="t1", raw_config={
+            "run_for": 0.01, "exit_code": 2}))
+        res = d.wait_task(h, timeout=5.0)
+        assert res.exit_code == 2 and not res.successful()
+
+    def test_mock_start_error(self):
+        d = MockDriver()
+        with pytest.raises(RuntimeError, match="boom"):
+            d.start_task(TaskConfig(id="t1",
+                                    raw_config={"start_error": "boom"}))
+
+    def test_rawexec_runs_command(self, tmp_path):
+        d = RawExecDriver()
+        out = tmp_path / "stdout.0"
+        h = d.start_task(TaskConfig(
+            id="t1", task_dir=str(tmp_path), stdout_path=str(out),
+            env={"GREETING": "hello"},
+            raw_config={"command": "/bin/sh",
+                        "args": ["-c", "echo $GREETING $PWD"]}))
+        res = d.wait_task(h, timeout=10.0)
+        assert res.successful()
+        text = out.read_bytes().decode()
+        assert "hello" in text and str(tmp_path) in text
+
+    def test_rawexec_stop_kills_group(self, tmp_path):
+        d = RawExecDriver()
+        h = d.start_task(TaskConfig(
+            id="t1", task_dir=str(tmp_path),
+            raw_config={"command": "/bin/sleep", "args": ["30"]}))
+        t0 = time.time()
+        d.stop_task(h, timeout_s=2.0)
+        res = d.wait_task(h, timeout=5.0)
+        assert res is not None and time.time() - t0 < 5.0
+        assert res.signal != 0
+
+
+class TestTaskEnv:
+    def test_nomad_env(self):
+        alloc = mock.alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        env = build_env(alloc, task, None, task_dir="/t/web")
+        assert env["NOMAD_ALLOC_ID"] == alloc.id
+        assert env["NOMAD_TASK_NAME"] == task.name
+        assert env["NOMAD_CPU_LIMIT"] == str(task.resources.cpu)
+        assert env["NOMAD_TASK_DIR"] == "/t/web/local"
+        assert env["NOMAD_META_ELB_CHECK_TYPE"] == "http"
+
+    def test_interpolation(self):
+        node = Node(id="n1", name="worker-1", datacenter="dc1",
+                    attributes={"kernel.name": "linux"},
+                    meta={"rack": "r7"})
+        env = {"NOMAD_ALLOC_ID": "a1"}
+        assert interpolate("${node.datacenter}-${meta.rack}", env, node) \
+            == "dc1-r7"
+        assert interpolate("${attr.kernel.name}", env, node) == "linux"
+        assert interpolate("${NOMAD_ALLOC_ID}", env, node) == "a1"
+        assert interpolate("${unknown.key}", env, node) == "${unknown.key}"
+
+
+class TestAllocDir:
+    def test_layout(self, tmp_path):
+        ad = AllocDir(str(tmp_path), "alloc1")
+        ad.build(["web", "db"])
+        assert os.path.isdir(os.path.join(ad.root, "web", "local"))
+        assert os.path.isdir(os.path.join(ad.root, "db", "secrets"))
+        assert os.path.isdir(ad.logs_dir)
+        assert os.path.islink(os.path.join(ad.root, "web", "alloc"))
+        mode = os.stat(os.path.join(ad.root, "web", "secrets")).st_mode
+        assert mode & 0o777 == 0o700
+        ad.destroy()
+        assert not os.path.exists(ad.root)
+
+
+def _mock_task_job(run_for=0.05, exit_code=0, count=1, attempts=0,
+                   mode="fail"):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.restart_policy = RestartPolicy(attempts=attempts, interval_s=300,
+                                      delay_s=0.05, mode=mode)
+    t = tg.tasks[0]
+    t.driver = "mock_driver"
+    t.config = {"run_for": run_for, "exit_code": exit_code}
+    return job
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    """In-process server + client (the reference's dev agent)."""
+    server = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0,
+                                 gc_interval=3600.0))
+    server.start()
+    client = Client(InProcConn(server),
+                    ClientConfig(data_dir=str(tmp_path / "client"),
+                                 heartbeat_interval=1.0))
+    client.start()
+    assert _wait(lambda: server.state.node_by_id(client.node.id) is not None)
+    yield server, client
+    client.shutdown()
+    server.shutdown()
+
+
+class TestClientE2E:
+    def test_alloc_placed_runs_completes(self, agent):
+        server, client = agent
+        job = _mock_task_job(run_for=0.2, count=2)
+        ev = server.job_register(job)
+        done = server.wait_for_eval(ev.id)
+        assert done.status == "complete"
+        # client picks the allocs up and runs them to completion
+        assert _wait(lambda: all(
+            a.client_status == "complete"
+            for a in server.state.allocs_by_job("default", job.id)) and
+            server.state.allocs_by_job("default", job.id) != [])
+        allocs = server.state.allocs_by_job("default", job.id)
+        assert len(allocs) == 2
+        for a in allocs:
+            ts = a.task_states["web"]
+            assert ts.state == "dead" and not ts.failed
+            assert any(e.type == "Started" for e in ts.events)
+
+    def test_failed_task_reports_and_reschedules(self, agent):
+        server, client = agent
+        job = _mock_task_job(run_for=0.01, exit_code=1)
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: any(
+            a.client_status == "failed"
+            for a in server.state.allocs_by_job("default", job.id)))
+        # server reacted: reschedule machinery produced follow-up evals
+        assert _wait(lambda: len(
+            server.state.evals_by_job("default", job.id)) > 1)
+
+    def test_restart_policy_retries_then_fails(self, agent):
+        server, client = agent
+        job = _mock_task_job(run_for=0.01, exit_code=1, attempts=2)
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: any(
+            a.client_status == "failed"
+            for a in server.state.allocs_by_job("default", job.id)))
+        alloc = server.state.allocs_by_job("default", job.id)[0]
+        ts = alloc.task_states["web"]
+        assert ts.restarts == 2
+        assert any(e.type == "Not Restarting" for e in ts.events)
+
+    def test_job_stop_kills_allocs(self, agent):
+        server, client = agent
+        job = _mock_task_job(run_for=60.0)
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: any(
+            a.client_status == "running"
+            for a in server.state.allocs_by_job("default", job.id)))
+        ev2 = server.job_deregister("default", job.id)
+        server.wait_for_eval(ev2.id)
+        assert _wait(lambda: all(
+            a.client_status in ("complete", "failed")
+            for a in server.state.allocs_by_job("default", job.id)))
+
+    def test_rawexec_end_to_end(self, agent, tmp_path):
+        server, client = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        marker = tmp_path / "ran.txt"
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", f"echo $NOMAD_ALLOC_ID > {marker}"]}
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: marker.exists() and marker.read_text().strip())
+        alloc = server.state.allocs_by_job("default", job.id)[0]
+        assert marker.read_text().strip() == alloc.id
+
+
+class TestLifecycle:
+    def test_prestart_runs_before_main(self, agent, tmp_path):
+        server, client = agent
+        order = tmp_path / "order.txt"
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        init = Task(name="init", driver="raw_exec",
+                    lifecycle=TaskLifecycle(hook="prestart"),
+                    config={"command": "/bin/sh",
+                            "args": ["-c", f"echo init >> {order}"]})
+        main = tg.tasks[0]
+        main.driver = "raw_exec"
+        main.config = {"command": "/bin/sh",
+                       "args": ["-c", f"echo main >> {order}"]}
+        tg.tasks = [init, main]
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: order.exists()
+                     and len(order.read_text().splitlines()) == 2)
+        assert order.read_text().splitlines() == ["init", "main"]
+
+
+    def test_poststop_runs_after_main(self, agent, tmp_path):
+        server, client = agent
+        order = tmp_path / "order2.txt"
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        main = tg.tasks[0]
+        main.driver = "raw_exec"
+        main.config = {"command": "/bin/sh",
+                       "args": ["-c", f"echo main >> {order}"]}
+        cleanup = Task(name="cleanup", driver="raw_exec",
+                       lifecycle=TaskLifecycle(hook="poststop"),
+                       config={"command": "/bin/sh",
+                               "args": ["-c", f"echo cleanup >> {order}"]})
+        tg.tasks = [main, cleanup]
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: order.exists()
+                     and len(order.read_text().splitlines()) == 2)
+        assert order.read_text().splitlines() == ["main", "cleanup"]
+
+
+class TestLogRotation:
+    def test_rotation_enforced_through_sinks(self, agent):
+        from nomad_tpu.structs.job import LogConfig
+
+        server, client = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.log_config = LogConfig(max_files=3, max_file_size_mb=1)
+        # ~3MB of output into 1MB files: rotation must cap the set at 3
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c",
+                             "yes 0123456789abcdef | head -c 3200000"]}
+        ev = server.job_register(job)
+        server.wait_for_eval(ev.id)
+        assert _wait(lambda: all(
+            a.client_status == "complete"
+            for a in server.state.allocs_by_job("default", job.id)) and
+            server.state.allocs_by_job("default", job.id) != [], 20.0)
+        alloc = server.state.allocs_by_job("default", job.id)[0]
+        ar = client.alloc_runner(alloc.id)
+        logs = os.listdir(ar.alloc_dir.logs_dir)
+        stdout_files = [f for f in logs if f.startswith("web.stdout.")]
+        assert 1 < len(stdout_files) <= 3
+        for f in stdout_files:
+            size = os.path.getsize(os.path.join(ar.alloc_dir.logs_dir, f))
+            assert size <= 1024 * 1024
+
+
+class TestClientRestore:
+    def test_client_restart_restores_allocs(self, tmp_path):
+        server = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0))
+        server.start()
+        cdir = str(tmp_path / "client")
+        node_id = None
+        try:
+            c1 = Client(InProcConn(server), ClientConfig(data_dir=cdir))
+            c1.start()
+            node_id = c1.node.id
+            _wait(lambda: server.state.node_by_id(node_id) is not None)
+            job = _mock_task_job(run_for=60.0)
+            ev = server.job_register(job)
+            server.wait_for_eval(ev.id)
+            assert _wait(lambda: c1.num_allocs() == 1)
+            assert _wait(lambda: any(
+                a.client_status == "running"
+                for a in server.state.allocs_by_job("default", job.id)))
+            c1.shutdown()
+            # let any in-flight state writes land: shutdown must NOT have
+            # reported the alloc terminal (that would break restore)
+            time.sleep(0.4)
+            persisted = ClientStateDB(cdir).allocs()
+            assert len(persisted) == 1
+            rec = next(iter(persisted.values()))["alloc"]
+            assert not rec.client_terminal_status(), \
+                "shutdown leaked a terminal status into client state"
+
+            # second client with the same state dir + node id resumes
+            node = server.state.node_by_id(node_id)
+            c2 = Client(InProcConn(server),
+                        ClientConfig(data_dir=cdir, node=copy.copy(node)))
+            c2.start()
+            assert _wait(lambda: c2.num_allocs() == 1)
+            c2.shutdown()
+        finally:
+            server.shutdown()

@@ -124,8 +124,41 @@ class TestCluster:
         a0 = follower.state.allocs_by_job("default", job.id)[0]
         upd = copy.copy(a0)
         upd.client_status = "running"
-        merged = follower.call("update_alloc_from_client", upd)
-        assert merged is not None and merged.client_status == "running"
+        follower.call("node_update_allocs", [upd])
         for a in cluster:
             assert _wait(lambda a=a: a.state.alloc_by_id(
                 a0.id).client_status == "running")
+
+    def test_rpc_client_agent_against_cluster(self, cluster, tmp_path):
+        """A real Client over the RPC fabric: watch loop, task execution,
+        status sync, reschedule side effects — through any server."""
+        from nomad_tpu.client import Client, ClientConfig, RpcConn
+
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        follower = next(a for a in cluster if a is not leader)
+        conn = RpcConn([follower.addr, leader.addr])
+        client = Client(conn, ClientConfig(
+            data_dir=str(tmp_path / "c"), heartbeat_interval=1.0,
+            watch_timeout=2.0))
+        client.start()
+        try:
+            assert _wait(lambda: leader.state.node_by_id(
+                client.node.id) is not None)
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 2
+            t = tg.tasks[0]
+            t.driver = "mock_driver"
+            t.config = {"run_for": 0.1}
+            ev = follower.call("job_register", job)
+            done = leader.server.wait_for_eval(ev.id, timeout=15.0)
+            assert done is not None and done.status == "complete"
+            assert _wait(lambda: leader.state.allocs_by_job(
+                "default", job.id) != [] and all(
+                a.client_status == "complete"
+                for a in leader.state.allocs_by_job("default", job.id)))
+            a0 = leader.state.allocs_by_job("default", job.id)[0]
+            assert a0.task_states["web"].state == "dead"
+        finally:
+            client.shutdown()
