@@ -1,0 +1,6 @@
+"""`python -m nomad_tpu` → the CLI (reference main.go:12)."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,133 @@
+"""Agent — one process running server and/or client plus the HTTP API.
+
+Behavioral reference: `command/agent/agent.go` (Agent: setupServer,
+setupClient; dev mode runs both — the reference's `nomad agent -dev`) and
+`command/agent/http.go` for the API listener. Config mirrors the agent
+HCL/JSON config surface (`command/agent/config.go`) at the fields this
+build implements.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .http import HTTPApi
+
+
+class AgentConfig:
+    def __init__(self, server: bool = True, client: bool = True,
+                 http_host: str = "127.0.0.1", http_port: int = 0,
+                 data_dir: Optional[str] = None,
+                 num_schedulers: int = 1, heartbeat_ttl: float = 30.0,
+                 node_name: str = "", datacenter: str = "dc1",
+                 region: str = "global",
+                 server_addrs=None) -> None:
+        self.server = server
+        self.client = client
+        self.http_host = http_host
+        self.http_port = http_port
+        self.data_dir = data_dir
+        self.num_schedulers = num_schedulers
+        self.heartbeat_ttl = heartbeat_ttl
+        self.node_name = node_name
+        self.datacenter = datacenter
+        self.region = region
+        self.server_addrs = server_addrs or []  # client-only mode targets
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AgentConfig":
+        known = {k: v for k, v in d.items()
+                 if k in cls().__dict__}
+        return cls(**known)
+
+
+class Agent:
+    """Composes Server + Client + HTTP API in one process."""
+
+    def __init__(self, config: Optional[AgentConfig] = None) -> None:
+        self.config = config or AgentConfig()
+        self.server = None
+        self.client = None
+        self.cluster = None
+        self._started_at = time.time()
+        if self.config.server:
+            from ..server import Server, ServerConfig
+
+            self.server = Server(ServerConfig(
+                num_schedulers=self.config.num_schedulers,
+                heartbeat_ttl=self.config.heartbeat_ttl,
+                data_dir=self.config.data_dir,
+            ))
+        if self.config.client:
+            from ..client import Client, ClientConfig, InProcConn, RpcConn
+            from ..structs import Node
+
+            node = Node(name=self.config.node_name,
+                        datacenter=self.config.datacenter)
+            if self.server is not None:
+                conn = InProcConn(self.server)
+            elif self.config.server_addrs:
+                conn = RpcConn(self.config.server_addrs)
+            else:
+                raise ValueError(
+                    "client-only agent needs server_addrs to join")
+            client_dir = None
+            if self.config.data_dir:
+                import os
+
+                client_dir = os.path.join(self.config.data_dir, "client")
+            self.client = Client(conn, ClientConfig(
+                data_dir=client_dir, node=node,
+                heartbeat_interval=max(self.config.heartbeat_ttl / 3, 0.5)))
+        self.http = HTTPApi(self, self.config.http_host,
+                            self.config.http_port)
+
+    @property
+    def http_addr(self):
+        return self.http.addr
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+        if self.client is not None:
+            self.client.start()
+        self.http.start()
+
+    def shutdown(self) -> None:
+        self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+
+    # ---- introspection (agent_endpoint.go) ----
+
+    def self_info(self) -> Dict[str, Any]:
+        from .. import __version__
+
+        info = {"version": __version__,
+                "server": self.server is not None,
+                "client": self.client is not None,
+                "uptime_s": time.time() - self._started_at}
+        if self.client is not None:
+            info["node_id"] = self.client.node.id
+            info["node_name"] = self.client.node.name
+        return info
+
+    def metrics(self) -> Dict[str, Any]:
+        """go-metrics /v1/metrics analog: subsystem counters."""
+        out: Dict[str, Any] = {"uptime_s": time.time() - self._started_at}
+        if self.server is not None:
+            out["broker"] = dict(self.server.broker.stats)
+            out["broker_ready"] = self.server.broker.ready_count()
+            out["broker_unacked"] = self.server.broker.unacked_count()
+            out["blocked_evals"] = self.server.blocked.blocked_count()
+            out["plan_apply"] = dict(self.server.planner.stats)
+            out["state_index"] = self.server.state.index.value
+        if self.client is not None:
+            out["client_allocs"] = self.client.num_allocs()
+        return out
+
+
+__all__ = ["Agent", "AgentConfig", "HTTPApi"]

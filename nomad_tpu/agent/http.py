@@ -1,0 +1,325 @@
+"""HTTP API server — the `/v1/*` surface.
+
+Behavioral reference: `command/agent/http.go` (route table :253-315, the
+`wrap` helper :319 — JSON responses, error mapping, blocking-query params
+`index`/`wait`, `stale` reads) and the per-noun handlers
+(`command/agent/{job,node,alloc,eval,deployment,operator,...}_endpoint.go`).
+
+JSON encoding: struct trees are serialized through the wire codec
+(structs/codec.py) with `__t` type tags, and the Python SDK decodes them
+back into structs — the reference's Go-SDK/CamelCase-JSON pairing mapped
+onto this codebase's single data model (documented deviation: field names
+are snake_case, not the reference's CamelCase).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..structs.codec import from_json_tree, from_wire, to_json_tree, to_wire
+
+
+class HttpError(Exception):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
+
+
+class HTTPApi:
+    """Routes /v1/* to server endpoints. `agent` carries .server (leader
+    methods), optional .client, and optional .cluster (ClusterServer)."""
+
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.agent = agent
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _respond(self, code: int, payload: Any) -> None:
+                body = json.dumps(to_json_tree(payload)).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _handle(self, method: str) -> None:
+                try:
+                    parsed = urlparse(self.path)
+                    query = {k: v[0] for k, v in
+                             parse_qs(parsed.query).items()}
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    body = from_json_tree(json.loads(raw)) if raw else None
+                    out = api.route(method, parsed.path, query, body)
+                    self._respond(200, out)
+                except HttpError as e:
+                    self._respond(e.code, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._respond(500,
+                                  {"error": f"{type(e).__name__}: {e}"})
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_POST(self):
+                self._handle("PUT")  # reference treats POST as PUT
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self.httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="http", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # ---- routing (http.go:253 registerHandlers) ----
+
+    def route(self, method: str, path: str, query: Dict[str, str],
+              body: Any) -> Any:
+        parts0 = [p for p in path.split("/") if p]
+        if not parts0 or parts0[0] != "v1":
+            raise HttpError(404, f"no handler for {path}")
+        # agent-local routes work without a server (client-only agents)
+        if parts0[1:] == ["agent", "self"]:
+            return self.agent.self_info()
+        if parts0[1:] == ["metrics"]:
+            return self.agent.metrics()
+        server = self.agent.server
+        if server is None:
+            raise HttpError(501,
+                            "this agent is not running a server; "
+                            "point the CLI/SDK at a server agent")
+        state = server.state
+
+        def blocking(fetch: Callable) -> Any:
+            """index/wait params (http.go parseWait + blocking queries)."""
+            min_index = int(query.get("index", 0) or 0)
+            wait = min(float(query.get("wait", 0) or 0), 60.0)
+            if min_index and wait:
+                idx, result = state.blocking_query(
+                    lambda snap: fetch(snap), min_index=min_index,
+                    timeout=wait)
+                return {"index": idx, "data": result}
+            idx, result = fetch(state.snapshot())
+            return {"index": idx, "data": result}
+
+        ns = query.get("namespace", "default")
+        parts = parts0[1:]
+
+        # /v1/jobs
+        if parts == ["jobs"]:
+            if method == "GET":
+                prefix = query.get("prefix", "")
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(j) for j in snap.jobs()
+                     if j.id.startswith(prefix)]))
+            if method == "PUT":
+                job = from_wire(body["job"] if "job" in body else body)
+                ev = server.job_register(job)
+                return {"eval_id": ev.id if ev else "",
+                        "job_modify_index": job.job_modify_index}
+        # /v1/job/<id>[/...]
+        if parts and parts[0] == "job" and len(parts) >= 2:
+            job_id = parts[1]
+            sub = parts[2] if len(parts) > 2 else ""
+            if not sub:
+                if method == "GET":
+                    job = state.job_by_id(ns, job_id)
+                    if job is None:
+                        raise HttpError(404, f"job {job_id!r} not found")
+                    return to_wire(job)
+                if method == "DELETE":
+                    ev = server.job_deregister(ns, job_id)
+                    return {"eval_id": ev.id if ev else ""}
+                if method == "PUT":  # register under this id
+                    job = from_wire(body["job"] if "job" in body else body)
+                    ev = server.job_register(job)
+                    return {"eval_id": ev.id if ev else ""}
+            if sub == "allocations":
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(a) for a in snap.allocs_by_job(ns, job_id)]))
+            if sub == "evaluations":
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(e) for e in snap.evals_by_job(ns, job_id)]))
+            if sub == "deployments":
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(d) for d in snap.deployments()
+                     if d.job_id == job_id and d.namespace == ns]))
+            if sub == "summary":
+                return self._job_summary(state, ns, job_id)
+            if sub == "periodic" and len(parts) > 3 and parts[3] == "force":
+                ev = server.periodic.force(ns, job_id)
+                if ev is None:
+                    raise HttpError(404, "not a periodic job or overlapped")
+                return {"eval_id": ev.id}
+            if sub == "plan":
+                job = from_wire(body["job"] if "job" in body else body)
+                return self._job_plan(server, job)
+        # /v1/nodes
+        if parts == ["nodes"]:
+            return blocking(lambda snap: (
+                snap.index_at, [to_wire(n) for n in snap.nodes()]))
+        if parts and parts[0] == "node" and len(parts) >= 2:
+            node_id = parts[1]
+            sub = parts[2] if len(parts) > 2 else ""
+            if not sub and method == "GET":
+                node = state.node_by_id(node_id)
+                if node is None:
+                    raise HttpError(404, f"node {node_id!r} not found")
+                return to_wire(node)
+            if sub == "drain" and method == "PUT":
+                drain = from_wire(body.get("drain_spec")) if body else None
+                evals = server.node_update_drain(node_id, drain)
+                return {"eval_ids": [e.id for e in evals]}
+            if sub == "eligibility" and method == "PUT":
+                server.node_update_eligibility(node_id,
+                                               body.get("eligibility"))
+                return {}
+            if sub == "allocations":
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(a) for a in snap.allocs_by_node(node_id)]))
+        # /v1/allocations, /v1/allocation/<id>
+        if parts == ["allocations"]:
+            return blocking(lambda snap: (
+                snap.index_at,
+                [to_wire(a) for a in snap._allocs.values()]))
+        if parts and parts[0] == "allocation" and len(parts) >= 2:
+            a = state.alloc_by_id(parts[1])
+            if a is None:
+                raise HttpError(404, "alloc not found")
+            return to_wire(a)
+        # /v1/evaluations, /v1/evaluation/<id>
+        if parts == ["evaluations"]:
+            return blocking(lambda snap: (
+                snap.index_at, [to_wire(e) for e in snap.evals()]))
+        if parts and parts[0] == "evaluation" and len(parts) >= 2:
+            e = state.eval_by_id(parts[1])
+            if e is None:
+                raise HttpError(404, "eval not found")
+            if len(parts) > 2 and parts[2] == "allocations":
+                return [to_wire(a) for a
+                        in state.allocs_by_job(e.namespace, e.job_id)
+                        if a.eval_id == e.id]
+            return to_wire(e)
+        # /v1/deployments, /v1/deployment/...
+        if parts == ["deployments"]:
+            return blocking(lambda snap: (
+                snap.index_at, [to_wire(d) for d in snap.deployments()]))
+        if parts and parts[0] == "deployment" and len(parts) >= 2:
+            watcher = server.deployments_watcher
+            action_map = {"promote": watcher.promote, "fail": watcher.fail}
+            if parts[1] in action_map and len(parts) > 2:
+                ev = action_map[parts[1]](parts[2])
+                return {"eval_id": ev.id if ev else ""}
+            if parts[1] == "pause" and len(parts) > 2:
+                watcher.pause(parts[2], bool(body.get("pause", True)))
+                return {}
+            d = state.deployment_by_id(parts[1])
+            if d is None:
+                raise HttpError(404, "deployment not found")
+            return to_wire(d)
+        # /v1/status/*
+        if parts == ["status", "leader"]:
+            cluster = getattr(self.agent, "cluster", None)
+            if cluster is not None:
+                return cluster.raft.leader()
+            return "self"
+        if parts == ["status", "peers"]:
+            cluster = getattr(self.agent, "cluster", None)
+            if cluster is not None:
+                return {pid: list(addr) for pid, addr
+                        in cluster.peers.items()}
+            return {}
+        # /v1/agent/*
+        if parts == ["agent", "members"]:
+            cluster = getattr(self.agent, "cluster", None)
+            peers = cluster.peers if cluster is not None else {}
+            return {"members": [{"name": pid, "addr": list(addr)}
+                                for pid, addr in peers.items()]}
+        # /v1/system/gc
+        if parts == ["system", "gc"] and method == "PUT":
+            server.run_gc("force-gc")
+            return {}
+        # /v1/operator/scheduler/configuration
+        if parts == ["operator", "scheduler", "configuration"]:
+            if method == "GET":
+                return to_wire(state.scheduler_config())
+            if method == "PUT":
+                state.set_scheduler_config(from_wire(body))
+                return {"updated": True}
+        raise HttpError(404, f"no handler for {method} {path}")
+
+    # ---- composed handlers ----
+
+    @staticmethod
+    def _job_summary(state, ns: str, job_id: str) -> Dict[str, Any]:
+        """JobSummary (structs.JobSummary): per-group alloc status counts."""
+        job = state.job_by_id(ns, job_id)
+        if job is None:
+            raise HttpError(404, f"job {job_id!r} not found")
+        groups: Dict[str, Dict[str, int]] = {}
+        for tg in job.task_groups:
+            groups[tg.name] = {"queued": 0, "starting": 0, "running": 0,
+                               "complete": 0, "failed": 0, "lost": 0}
+        for a in state.allocs_by_job(ns, job_id):
+            g = groups.setdefault(a.task_group, {})
+            key = {"pending": "starting"}.get(a.client_status,
+                                             a.client_status)
+            g[key] = g.get(key, 0) + 1
+        return {"job_id": job_id, "namespace": ns, "summary": groups}
+
+    @staticmethod
+    def _job_plan(server, job) -> Dict[str, Any]:
+        """Dry-run scheduling (Job.Plan, nomad/job_endpoint.go:1626): run
+        the scheduler against an ISOLATED snapshot — the harness applies
+        the plan to the snapshot only, and the cluster tensors are copied
+        so the live kernels never see the what-if placement."""
+        from ..scheduler.harness import Harness
+        from ..structs import Evaluation
+
+        snap = server.state.snapshot().detach_for_writes()
+        h = Harness(state=snap)
+        snap.upsert_job(job)
+        ev = Evaluation(namespace=job.namespace, job_id=job.id,
+                        type=job.type, priority=job.priority,
+                        triggered_by="job-register", status="pending")
+        h.process(ev)
+        plan = h.plans[-1] if h.plans else None
+        failed = {}
+        for e in h.evals:
+            for tg, m in (e.failed_tg_allocs or {}).items():
+                failed[tg] = {"nodes_evaluated": m.nodes_evaluated,
+                              "nodes_filtered": m.nodes_filtered,
+                              "nodes_exhausted": m.nodes_exhausted}
+        return {
+            "placements": 0 if plan is None else sum(
+                len(v) for v in plan.node_allocation.values()),
+            "stops": 0 if plan is None else sum(
+                len(v) for v in plan.node_update.values()),
+            "failed_tg_allocs": failed,
+        }

@@ -1,0 +1,11 @@
+"""Python SDK for the HTTP API.
+
+Behavioral reference: the `api/` Go SDK (16,697 LoC, one file per noun —
+api/jobs.go, nodes.go, allocations.go, evaluations.go, deployments.go,
+operator.go). Here one client class exposes the same noun-scoped surface;
+structs decode through the shared wire codec, so SDK users handle the
+same `nomad_tpu.structs` types the server does (the reference keeps a
+separate mirrored model; see SURVEY §2.5)."""
+from .client import ApiError, NomadClient
+
+__all__ = ["ApiError", "NomadClient"]

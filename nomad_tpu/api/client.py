@@ -1,0 +1,197 @@
+"""HTTP API client (the Go SDK's `api.Client` analog)."""
+from __future__ import annotations
+
+import json
+from http.client import HTTPConnection
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import urlencode
+
+from ..structs.codec import (from_json_tree, from_wire, to_json_tree,
+                             to_wire)
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class NomadClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 4646,
+                 timeout: float = 70.0) -> None:
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    # ---- transport ----
+
+    def _request(self, method: str, path: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 body: Any = None) -> Any:
+        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        try:
+            qs = f"?{urlencode(params)}" if params else ""
+            payload = json.dumps(to_json_tree(body)) \
+                if body is not None else None
+            conn.request(method, f"{path}{qs}", body=payload,
+                         headers={"Content-Type": "application/json"})
+            res = conn.getresponse()
+            data = from_json_tree(json.loads(res.read() or b"null"))
+            if res.status >= 400:
+                raise ApiError(res.status,
+                               (data or {}).get("error", "request failed"))
+            return data
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _unblock(res: Any) -> Tuple[int, Any]:
+        """Split a blocking-query envelope {index, data}."""
+        if isinstance(res, dict) and set(res) == {"index", "data"}:
+            return res["index"], res["data"]
+        return 0, res
+
+    # ---- jobs (api/jobs.go) ----
+
+    def jobs(self, prefix: str = "") -> List[Any]:
+        _, data = self._unblock(self._request(
+            "GET", "/v1/jobs", params={"prefix": prefix} if prefix else None))
+        return [from_wire(j) for j in data]
+
+    def register_job(self, job) -> str:
+        out = self._request("PUT", "/v1/jobs", body={"job": to_wire(job)})
+        return out.get("eval_id", "")
+
+    def job(self, job_id: str, namespace: str = "default"):
+        return from_wire(self._request(
+            "GET", f"/v1/job/{job_id}", params={"namespace": namespace}))
+
+    def deregister_job(self, job_id: str, namespace: str = "default") -> str:
+        out = self._request("DELETE", f"/v1/job/{job_id}",
+                            params={"namespace": namespace})
+        return out.get("eval_id", "")
+
+    def job_allocations(self, job_id: str, namespace: str = "default",
+                        index: int = 0, wait: float = 60.0) -> List[Any]:
+        """With `index` set this is a blocking query (long-poll up to
+        `wait` seconds, reference default behavior)."""
+        params = {"namespace": namespace}
+        if index:
+            params.update(index=index, wait=wait or 60.0)
+        _, data = self._unblock(self._request(
+            "GET", f"/v1/job/{job_id}/allocations", params=params))
+        return [from_wire(a) for a in data]
+
+    def job_evaluations(self, job_id: str,
+                        namespace: str = "default") -> List[Any]:
+        _, data = self._unblock(self._request(
+            "GET", f"/v1/job/{job_id}/evaluations",
+            params={"namespace": namespace}))
+        return [from_wire(e) for e in data]
+
+    def job_summary(self, job_id: str, namespace: str = "default") -> dict:
+        return self._request("GET", f"/v1/job/{job_id}/summary",
+                             params={"namespace": namespace})
+
+    def plan_job(self, job) -> dict:
+        return self._request("PUT", f"/v1/job/{job.id}/plan",
+                             body={"job": to_wire(job)})
+
+    def periodic_force(self, job_id: str,
+                       namespace: str = "default") -> str:
+        out = self._request("PUT", f"/v1/job/{job_id}/periodic/force",
+                            params={"namespace": namespace})
+        return out.get("eval_id", "")
+
+    # ---- nodes (api/nodes.go) ----
+
+    def nodes(self) -> List[Any]:
+        _, data = self._unblock(self._request("GET", "/v1/nodes"))
+        return [from_wire(n) for n in data]
+
+    def node(self, node_id: str):
+        return from_wire(self._request("GET", f"/v1/node/{node_id}"))
+
+    def drain_node(self, node_id: str, drain_spec=None) -> List[str]:
+        out = self._request(
+            "PUT", f"/v1/node/{node_id}/drain",
+            body={"drain_spec": to_wire(drain_spec)
+                  if drain_spec is not None else None})
+        return out.get("eval_ids", [])
+
+    def node_eligibility(self, node_id: str, eligibility: str) -> None:
+        self._request("PUT", f"/v1/node/{node_id}/eligibility",
+                      body={"eligibility": eligibility})
+
+    def node_allocations(self, node_id: str) -> List[Any]:
+        _, data = self._unblock(self._request(
+            "GET", f"/v1/node/{node_id}/allocations"))
+        return [from_wire(a) for a in data]
+
+    # ---- allocations / evaluations (api/allocations.go, evaluations.go) --
+
+    def allocations(self) -> List[Any]:
+        _, data = self._unblock(self._request("GET", "/v1/allocations"))
+        return [from_wire(a) for a in data]
+
+    def allocation(self, alloc_id: str):
+        return from_wire(self._request("GET", f"/v1/allocation/{alloc_id}"))
+
+    def evaluations(self) -> List[Any]:
+        _, data = self._unblock(self._request("GET", "/v1/evaluations"))
+        return [from_wire(e) for e in data]
+
+    def evaluation(self, eval_id: str):
+        return from_wire(self._request("GET", f"/v1/evaluation/{eval_id}"))
+
+    def wait_for_eval(self, eval_id: str, timeout: float = 15.0):
+        """Poll until the eval reaches a terminal status (CLI monitor)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            ev = self.evaluation(eval_id)
+            if ev.status in ("complete", "failed", "cancelled"):
+                return ev
+            time.sleep(0.1)
+        return self.evaluation(eval_id)
+
+    # ---- deployments (api/deployments.go) ----
+
+    def deployments(self) -> List[Any]:
+        _, data = self._unblock(self._request("GET", "/v1/deployments"))
+        return [from_wire(d) for d in data]
+
+    def deployment(self, deployment_id: str):
+        return from_wire(self._request(
+            "GET", f"/v1/deployment/{deployment_id}"))
+
+    def promote_deployment(self, deployment_id: str) -> str:
+        out = self._request("PUT", f"/v1/deployment/promote/{deployment_id}")
+        return out.get("eval_id", "")
+
+    def fail_deployment(self, deployment_id: str) -> str:
+        out = self._request("PUT", f"/v1/deployment/fail/{deployment_id}")
+        return out.get("eval_id", "")
+
+    # ---- operator / system / agent ----
+
+    def scheduler_config(self):
+        return from_wire(self._request(
+            "GET", "/v1/operator/scheduler/configuration"))
+
+    def set_scheduler_config(self, config) -> None:
+        self._request("PUT", "/v1/operator/scheduler/configuration",
+                      body=to_wire(config))
+
+    def system_gc(self) -> None:
+        self._request("PUT", "/v1/system/gc")
+
+    def agent_self(self) -> dict:
+        return self._request("GET", "/v1/agent/self")
+
+    def metrics(self) -> dict:
+        return self._request("GET", "/v1/metrics")
+
+    def status_leader(self):
+        return self._request("GET", "/v1/status/leader")

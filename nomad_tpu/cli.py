@@ -1,0 +1,518 @@
+"""CLI — the `nomad <subcommand>` surface.
+
+Behavioral reference: `command/commands.go:142-661` registry and the
+individual command files (`command/job_run.go`, `job_status.go`,
+`node_status.go`, `alloc_status.go`, `node_drain.go`, `eval_status.go`,
+`deployment_*.go`, `operator_*.go`, `agent/command.go`). Implemented
+subcommands cover the core operator loop: agent, job
+run/status/stop/plan/inspect/periodic-force, node
+status/drain/eligibility, alloc status, eval status, deployment
+list/status/promote/fail, server members, operator scheduler-config,
+system gc, status, version.
+
+Usage: `python -m nomad_tpu <subcommand> ...`; server address from
+`-address` or `$NOMAD_ADDR` (default http://127.0.0.1:4646).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from .api import ApiError, NomadClient
+
+
+def _client(args) -> NomadClient:
+    addr = args.address or os.environ.get("NOMAD_ADDR",
+                                          "http://127.0.0.1:4646")
+    import re
+
+    m = re.match(r"^(?:(?P<scheme>https?)://)?(?P<host>[^:/]+)"
+                 r"(?::(?P<port>\d+))?/?$", addr)
+    if m is None:
+        print(f"Error: malformed address {addr!r} "
+              "(expected [http://]host[:port])", file=sys.stderr)
+        raise SystemExit(1)
+    if m.group("scheme") == "https":
+        print("Error: TLS is not supported by this build; use http://",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return NomadClient(m.group("host"), int(m.group("port") or 4646))
+
+
+def _columns(rows: List[List[str]], header: List[str]) -> str:
+    rows = [header] + rows
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(header))]
+    return "\n".join(
+        "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in rows)
+
+
+def _monitor(api: NomadClient, eval_id: str) -> int:
+    """Eval monitor (command/monitor.go): follow the eval to completion."""
+    print(f"==> Monitoring evaluation {eval_id[:8]}")
+    ev = api.wait_for_eval(eval_id, timeout=30.0)
+    print(f"    Evaluation status: {ev.status}")
+    if ev.status != "complete":
+        print(f"    {ev.status_description}")
+        return 1
+    for tg, m in (ev.failed_tg_allocs or {}).items():
+        print(f"    Task group {tg!r} failed placement: "
+              f"{m.nodes_evaluated} evaluated, {m.nodes_filtered} filtered, "
+              f"{m.nodes_exhausted} exhausted")
+    if ev.blocked_eval_id if hasattr(ev, "blocked_eval_id") else None:
+        print(f"    Blocked eval created: {ev.blocked_eval_id[:8]}")
+    return 0
+
+
+# ---- job ----
+
+def cmd_job_run(args) -> int:
+    from .jobspec import parse_file
+
+    api = _client(args)
+    job = parse_file(args.spec)
+    eval_id = api.register_job(job)
+    if not eval_id:
+        print(f'Job "{job.id}" registered (no evaluation: '
+              f'periodic/parameterized)')
+        return 0
+    print(f'Job "{job.id}" registered; evaluation {eval_id[:8]}')
+    if args.detach:
+        return 0
+    return _monitor(api, eval_id)
+
+
+def cmd_job_status(args) -> int:
+    api = _client(args)
+    if not args.job_id:
+        jobs = api.jobs()
+        print(_columns(
+            [[j.id, j.type, str(j.priority),
+              "dead" if j.stop else j.status or "running"] for j in jobs],
+            ["ID", "Type", "Priority", "Status"]))
+        return 0
+    job = api.job(args.job_id, namespace=args.namespace)
+    print(f"ID            = {job.id}")
+    print(f"Name          = {job.name}")
+    print(f"Type          = {job.type}")
+    print(f"Priority      = {job.priority}")
+    print(f"Datacenters   = {','.join(job.datacenters)}")
+    print(f"Status        = {'dead (stopped)' if job.stop else job.status}")
+    summary = api.job_summary(args.job_id, namespace=args.namespace)
+    print("\nSummary")
+    rows = [[tg] + [str(counts.get(k, 0)) for k in
+                    ("queued", "starting", "running", "complete",
+                     "failed", "lost")]
+            for tg, counts in summary["summary"].items()]
+    print(_columns(rows, ["Task Group", "Queued", "Starting", "Running",
+                          "Complete", "Failed", "Lost"]))
+    allocs = api.job_allocations(args.job_id, namespace=args.namespace)
+    if allocs:
+        print("\nAllocations")
+        print(_columns(
+            [[a.id[:8], a.node_id[:8], a.task_group, a.desired_status,
+              a.client_status] for a in allocs],
+            ["ID", "Node ID", "Task Group", "Desired", "Status"]))
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    api = _client(args)
+    eval_id = api.deregister_job(args.job_id, namespace=args.namespace)
+    print(f'Job "{args.job_id}" deregistered')
+    if eval_id and not args.detach:
+        return _monitor(api, eval_id)
+    return 0
+
+
+def cmd_job_plan(args) -> int:
+    from .jobspec import parse_file
+
+    api = _client(args)
+    job = parse_file(args.spec)
+    out = api.plan_job(job)
+    print(f"+ Job: {job.id!r}")
+    print(f"Placements: {out['placements']}  Stops: {out['stops']}")
+    for tg, m in out.get("failed_tg_allocs", {}).items():
+        print(f"WARNING: group {tg!r} would fail placement "
+              f"({m['nodes_evaluated']} evaluated, "
+              f"{m['nodes_filtered']} filtered)")
+    return 0
+
+
+def cmd_job_inspect(args) -> int:
+    from .structs.codec import to_wire
+
+    api = _client(args)
+    job = api.job(args.job_id, namespace=args.namespace)
+    print(json.dumps(to_wire(job), indent=2, default=str))
+    return 0
+
+
+def cmd_job_periodic_force(args) -> int:
+    api = _client(args)
+    eval_id = api.periodic_force(args.job_id, namespace=args.namespace)
+    print(f"Forced periodic launch; evaluation {eval_id[:8]}")
+    return _monitor(api, eval_id) if not args.detach else 0
+
+
+# ---- node ----
+
+def cmd_node_status(args) -> int:
+    api = _client(args)
+    if not args.node_id:
+        print(_columns(
+            [[n.id[:8], n.name, n.datacenter, n.node_class or "<none>",
+              n.scheduling_eligibility, n.status] for n in api.nodes()],
+            ["ID", "Name", "DC", "Class", "Eligibility", "Status"]))
+        return 0
+    matches = [n for n in api.nodes() if n.id.startswith(args.node_id)]
+    if len(matches) != 1:
+        print(f"{len(matches)} nodes match prefix {args.node_id!r}",
+              file=sys.stderr)
+        return 1
+    node = api.node(matches[0].id)
+    print(f"ID          = {node.id}")
+    print(f"Name        = {node.name}")
+    print(f"DC          = {node.datacenter}")
+    print(f"Status      = {node.status}")
+    print(f"Eligibility = {node.scheduling_eligibility}")
+    print(f"Drain       = {node.drain is not None}")
+    allocs = api.node_allocations(node.id)
+    if allocs:
+        print("\nAllocations")
+        print(_columns(
+            [[a.id[:8], a.job_id, a.desired_status, a.client_status]
+             for a in allocs],
+            ["ID", "Job", "Desired", "Status"]))
+    return 0
+
+
+def cmd_node_drain(args) -> int:
+    from .structs.node import DrainStrategy
+
+    api = _client(args)
+    if args.enable:
+        spec = DrainStrategy(deadline_s=args.deadline,
+                             ignore_system_jobs=args.ignore_system)
+        api.drain_node(args.node_id, spec)
+        print(f"Node {args.node_id[:8]} drain strategy set")
+    else:
+        api.drain_node(args.node_id, None)
+        print(f"Node {args.node_id[:8]} drain disabled")
+    return 0
+
+
+def cmd_node_eligibility(args) -> int:
+    api = _client(args)
+    elig = "eligible" if args.enable else "ineligible"
+    api.node_eligibility(args.node_id, elig)
+    print(f"Node {args.node_id[:8]} scheduling eligibility: {elig}")
+    return 0
+
+
+# ---- alloc / eval ----
+
+def cmd_alloc_status(args) -> int:
+    api = _client(args)
+    matches = [a for a in api.allocations()
+               if a.id.startswith(args.alloc_id)]
+    if len(matches) != 1:
+        print(f"{len(matches)} allocations match {args.alloc_id!r}",
+              file=sys.stderr)
+        return 1
+    a = api.allocation(matches[0].id)
+    print(f"ID            = {a.id}")
+    print(f"Name          = {a.name}")
+    print(f"Node ID       = {a.node_id}")
+    print(f"Job ID        = {a.job_id}")
+    print(f"Desired       = {a.desired_status}")
+    print(f"Client Status = {a.client_status}")
+    for task, ts in (a.task_states or {}).items():
+        print(f"\nTask {task!r} is {ts.state} "
+              f"(failed={ts.failed}, restarts={ts.restarts})")
+        for e in ts.events[-8:]:
+            stamp = time.strftime("%H:%M:%S", time.localtime(e.time))
+            print(f"  {stamp}  {e.type:<16} {e.message}")
+    return 0
+
+
+def cmd_eval_status(args) -> int:
+    api = _client(args)
+    ev = api.evaluation(args.eval_id)
+    print(f"ID          = {ev.id}")
+    print(f"Status      = {ev.status}")
+    print(f"Type        = {ev.type}")
+    print(f"TriggeredBy = {ev.triggered_by}")
+    print(f"Job ID      = {ev.job_id}")
+    if ev.status_description:
+        print(f"Description = {ev.status_description}")
+    return 0
+
+
+# ---- deployment ----
+
+def cmd_deployment_list(args) -> int:
+    api = _client(args)
+    print(_columns(
+        [[d.id[:8], d.job_id, d.status, d.status_description]
+         for d in api.deployments()],
+        ["ID", "Job ID", "Status", "Description"]))
+    return 0
+
+
+def cmd_deployment_status(args) -> int:
+    api = _client(args)
+    d = api.deployment(args.deployment_id)
+    print(f"ID     = {d.id}")
+    print(f"Job ID = {d.job_id}")
+    print(f"Status = {d.status}")
+    rows = []
+    for tg, s in d.task_groups.items():
+        rows.append([tg, str(s.desired_total), str(s.placed_allocs),
+                     str(s.healthy_allocs), str(s.unhealthy_allocs),
+                     str(s.promoted)])
+    print(_columns(rows, ["Group", "Desired", "Placed", "Healthy",
+                          "Unhealthy", "Promoted"]))
+    return 0
+
+
+def cmd_deployment_promote(args) -> int:
+    api = _client(args)
+    api.promote_deployment(args.deployment_id)
+    print(f"Deployment {args.deployment_id[:8]} promoted")
+    return 0
+
+
+def cmd_deployment_fail(args) -> int:
+    api = _client(args)
+    api.fail_deployment(args.deployment_id)
+    print(f"Deployment {args.deployment_id[:8]} marked failed")
+    return 0
+
+
+# ---- operator / misc ----
+
+def cmd_server_members(args) -> int:
+    api = _client(args)
+    out = api._request("GET", "/v1/agent/members")
+    print(_columns([[m["name"], str(m["addr"])]
+                    for m in out.get("members", [])],
+                   ["Name", "Addr"]))
+    return 0
+
+
+def cmd_operator_scheduler_get(args) -> int:
+    api = _client(args)
+    cfg = api.scheduler_config()
+    print(f"Algorithm          = {cfg.scheduler_algorithm}")
+    print(f"Preemption(system) = {cfg.preemption_system_enabled}")
+    print(f"Preemption(service)= {cfg.preemption_service_enabled}")
+    print(f"Preemption(batch)  = {cfg.preemption_batch_enabled}")
+    return 0
+
+
+def cmd_operator_scheduler_set(args) -> int:
+    api = _client(args)
+    cfg = api.scheduler_config()
+    if args.algorithm:
+        cfg.scheduler_algorithm = args.algorithm
+    api.set_scheduler_config(cfg)
+    print("Scheduler configuration updated")
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    _client(args).system_gc()
+    print("System GC triggered")
+    return 0
+
+
+def cmd_status(args) -> int:
+    api = _client(args)
+    print(f"Leader: {api.status_leader()}")
+    info = api.agent_self()
+    print(f"Version: {info['version']}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    from . import __version__
+
+    print(f"nomad-tpu v{__version__}")
+    return 0
+
+
+def cmd_agent(args) -> int:
+    from .agent import Agent, AgentConfig
+
+    if not (args.dev or args.server or args.client):
+        print("Error: must have at least client or server mode enabled "
+              "(-dev | -server | -client)", file=sys.stderr)
+        return 1
+    cfg = AgentConfig(
+        server=args.dev or args.server,
+        client=args.dev or args.client,
+        http_host=args.bind, http_port=args.http_port,
+        data_dir=args.data_dir,
+    )
+    if args.config:
+        from .jobspec.hcl import parse_hcl
+
+        with open(args.config) as fh:
+            tree = parse_hcl(fh.read())
+        for k, v in tree.items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+    agent = Agent(cfg)
+    agent.start()
+    host, port = agent.http_addr
+    mode = "+".join(m for m, on in (("server", cfg.server),
+                                    ("client", cfg.client)) if on)
+    print(f"==> nomad-tpu agent started ({mode}); "
+          f"HTTP on http://{host}:{port}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("==> shutting down")
+        agent.shutdown()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="nomad-tpu")
+    p.add_argument("-address", default=None,
+                   help="HTTP API address (default $NOMAD_ADDR)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ag = sub.add_parser("agent", help="run an agent")
+    ag.add_argument("-dev", action="store_true")
+    ag.add_argument("-server", action="store_true")
+    ag.add_argument("-client", action="store_true")
+    ag.add_argument("-bind", default="127.0.0.1")
+    ag.add_argument("-http-port", type=int, default=4646)
+    ag.add_argument("-data-dir", default=None)
+    ag.add_argument("-config", default=None)
+    ag.set_defaults(fn=cmd_agent)
+
+    job = sub.add_parser("job", help="job commands").add_subparsers(
+        dest="sub", required=True)
+    jr = job.add_parser("run")
+    jr.add_argument("spec")
+    jr.add_argument("-detach", action="store_true")
+    jr.set_defaults(fn=cmd_job_run)
+    js = job.add_parser("status")
+    js.add_argument("job_id", nargs="?")
+    js.add_argument("-namespace", default="default")
+    js.set_defaults(fn=cmd_job_status)
+    jst = job.add_parser("stop")
+    jst.add_argument("job_id")
+    jst.add_argument("-namespace", default="default")
+    jst.add_argument("-detach", action="store_true")
+    jst.set_defaults(fn=cmd_job_stop)
+    jp = job.add_parser("plan")
+    jp.add_argument("spec")
+    jp.set_defaults(fn=cmd_job_plan)
+    ji = job.add_parser("inspect")
+    ji.add_argument("job_id")
+    ji.add_argument("-namespace", default="default")
+    ji.set_defaults(fn=cmd_job_inspect)
+    jpf = job.add_parser("periodic-force")
+    jpf.add_argument("job_id")
+    jpf.add_argument("-namespace", default="default")
+    jpf.add_argument("-detach", action="store_true")
+    jpf.set_defaults(fn=cmd_job_periodic_force)
+
+    node = sub.add_parser("node", help="node commands").add_subparsers(
+        dest="sub", required=True)
+    ns_ = node.add_parser("status")
+    ns_.add_argument("node_id", nargs="?")
+    ns_.set_defaults(fn=cmd_node_status)
+    nd = node.add_parser("drain")
+    nd.add_argument("node_id")
+    g = nd.add_mutually_exclusive_group(required=True)
+    g.add_argument("-enable", action="store_true")
+    g.add_argument("-disable", action="store_true")
+    nd.add_argument("-deadline", type=float, default=3600.0)
+    nd.add_argument("-ignore-system", action="store_true")
+    nd.set_defaults(fn=cmd_node_drain)
+    ne = node.add_parser("eligibility")
+    ne.add_argument("node_id")
+    g = ne.add_mutually_exclusive_group(required=True)
+    g.add_argument("-enable", action="store_true")
+    g.add_argument("-disable", action="store_true")
+    ne.set_defaults(fn=cmd_node_eligibility)
+
+    al = sub.add_parser("alloc", help="alloc commands").add_subparsers(
+        dest="sub", required=True)
+    als = al.add_parser("status")
+    als.add_argument("alloc_id")
+    als.set_defaults(fn=cmd_alloc_status)
+
+    ev = sub.add_parser("eval", help="eval commands").add_subparsers(
+        dest="sub", required=True)
+    evs = ev.add_parser("status")
+    evs.add_argument("eval_id")
+    evs.set_defaults(fn=cmd_eval_status)
+
+    dep = sub.add_parser("deployment",
+                         help="deployment commands").add_subparsers(
+        dest="sub", required=True)
+    dl = dep.add_parser("list")
+    dl.set_defaults(fn=cmd_deployment_list)
+    ds = dep.add_parser("status")
+    ds.add_argument("deployment_id")
+    ds.set_defaults(fn=cmd_deployment_status)
+    dp = dep.add_parser("promote")
+    dp.add_argument("deployment_id")
+    dp.set_defaults(fn=cmd_deployment_promote)
+    df = dep.add_parser("fail")
+    df.add_argument("deployment_id")
+    df.set_defaults(fn=cmd_deployment_fail)
+
+    srv = sub.add_parser("server", help="server commands").add_subparsers(
+        dest="sub", required=True)
+    sm = srv.add_parser("members")
+    sm.set_defaults(fn=cmd_server_members)
+
+    op = sub.add_parser("operator", help="operator commands").add_subparsers(
+        dest="sub", required=True)
+    osg = op.add_parser("scheduler-get-config")
+    osg.set_defaults(fn=cmd_operator_scheduler_get)
+    oss = op.add_parser("scheduler-set-config")
+    oss.add_argument("-algorithm", choices=["binpack", "spread"])
+    oss.set_defaults(fn=cmd_operator_scheduler_set)
+
+    sysp = sub.add_parser("system", help="system commands").add_subparsers(
+        dest="sub", required=True)
+    sg = sysp.add_parser("gc")
+    sg.set_defaults(fn=cmd_system_gc)
+
+    st = sub.add_parser("status", help="cluster status")
+    st.set_defaults(fn=cmd_status)
+    vp = sub.add_parser("version")
+    vp.set_defaults(fn=cmd_version)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except ConnectionRefusedError:
+        print("Error: cannot reach the agent HTTP API "
+              "(is `nomad-tpu agent` running? set -address/$NOMAD_ADDR)",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

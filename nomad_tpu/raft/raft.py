@@ -252,6 +252,8 @@ class RaftNode:
             idx = self.log.append(append_term, data)
             ev = threading.Event()
             self._waiters[idx] = ev
+            # single-voter clusters reach majority on append alone
+            self._advance_commit()
         self._replicate_all()
         if not ev.wait(timeout):
             with self._lock:

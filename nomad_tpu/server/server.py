@@ -113,6 +113,7 @@ class Server:
         self.periodic.start()
         self.timetable.witness(self.state.index.value)
         self._stop_event.clear()
+        self._last_gc = time.time()  # first GC a full interval after start
         self._gc_thread = threading.Thread(target=self._run_gc_ticker,
                                            name="core-gc", daemon=True)
         self._gc_thread.start()
@@ -165,7 +166,7 @@ class Server:
         while not self._stop_event.wait(min(self.config.gc_interval, 1.0)):
             self.timetable.witness(self.state.index.value)
             now = time.time()
-            if now - getattr(self, "_last_gc", 0.0) < self.config.gc_interval:
+            if now - self._last_gc < self.config.gc_interval:
                 continue
             self._last_gc = now
             for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,

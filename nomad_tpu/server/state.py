@@ -50,6 +50,27 @@ class StateSnapshot(InMemState):
         self.cluster = store.cluster
         self.index_at = store.index.value
 
+    def detach_for_writes(self) -> "StateSnapshot":
+        """Make this snapshot safe to MUTATE (dry-run scheduling): the
+        shallow-copied tables share inner per-job/per-node maps and the
+        live index counter with the store — writes through the InMemState
+        mutators would leak into live state. Copies the inner maps, gives
+        the snapshot a private index counter, and deep-copies the cluster
+        tensors. (Job.Plan is the consumer, agent/http.py _job_plan.)"""
+        import copy
+
+        self._allocs_by_job = {k: dict(v)
+                               for k, v in self._allocs_by_job.items()}
+        self._allocs_by_node = {k: dict(v)
+                                for k, v in self._allocs_by_node.items()}
+        self._deployments = {k: copy.copy(v)
+                             for k, v in self._deployments.items()}
+        counter = _IndexCounter()
+        counter.value = self.index_at
+        self.index = counter
+        self.cluster = copy.deepcopy(self.cluster)
+        return self
+
 
 class StateStore(InMemState):
     """Thread-safe store with index watching (blocking queries)."""

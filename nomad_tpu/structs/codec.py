@@ -65,6 +65,39 @@ def to_wire(obj: Any) -> Any:
     raise TypeError(f"unencodable type {type(obj).__name__}: {obj!r}")
 
 
+def to_json_tree(tree: Any) -> Any:
+    """Wire tree → JSON-safe tree (bytes become {"__b": base64}). The
+    msgpack transports carry bytes natively; HTTP/JSON needs this bridge.
+    Injective: user dicts that collide with the markers are wrapped in
+    {"__bmap": ...} so decoding never misreads them."""
+    import base64
+
+    if isinstance(tree, bytes):
+        return {"__b": base64.b64encode(tree).decode()}
+    if isinstance(tree, dict):
+        enc = {k: to_json_tree(v) for k, v in tree.items()}
+        if set(tree) & {"__b", "__bmap"}:
+            return {"__bmap": enc}
+        return enc
+    if isinstance(tree, (list, tuple)):
+        return [to_json_tree(v) for v in tree]
+    return tree
+
+
+def from_json_tree(tree: Any) -> Any:
+    import base64
+
+    if isinstance(tree, dict):
+        if set(tree) == {"__b"}:
+            return base64.b64decode(tree["__b"])
+        if set(tree) == {"__bmap"}:
+            return {k: from_json_tree(v) for k, v in tree["__bmap"].items()}
+        return {k: from_json_tree(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [from_json_tree(v) for v in tree]
+    return tree
+
+
 def from_wire(tree: Any) -> Any:
     """Inverse of to_wire. Unknown fields are ignored (forward compat)."""
     if isinstance(tree, dict):

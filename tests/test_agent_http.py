@@ -1,0 +1,223 @@
+"""Agent + HTTP API + SDK end-to-end (reference models:
+command/agent/http_test.go, *_endpoint_test.go, internal/testing/apitests
+— a dev-mode agent driven entirely through the API)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import ApiError, NomadClient
+from nomad_tpu.structs.job import PeriodicConfig
+from nomad_tpu.structs.node import DrainStrategy
+
+
+def _wait(cond, timeout=15.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    """Dev-mode agent: server + client + HTTP in one process."""
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+    yield a, api
+    a.shutdown()
+
+
+def _mock_driver_job(run_for=0.1, count=1):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    t = tg.tasks[0]
+    t.driver = "mock_driver"
+    t.config = {"run_for": run_for}
+    return job
+
+
+class TestHttpApi:
+    def test_job_lifecycle_via_sdk(self, agent):
+        a, api = agent
+        job = _mock_driver_job(count=2)
+        eval_id = api.register_job(job)
+        assert eval_id
+        ev = api.wait_for_eval(eval_id)
+        assert ev.status == "complete"
+        got = api.job(job.id)
+        assert got.id == job.id and got.task_groups[0].count == 2
+        assert any(j.id == job.id for j in api.jobs())
+        assert _wait(lambda: len(api.job_allocations(job.id)) == 2)
+        assert _wait(lambda: all(
+            al.client_status == "complete"
+            for al in api.job_allocations(job.id)))
+        summary = api.job_summary(job.id)
+        assert summary["summary"]["web"]["complete"] == 2
+        # stop
+        stop_eval = api.deregister_job(job.id)
+        assert stop_eval
+        assert api.job(job.id).stop
+
+    def test_404s(self, agent):
+        a, api = agent
+        with pytest.raises(ApiError) as ei:
+            api.job("does-not-exist")
+        assert ei.value.code == 404
+        with pytest.raises(ApiError):
+            api.allocation("nope")
+
+    def test_node_endpoints(self, agent):
+        a, api = agent
+        nodes = api.nodes()
+        assert len(nodes) == 1
+        node = api.node(nodes[0].id)
+        assert node.attributes.get("kernel.name")
+        api.node_eligibility(node.id, "ineligible")
+        assert api.node(node.id).scheduling_eligibility == "ineligible"
+        api.node_eligibility(node.id, "eligible")
+        # drain round trip: an empty node's drain completes immediately
+        # (strategy cleared, node left ineligible)
+        api.drain_node(node.id, DrainStrategy(deadline_s=60.0))
+        assert _wait(lambda: (
+            api.node(node.id).drain is None
+            and api.node(node.id).scheduling_eligibility == "ineligible"))
+        api.drain_node(node.id, None)  # cancel → eligible again
+        got = api.node(node.id)
+        assert got.drain is None and got.scheduling_eligibility == "eligible"
+
+    def test_evaluations_and_allocations_listing(self, agent):
+        a, api = agent
+        job = _mock_driver_job()
+        ev_id = api.register_job(job)
+        api.wait_for_eval(ev_id)
+        evs = api.job_evaluations(job.id)
+        assert any(e.id == ev_id for e in evs)
+        assert _wait(lambda: len(api.allocations()) >= 1)
+        al = api.job_allocations(job.id)[0]
+        assert api.allocation(al.id).id == al.id
+
+    def test_job_plan_dry_run(self, agent):
+        a, api = agent
+        job = _mock_driver_job(count=3)
+        idx_before = a.server.state.index.value
+        out = api.plan_job(job)
+        assert out["placements"] == 3
+        # dry run placed nothing for real and never touched live state
+        assert api.job_allocations(job.id) == []
+        assert a.server.state.index.value == idx_before
+        with pytest.raises(ApiError):
+            api.job(job.id)
+
+    def test_job_plan_does_not_leak_into_existing_job(self, agent):
+        """A dry-run against a job that already has allocations must not
+        add phantom allocations to the live store."""
+        a, api = agent
+        job = _mock_driver_job(count=1)
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: len(api.job_allocations(job.id)) == 1)
+        job2 = _mock_driver_job(count=1)
+        job2.id = job.id  # plan an update of the same job
+        api.plan_job(job2)
+        time.sleep(0.2)
+        assert len(api.job_allocations(job.id)) == 1
+
+    def test_bytes_and_marker_keys_round_trip(self, agent):
+        a, api = agent
+        job = _mock_driver_job()
+        job.payload = b"\x00\x01bin"
+        job.meta = {"__b": "literal", "ok": "1"}
+        api.wait_for_eval(api.register_job(job))
+        got = api.job(job.id)
+        assert got.payload == b"\x00\x01bin"
+        assert got.meta == {"__b": "literal", "ok": "1"}
+
+    def test_client_only_agent_local_routes(self, tmp_path):
+        # a client-only agent serves /v1/agent/self and /v1/metrics but
+        # 501s server routes with a helpful message
+        server_agent = Agent(AgentConfig(client=False, heartbeat_ttl=60.0))
+        server_agent.start()
+        try:
+            # reach through RPC? client-only agent needs server_addrs —
+            # fabricate with the in-proc server's... use RpcConn targets
+            from nomad_tpu.server.cluster import (ClusterServer,
+                                                  ClusterServerConfig)
+
+            cs = ClusterServer(ClusterServerConfig(node_id="s1"))
+            cs.start()
+            try:
+                import time as _t
+
+                _t.sleep(0.5)
+                c_agent = Agent(AgentConfig(
+                    server=False, client=True, server_addrs=[cs.addr]))
+                c_agent.start()
+                try:
+                    api2 = NomadClient(c_agent.http_addr[0],
+                                       c_agent.http_addr[1])
+                    info = api2.agent_self()
+                    assert info["client"] and not info["server"]
+                    assert "client_allocs" in api2.metrics()
+                    with pytest.raises(ApiError) as ei:
+                        api2.nodes()
+                    assert ei.value.code == 501
+                finally:
+                    c_agent.shutdown()
+            finally:
+                cs.shutdown()
+        finally:
+            server_agent.shutdown()
+
+    def test_periodic_force(self, agent):
+        a, api = agent
+        job = _mock_driver_job()
+        job.periodic = PeriodicConfig(spec="0 0 1 1 *")
+        assert api.register_job(job) == ""  # no eval for periodic
+        eval_id = api.periodic_force(job.id)
+        assert eval_id
+        ev = api.wait_for_eval(eval_id)
+        assert ev.status == "complete"
+
+    def test_operator_scheduler_config(self, agent):
+        a, api = agent
+        cfg = api.scheduler_config()
+        assert cfg.scheduler_algorithm == "binpack"
+        cfg.scheduler_algorithm = "spread"
+        api.set_scheduler_config(cfg)
+        assert api.scheduler_config().scheduler_algorithm == "spread"
+
+    def test_agent_self_and_metrics(self, agent):
+        a, api = agent
+        info = api.agent_self()
+        assert info["server"] and info["client"]
+        m = api.metrics()
+        assert "broker" in m and m["state_index"] > 0
+
+    def test_system_gc(self, agent):
+        a, api = agent
+        api.system_gc()  # no error
+
+    def test_blocking_query_unblocks_on_write(self, agent):
+        import threading
+
+        a, api = agent
+        job = _mock_driver_job()
+        idx = a.server.state.index.value
+        got = {}
+
+        def block():
+            got["allocs"] = api.job_allocations(job.id, index=idx, wait=10.0)
+
+        t = threading.Thread(target=block)
+        t.start()
+        time.sleep(0.2)
+        api.register_job(job)
+        t.join(timeout=15.0)
+        assert not t.is_alive()
+        assert _wait(lambda: len(api.job_allocations(job.id)) == 1)
