@@ -22,7 +22,7 @@ class AgentConfig:
                  num_schedulers: int = 1, heartbeat_ttl: float = 30.0,
                  node_name: str = "", datacenter: str = "dc1",
                  region: str = "global",
-                 server_addrs=None) -> None:
+                 server_addrs=None, acl_enabled: bool = False) -> None:
         self.server = server
         self.client = client
         self.http_host = http_host
@@ -34,6 +34,7 @@ class AgentConfig:
         self.datacenter = datacenter
         self.region = region
         self.server_addrs = server_addrs or []  # client-only mode targets
+        self.acl_enabled = acl_enabled
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "AgentConfig":
@@ -58,6 +59,7 @@ class Agent:
                 num_schedulers=self.config.num_schedulers,
                 heartbeat_ttl=self.config.heartbeat_ttl,
                 data_dir=self.config.data_dir,
+                acl_enabled=self.config.acl_enabled,
             ))
         if self.config.client:
             from ..client import Client, ClientConfig, InProcConn, RpcConn

@@ -59,7 +59,10 @@ class HTTPApi:
                     length = int(self.headers.get("Content-Length") or 0)
                     raw = self.rfile.read(length) if length else b""
                     body = from_json_tree(json.loads(raw)) if raw else None
-                    out = api.route(method, parsed.path, query, body)
+                    token = self.headers.get("X-Nomad-Token") \
+                        or query.get("token")
+                    out = api.route(method, parsed.path, query, body,
+                                    token=token)
                     self._respond(200, out)
                 except HttpError as e:
                     self._respond(e.code, {"error": str(e)})
@@ -95,7 +98,7 @@ class HTTPApi:
     # ---- routing (http.go:253 registerHandlers) ----
 
     def route(self, method: str, path: str, query: Dict[str, str],
-              body: Any) -> Any:
+              body: Any, token: Optional[str] = None) -> Any:
         parts0 = [p for p in path.split("/") if p]
         if not parts0 or parts0[0] != "v1":
             raise HttpError(404, f"no handler for {path}")
@@ -110,6 +113,24 @@ class HTTPApi:
                             "this agent is not running a server; "
                             "point the CLI/SDK at a server agent")
         state = server.state
+
+        # ---- ACL resolution + enforcement helpers (every endpoint in the
+        # reference resolves the token first; nomad/acl.go) ----
+        from ..acl import ACLError
+
+        ns_for_acl = query.get("namespace", "default")
+        try:
+            acl = server.resolve_token(token)
+        except ACLError as e:
+            raise HttpError(403, str(e))
+
+        def require(ok: bool) -> None:
+            if not ok:
+                raise HttpError(403, "Permission denied")
+
+        # /v1/acl/* management surface (acl_endpoint.go)
+        if parts0[1:2] == ["acl"]:
+            return self._acl_routes(server, method, parts0[2:], body, acl)
 
         def blocking(fetch: Callable) -> Any:
             """index/wait params (http.go parseWait + blocking queries)."""
@@ -129,13 +150,18 @@ class HTTPApi:
         # /v1/jobs
         if parts == ["jobs"]:
             if method == "GET":
+                require(acl.allow_namespace_operation(ns_for_acl,
+                                                      "list-jobs"))
                 prefix = query.get("prefix", "")
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(j) for j in snap.jobs()
-                     if j.id.startswith(prefix)]))
+                     if j.namespace == ns_for_acl
+                     and j.id.startswith(prefix)]))
             if method == "PUT":
                 job = from_wire(body["job"] if "job" in body else body)
+                require(acl.allow_namespace_operation(job.namespace,
+                                                      "submit-job"))
                 ev = server.job_register(job)
                 return {"eval_id": ev.id if ev else "",
                         "job_modify_index": job.job_modify_index}
@@ -145,82 +171,104 @@ class HTTPApi:
             sub = parts[2] if len(parts) > 2 else ""
             if not sub:
                 if method == "GET":
+                    require(acl.allow_namespace_operation(ns, "read-job"))
                     job = state.job_by_id(ns, job_id)
                     if job is None:
                         raise HttpError(404, f"job {job_id!r} not found")
                     return to_wire(job)
                 if method == "DELETE":
+                    require(acl.allow_namespace_operation(ns, "submit-job"))
                     ev = server.job_deregister(ns, job_id)
                     return {"eval_id": ev.id if ev else ""}
                 if method == "PUT":  # register under this id
                     job = from_wire(body["job"] if "job" in body else body)
+                    require(acl.allow_namespace_operation(job.namespace,
+                                                          "submit-job"))
                     ev = server.job_register(job)
                     return {"eval_id": ev.id if ev else ""}
             if sub == "allocations":
+                require(acl.allow_namespace_operation(ns, "read-job"))
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(a) for a in snap.allocs_by_job(ns, job_id)]))
             if sub == "evaluations":
+                require(acl.allow_namespace_operation(ns, "read-job"))
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(e) for e in snap.evals_by_job(ns, job_id)]))
             if sub == "deployments":
+                require(acl.allow_namespace_operation(ns, "read-job"))
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(d) for d in snap.deployments()
                      if d.job_id == job_id and d.namespace == ns]))
             if sub == "summary":
+                require(acl.allow_namespace_operation(ns, "read-job"))
                 return self._job_summary(state, ns, job_id)
             if sub == "periodic" and len(parts) > 3 and parts[3] == "force":
+                require(acl.allow_namespace_operation(ns, "submit-job"))
                 ev = server.periodic.force(ns, job_id)
                 if ev is None:
                     raise HttpError(404, "not a periodic job or overlapped")
                 return {"eval_id": ev.id}
             if sub == "plan":
                 job = from_wire(body["job"] if "job" in body else body)
+                require(acl.allow_namespace_operation(job.namespace,
+                                                      "submit-job"))
                 return self._job_plan(server, job)
         # /v1/nodes
         if parts == ["nodes"]:
+            require(acl.allow_node_read())
             return blocking(lambda snap: (
                 snap.index_at, [to_wire(n) for n in snap.nodes()]))
         if parts and parts[0] == "node" and len(parts) >= 2:
             node_id = parts[1]
             sub = parts[2] if len(parts) > 2 else ""
             if not sub and method == "GET":
+                require(acl.allow_node_read())
                 node = state.node_by_id(node_id)
                 if node is None:
                     raise HttpError(404, f"node {node_id!r} not found")
                 return to_wire(node)
             if sub == "drain" and method == "PUT":
+                require(acl.allow_node_write())
                 drain = from_wire(body.get("drain_spec")) if body else None
                 evals = server.node_update_drain(node_id, drain)
                 return {"eval_ids": [e.id for e in evals]}
             if sub == "eligibility" and method == "PUT":
+                require(acl.allow_node_write())
                 server.node_update_eligibility(node_id,
                                                body.get("eligibility"))
                 return {}
             if sub == "allocations":
+                require(acl.allow_node_read())
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(a) for a in snap.allocs_by_node(node_id)]))
         # /v1/allocations, /v1/allocation/<id>
         if parts == ["allocations"]:
+            require(acl.allow_namespace_operation(ns_for_acl, "read-job"))
             return blocking(lambda snap: (
                 snap.index_at,
-                [to_wire(a) for a in snap._allocs.values()]))
+                [to_wire(a) for a in snap._allocs.values()
+                 if a.namespace == ns_for_acl]))
         if parts and parts[0] == "allocation" and len(parts) >= 2:
             a = state.alloc_by_id(parts[1])
             if a is None:
                 raise HttpError(404, "alloc not found")
+            require(acl.allow_namespace_operation(a.namespace, "read-job"))
             return to_wire(a)
         # /v1/evaluations, /v1/evaluation/<id>
         if parts == ["evaluations"]:
+            require(acl.allow_namespace_operation(ns_for_acl, "read-job"))
             return blocking(lambda snap: (
-                snap.index_at, [to_wire(e) for e in snap.evals()]))
+                snap.index_at, [to_wire(e) for e in snap.evals()
+                                if e.namespace == ns_for_acl]))
         if parts and parts[0] == "evaluation" and len(parts) >= 2:
             e = state.eval_by_id(parts[1])
             if e is None:
                 raise HttpError(404, "eval not found")
+            require(acl.allow_namespace_operation(e.namespace, "read-job"))
             if len(parts) > 2 and parts[2] == "allocations":
                 return [to_wire(a) for a
                         in state.allocs_by_job(e.namespace, e.job_id)
@@ -228,20 +276,34 @@ class HTTPApi:
             return to_wire(e)
         # /v1/deployments, /v1/deployment/...
         if parts == ["deployments"]:
+            require(acl.allow_namespace_operation(ns_for_acl, "read-job"))
             return blocking(lambda snap: (
-                snap.index_at, [to_wire(d) for d in snap.deployments()]))
+                snap.index_at, [to_wire(d) for d in snap.deployments()
+                                if d.namespace == ns_for_acl]))
         if parts and parts[0] == "deployment" and len(parts) >= 2:
             watcher = server.deployments_watcher
-            action_map = {"promote": watcher.promote, "fail": watcher.fail}
-            if parts[1] in action_map and len(parts) > 2:
-                ev = action_map[parts[1]](parts[2])
+            if parts[1] in ("promote", "fail", "pause"):
+                if len(parts) < 3:
+                    raise HttpError(404, "deployment id required")
+                target = state.deployment_by_id(parts[2])
+                if target is None:
+                    raise HttpError(404, "deployment not found")
+                # authorize against the DEPLOYMENT's namespace, never a
+                # caller-chosen query param
+                require(acl.allow_namespace_operation(target.namespace,
+                                                      "submit-job"))
+                if parts[1] == "pause":
+                    watcher.pause(target.id,
+                                  bool((body or {}).get("pause", True)))
+                    return {}
+                action = watcher.promote if parts[1] == "promote" \
+                    else watcher.fail
+                ev = action(target.id)
                 return {"eval_id": ev.id if ev else ""}
-            if parts[1] == "pause" and len(parts) > 2:
-                watcher.pause(parts[2], bool(body.get("pause", True)))
-                return {}
             d = state.deployment_by_id(parts[1])
             if d is None:
                 raise HttpError(404, "deployment not found")
+            require(acl.allow_namespace_operation(d.namespace, "read-job"))
             return to_wire(d)
         # /v1/status/*
         if parts == ["status", "leader"]:
@@ -257,22 +319,107 @@ class HTTPApi:
             return {}
         # /v1/agent/*
         if parts == ["agent", "members"]:
+            require(acl.allow_agent_read())
             cluster = getattr(self.agent, "cluster", None)
             peers = cluster.peers if cluster is not None else {}
             return {"members": [{"name": pid, "addr": list(addr)}
                                 for pid, addr in peers.items()]}
         # /v1/system/gc
         if parts == ["system", "gc"] and method == "PUT":
+            require(acl.allow_operator_write())
             server.run_gc("force-gc")
             return {}
         # /v1/operator/scheduler/configuration
         if parts == ["operator", "scheduler", "configuration"]:
             if method == "GET":
+                require(acl.allow_operator_read())
                 return to_wire(state.scheduler_config())
             if method == "PUT":
+                require(acl.allow_operator_write())
                 state.set_scheduler_config(from_wire(body))
                 return {"updated": True}
         raise HttpError(404, f"no handler for {method} {path}")
+
+    # ---- /v1/acl/* (acl_endpoint.go) ----
+
+    @staticmethod
+    def _acl_routes(server, method: str, parts: List[str], body: Any,
+                    acl) -> Any:
+        """Mutations go through the state-store write API (journaled /
+        replicated); ids are generated HERE so replay indexes identical
+        tokens. Client errors map to 400, not 500."""
+        import time as _time
+        import uuid as _uuid
+
+        from ..acl import ACLError, ACLPolicy, ACLToken, new_management_token
+        from ..jobspec.hcl import HclError
+
+        state = server.state
+        store = server.acl
+
+        def require_mgmt() -> None:
+            if not acl.management:
+                raise HttpError(403, "Permission denied")
+
+        try:
+            if parts == ["bootstrap"] and method == "PUT":
+                # one-shot, token-less (acl_endpoint.go:64)
+                if store.bootstrapped:
+                    raise HttpError(400, "ACL bootstrap already done")
+                token = new_management_token("Bootstrap Token")
+                state.acl_bootstrap(token)
+                return to_wire(token)
+            if parts == ["policies"] and method == "GET":
+                require_mgmt()
+                return [to_wire(p) for p in store.policies()]
+            if parts and parts[0] == "policy" and len(parts) == 2:
+                require_mgmt()
+                name = parts[1]
+                if method == "GET":
+                    p = store.policy(name)
+                    if p is None:
+                        raise HttpError(404, f"policy {name!r} not found")
+                    return to_wire(p)
+                if method == "PUT":
+                    state.upsert_acl_policy(ACLPolicy(
+                        name=name,
+                        description=(body or {}).get("description", ""),
+                        rules=(body or {}).get("rules", "")))
+                    return {}
+                if method == "DELETE":
+                    state.delete_acl_policy(name)
+                    return {}
+            if parts == ["tokens"] and method == "GET":
+                require_mgmt()
+                return [to_wire(t) for t in store.tokens()]
+            if parts == ["token"] and method == "PUT":
+                require_mgmt()
+                b = body or {}
+                token = from_wire(b) if b.get("__t") else ACLToken(
+                    name=b.get("name", ""),
+                    type=b.get("type", "client"),
+                    policies=list(b.get("policies", [])))
+                if not token.accessor_id:
+                    token.accessor_id = str(_uuid.uuid4())
+                if not token.secret_id:
+                    token.secret_id = str(_uuid.uuid4())
+                if not token.create_time:
+                    token.create_time = _time.time()
+                state.upsert_acl_token(token)
+                return to_wire(token)
+            if parts and parts[0] == "token" and len(parts) == 2:
+                require_mgmt()
+                if method == "GET":
+                    t = store.token_by_accessor(parts[1])
+                    if t is None:
+                        raise HttpError(404, "token not found")
+                    return to_wire(t)
+                if method == "DELETE":
+                    state.delete_acl_token(parts[1])
+                    return {}
+        except (ACLError, HclError) as e:
+            raise HttpError(400, str(e))
+        raise HttpError(404, f"no ACL handler for {method} {parts}")
 
     # ---- composed handlers ----
 

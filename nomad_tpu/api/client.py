@@ -18,10 +18,11 @@ class ApiError(Exception):
 
 class NomadClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 4646,
-                 timeout: float = 70.0) -> None:
+                 timeout: float = 70.0, token: Optional[str] = None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.token = token  # X-Nomad-Token (api.Client SetSecretID)
 
     # ---- transport ----
 
@@ -33,8 +34,11 @@ class NomadClient:
             qs = f"?{urlencode(params)}" if params else ""
             payload = json.dumps(to_json_tree(body)) \
                 if body is not None else None
+            headers = {"Content-Type": "application/json"}
+            if self.token:
+                headers["X-Nomad-Token"] = self.token
             conn.request(method, f"{path}{qs}", body=payload,
-                         headers={"Content-Type": "application/json"})
+                         headers=headers)
             res = conn.getresponse()
             data = from_json_tree(json.loads(res.read() or b"null"))
             if res.status >= 400:
@@ -195,3 +199,34 @@ class NomadClient:
 
     def status_leader(self):
         return self._request("GET", "/v1/status/leader")
+
+    # ---- ACLs (api/acl.go) ----
+
+    def acl_bootstrap(self):
+        return from_wire(self._request("PUT", "/v1/acl/bootstrap"))
+
+    def acl_policies(self) -> List[Any]:
+        return [from_wire(p) for p in self._request("GET",
+                                                    "/v1/acl/policies")]
+
+    def acl_upsert_policy(self, name: str, rules: str,
+                          description: str = "") -> None:
+        self._request("PUT", f"/v1/acl/policy/{name}",
+                      body={"rules": rules, "description": description})
+
+    def acl_delete_policy(self, name: str) -> None:
+        self._request("DELETE", f"/v1/acl/policy/{name}")
+
+    def acl_create_token(self, name: str = "", type: str = "client",
+                         policies: Optional[List[str]] = None):
+        return from_wire(self._request(
+            "PUT", "/v1/acl/token",
+            body={"name": name, "type": type,
+                  "policies": policies or []}))
+
+    def acl_tokens(self) -> List[Any]:
+        return [from_wire(t) for t in self._request("GET",
+                                                    "/v1/acl/tokens")]
+
+    def acl_delete_token(self, accessor_id: str) -> None:
+        self._request("DELETE", f"/v1/acl/token/{accessor_id}")

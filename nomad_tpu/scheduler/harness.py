@@ -250,6 +250,35 @@ class InMemState:
     def set_scheduler_config(self, config: SchedulerConfiguration) -> None:
         self._config = config
 
+    # ---- ACL tables (reference state_store.go ACL sections; the token
+    # store rides inside the state so WAL/Raft replicate it like any
+    # other table — restart and peers keep issued tokens valid) ----
+
+    @property
+    def acl(self):
+        store = getattr(self, "_acl_store", None)
+        if store is None:
+            from ..acl import TokenStore
+
+            store = self._acl_store = TokenStore()
+        return store
+
+    def upsert_acl_policy(self, policy) -> None:
+        self.acl.upsert_policy(policy)
+
+    def delete_acl_policy(self, name: str) -> None:
+        self.acl.delete_policy(name)
+
+    def upsert_acl_token(self, token) -> None:
+        # callers pre-fill accessor/secret ids so replay is deterministic
+        self.acl.upsert_token(token)
+
+    def delete_acl_token(self, accessor_id: str) -> None:
+        self.acl.delete_token(accessor_id)
+
+    def acl_bootstrap(self, token) -> None:
+        self.acl.bootstrap(token)
+
 
 class Harness:
     """Reference Harness (scheduler/testing.go:43): captures submitted plans

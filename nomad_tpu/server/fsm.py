@@ -24,6 +24,8 @@ ALLOWED_OPS = frozenset({
     "upsert_alloc", "delete_alloc", "update_alloc_from_client",
     "upsert_deployment", "delete_deployment",
     "upsert_plan_results", "mark_job_stable", "set_scheduler_config",
+    "upsert_acl_policy", "delete_acl_policy",
+    "upsert_acl_token", "delete_acl_token", "acl_bootstrap",
 })
 
 
@@ -58,6 +60,11 @@ def snapshot_state(state) -> Dict[str, Any]:
         "evals": [to_wire(e) for e in state.evals()],
         "deployments": [to_wire(d) for d in state.deployments()],
         "scheduler_config": to_wire(state.scheduler_config()),
+        "acl": {
+            "bootstrapped": state.acl.bootstrapped,
+            "policies": [to_wire(p) for p in state.acl.policies()],
+            "tokens": [to_wire(t) for t in state.acl.tokens()],
+        },
     }
 
 
@@ -89,4 +96,11 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
     cfg = snap.get("scheduler_config")
     if cfg is not None:
         state.set_scheduler_config(from_wire(cfg))
+    acl = snap.get("acl")
+    if acl is not None:
+        for tree in acl.get("policies", []):
+            state.upsert_acl_policy(from_wire(tree))
+        for tree in acl.get("tokens", []):
+            state.upsert_acl_token(from_wire(tree))
+        state.acl.bootstrapped = bool(acl.get("bootstrapped"))
     state.index.value = snap["index"]

@@ -45,7 +45,8 @@ class ServerConfig:
     def __init__(self, num_schedulers: int = 1, heartbeat_ttl: float = 10.0,
                  nack_timeout: float = 60.0, gc_interval: float = 60.0,
                  gc=None, data_dir: Optional[str] = None,
-                 fsync: bool = False, snapshot_threshold: int = 8192):
+                 fsync: bool = False, snapshot_threshold: int = 8192,
+                 acl_enabled: bool = False):
         self.num_schedulers = num_schedulers
         self.heartbeat_ttl = heartbeat_ttl
         self.nack_timeout = nack_timeout
@@ -54,6 +55,7 @@ class ServerConfig:
         self.data_dir = data_dir  # None → in-memory only (dev agent mode)
         self.fsync = fsync
         self.snapshot_threshold = snapshot_threshold
+        self.acl_enabled = acl_enabled
 
 
 class Server:
@@ -96,6 +98,21 @@ class Server:
         self._gc_thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
         self._running = False
+
+    @property
+    def acl(self):
+        # the token store lives in the state store: WAL-journaled,
+        # snapshot-included, Raft-replicated like every other table
+        return self.state.acl
+
+    def resolve_token(self, secret: Optional[str]):
+        """secret → compiled ACL (reference Server.ResolveToken,
+        nomad/acl.go:38). With ACLs disabled everything is permitted."""
+        from ..acl import management_acl
+
+        if not self.config.acl_enabled:
+            return management_acl()
+        return self.acl.resolve(secret)
 
     # ---- lifecycle (leader.go:222 establishLeadership) ----
 

@@ -46,6 +46,7 @@ class StateSnapshot(InMemState):
         self._deployments = dict(store._deployments)
         self._evals = dict(store._evals)
         self._config = store._config
+        self._acl_store = store.acl  # shared: snapshots read live tokens
         self.index = store.index
         self.cluster = store.cluster
         self.index_at = store.index.value

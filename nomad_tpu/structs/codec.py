@@ -38,9 +38,13 @@ def _build_registry() -> None:
                     )
                 _REGISTRY[obj.__name__] = obj
     # Wire-visible dataclasses living outside nomad_tpu.structs
+    from nomad_tpu.acl.policy import HostVolumeRule, NamespaceRule, Policy
+    from nomad_tpu.acl.tokens import ACLPolicy, ACLToken
     from nomad_tpu.scheduler.util import SchedulerConfiguration
 
-    _REGISTRY[SchedulerConfiguration.__name__] = SchedulerConfiguration
+    for cls in (SchedulerConfiguration, ACLPolicy, ACLToken, Policy,
+                NamespaceRule, HostVolumeRule):
+        _REGISTRY[cls.__name__] = cls
 
 
 def registry() -> Dict[str, Type]:

@@ -1,0 +1,270 @@
+"""ACL engine tests (reference models: acl/policy_test.go, acl/acl_test.go,
+nomad/acl_endpoint_test.go, HTTP enforcement via a test agent)."""
+import time
+
+import pytest
+
+from nomad_tpu.acl import (ACL, ACLError, ACLPolicy, ACLToken, Policy,
+                           TokenStore, management_acl, parse_policy)
+from nomad_tpu.jobspec.hcl import HclError
+
+
+class TestPolicyParse:
+    def test_namespace_coarse_expansion(self):
+        p = parse_policy('namespace "default" { policy = "read" }')
+        rule = p.namespaces[0]
+        assert rule.name == "default"
+        assert "read-job" in rule.capabilities
+        assert "list-jobs" in rule.capabilities
+        assert "submit-job" not in rule.capabilities
+
+    def test_write_includes_read(self):
+        p = parse_policy('namespace "apps" { policy = "write" }')
+        caps = p.namespaces[0].capabilities
+        assert {"read-job", "submit-job", "dispatch-job"} <= set(caps)
+
+    def test_fine_grained_capabilities(self):
+        p = parse_policy(
+            'namespace "x" { capabilities = ["submit-job", "read-job"] }')
+        assert set(p.namespaces[0].capabilities) == {"submit-job",
+                                                     "read-job"}
+
+    def test_coarse_scopes(self):
+        p = parse_policy(
+            'node { policy = "read" }\n'
+            'agent { policy = "write" }\n'
+            'operator { policy = "read" }\n'
+            'quota { policy = "deny" }')
+        assert (p.node, p.agent, p.operator, p.quota) == (
+            "read", "write", "read", "deny")
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(HclError):
+            parse_policy('namespace "x" { policy = "banana" }')
+        with pytest.raises(HclError):
+            parse_policy('namespace "x" { capabilities = ["fly"] }')
+        with pytest.raises(HclError):
+            parse_policy('node { policy = "scale" }')
+
+
+class TestAclEvaluation:
+    def _acl(self, *sources):
+        return ACL.from_policies([parse_policy(s) for s in sources])
+
+    def test_namespace_operation(self):
+        acl = self._acl('namespace "default" { policy = "read" }')
+        assert acl.allow_namespace_operation("default", "read-job")
+        assert not acl.allow_namespace_operation("default", "submit-job")
+        assert not acl.allow_namespace_operation("other", "read-job")
+
+    def test_glob_longest_match_wins(self):
+        acl = self._acl(
+            'namespace "*" { policy = "read" }\n'
+            'namespace "prod-*" { policy = "deny" }')
+        assert acl.allow_namespace_operation("dev", "read-job")
+        assert not acl.allow_namespace_operation("prod-api", "read-job")
+
+    def test_merge_is_union_but_deny_wins(self):
+        acl = self._acl(
+            'namespace "ns" { capabilities = ["read-job"] }',
+            'namespace "ns" { capabilities = ["submit-job"] }')
+        assert acl.allow_namespace_operation("ns", "read-job")
+        assert acl.allow_namespace_operation("ns", "submit-job")
+        acl2 = self._acl(
+            'namespace "ns" { policy = "write" }',
+            'namespace "ns" { policy = "deny" }')
+        assert not acl2.allow_namespace_operation("ns", "read-job")
+
+    def test_node_agent_operator(self):
+        acl = self._acl('node { policy = "write" }\n'
+                        'operator { policy = "read" }')
+        assert acl.allow_node_read() and acl.allow_node_write()
+        assert acl.allow_operator_read()
+        assert not acl.allow_operator_write()
+        assert not acl.allow_agent_read()
+
+    def test_host_volume_glob(self):
+        acl = self._acl('host_volume "prod-*" { policy = "write" }\n'
+                        'host_volume "*" { policy = "read" }')
+        assert acl.allow_host_volume_operation("prod-db", write=True)
+        assert acl.allow_host_volume_operation("scratch", write=False)
+        assert not acl.allow_host_volume_operation("scratch", write=True)
+
+    def test_management_allows_all(self):
+        m = management_acl()
+        assert m.allow_namespace_operation("any", "submit-job")
+        assert m.allow_operator_write()
+
+
+class TestTokenStore:
+    def test_bootstrap_once(self):
+        ts = TokenStore()
+        tok = ts.bootstrap()
+        assert tok.type == "management"
+        with pytest.raises(ACLError):
+            ts.bootstrap()
+        assert ts.resolve(tok.secret_id).management
+
+    def test_client_token_resolution(self):
+        ts = TokenStore()
+        ts.upsert_policy(ACLPolicy(
+            name="readonly",
+            rules='namespace "default" { policy = "read" }'))
+        tok = ts.upsert_token(ACLToken(name="dev", policies=["readonly"]))
+        acl = ts.resolve(tok.secret_id)
+        assert acl.allow_namespace_operation("default", "read-job")
+        assert not acl.allow_namespace_operation("default", "submit-job")
+
+    def test_unknown_token_rejected(self):
+        ts = TokenStore()
+        with pytest.raises(ACLError):
+            ts.resolve("not-a-secret")
+
+    def test_anonymous_has_no_grants(self):
+        ts = TokenStore()
+        acl = ts.resolve(None)
+        assert not acl.allow_namespace_operation("default", "read-job")
+
+    def test_policy_update_invalidates_cache(self):
+        ts = TokenStore()
+        ts.upsert_policy(ACLPolicy(
+            name="p", rules='namespace "default" { policy = "read" }'))
+        tok = ts.upsert_token(ACLToken(policies=["p"]))
+        assert ts.resolve(tok.secret_id).allow_namespace_operation(
+            "default", "read-job")
+        ts.upsert_policy(ACLPolicy(
+            name="p", rules='namespace "default" { policy = "deny" }'))
+        assert not ts.resolve(tok.secret_id).allow_namespace_operation(
+            "default", "read-job")
+
+    def test_bad_policy_rules_rejected(self):
+        ts = TokenStore()
+        with pytest.raises(HclError):
+            ts.upsert_policy(ACLPolicy(name="bad", rules="not { hcl"))
+
+
+class TestHttpEnforcement:
+    @pytest.fixture()
+    def secure_agent(self, tmp_path):
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import NomadClient
+
+        a = Agent(AgentConfig(client=False, acl_enabled=True,
+                              heartbeat_ttl=60.0))
+        a.start()
+        host, port = a.http_addr
+        yield a, host, port
+        a.shutdown()
+
+    def test_full_acl_flow_over_http(self, secure_agent):
+        from nomad_tpu import mock
+        from nomad_tpu.api import ApiError, NomadClient
+
+        a, host, port = secure_agent
+        anon = NomadClient(host, port)
+        # anonymous is locked out
+        with pytest.raises(ApiError) as ei:
+            anon.jobs()
+        assert ei.value.code == 403
+        # bootstrap management token (token-less one-shot)
+        boot = anon.acl_bootstrap()
+        mgmt = NomadClient(host, port, token=boot.secret_id)
+        assert mgmt.jobs() == []
+        # second bootstrap rejected
+        with pytest.raises(ApiError):
+            anon.acl_bootstrap()
+        # create read-only policy + client token
+        mgmt.acl_upsert_policy(
+            "readonly", 'namespace "default" { policy = "read" }\n'
+                        'node { policy = "read" }')
+        tok = mgmt.acl_create_token(name="ro", policies=["readonly"])
+        ro = NomadClient(host, port, token=tok.secret_id)
+        assert ro.jobs() == []
+        assert ro.nodes() == []
+        job = mock.job()
+        with pytest.raises(ApiError) as ei:
+            ro.register_job(job)
+        assert ei.value.code == 403
+        with pytest.raises(ApiError):
+            ro.system_gc()
+        # management can register
+        mgmt.register_job(job)
+        assert len(ro.jobs()) == 1
+        # bad token is an error
+        bad = NomadClient(host, port, token="bogus")
+        with pytest.raises(ApiError) as ei:
+            bad.jobs()
+        assert ei.value.code == 403
+        # token deletion revokes access
+        mgmt.acl_delete_token(tok.accessor_id)
+        with pytest.raises(ApiError):
+            NomadClient(host, port, token=tok.secret_id).jobs()
+
+    def test_acl_state_survives_restart(self, tmp_path):
+        """Tokens/policies ride the WAL like any other table: a restarted
+        server still honors issued tokens and refuses re-bootstrap."""
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import ApiError, NomadClient
+
+        data = str(tmp_path / "srv")
+        a1 = Agent(AgentConfig(client=False, acl_enabled=True,
+                               data_dir=data, heartbeat_ttl=60.0))
+        a1.start()
+        try:
+            anon = NomadClient(*a1.http_addr)
+            boot = anon.acl_bootstrap()
+            mgmt = NomadClient(a1.http_addr[0], a1.http_addr[1],
+                               token=boot.secret_id)
+            mgmt.acl_upsert_policy(
+                "ro", 'namespace "default" { policy = "read" }')
+            tok = mgmt.acl_create_token(name="t", policies=["ro"])
+        finally:
+            a1.shutdown()
+
+        a2 = Agent(AgentConfig(client=False, acl_enabled=True,
+                               data_dir=data, heartbeat_ttl=60.0))
+        a2.start()
+        try:
+            host, port = a2.http_addr
+            # both tokens still resolve
+            assert NomadClient(host, port,
+                               token=boot.secret_id).jobs() == []
+            assert NomadClient(host, port,
+                               token=tok.secret_id).jobs() == []
+            # re-bootstrap still refused
+            with pytest.raises(ApiError):
+                NomadClient(host, port).acl_bootstrap()
+        finally:
+            a2.shutdown()
+
+    def test_deployment_action_uses_target_namespace(self, secure_agent):
+        """promote/fail authorize against the DEPLOYMENT's namespace, not
+        a caller-supplied ?namespace= param."""
+        from nomad_tpu.api import ApiError, NomadClient
+        from nomad_tpu.structs.deployment import Deployment
+
+        a, host, port = secure_agent
+        boot = NomadClient(host, port).acl_bootstrap()
+        mgmt = NomadClient(host, port, token=boot.secret_id)
+        mgmt.acl_upsert_policy(
+            "dev-write", 'namespace "dev" { policy = "write" }')
+        tok = mgmt.acl_create_token(name="dev", policies=["dev-write"])
+        dev = NomadClient(host, port, token=tok.secret_id)
+        d = Deployment(id="dep-prod", namespace="prod", job_id="payments")
+        a.server.state.upsert_deployment(d)
+        with pytest.raises(ApiError) as ei:
+            dev._request("PUT", "/v1/deployment/fail/dep-prod",
+                         params={"namespace": "dev"})
+        assert ei.value.code == 403
+
+    def test_acls_disabled_is_open(self, tmp_path):
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import NomadClient
+
+        a = Agent(AgentConfig(client=False, heartbeat_ttl=60.0))
+        a.start()
+        try:
+            api = NomadClient(*a.http_addr)
+            assert api.jobs() == []  # no token, no enforcement
+        finally:
+            a.shutdown()
