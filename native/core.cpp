@@ -1,0 +1,105 @@
+// nomad_tpu native core — host-side hot-path primitives.
+//
+// Behavioral reference: the reference's performance-critical inner loops
+// that sit OUTSIDE the device kernels — port bitmap search
+// (nomad/structs/network.go:487 getDynamicPortsPrecise over
+// structs.Bitmap, bitmap.go:6), the AllocsFit superset check
+// (nomad/structs/funcs.go:103) as run per-node by the plan applier
+// (plan_apply.go:629), and the bin-pack score (funcs.go:175). The
+// reference runs these in Go; this build runs them in C++ behind a C ABI
+// consumed via ctypes (zero-copy over numpy buffers), per the TPU-build
+// design: JAX/XLA owns the device compute, C++ owns the host runtime
+// loops.
+//
+// Contract notes:
+// - `used` port arrays are byte masks (numpy bool_), length 65536.
+// - resource matrices are row-major float32 [N, R].
+// - every function is thread-compatible: callers own synchronization of
+//   the underlying buffers (the Python side calls under its store lock).
+
+#include <cstdint>
+#include <cmath>
+#include <cstring>
+
+extern "C" {
+
+// First-fit `count` free ports in [min_port, max_port), skipping
+// `reserved` values. Returns number written to `out` (== count on
+// success; fewer → failure, caller treats as exhaustion).
+int nomad_first_fit_ports(const uint8_t* used, int min_port, int max_port,
+                          const int32_t* reserved, int n_reserved,
+                          int count, int32_t* out) {
+    if (count <= 0) return 0;
+    int found = 0;
+    for (int p = min_port; p < max_port && found < count; ++p) {
+        if (used[p]) continue;
+        bool skip = false;
+        for (int r = 0; r < n_reserved; ++r) {
+            if (reserved[r] == p) { skip = true; break; }
+        }
+        if (skip) continue;
+        out[found++] = p;
+    }
+    return found;
+}
+
+// Per-row superset check: capacity[row] - used[row] >= ask (all R dims).
+// out_mask[i] = 1 when ask fits on rows[i].
+void nomad_fits_batch(const float* capacity, const float* used, int R,
+                      const float* ask, const int32_t* rows, int n_rows,
+                      uint8_t* out_mask) {
+    for (int i = 0; i < n_rows; ++i) {
+        const float* cap = capacity + (size_t)rows[i] * R;
+        const float* use = used + (size_t)rows[i] * R;
+        uint8_t ok = 1;
+        for (int r = 0; r < R; ++r) {
+            if (use[r] + ask[r] > cap[r]) { ok = 0; break; }
+        }
+        out_mask[i] = ok;
+    }
+}
+
+// Batch scatter-add of usage rows into the used matrix:
+//   used[rows[i]] += sign * usage[i]   (the plan-commit fan-in)
+void nomad_scatter_add(float* used, int R, const int32_t* rows,
+                       const float* usage, int n, float sign) {
+    for (int i = 0; i < n; ++i) {
+        float* dst = used + (size_t)rows[i] * R;
+        const float* src = usage + (size_t)i * R;
+        for (int r = 0; r < R; ++r) dst[r] += sign * src[r];
+    }
+}
+
+// Google BestFit-v3 bin-pack score (funcs.go:175 ScoreFitBinPack):
+//   score = 20 - 10^free_cpu - 10^free_mem, clamped to [0, 18]
+// (normalization by 18 happens at the rank layer, rank.go:11-13).
+// capacity rows are node resources MINUS reserved (the same contract as
+// tensor/cluster.py); cpu is dim 0, mem dim 1. Zero-capacity rows → 0.
+void nomad_score_binpack(const float* capacity, const float* used, int R,
+                         const float* ask, const int32_t* rows, int n_rows,
+                         float* out) {
+    for (int i = 0; i < n_rows; ++i) {
+        const float* cap = capacity + (size_t)rows[i] * R;
+        const float* use = used + (size_t)rows[i] * R;
+        float total_cpu = cap[0], total_mem = cap[1];
+        if (total_cpu <= 0.f || total_mem <= 0.f) { out[i] = 0.f; continue; }
+        float free_cpu = (total_cpu - use[0] - ask[0]) / total_cpu;
+        float free_mem = (total_mem - use[1] - ask[1]) / total_mem;
+        float score = 20.f - std::pow(10.f, free_cpu)
+                           - std::pow(10.f, free_mem);
+        if (score > 18.f) score = 18.f;
+        if (score < 0.f) score = 0.f;
+        out[i] = score;
+    }
+}
+
+// Count free ports in a range (introspection / metrics).
+int nomad_count_free_ports(const uint8_t* used, int min_port, int max_port) {
+    int n = 0;
+    for (int p = min_port; p < max_port; ++p) n += used[p] ? 0 : 1;
+    return n;
+}
+
+int nomad_core_abi_version() { return 1; }
+
+}  // extern "C"

@@ -159,6 +159,8 @@ def _blocks(v) -> List[dict]:
 
 
 def _labeled(blk: dict) -> bool:
-    return (len(blk) == 1
-            and isinstance(next(iter(blk.values())), dict)
-            and "policy" not in blk and "capabilities" not in blk)
+    # A labeled block decodes as {label: {body...}} — structurally: one
+    # key whose value is a dict. Rule bodies never have dict-valued keys
+    # (policy is a string, capabilities a list), so this is unambiguous
+    # even for a namespace literally named "policy".
+    return len(blk) == 1 and isinstance(next(iter(blk.values())), dict)

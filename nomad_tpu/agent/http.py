@@ -128,6 +128,17 @@ class HTTPApi:
             if not ok:
                 raise HttpError(403, "Permission denied")
 
+        def ns_visible(item_ns: str, cap: str) -> bool:
+            """List filter: ?namespace=* spans every namespace the token
+            can read (reference wildcard-namespace lists)."""
+            if ns_for_acl == "*":
+                return acl.allow_namespace_operation(item_ns, cap)
+            return item_ns == ns_for_acl
+
+        def require_ns(cap: str) -> None:
+            if ns_for_acl != "*":
+                require(acl.allow_namespace_operation(ns_for_acl, cap))
+
         # /v1/acl/* management surface (acl_endpoint.go)
         if parts0[1:2] == ["acl"]:
             return self._acl_routes(server, method, parts0[2:], body, acl)
@@ -150,13 +161,12 @@ class HTTPApi:
         # /v1/jobs
         if parts == ["jobs"]:
             if method == "GET":
-                require(acl.allow_namespace_operation(ns_for_acl,
-                                                      "list-jobs"))
+                require_ns("list-jobs")
                 prefix = query.get("prefix", "")
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(j) for j in snap.jobs()
-                     if j.namespace == ns_for_acl
+                     if ns_visible(j.namespace, "list-jobs")
                      and j.id.startswith(prefix)]))
             if method == "PUT":
                 job = from_wire(body["job"] if "job" in body else body)
@@ -247,28 +257,32 @@ class HTTPApi:
                     [to_wire(a) for a in snap.allocs_by_node(node_id)]))
         # /v1/allocations, /v1/allocation/<id>
         if parts == ["allocations"]:
-            require(acl.allow_namespace_operation(ns_for_acl, "read-job"))
+            require_ns("read-job")
             return blocking(lambda snap: (
                 snap.index_at,
                 [to_wire(a) for a in snap._allocs.values()
-                 if a.namespace == ns_for_acl]))
+                 if ns_visible(a.namespace, "read-job")]))
         if parts and parts[0] == "allocation" and len(parts) >= 2:
+            require_ns("read-job")
             a = state.alloc_by_id(parts[1])
-            if a is None:
+            if a is None or not acl.allow_namespace_operation(a.namespace,
+                                                              "read-job"):
+                # a denied id reads exactly like a missing one — no
+                # cross-namespace existence oracle
                 raise HttpError(404, "alloc not found")
-            require(acl.allow_namespace_operation(a.namespace, "read-job"))
             return to_wire(a)
         # /v1/evaluations, /v1/evaluation/<id>
         if parts == ["evaluations"]:
-            require(acl.allow_namespace_operation(ns_for_acl, "read-job"))
+            require_ns("read-job")
             return blocking(lambda snap: (
                 snap.index_at, [to_wire(e) for e in snap.evals()
-                                if e.namespace == ns_for_acl]))
+                                if ns_visible(e.namespace, "read-job")]))
         if parts and parts[0] == "evaluation" and len(parts) >= 2:
+            require_ns("read-job")
             e = state.eval_by_id(parts[1])
-            if e is None:
+            if e is None or not acl.allow_namespace_operation(e.namespace,
+                                                              "read-job"):
                 raise HttpError(404, "eval not found")
-            require(acl.allow_namespace_operation(e.namespace, "read-job"))
             if len(parts) > 2 and parts[2] == "allocations":
                 return [to_wire(a) for a
                         in state.allocs_by_job(e.namespace, e.job_id)
@@ -276,22 +290,22 @@ class HTTPApi:
             return to_wire(e)
         # /v1/deployments, /v1/deployment/...
         if parts == ["deployments"]:
-            require(acl.allow_namespace_operation(ns_for_acl, "read-job"))
+            require_ns("read-job")
             return blocking(lambda snap: (
                 snap.index_at, [to_wire(d) for d in snap.deployments()
-                                if d.namespace == ns_for_acl]))
+                                if ns_visible(d.namespace, "read-job")]))
         if parts and parts[0] == "deployment" and len(parts) >= 2:
             watcher = server.deployments_watcher
             if parts[1] in ("promote", "fail", "pause"):
                 if len(parts) < 3:
                     raise HttpError(404, "deployment id required")
+                require_ns("submit-job")
                 target = state.deployment_by_id(parts[2])
-                if target is None:
-                    raise HttpError(404, "deployment not found")
                 # authorize against the DEPLOYMENT's namespace, never a
-                # caller-chosen query param
-                require(acl.allow_namespace_operation(target.namespace,
-                                                      "submit-job"))
+                # caller-chosen query param; a denied id reads as missing
+                if target is None or not acl.allow_namespace_operation(
+                        target.namespace, "submit-job"):
+                    raise HttpError(404, "deployment not found")
                 if parts[1] == "pause":
                     watcher.pause(target.id,
                                   bool((body or {}).get("pause", True)))
@@ -300,10 +314,11 @@ class HTTPApi:
                     else watcher.fail
                 ev = action(target.id)
                 return {"eval_id": ev.id if ev else ""}
+            require_ns("read-job")
             d = state.deployment_by_id(parts[1])
-            if d is None:
+            if d is None or not acl.allow_namespace_operation(d.namespace,
+                                                              "read-job"):
                 raise HttpError(404, "deployment not found")
-            require(acl.allow_namespace_operation(d.namespace, "read-job"))
             return to_wire(d)
         # /v1/status/*
         if parts == ["status", "leader"]:

@@ -1,0 +1,181 @@
+"""ctypes bindings for the C++ host-runtime core (`native/core.cpp`).
+
+Builds `libnomad_core.so` with g++ on first use (cached by source mtime)
+and exposes zero-copy wrappers over numpy buffers. Every entry point has
+a pure-Python fallback so the framework runs where no compiler exists;
+`available()` reports which path is active.
+
+Consumers: `structs/network.py` (dynamic-port first-fit) and any host
+loop needing batch fit/score/scatter primitives.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "core.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libnomad_core.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+             "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("NOMAD_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SRC):
+            return None
+        stale = (not os.path.exists(_LIB)
+                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC))
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        lib.nomad_first_fit_ports.restype = ctypes.c_int
+        lib.nomad_count_free_ports.restype = ctypes.c_int
+        lib.nomad_core_abi_version.restype = ctypes.c_int
+        if lib.nomad_core_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---- first-fit dynamic ports ----
+
+def first_fit_ports(used: np.ndarray, min_port: int, max_port: int,
+                    reserved: Sequence[int], count: int) -> List[int]:
+    """First `count` free ports in [min_port, max_port) excluding
+    `reserved`. Returns [] when exhausted. `used` is bool[65536]."""
+    if count <= 0:
+        return []
+    lib = _load()
+    if lib is None:
+        return _first_fit_py(used, min_port, max_port, reserved, count)
+    used = np.ascontiguousarray(used, dtype=np.bool_)
+    res = np.asarray(list(reserved), dtype=np.int32)
+    out = np.empty(count, dtype=np.int32)
+    n = lib.nomad_first_fit_ports(
+        _ptr(used, ctypes.c_uint8), min_port, max_port,
+        _ptr(res, ctypes.c_int32), len(res), count,
+        _ptr(out, ctypes.c_int32))
+    if n < count:
+        return []
+    return [int(p) for p in out]
+
+
+def _first_fit_py(used, min_port, max_port, reserved, count) -> List[int]:
+    mask = used[min_port:max_port].copy()
+    for r in reserved:
+        if min_port <= r < max_port:
+            mask[r - min_port] = True
+    free = np.flatnonzero(~mask)
+    if len(free) < count:
+        return []
+    return [int(p) + min_port for p in free[:count]]
+
+
+# ---- batch fit / score / scatter ----
+
+def fits_batch(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
+               rows: np.ndarray) -> np.ndarray:
+    """bool[n]: ask fits on capacity[rows]-used[rows] in every dimension."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lib = _load()
+    if lib is None:
+        free = capacity[rows] - used[rows]
+        return np.all(free >= ask[None, :], axis=1)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    used = np.ascontiguousarray(used, dtype=np.float32)
+    ask = np.ascontiguousarray(ask, dtype=np.float32)
+    out = np.empty(len(rows), dtype=np.uint8)
+    lib.nomad_fits_batch(
+        _ptr(capacity, ctypes.c_float), _ptr(used, ctypes.c_float),
+        capacity.shape[1], _ptr(ask, ctypes.c_float),
+        _ptr(rows, ctypes.c_int32), len(rows), _ptr(out, ctypes.c_uint8))
+    return out.astype(bool)
+
+
+def scatter_add(used: np.ndarray, rows: np.ndarray, usage: np.ndarray,
+                sign: float = 1.0) -> None:
+    """used[rows[i]] += sign * usage[i], in place."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lib = _load()
+    if (lib is None or not used.flags.c_contiguous
+            or used.dtype != np.float32):
+        np.add.at(used, rows, sign * usage)
+        return
+    usage = np.ascontiguousarray(usage, dtype=np.float32)
+    lib.nomad_scatter_add(
+        _ptr(used, ctypes.c_float), used.shape[1],
+        _ptr(rows, ctypes.c_int32), _ptr(usage, ctypes.c_float),
+        len(rows), ctypes.c_float(sign))
+
+
+def score_binpack(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
+                  rows: np.ndarray) -> np.ndarray:
+    """BestFit-v3 scores in [0, 18] for ask on each row (funcs.go:175
+    ScoreFitBinPack, same clamping; capacity = resources − reserved)."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    lib = _load()
+    if lib is None:
+        cap = capacity[rows]
+        use = used[rows]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            free_cpu = (cap[:, 0] - use[:, 0] - ask[0]) / cap[:, 0]
+            free_mem = (cap[:, 1] - use[:, 1] - ask[1]) / cap[:, 1]
+            score = 20.0 - 10.0 ** free_cpu - 10.0 ** free_mem
+        score = np.clip(score, 0.0, 18.0)
+        score = np.where((cap[:, 0] > 0) & (cap[:, 1] > 0), score, 0.0)
+        return score.astype(np.float32)
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    used = np.ascontiguousarray(used, dtype=np.float32)
+    ask = np.ascontiguousarray(ask, dtype=np.float32)
+    out = np.empty(len(rows), dtype=np.float32)
+    lib.nomad_score_binpack(
+        _ptr(capacity, ctypes.c_float), _ptr(used, ctypes.c_float),
+        capacity.shape[1], _ptr(ask, ctypes.c_float),
+        _ptr(rows, ctypes.c_int32), len(rows), _ptr(out, ctypes.c_float))
+    return out
+
+
+def count_free_ports(used: np.ndarray, min_port: int, max_port: int) -> int:
+    lib = _load()
+    if lib is None:
+        return int(np.count_nonzero(~used[min_port:max_port]))
+    used = np.ascontiguousarray(used, dtype=np.bool_)
+    return lib.nomad_count_free_ports(_ptr(used, ctypes.c_uint8),
+                                      min_port, max_port)

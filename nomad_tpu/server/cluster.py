@@ -103,6 +103,12 @@ class RaftStateStore(StateStore):
                 # bootstrap (pre-raft attach) or nested call under an
                 # FSM apply: mutate directly
                 return getattr(StateStore, name)(self, *args)
+            from .fsm import validate_op
+
+            # reject before replication — a committed entry that raises in
+            # the FSM would be skipped on every peer, but should never be
+            # paid for (fsm.validate_op)
+            validate_op(self, name, args)
             self.raft.apply({"op": name, "args": _encode_args(name, args)})
             # The committed entry has been applied locally (apply blocks
             # until last_applied covers it); reads now see the write.
@@ -175,7 +181,7 @@ class ClusterServer:
             raft_dir = config.data_dir
         self.raft = RaftNode(
             config.node_id, self.peers, self.rpc, self.pool,
-            apply_fn=fsm.apply, data_dir=raft_dir,
+            apply_fn=fsm.apply_resilient, data_dir=raft_dir,
             on_leadership_change=self._on_leadership_change,
         )
         state.raft = self.raft

@@ -29,6 +29,30 @@ ALLOWED_OPS = frozenset({
 })
 
 
+def validate_op(state, op: str, args) -> None:
+    """Reject an op BEFORE it is journaled/replicated. Mutators that can
+    raise on bad input (the ACL ops validate policies/tokens) must fail
+    here, while nothing has been written — an entry that raises during
+    FSM apply would poison the log and break every replay/peer."""
+    if op == "upsert_acl_policy":
+        from ..acl.policy import parse_policy
+
+        parse_policy(args[0].rules)
+    elif op == "upsert_acl_token":
+        from ..acl.tokens import TOKEN_TYPE_CLIENT, TOKEN_TYPE_MANAGEMENT
+
+        t = args[0]
+        if t.type not in (TOKEN_TYPE_CLIENT, TOKEN_TYPE_MANAGEMENT):
+            raise ValueError(f"invalid token type {t.type!r}")
+        if t.type == TOKEN_TYPE_CLIENT and not t.policies:
+            raise ValueError("client token requires policies")
+    elif op == "acl_bootstrap":
+        if state.acl.bootstrapped:
+            from ..acl import ACLError
+
+            raise ACLError("ACL bootstrap already done")
+
+
 class FSM:
     """Applies decoded log entries to a StateStore (fsm.go Apply :180)."""
 
@@ -41,6 +65,16 @@ class FSM:
             raise ValueError(f"unknown FSM op {op!r}")
         args = [from_wire(a) for a in entry["args"]]
         getattr(self.state, op)(*args)
+
+    def apply_resilient(self, entry: Dict[str, Any]) -> None:
+        """Replay/replication path: a bad entry is logged and skipped —
+        identical (deterministic) on every replayer — never fatal."""
+        try:
+            self.apply(entry)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
 
 
 # ---- snapshot (fsm.go Snapshot :1242 / Restore :1256) ----

@@ -175,7 +175,7 @@ class DurableStateStore(StateStore):
                 restore_state(self, snap)
             fsm = FSM(self)
             for entry in entries:
-                fsm.apply(entry)
+                fsm.apply_resilient(entry)
         finally:
             self._restoring = False
         return len(entries)
@@ -199,6 +199,12 @@ class DurableStateStore(StateStore):
             with self._cv:
                 depth = getattr(self._local, "depth", 0)
                 if depth == 0 and not self._restoring:
+                    # Validate while holding the store lock, BEFORE the
+                    # journal append — an op that would raise during apply
+                    # must never reach the log (fsm.validate_op).
+                    from .fsm import validate_op
+
+                    validate_op(self, name, args)
                     # Write-AHEAD: journal before mutating so a failed append
                     # leaves memory and log consistent (the op is rejected,
                     # not half-recorded). Replay through the same mutators

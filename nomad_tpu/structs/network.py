@@ -207,17 +207,18 @@ class NetworkIndex:
     ) -> Tuple[List[int], str]:
         """First `count` free ports in the dynamic range (reference
         getDynamicPortsPrecise, network.go:487 — but first-fit instead of the
-        reference's random sample over the free set; deterministic by design)."""
+        reference's random sample over the free set; deterministic by design).
+        Runs in the C++ core when built (native/core.cpp
+        nomad_first_fit_ports); the Python fallback is bit-identical."""
         if count == 0:
             return [], ""
-        mask = used[MIN_DYNAMIC_PORT:MAX_DYNAMIC_PORT].copy()
-        for r in reserved:
-            if MIN_DYNAMIC_PORT <= r < MAX_DYNAMIC_PORT:
-                mask[r - MIN_DYNAMIC_PORT] = True
-        free = np.flatnonzero(~mask)
-        if len(free) < count:
+        from ..native import first_fit_ports
+
+        ports = first_fit_ports(used, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT,
+                                reserved, count)
+        if not ports:
             return [], "dynamic port selection failed"
-        return [int(p) + MIN_DYNAMIC_PORT for p in free[:count]], ""
+        return ports, ""
 
     @staticmethod
     def _dynamic_ports_stochastic(
