@@ -353,6 +353,66 @@ class HTTPApi:
                 require(acl.allow_operator_write())
                 state.set_scheduler_config(from_wire(body))
                 return {"updated": True}
+        # /v1/job/<id>/scale handled above via parts[2]; /v1/volumes,
+        # /v1/volume/csi/<id>, /v1/plugins, /v1/search, /v1/scaling/policies,
+        # /v1/event/stream below
+        if parts == ["volumes"]:
+            require_ns("csi-list-volume")
+            return blocking(lambda snap: (
+                snap.index_at,
+                [to_wire(v) for v in snap.csi_volumes()
+                 if ns_visible(v.namespace, "csi-list-volume")]))
+        if parts and parts[0] == "volume" and len(parts) >= 3 \
+                and parts[1] == "csi":
+            vol_id = parts[2]
+            if method == "GET":
+                require_ns("csi-read-volume")
+                vol = state.csi_volume(ns, vol_id)
+                if vol is None:
+                    raise HttpError(404, "volume not found")
+                return to_wire(vol)
+            if method == "PUT":
+                if len(parts) > 3 and parts[3] == "claim":
+                    require(acl.allow_namespace_operation(
+                        ns, "csi-mount-volume"))
+                    ok = server.csi_volume_claim(
+                        ns, vol_id, body["alloc_id"], body.get("mode",
+                                                               "write"))
+                    if not ok:
+                        raise HttpError(409, "claim rejected")
+                    return {}
+                require(acl.allow_namespace_operation(
+                    ns, "csi-write-volume"))
+                vol = from_wire(body)
+                server.csi_volume_register(vol)
+                return {}
+            if method == "DELETE":
+                require(acl.allow_namespace_operation(
+                    ns, "csi-write-volume"))
+                server.csi_volume_deregister(
+                    ns, vol_id, force=query.get("force") == "true")
+                return {}
+        if parts == ["plugins"]:
+            require(acl.allow_plugin_read() or acl.management)
+            return [to_wire(p) for p in state.csi_plugins()]
+        if parts == ["scaling", "policies"]:
+            require_ns("list-scaling-policies")
+            return [to_wire(p) for p in server.scaling_policies(
+                None if ns_for_acl == "*" else ns_for_acl)]
+        if parts == ["search"] and method == "PUT":
+            b = body or {}
+            # per-context results are namespace-scoped reads
+            require_ns("read-job")
+            return server.search(b.get("prefix", ""),
+                                 b.get("context", "all"), ns)
+        if parts == ["event", "stream"]:
+            topics = [t for t in query.get("topic", "").split(",") if t]
+            index = int(query.get("index", 0) or 0)
+            wait = min(float(query.get("wait", 0) or 0), 60.0)
+            idx, events = server.events.events_after(index, topics or None,
+                                                     timeout=wait)
+            return {"index": idx,
+                    "events": [to_wire(e) for e in events]}
         raise HttpError(404, f"no handler for {method} {path}")
 
     # ---- /v1/acl/* (acl_endpoint.go) ----

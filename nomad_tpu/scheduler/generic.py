@@ -57,6 +57,7 @@ from .util import (
     progress_made,
     proposed_allocs,
     ready_nodes_in_dcs,
+    resolve_volume_asks,
     retry_max,
     tainted_nodes,
     update_non_terminal_allocs_to_lost,
@@ -310,7 +311,9 @@ class GenericScheduler:
         for tg_name, entries in groups.items():
             tg = tg_by_name[tg_name]
             plan_ctx = self._plan_context_for(tg, entries)
-            result = self.stack.select(self.job, tg, len(entries), plan_ctx)
+            volumes = resolve_volume_asks(self.state, self.job.namespace, tg)
+            result = self.stack.select(self.job, tg, len(entries), plan_ctx,
+                                       volumes=volumes)
 
             for i, (p, prev, _dest) in enumerate(entries):
                 node_id = result.node_ids[i]

@@ -250,6 +250,64 @@ class InMemState:
     def set_scheduler_config(self, config: SchedulerConfiguration) -> None:
         self._config = config
 
+    # ---- CSI volumes (reference state/schema.go :687/:719, csi state
+    # methods in state_store.go) ----
+
+    @property
+    def _csi(self):
+        tbl = getattr(self, "_csi_volumes", None)
+        if tbl is None:
+            tbl = self._csi_volumes = {}
+        return tbl
+
+    def upsert_csi_volume(self, vol) -> None:
+        vol.modify_index = next(self.index)
+        if not vol.create_index:
+            vol.create_index = vol.modify_index
+        self._csi[(vol.namespace, vol.id)] = vol
+
+    def delete_csi_volume(self, namespace: str, vol_id: str) -> None:
+        self._csi.pop((namespace, vol_id), None)
+
+    def csi_volume(self, namespace: str, vol_id: str):
+        return self._csi.get((namespace, vol_id))
+
+    def csi_volumes(self) -> List[object]:
+        return list(self._csi.values())
+
+    def csi_volume_claim(self, namespace: str, vol_id: str, alloc_id: str,
+                         mode: str) -> bool:
+        vol = self._csi.get((namespace, vol_id))
+        if vol is None or not vol.claim(alloc_id, mode):
+            return False
+        vol.modify_index = next(self.index)
+        return True
+
+    def csi_volume_release(self, namespace: str, vol_id: str,
+                           alloc_id: str) -> None:
+        vol = self._csi.get((namespace, vol_id))
+        if vol is not None and vol.release(alloc_id):
+            vol.modify_index = next(self.index)
+
+    def csi_plugins(self) -> List[object]:
+        """Aggregate plugin health from node fingerprints (csi.go
+        CSIPlugin counts)."""
+        from ..structs.csi import CSIPlugin
+
+        plugins: Dict[str, CSIPlugin] = {}
+        for node in self._nodes.values():
+            for pid, info in (node.csi_node_plugins or {}).items():
+                p = plugins.setdefault(pid, CSIPlugin(id=pid))
+                p.nodes_expected += 1
+                if getattr(info, "healthy", True):
+                    p.nodes_healthy += 1
+            for pid, info in (node.csi_controller_plugins or {}).items():
+                p = plugins.setdefault(pid, CSIPlugin(id=pid))
+                p.controllers_expected += 1
+                if getattr(info, "healthy", True):
+                    p.controllers_healthy += 1
+        return list(plugins.values())
+
     # ---- ACL tables (reference state_store.go ACL sections; the token
     # store rides inside the state so WAL/Raft replicate it like any
     # other table — restart and peers keep issued tokens valid) ----

@@ -64,6 +64,26 @@ def meets_constraints(node: Node, constraints: Sequence[Constraint]) -> bool:
     return True
 
 
+def volumes_ok(node: Node, tg, csi_volumes: Optional[dict] = None) -> bool:
+    """HostVolumeChecker (feasible.go:117) + CSIVolumeChecker's per-node
+    half (feasible.go:194). `csi_volumes` maps volume id → CSIVolume."""
+    for req in (tg.volumes or {}).values():
+        if req.type == "host":
+            cfg = (node.host_volumes or {}).get(req.source)
+            if cfg is None:
+                return False
+            if cfg.read_only and not req.read_only:
+                return False
+        elif req.type == "csi":
+            vol = (csi_volumes or {}).get(req.source)
+            if vol is None or not vol.schedulable:
+                return False
+            info = (node.csi_node_plugins or {}).get(vol.plugin_id)
+            if info is None or not getattr(info, "healthy", True):
+                return False
+    return True
+
+
 def driver_ok(node: Node, driver: str) -> bool:
     """Reference DriverChecker (feasible.go:398,427): DriverInfo
     detected+healthy, legacy fallback to `driver.<name>` attr truthiness."""
@@ -115,6 +135,7 @@ def select_option(
     penalty_nodes: Optional[set] = None,
     algorithm: str = "binpack",
     sampled: Optional[int] = None,
+    csi_volumes: Optional[dict] = None,
 ) -> Optional[OracleOption]:
     """One Select(): returns the best-scoring feasible node or None.
 
@@ -153,6 +174,8 @@ def select_option(
         if not all(driver_ok(node, d) for d in drivers):
             continue
         if not meets_constraints(node, combined_constraints):
+            continue
+        if not volumes_ok(node, tg, csi_volumes):
             continue
 
         proposed = ctx.proposed_allocs(node.id)

@@ -92,8 +92,12 @@ class TPUStack:
         n_place: int,
         plan: Optional[PlanContext] = None,
         max_allocs: Optional[int] = None,
+        volumes: Optional[list] = None,
     ) -> Tuple[TGParams, int]:
-        """Build TGParams (numpy; converted on dispatch)."""
+        """Build TGParams (numpy; converted on dispatch). `volumes` are
+        pre-resolved feasibility entries from the scheduler (host/csi —
+        the scheduler resolves CSI volume ids against state because the
+        stack itself is stateless; see constraints.compile_constraints)."""
         plan = plan or PlanContext()
         cl = self.cluster
         n = cl.n_cap
@@ -105,7 +109,8 @@ class TPUStack:
         drivers = sorted({t.driver for t in tg.tasks})
 
         cc = compile_constraints(
-            combined, vocab, datacenters=job.datacenters, drivers=drivers
+            combined, vocab, datacenters=job.datacenters, drivers=drivers,
+            volumes=volumes,
         )
         affinities = list(job.affinities) + list(tg.affinities)
         for t in tg.tasks:
@@ -314,11 +319,12 @@ class TPUStack:
         tg: TaskGroup,
         n_place: int,
         plan: Optional[PlanContext] = None,
+        volumes: Optional[list] = None,
     ) -> SelectResult:
         """Place `n_place` allocs of one task group. One kernel dispatch."""
         from ..kernels.placement import place_task_group, place_task_group_jit
 
-        params, m = self.compile_tg(job, tg, n_place, plan)
+        params, m = self.compile_tg(job, tg, n_place, plan, volumes=volumes)
         arrays = self.device_arrays()
         if self._jit:
             result = place_task_group_jit(arrays, _to_device(params), m)

@@ -62,6 +62,28 @@ class SchedulerConfiguration:
     preemption_batch_enabled: bool = False
 
 
+def resolve_volume_asks(state, namespace: str, tg) -> list:
+    """Task-group volume requests → feasibility entries for the
+    constraint compiler (HostVolumeChecker feasible.go:117 +
+    CSIVolumeChecker feasible.go:194). CSI ids resolve against state
+    here because the stack/kernels are stateless; a missing or
+    unschedulable volume poisons feasibility (no node passes)."""
+    out = []
+    for req in (tg.volumes or {}).values():
+        if req.type == "host":
+            out.append(("host", req.source, req.read_only))
+        elif req.type == "csi":
+            vol = None
+            lookup = getattr(state, "csi_volume", None)
+            if lookup is not None:
+                vol = lookup(namespace, req.source)
+            if vol is None or not vol.schedulable:
+                out.append(("missing", req.source, req.read_only))
+            else:
+                out.append(("csi", vol.plugin_id, req.read_only))
+    return out
+
+
 def proposed_allocs(state: State, plan: Plan, node_id: str) -> List[Allocation]:
     """Plan-relative proposed allocations on a node (reference
     EvalContext.ProposedAllocs, scheduler/context.go:120): non-terminal state

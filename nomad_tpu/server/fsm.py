@@ -26,6 +26,8 @@ ALLOWED_OPS = frozenset({
     "upsert_plan_results", "mark_job_stable", "set_scheduler_config",
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token", "acl_bootstrap",
+    "upsert_csi_volume", "delete_csi_volume",
+    "csi_volume_claim", "csi_volume_release",
 })
 
 
@@ -94,6 +96,7 @@ def snapshot_state(state) -> Dict[str, Any]:
         "evals": [to_wire(e) for e in state.evals()],
         "deployments": [to_wire(d) for d in state.deployments()],
         "scheduler_config": to_wire(state.scheduler_config()),
+        "csi_volumes": [to_wire(v) for v in state.csi_volumes()],
         "acl": {
             "bootstrapped": state.acl.bootstrapped,
             "policies": [to_wire(p) for p in state.acl.policies()],
@@ -130,6 +133,8 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
     cfg = snap.get("scheduler_config")
     if cfg is not None:
         state.set_scheduler_config(from_wire(cfg))
+    for tree in snap.get("csi_volumes", []):
+        _upsert_preserving_indexes(state.upsert_csi_volume, from_wire(tree))
     acl = snap.get("acl")
     if acl is not None:
         for tree in acl.get("policies", []):

@@ -89,11 +89,15 @@ class Server:
         from ..lib import TimeTable
         from .deployments import DeploymentsWatcher
         from .drainer import NodeDrainer
+        from .events import EventBroker
         from .periodic import PeriodicDispatch
+        from .volumewatcher import VolumeWatcher
 
         self.deployments_watcher = DeploymentsWatcher(self)
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatch(self)
+        self.volume_watcher = VolumeWatcher(self)
+        self.events = EventBroker()
         self.timetable = TimeTable()
         self._gc_thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -128,6 +132,7 @@ class Server:
         self.deployments_watcher.start()
         self.drainer.start()
         self.periodic.start()
+        self.volume_watcher.start()
         self.timetable.witness(self.state.index.value)
         self._stop_event.clear()
         self._last_gc = time.time()  # first GC a full interval after start
@@ -162,6 +167,7 @@ class Server:
         self._stop_event.set()
         self.periodic.shutdown()
         self.drainer.shutdown()
+        self.volume_watcher.shutdown()
         self.deployments_watcher.shutdown()
         self.heartbeater.shutdown()
         for w in self.workers:
@@ -213,8 +219,17 @@ class Server:
 
     # ---- eval application (FSM upsertEvals analog, fsm.go:692) ----
 
+    def _publish(self, topic: str, type_: str, key: str,
+                 namespace: str = "") -> None:
+        from .events import Event
+
+        self.events.publish(Event(topic=topic, type=type_, key=key,
+                                  namespace=namespace,
+                                  index=self.state.index.value))
+
     def apply_eval_update(self, eval: Evaluation, reblock: bool = False) -> None:
         self.state.upsert_eval(eval)
+        self._publish("Eval", "EvalUpdated", eval.id, eval.namespace)
         if reblock or eval.should_block():
             self.blocked.block(eval)
             for dup in self.blocked.duplicates():
@@ -255,6 +270,7 @@ class Server:
             else:
                 job.version = existing.version + 1
         self.state.upsert_job(job)
+        self._publish("Job", "JobRegistered", job.id, job.namespace)
         if job.is_periodic() or job.is_parameterized():
             # Periodic/parameterized jobs produce no eval at register time:
             # the dispatcher (or Job.Dispatch) creates child jobs later
@@ -281,6 +297,7 @@ class Server:
         job = copy.copy(job)  # snapshots keep the pre-stop view
         job.stop = True
         self.state.upsert_job(job)
+        self._publish("Job", "JobDeregistered", job.id, job.namespace)
         if job.is_periodic():
             self.periodic.remove(namespace, job_id)
         return self._create_eval(
@@ -300,6 +317,7 @@ class Server:
             node.compute_class()
         was = self.state.node_by_id(node.id)
         self.state.upsert_node(node)
+        self._publish("Node", "NodeRegistered", node.id)
         self.heartbeater.reset(node.id)
         if node.status == NODE_STATUS_READY:
             # capacity may have appeared (node_endpoint.go:270)
@@ -330,6 +348,7 @@ class Server:
         node.status = status
         node.status_description = description
         self.state.upsert_node(node)
+        self._publish("Node", "NodeStatusChanged", node.id)
         evals = []
         if status == NODE_STATUS_DOWN:
             self.heartbeater.remove(node_id)
@@ -431,6 +450,8 @@ class Server:
             merged = self.state.update_alloc_from_client(up)
             if merged is None:
                 continue
+            self._publish("Alloc", "AllocUpdated", merged.id,
+                          merged.namespace)
             if merged.terminal_status():
                 node = self.state.node_by_id(merged.node_id)
                 if node is not None:
@@ -481,6 +502,101 @@ class Server:
         self.deployments_watcher.notify()
 
     # ---- test/ops helpers ----
+
+    # ---- CSI volume endpoints (nomad/csi_endpoint.go) ----
+
+    def csi_volume_register(self, vol) -> None:
+        if not vol.id or not vol.plugin_id:
+            raise ValueError("CSI volume requires id and plugin_id")
+        self.state.upsert_csi_volume(vol)
+
+    def csi_volume_deregister(self, namespace: str, vol_id: str,
+                              force: bool = False) -> None:
+        vol = self.state.csi_volume(namespace, vol_id)
+        if vol is None:
+            return
+        if vol.in_use() and not force:
+            raise ValueError(f"volume {vol_id!r} has active claims")
+        self.state.delete_csi_volume(namespace, vol_id)
+
+    def csi_volume_claim(self, namespace: str, vol_id: str, alloc_id: str,
+                         mode: str) -> bool:
+        """Client claims a volume for an alloc (CSIVolume.Claim RPC)."""
+        return self.state.csi_volume_claim(namespace, vol_id, alloc_id,
+                                           mode)
+
+    # ---- scaling (nomad/job_endpoint.go:969 Scale + scaling policies) ----
+
+    def job_scale(self, namespace: str, job_id: str, group: str,
+                  count: int, message: str = "") -> Optional[Evaluation]:
+        import copy
+
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(f"group {group!r} not found in {job_id!r}")
+        for sp in job.scaling_policies:
+            if sp.target.get("Group") == group and sp.enabled:
+                if not (sp.min <= count <= sp.max):
+                    raise ValueError(
+                        f"count {count} outside scaling policy bounds "
+                        f"[{sp.min}, {sp.max}]")
+        job = copy.deepcopy(job)
+        job.lookup_task_group(group).count = count
+        job.version += 1
+        self.state.upsert_job(job)
+        return self._create_eval(
+            namespace=namespace, priority=job.priority, type=job.type,
+            triggered_by="job-scaling", job_id=job_id,
+            job_modify_index=job.modify_index, status=EVAL_STATUS_PENDING,
+        )
+
+    def scaling_policies(self, namespace: Optional[str] = None) -> List:
+        out = []
+        for job in self.state.jobs():
+            if namespace is not None and job.namespace != namespace:
+                continue
+            for sp in job.scaling_policies:
+                out.append(sp)
+        return out
+
+    # ---- search (nomad/search_endpoint.go fuzzy/prefix search) ----
+
+    SEARCH_CONTEXTS = ("jobs", "nodes", "allocs", "evals", "deployments",
+                      "volumes")
+
+    def search(self, prefix: str, context: str = "all",
+               namespace: str = "default") -> Dict[str, List[str]]:
+        state = self.state
+        contexts = (self.SEARCH_CONTEXTS if context in ("", "all")
+                    else (context,))
+        out: Dict[str, List[str]] = {}
+
+        def matches(ids):
+            return sorted(i for i in ids if i.startswith(prefix))[:20]
+
+        for ctx in contexts:
+            if ctx == "jobs":
+                out[ctx] = matches(j.id for j in state.jobs()
+                                   if j.namespace == namespace)
+            elif ctx == "nodes":
+                out[ctx] = matches(n.id for n in state.nodes())
+            elif ctx == "allocs":
+                out[ctx] = matches(
+                    a.id for a in state.snapshot()._allocs.values()
+                    if a.namespace == namespace)
+            elif ctx == "evals":
+                out[ctx] = matches(e.id for e in state.evals()
+                                   if e.namespace == namespace)
+            elif ctx == "deployments":
+                out[ctx] = matches(d.id for d in state.deployments()
+                                   if d.namespace == namespace)
+            elif ctx == "volumes":
+                out[ctx] = matches(v.id for v in state.csi_volumes()
+                                   if v.namespace == namespace)
+        return out
 
     def wait_for_eval(self, eval_id: str, statuses=("complete", "failed"),
                       timeout: float = 10.0) -> Optional[Evaluation]:

@@ -46,6 +46,7 @@ class StateSnapshot(InMemState):
         self._deployments = dict(store._deployments)
         self._evals = dict(store._evals)
         self._config = store._config
+        self._csi_volumes = dict(store._csi)
         self._acl_store = store.acl  # shared: snapshots read live tokens
         self.index = store.index
         self.cluster = store.cluster
@@ -128,6 +129,12 @@ class StateStore(InMemState):
     upsert_eval = _locked("upsert_eval")
     delete_eval = _locked("delete_eval")
     upsert_plan_results = _locked("upsert_plan_results")
+    upsert_csi_volume = _locked("upsert_csi_volume")
+    delete_csi_volume = _locked("delete_csi_volume")
+    csi_volume_claim = _locked("csi_volume_claim")
+    csi_volume_release = _locked("csi_volume_release")
+    csi_volumes = _locked("csi_volumes")
+    csi_plugins = _locked("csi_plugins")
     # Iterating reads must hold the lock too — the table dicts mutate in place.
     nodes = _locked("nodes")
     jobs = _locked("jobs")

@@ -1,0 +1,54 @@
+"""VolumeWatcher — release CSI volume claims as allocations terminate.
+
+Behavioral reference: `nomad/volumewatcher/` (volumes_watcher.go :183 —
+one watcher per claimed volume; volume_watcher.go :249 — when a claiming
+alloc is terminal the claim is unpublished/released through the claim
+RPCs). This build's watcher is one poll loop over the claimed-volume set
+(the store is process-local; the per-volume goroutine fan-out collapses
+to a scan), releasing claims whose alloc is gone or terminal.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+DEFAULT_POLL_INTERVAL = 0.1
+
+
+class VolumeWatcher:
+    def __init__(self, server, poll_interval: float = DEFAULT_POLL_INTERVAL):
+        self.server = server
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="volwatch",
+                                        daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.tick()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def tick(self) -> None:
+        state = self.server.state
+        for vol in state.csi_volumes():
+            if not vol.in_use():
+                continue
+            for alloc_id in list(vol.read_claims) + list(vol.write_claims):
+                alloc = state.alloc_by_id(alloc_id)
+                if alloc is None or alloc.terminal_status():
+                    state.csi_volume_release(vol.namespace, vol.id,
+                                             alloc_id)

@@ -1,0 +1,96 @@
+"""CSI volume + plugin data model.
+
+Behavioral reference: `nomad/structs/csi.go` — `CSIVolume` (claim modes,
+access/attachment modes, schedulability), `CSIPlugin` (aggregated health
+from node/controller fingerprints); state tables `nomad/state/schema.go`
+:687/:719. Claims follow the reference's reader/writer accounting:
+single-writer modes admit one write claim, multi-writer several; readers
+bounded only by mode.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# access modes (csi.go CSIVolumeAccessMode)
+ACCESS_SINGLE_READER = "single-node-reader-only"
+ACCESS_SINGLE_WRITER = "single-node-writer"
+ACCESS_MULTI_READER = "multi-node-reader-only"
+ACCESS_MULTI_SINGLE_WRITER = "multi-node-single-writer"
+ACCESS_MULTI_WRITER = "multi-node-multi-writer"
+
+ATTACH_FILESYSTEM = "file-system"
+ATTACH_BLOCK = "block-device"
+
+CLAIM_READ = "read"
+CLAIM_WRITE = "write"
+
+
+@dataclass
+class CSIVolume:
+    """Reference structs.CSIVolume (csi.go)."""
+
+    id: str = ""
+    namespace: str = "default"
+    name: str = ""
+    plugin_id: str = ""
+    access_mode: str = ACCESS_SINGLE_WRITER
+    attachment_mode: str = ATTACH_FILESYSTEM
+    # alloc_id -> claim mode
+    read_claims: Dict[str, bool] = field(default_factory=dict)
+    write_claims: Dict[str, bool] = field(default_factory=dict)
+    schedulable: bool = True
+    create_index: int = 0
+    modify_index: int = 0
+
+    def writers_allowed(self) -> int:
+        if self.access_mode in (ACCESS_SINGLE_WRITER,
+                                ACCESS_MULTI_SINGLE_WRITER):
+            return 1
+        if self.access_mode == ACCESS_MULTI_WRITER:
+            return 1_000_000
+        return 0
+
+    def readers_allowed(self) -> int:
+        if self.access_mode == ACCESS_SINGLE_READER:
+            return 1
+        return 1_000_000
+
+    def claim_ok(self, mode: str) -> bool:
+        """Can another claim of `mode` be admitted? (csi.go ClaimRead/
+        ClaimWrite checks)."""
+        if not self.schedulable:
+            return False
+        if mode == CLAIM_WRITE:
+            return len(self.write_claims) < self.writers_allowed()
+        return len(self.read_claims) < self.readers_allowed()
+
+    def claim(self, alloc_id: str, mode: str) -> bool:
+        if alloc_id in self.read_claims or alloc_id in self.write_claims:
+            return True  # idempotent re-claim
+        if not self.claim_ok(mode):
+            return False
+        (self.write_claims if mode == CLAIM_WRITE
+         else self.read_claims)[alloc_id] = True
+        return True
+
+    def release(self, alloc_id: str) -> bool:
+        a = self.read_claims.pop(alloc_id, None)
+        b = self.write_claims.pop(alloc_id, None)
+        return a is not None or b is not None
+
+    def in_use(self) -> bool:
+        return bool(self.read_claims or self.write_claims)
+
+
+@dataclass
+class CSIPlugin:
+    """Aggregated plugin view (csi.go CSIPlugin): counts derived from node
+    fingerprints; recomputed on read by the state layer."""
+
+    id: str = ""
+    provider: str = ""
+    controllers_healthy: int = 0
+    controllers_expected: int = 0
+    nodes_healthy: int = 0
+    nodes_expected: int = 0

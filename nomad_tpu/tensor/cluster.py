@@ -162,6 +162,16 @@ class ClusterTensors:
                 if name not in drivers:
                     truthy = "1" if v in ("1", "true") else "0"
                     self._set_attr(row, f"__driver.{name}", truthy)
+        # Volume/plugin pseudo-attrs: host volumes (HostVolumeChecker,
+        # feasible.go:117 — value encodes writability) and CSI node
+        # plugins (CSIVolumeChecker's per-node plugin presence half,
+        # feasible.go:194)
+        for name, cfg in (node.host_volumes or {}).items():
+            self._set_attr(row, f"__volume.host.{name}",
+                           "ro" if cfg.read_only else "rw")
+        for pid, info in (node.csi_node_plugins or {}).items():
+            healthy = "1" if getattr(info, "healthy", True) else "0"
+            self._set_attr(row, f"__plugin.csi.{pid}", healthy)
         self.version += 1
         return row
 

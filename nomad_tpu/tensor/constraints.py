@@ -163,6 +163,7 @@ def compile_constraints(
     vocab: AttrVocab,
     datacenters: Optional[Sequence[str]] = None,
     drivers: Optional[Sequence[str]] = None,
+    volumes: Optional[Sequence[tuple]] = None,
     lut_bucket: int = 8,
 ) -> CompiledConstraints:
     """Compile constraints (+ datacenter membership + driver checks) into LUTs.
@@ -195,6 +196,25 @@ def compile_constraints(
 
     for drv in drivers or ():
         add_lut_row(f"__driver.{drv}", lambda v, found: found and v == "1")
+
+    # Volume feasibility rows (HostVolumeChecker feasible.go:117,
+    # CSIVolumeChecker feasible.go:194 — the per-node half). Entries:
+    #   ("host", source, read_only)  — node must expose the host volume,
+    #                                  writable unless the ask is ro
+    #   ("csi", plugin_id, _)        — node must run a healthy plugin
+    #   ("missing", reason, _)       — unresolvable ask: no node feasible
+    for kind, name, ro in volumes or ():
+        if kind == "host":
+            add_lut_row(
+                f"__volume.host.{name}",
+                lambda v, found, ro=ro: found and (v == "rw"
+                                                   or (ro and v == "ro")))
+        elif kind == "csi":
+            add_lut_row(f"__plugin.csi.{name}",
+                        lambda v, found: found and v == "1")
+        else:  # missing volume: poison
+            k = vocab.intern_key("node.datacenter")
+            rows.append((k, np.zeros(width, dtype=bool)))
 
     for c in constraints:
         if c.operand == CONSTRAINT_DISTINCT_HOSTS:
