@@ -22,9 +22,12 @@ import os
 import random
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
+
+from ..lib.journal import load_journal
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -44,22 +47,25 @@ class NotLeaderError(Exception):
 class _Log:
     """1-indexed in-memory log with optional append-only file journal."""
 
-    def __init__(self, path: Optional[str] = None) -> None:
+    def __init__(self, path: Optional[str] = None,
+                 fsync: bool = False) -> None:
         self.entries: List[Dict[str, Any]] = []  # {"term": t, "data": ...}
         self._path = path
+        self._fsync = fsync
         self._fh = None
         if path is not None and os.path.exists(path):
-            with open(path, "rb") as fh:
-                unpacker = msgpack.Unpacker(fh, raw=False,
-                                            strict_map_key=False)
-                try:
-                    for rec in unpacker:
-                        if rec.get("op") == "trunc":
-                            del self.entries[rec["from"] - 1:]
-                        else:
-                            self.entries.append(rec)
-                except Exception:
-                    pass  # torn tail
+            # load_journal truncates any torn/invalid tail in place so the
+            # append-mode reopen below can't land acknowledged entries
+            # after undecodable bytes (Raft persisted-log safety).
+            recs = load_journal(
+                path,
+                validate=lambda r: ("term" in r and "data" in r)
+                or (r.get("op") == "trunc" and "from" in r))
+            for rec in recs:
+                if rec.get("op") == "trunc":
+                    del self.entries[rec["from"] - 1:]
+                else:
+                    self.entries.append(rec)
 
     def _journal(self, rec: Dict[str, Any]) -> None:
         if self._path is None:
@@ -68,6 +74,8 @@ class _Log:
             self._fh = open(self._path, "ab")
         self._fh.write(msgpack.packb(rec, use_bin_type=True))
         self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
 
     def last_index(self) -> int:
         return len(self.entries)
@@ -115,6 +123,7 @@ class RaftNode:
                  heartbeat_interval: float = HEARTBEAT_INTERVAL,
                  election_timeout: Tuple[float, float] = ELECTION_TIMEOUT,
                  on_leadership_change: Optional[Callable[[bool], None]] = None,
+                 fsync: bool = False,
                  ) -> None:
         self.id = node_id
         self.peers = dict(peers)
@@ -126,6 +135,9 @@ class RaftNode:
 
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
+        self._leadership_q: "deque[bool]" = deque()
+        self._notify_lock = threading.Lock()
+        self._notifier_running = False
 
         self._meta_path = None
         log_path = None
@@ -133,7 +145,7 @@ class RaftNode:
             os.makedirs(data_dir, exist_ok=True)
             self._meta_path = os.path.join(data_dir, "raft_meta.mp")
             log_path = os.path.join(data_dir, "raft_log.mp")
-        self.log = _Log(log_path)
+        self.log = _Log(log_path, fsync=fsync)
 
         self.term = 0
         self.voted_for: Optional[str] = None
@@ -227,10 +239,38 @@ class RaftNode:
         self._notify_leadership(True)
 
     def _notify_leadership(self, is_leader: bool) -> None:
-        if self.on_leadership_change is not None:
-            cb = self.on_leadership_change
-            threading.Thread(target=cb, args=(is_leader,),
-                             daemon=True).start()
+        # Deliver from a single serialized queue so a rapid loss→regain
+        # (or regain→loss) can't reach the callback out of order on
+        # unordered daemon threads, leaving subsystems running as a
+        # follower or stopped while leader.
+        if self.on_leadership_change is None:
+            return
+        self._leadership_q.append(is_leader)
+        with self._notify_lock:
+            if self._notifier_running:
+                return
+            self._notifier_running = True
+        threading.Thread(target=self._drain_leadership_q,
+                         daemon=True).start()
+
+    def _drain_leadership_q(self) -> None:
+        while True:
+            try:
+                is_leader = self._leadership_q.popleft()
+            except IndexError:
+                with self._notify_lock:
+                    if not self._leadership_q:
+                        self._notifier_running = False
+                        return
+                continue
+            try:
+                self.on_leadership_change(is_leader)
+            except Exception:
+                # callback errors must not kill delivery, but a silently
+                # stalled leader (no subsystems running) is undebuggable
+                import traceback
+
+                traceback.print_exc()
 
     # ---- public API ----
 

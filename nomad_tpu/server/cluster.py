@@ -183,6 +183,7 @@ class ClusterServer:
             config.node_id, self.peers, self.rpc, self.pool,
             apply_fn=fsm.apply_resilient, data_dir=raft_dir,
             on_leadership_change=self._on_leadership_change,
+            fsync=config.fsync,
         )
         state.raft = self.raft
         self._srv_cfg = srv_cfg

@@ -21,6 +21,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import msgpack
 
+from ..lib.journal import load_journal
 from ..structs.codec import to_wire
 from .fsm import ALLOWED_OPS, FSM, snapshot_state
 from .state import StateStore
@@ -54,23 +55,13 @@ class Wal:
         after = snap["wal_seq"] if snap else 0
         entries: List[Dict[str, Any]] = []
         if os.path.exists(self._path):
-            clean_end = 0
-            with open(self._path, "rb") as fh:
-                unpacker = msgpack.Unpacker(fh, raw=False,
-                                            strict_map_key=False)
-                try:
-                    for entry in unpacker:
-                        clean_end = unpacker.tell()
-                        if entry["s"] > after:
-                            entries.append(entry)
-                except Exception:
-                    pass  # corrupt frame: keep the clean prefix only
-            if clean_end < os.path.getsize(self._path):
-                # Torn tail (a partial frame ends iteration silently, a
-                # corrupt one raises). Truncate so future appends don't land
-                # after undecodable bytes — they'd be lost on next load.
-                with open(self._path, "r+b") as fh:
-                    fh.truncate(clean_end)
+            # load_journal truncates the torn/invalid tail in place so
+            # future appends don't land after undecodable bytes — they'd
+            # be lost on next load.
+            for entry in load_journal(self._path,
+                                      validate=lambda r: "s" in r):
+                if entry["s"] > after:
+                    entries.append(entry)
         last_seq = entries[-1]["s"] if entries else after
         self.seq = max(self.seq, last_seq)
         return snap, entries

@@ -336,3 +336,49 @@ class TestHttpEnforcement:
             assert api.jobs() == []  # no token, no enforcement
         finally:
             a.shutdown()
+
+
+class TestTokenCacheInvalidation:
+    """ADVICE r1: token mutations must bump the cache generation so a
+    resolve() racing a revocation cannot re-insert the stale compiled ACL
+    after the delete popped it (nomad/acl.go cache semantics)."""
+
+    def _store_with_token(self):
+        ts = TokenStore()
+        ts.upsert_policy(ACLPolicy(
+            name="p", rules='namespace "default" { policy = "read" }'))
+        tok = ts.upsert_token(ACLToken(name="t", policies=["p"]))
+        return ts, tok
+
+    def test_delete_token_bumps_generation(self):
+        ts, tok = self._store_with_token()
+        gen = ts._cache_gen
+        ts.delete_token(tok.accessor_id)
+        assert ts._cache_gen > gen
+        with pytest.raises(ACLError):
+            ts.resolve(tok.secret_id)
+
+    def test_rotation_bumps_generation(self):
+        ts, tok = self._store_with_token()
+        ts.resolve(tok.secret_id)  # warm the cache
+        gen = ts._cache_gen
+        rotated = ACLToken(accessor_id=tok.accessor_id, name="t",
+                           policies=["p"])
+        ts.upsert_token(rotated)
+        assert ts._cache_gen > gen
+        with pytest.raises(ACLError):
+            ts.resolve(tok.secret_id)  # old secret no longer resolves
+        ts.resolve(rotated.secret_id)
+
+    def test_racing_resolve_does_not_recache_revoked_token(self):
+        ts, tok = self._store_with_token()
+        # emulate the race: resolve() captured the token + generation,
+        # then the revocation landed before it re-took the lock to cache
+        with ts._lock:
+            gen = ts._cache_gen
+        ts.delete_token(tok.accessor_id)
+        acl = ts._compile(tok.policies)
+        with ts._lock:
+            if ts._cache_gen == gen:  # the guard under test
+                ts._acl_cache[tok.secret_id] = acl
+        assert tok.secret_id not in ts._acl_cache

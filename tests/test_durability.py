@@ -189,3 +189,25 @@ class TestServerResume:
             assert len(s2.state.nodes()) == 1
         finally:
             s2.shutdown()
+
+
+def test_wal_decodable_garbage_tail_truncated(tmp_path):
+    """Same defect class as the raft journal: garbage that decodes as a
+    valid non-dict msgpack value must be truncated, not kept."""
+    from nomad_tpu.server.wal import Wal
+
+    w = Wal(str(tmp_path))
+    for i in range(3):
+        w.append("op", [i])
+    w.close()
+    path = str(tmp_path / "wal.log")
+    with open(path, "ab") as fh:
+        fh.write(b"\x05")
+    w2 = Wal(str(tmp_path))
+    _, entries = w2.load()
+    assert len(entries) == 3
+    w2.append("op", [3])
+    w2.close()
+    w3 = Wal(str(tmp_path))
+    _, entries = w3.load()
+    assert [e["args"][0] for e in entries] == [0, 1, 2, 3]

@@ -224,3 +224,75 @@ class TestRaft:
         leader.barrier()
         li = cluster.nodes.index(leader)
         assert cluster.applied[li] == [{"v": 1}]  # noop filtered
+
+
+class TestLogDurability:
+    """ADVICE r1 (high): a torn/corrupt journal tail must be truncated on
+    load — otherwise post-crash appends land after undecodable bytes and
+    acknowledged entries silently vanish on the next load, violating
+    Raft's persisted-log safety assumption (mirrors Wal.load)."""
+
+    def test_torn_tail_then_append_survives_reload(self, tmp_path):
+        from nomad_tpu.raft.raft import _Log
+
+        path = str(tmp_path / "raft_log.mp")
+        log = _Log(path)
+        for i in range(3):
+            log.append(1, {"v": i})
+        log.close()
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:  # corrupt tail: undecodable bytes
+            fh.write(data + b"\xc1\xc1\xc1")
+        log2 = _Log(path)
+        assert len(log2.entries) == 3
+        log2.append(2, {"v": 3})  # acknowledged post-crash entry
+        log2.close()
+        log3 = _Log(path)
+        assert [e["data"]["v"] for e in log3.entries] == [0, 1, 2, 3]
+
+    def test_partial_final_frame_truncated(self, tmp_path):
+        from nomad_tpu.raft.raft import _Log
+
+        path = str(tmp_path / "raft_log.mp")
+        log = _Log(path)
+        for i in range(4):
+            log.append(1, {"v": i})
+        log.close()
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:
+            fh.write(data[:-2])  # torn write mid-frame
+        log2 = _Log(path)
+        assert len(log2.entries) == 3
+        log2.append(1, {"v": 99})
+        log2.close()
+        log3 = _Log(path)
+        assert [e["data"]["v"] for e in log3.entries] == [0, 1, 2, 99]
+
+    def test_fsync_option_accepted(self, tmp_path):
+        from nomad_tpu.raft.raft import _Log
+
+        path = str(tmp_path / "raft_log.mp")
+        log = _Log(path, fsync=True)
+        log.append(1, {"v": 0})
+        log.close()
+        assert len(_Log(path).entries) == 1
+
+    def test_decodable_garbage_tail_truncated(self, tmp_path):
+        """A tail byte that decodes as a VALID msgpack value (positive
+        fixint) must still be truncated — clean_end may only advance past
+        frames that validate as journal records."""
+        from nomad_tpu.raft.raft import _Log
+
+        path = str(tmp_path / "raft_log.mp")
+        log = _Log(path)
+        for i in range(3):
+            log.append(1, {"v": i})
+        log.close()
+        with open(path, "ab") as fh:
+            fh.write(b"\x05")  # decodes as int 5 — not a record
+        log2 = _Log(path)
+        assert len(log2.entries) == 3
+        log2.append(2, {"v": 3})  # acknowledged post-crash entry
+        log2.close()
+        log3 = _Log(path)
+        assert [e["data"]["v"] for e in log3.entries] == [0, 1, 2, 3]
