@@ -65,8 +65,13 @@ class TGParams(NamedTuple):
     preferred_idx: jax.Array     # i32[M] — preferred node row (sticky disk), −1 none
     extra_mask: jax.Array        # bool[N] — host-evaluated checks (CSI, …)
     distinct_hosts: jax.Array    # bool — job or tg has distinct_hosts
-    job_count0: jax.Array        # f32[N] — proposed allocs of job per node
-    jobtg_count0: jax.Array      # f32[N] — proposed allocs of (job,tg)
+    # sparse proposed-alloc counts, scattered to dense [N] on device (a job
+    # touches few nodes; dense per-eval [N] vectors would dominate the
+    # host→device batch transfer)
+    jc_idx: jax.Array            # i32[J] — node rows with allocs of job, −1 pad
+    jc_val: jax.Array            # f32[J] — distinct-hosts counts per row
+    jtc_idx: jax.Array           # i32[J2] — node rows with allocs of (job,tg)
+    jtc_val: jax.Array           # f32[J2] — anti-affinity counts per row
     # plan-relative resource deltas (stops/preemptions), sparse scatter
     delta_idx: jax.Array         # i32[D] — node row or −1
     delta_res: jax.Array         # f32[D, R] — resources to subtract
@@ -103,32 +108,61 @@ def fit_scores(util: jax.Array, cap: jax.Array
     return binpack, spread
 
 
+def _select_tokens(attrs: jax.Array, key_idx: jax.Array, v: int) -> jax.Array:
+    """tok[n, c] = attrs[n, key_idx[c]], normalized into [0, v):
+    missing (−1) → last slot; clamp above: LUT widths are per-program
+    (sized to the keys the program references), but PAD rows point at an
+    arbitrary key whose tokens may exceed V — clamping them onto the
+    missing slot keeps padding inert (pad rows are all-true / zero-weight
+    in every column) instead of out-of-bounds.
+
+    Expressed as a one-hot matmul over the key axis rather than a gather:
+    TPU gathers serialize, matmuls ride the MXU (tokens < 2^24 are exact
+    in f32)."""
+    k = attrs.shape[1]
+    oh = (key_idx[:, None] == jnp.arange(k)[None, :]).astype(jnp.float32)
+    tok = jnp.einsum("nk,ck->nc", attrs.astype(jnp.float32), oh)
+    tok = tok.astype(jnp.int32)
+    return jnp.where(tok < 0, v - 1, jnp.minimum(tok, v - 1))
+
+
+def _onehot_tokens(tok: jax.Array, v: int) -> jax.Array:
+    """[..., C] int tokens → [..., C, V] f32 one-hot."""
+    return (tok[..., None] == jnp.arange(v)).astype(jnp.float32)
+
+
 def _lut_gather(lut: jax.Array, key_idx: jax.Array, attrs: jax.Array) -> jax.Array:
-    """out[n, c] = lut[c, tok(n, key_idx[c])] with missing → last slot."""
+    """out[n, c] = lut[c, tok(n, key_idx[c])] with missing → last slot,
+    as one-hot einsum (gather-free)."""
     if lut.shape[0] == 0:
         return jnp.ones((attrs.shape[0], 0), dtype=lut.dtype)
     v = lut.shape[1]
-    tok = attrs[:, key_idx]                       # [N, C]
-    tok = jnp.where(tok < 0, v - 1, tok)
-    return jnp.take_along_axis(lut.T, tok, axis=0)  # [N, C]
+    tok = _select_tokens(attrs, key_idx, v)
+    oh = _onehot_tokens(tok, v)                    # [N, C, V]
+    out = jnp.einsum("ncv,cv->nc", oh, lut.astype(jnp.float32))
+    if lut.dtype == jnp.bool_ or lut.dtype == np.bool_:
+        return out > 0.5
+    return out
 
 
 def _spread_boost(
-    stok: jax.Array,        # i32[N, S] value tokens (−1 missing → V−1)
+    stok: jax.Array,        # i32[N, S] normalized value tokens (miss = V−1)
+    stok_oh: jax.Array,     # f32[N, S, V] one-hot of stok
     counts: jax.Array,      # f32[S, V]
     p: TGParams,
 ) -> jax.Array:
     """Per-node total spread boost (reference spread.go:120-174 +
-    evenSpreadScoreBoost :178)."""
+    evenSpreadScoreBoost :178). Token lookups are one-hot einsums — this
+    runs inside the alloc scan, and TPU gathers would serialize it."""
     S, V = counts.shape
     if S == 0:
         return jnp.zeros(stok.shape[0], dtype=jnp.float32)
     miss = V - 1
-    tok = jnp.where(stok < 0, miss, stok)          # [N, S]
-    cur = jnp.take_along_axis(counts.T, tok, axis=0)  # [N, S] counts[s, tok]
+    tok = stok                                     # [N, S]
+    cur = jnp.einsum("nsv,sv->ns", stok_oh, counts)  # counts[s, tok]
 
     # -- target mode: boost = (desired − (cur+1))/desired · w, or −1 --
-    desired = jnp.take_along_axis(p.spread_desired.T, tok, axis=0)  # [N, S]
+    desired = jnp.einsum("nsv,sv->ns", stok_oh, p.spread_desired)
     used_count = cur + 1.0
     target_boost = jnp.where(
         desired > 0.0,
@@ -184,16 +218,21 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
     aff_vals = _lut_gather(p.aff_lut, p.aff_key_idx, cluster.attrs)  # [N, A] f32
     aff_score = jnp.sum(aff_vals, axis=1) * p.aff_inv_sum            # [N]
 
-    stok = (
-        cluster.attrs[:, p.spread_key_idx]
-        if p.spread_key_idx.shape[0]
-        else jnp.zeros((n, 0), dtype=jnp.int32)
-    )
+    s_v = p.spread_desired.shape[1]
+    if p.spread_key_idx.shape[0]:
+        stok = _select_tokens(cluster.attrs, p.spread_key_idx, s_v)
+        stok_oh = _onehot_tokens(stok, s_v)        # [N, S, V]
+    else:
+        stok = jnp.zeros((n, 0), dtype=jnp.int32)
+        stok_oh = jnp.zeros((n, 0, s_v), dtype=jnp.float32)
 
-    # plan-relative deltas (stopped/preempted allocs release resources)
+    # plan-relative deltas (stopped/preempted allocs release resources);
+    # comparison-einsum instead of scatter (−1 pads match no row)
     used0 = cluster.used
     if p.delta_idx.shape[0]:
-        used0 = used0.at[p.delta_idx].add(-p.delta_res, mode="drop")
+        eq = (p.delta_idx[:, None] == jnp.arange(n)[None, :]
+              ).astype(jnp.float32)                # [D, N]
+        used0 = used0 - jnp.einsum("dn,dr->nr", eq, p.delta_res)
 
     nodes_feasible = jnp.sum(feas.astype(jnp.int32))
 
@@ -202,8 +241,9 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         used, job_cnt, tg_cnt, scounts = carry
         active = i < p.n_place
 
-        # per-step reschedule penalty nodes (rank.go:570 SetPenaltyNodes)
-        penalty = jnp.zeros(n, dtype=bool).at[pen_idx].set(True, mode="drop")
+        # per-step reschedule penalty nodes (rank.go:570 SetPenaltyNodes);
+        # compare, don't scatter (−1 pads match no row)
+        penalty = jnp.any(pen_idx[:, None] == jnp.arange(n)[None, :], axis=0)
 
         util = used + p.ask[None, :]                       # [N, R]
         fits = jnp.all(util <= cap, axis=1)
@@ -229,7 +269,7 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         ssum = ssum + jnp.where(inc_aff, aff_score, 0.0)
         scnt = scnt + inc_aff
 
-        spread_score = _spread_boost(stok, scounts, p)
+        spread_score = _spread_boost(stok, stok_oh, scounts, p)
         inc_spread = spread_score != 0.0
         ssum = ssum + jnp.where(inc_spread, spread_score, 0.0)
         scnt = scnt + inc_spread
@@ -250,12 +290,12 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         job_cnt = job_cnt + onehot
         tg_cnt = tg_cnt + onehot
         if scounts.shape[0]:
-            sel_tok = stok[idx]                     # [S]
-            valid = (sel_tok >= 0) & found          # missing values never enter
-            upd = jax.nn.one_hot(                   # the use map (spread.go:326)
-                jnp.where(sel_tok < 0, 0, sel_tok),
-                scounts.shape[1],
-                dtype=scounts.dtype,
+            sel_tok = stok[idx]                     # [S], normalized
+            # missing values never enter the use map (spread.go:326);
+            # miss is the last slot after _select_tokens normalization
+            valid = (sel_tok != scounts.shape[1] - 1) & found
+            upd = jax.nn.one_hot(
+                sel_tok, scounts.shape[1], dtype=scounts.dtype,
             ) * valid[:, None]
             scounts = scounts + upd
 
@@ -267,7 +307,15 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
             masked,
         )
 
-    init = (used0, p.job_count0, p.jobtg_count0, p.spread_counts0)
+    job_cnt0 = jnp.einsum(
+        "jn,j->n",
+        (p.jc_idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32),
+        p.jc_val)
+    tg_cnt0 = jnp.einsum(
+        "jn,j->n",
+        (p.jtc_idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32),
+        p.jtc_val)
+    init = (used0, job_cnt0, tg_cnt0, p.spread_counts0)
     xs = (jnp.arange(max_allocs), p.penalty_idx, p.preferred_idx)
     (used_f, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
         step, init, xs
@@ -286,6 +334,70 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
 def place_task_group_jit(cluster: ClusterArrays, p: TGParams, max_allocs: int
                          ) -> PlacementResult:
     return place_task_group(cluster, p, max_allocs)
+
+
+# ---- packed transport ------------------------------------------------------
+# A batched TGParams is ~24 small arrays; on a tunneled/remote TPU each
+# host→device transfer pays a full round trip (~10ms), so shipping leaves
+# individually costs ~0.3s per batch. Packing into one buffer per dtype
+# class turns that into 3 transfers; the jitted unpack (static offsets,
+# slice+reshape) fuses to nothing.
+
+_PACK_I32 = ("n_place", "algorithm", "key_idx", "aff_key_idx", "penalty_idx",
+             "preferred_idx", "jc_idx", "jtc_idx", "delta_idx",
+             "spread_key_idx")
+_PACK_F32 = ("ask", "desired_count", "aff_lut", "aff_inv_sum", "jc_val",
+             "jtc_val", "delta_res", "spread_weight", "spread_desired",
+             "spread_counts0")
+_PACK_U8 = ("lut", "extra_mask", "distinct_hosts", "spread_has_targets",
+            "spread_active")
+
+
+def pack_params(batch: TGParams):
+    """Flatten a (batched) TGParams into (i32, f32, u8) numpy buffers plus a
+    static spec for the on-device unpack."""
+    bufs = {"i": [], "f": [], "u": []}
+    spec = []
+    for name in TGParams._fields:
+        a = np.asarray(getattr(batch, name))
+        if name in _PACK_I32:
+            cls, dt = "i", np.int32
+        elif name in _PACK_F32:
+            cls, dt = "f", np.float32
+        else:
+            cls, dt = "u", np.uint8
+        flat = np.ascontiguousarray(a, dtype=dt).reshape(-1)
+        off = sum(x.size for x in bufs[cls])
+        bufs[cls].append(flat)
+        spec.append((name, cls, off, a.shape))
+    cat = {c: (np.concatenate(v) if v else np.zeros(0, dtype=d))
+           for (c, v), d in zip(bufs.items(),
+                                (np.int32, np.float32, np.uint8))}
+    return cat["i"], cat["f"], cat["u"], tuple(spec)
+
+
+def _unpack_params(i32buf, f32buf, u8buf, spec) -> TGParams:
+    fields = {}
+    bufs = {"i": i32buf, "f": f32buf, "u": u8buf}
+    for name, cls, off, shape in spec:
+        size = int(np.prod(shape)) if shape else 1
+        seg = jax.lax.dynamic_slice_in_dim(bufs[cls], off, size)
+        a = seg.reshape(shape)
+        if cls == "u":
+            a = a != 0
+        fields[name] = a
+    return TGParams(**fields)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "max_allocs"))
+def place_packed_batch(cluster: ClusterArrays, i32buf, f32buf, u8buf,
+                       spec, max_allocs: int) -> Tuple[jax.Array, jax.Array]:
+    """Packed-transport batched placement; returns only (sel_idx, sel_score)
+    so the device→host fetch is one small transfer too."""
+    batch = _unpack_params(i32buf, f32buf, u8buf, spec)
+    fn = functools.partial(place_task_group, max_allocs=max_allocs)
+    r = jax.vmap(fn, in_axes=(None, 0))(cluster, batch)
+    return r.sel_idx, r.sel_score
 
 
 @functools.partial(jax.jit, static_argnames=("max_allocs",))
@@ -310,7 +422,10 @@ def system_feasibility(cluster: ClusterArrays, p: TGParams
     feas = cluster.node_ok & p.extra_mask & jnp.all(feas_c, axis=1)
     used = cluster.used
     if p.delta_idx.shape[0]:
-        used = used.at[p.delta_idx].add(-p.delta_res, mode="drop")
+        n = used.shape[0]
+        eq = (p.delta_idx[:, None] == jnp.arange(n)[None, :]
+              ).astype(jnp.float32)
+        used = used - jnp.einsum("dn,dr->nr", eq, p.delta_res)
     util = used + p.ask[None, :]
     fits = jnp.all(util <= cluster.capacity, axis=1)
     return feas, feas & fits

@@ -31,9 +31,11 @@ from ..utils import bucket as _bucket, widen_lut as _widen_v
 BATCH_AXIS = "batch"
 NODE_AXIS = "nodes"
 
-# TGParams fields that carry the node axis (leading axis after batching is
-# the eval batch; the node axis is axis -1 for these vectors).
-_NODE_AXIS_FIELDS = frozenset({"extra_mask", "job_count0", "jobtg_count0"})
+# TGParams no longer carries node-width per-eval vectors: job counts ship
+# sparse (jc_idx/jc_val) and the host-check mask is width-1 when trivial.
+# Params are therefore replicated across the node ring; only the cluster
+# snapshot is sharded along NODE_AXIS (GSPMD broadcasts the mask AND).
+_NODE_AXIS_FIELDS = frozenset()
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -121,6 +123,9 @@ def pad_params(params_list: Sequence[TGParams]
     p_n = _bucket(max(p.penalty_idx.shape[1] for p in ps))
     d_n = _bucket(max(p.delta_idx.shape[0] for p in ps))
     s_n = _bucket(max(p.spread_key_idx.shape[0] for p in ps))
+    j_n = _bucket(max(p.jc_idx.shape[0] for p in ps))
+    j2_n = _bucket(max(p.jtc_idx.shape[0] for p in ps))
+    e_n = max(p.extra_mask.shape[0] for p in ps)
 
     out = []
     for p in ps:
@@ -136,10 +141,15 @@ def pad_params(params_list: Sequence[TGParams]
             wide[:, : pen.shape[1]] = pen
             pen = wide
         out.append(p._replace(
+            extra_mask=_pad_rows(p.extra_mask, e_n, True),
             key_idx=key_idx, lut=lut,
             aff_key_idx=aff_key_idx, aff_lut=aff_lut,
             penalty_idx=pen,
             preferred_idx=_pad_rows(p.preferred_idx, m, -1),
+            jc_idx=_pad_rows(p.jc_idx, j_n, -1),
+            jc_val=_pad_rows(p.jc_val, j_n, 0.0),
+            jtc_idx=_pad_rows(p.jtc_idx, j2_n, -1),
+            jtc_val=_pad_rows(p.jtc_val, j2_n, 0.0),
             delta_idx=_pad_rows(p.delta_idx, d_n, -1),
             delta_res=_pad_rows(p.delta_res, d_n, 0.0),
             spread_key_idx=_pad_rows(p.spread_key_idx, s_n, 0),

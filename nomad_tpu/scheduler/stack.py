@@ -66,6 +66,11 @@ class TPUStack:
         self._jit = jit
         self._snapshot_version = -1
         self._dev_arrays: Optional[ClusterArrays] = None
+        # (job.id, version, modify_index, tg, volumes) → compiled static
+        # program; re-evaluating the same job spec (retries, node-down
+        # churn, deployments) skips the LUT compile entirely
+        self._prog_cache: Dict[tuple, dict] = {}
+        self._prog_cache_max = 1024
 
     # ---- device snapshot management ----
 
@@ -100,67 +105,46 @@ class TPUStack:
         stack itself is stateless; see constraints.compile_constraints)."""
         plan = plan or PlanContext()
         cl = self.cluster
-        n = cl.n_cap
-        vocab = cl.vocab
 
-        combined = list(job.constraints) + list(tg.constraints)
-        for t in tg.tasks:
-            combined.extend(t.constraints)
-        drivers = sorted({t.driver for t in tg.tasks})
+        prog = self._static_program(job, tg, volumes)
+        cc: CompiledConstraints = prog["cc"]
+        v: int = prog["v"]
+        feas_lut = prog["feas_lut"]
+        aff_lut = prog["aff_lut"]
+        ca: CompiledAffinities = prog["ca"]
+        spreads = prog["spreads"]
+        dh_job = prog["dh_job"]
+        distinct = prog["distinct"]
+        extra = prog["extra"]
+        if extra is None:
+            # trivially all-true: ship one broadcastable element, not [N]
+            extra = np.ones(1, dtype=bool)
 
-        cc = compile_constraints(
-            combined, vocab, datacenters=job.datacenters, drivers=drivers,
-            volumes=volumes,
-        )
-        affinities = list(job.affinities) + list(tg.affinities)
-        for t in tg.tasks:
-            affinities.extend(t.affinities)
-        ca = compile_affinities(affinities, vocab)
-
-        # LUT widths can differ between the two compiles (vocab can grow);
-        # normalize to a common width so the kernel sees one V.
-        v = max(cc.lut.shape[1] if cc.lut.size else 2,
-                ca.lut.shape[1] if ca.lut.size else 2)
-        feas_lut = _pad_lut(cc.lut, v, fill=False, dtype=np.bool_)
-        aff_lut = _pad_lut(ca.lut, v, fill=0.0, dtype=np.float32)
-        # Keys interned during compilation must exist as attrs columns before
-        # the device gather (token −1 everywhere for brand-new keys).
-        while vocab.num_keys > cl.k_cap:
-            cl._grow_keys()
-            cl.version += 1
-
-        # host-evaluated constraints (node-dependent RTarget) → extra mask
-        extra = np.ones(n, dtype=bool)
-        if cc.needs_host or ca.needs_host:
-            for node_id, row in cl.row_of.items():
-                node = cl.nodes[node_id]
-                if cc.needs_host and not meets_constraints(node, cc.needs_host):
-                    extra[row] = False
-
-        # distinct_hosts flags (feasible.go:494-500: job level vs tg level)
-        dh_job = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in job.constraints)
-        dh_tg = any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints)
-        # NB: tg-level distinct_hosts requires job+tg collision; job-level only
-        # job collision. The kernel has one count vector; encode tg-level by
-        # using the jobtg counts as the distinct counts.
-        distinct = dh_job or dh_tg
-
-        # per-eval count vectors (state + plan adjustments)
-        jc, jtc = cl.job_count_vectors(job.id, tg.name)
+        # per-eval count maps (state + plan adjustments), kept sparse: a job
+        # touches few nodes, so these ship as (row, count) pairs and are
+        # scattered to dense [N] on device (kernels/placement.py)
+        jc: Dict[int, float] = {}
+        jtc: Dict[int, float] = {}
+        for row, tgname in cl.job_allocs.get(job.id, {}).values():
+            jc[row] = jc.get(row, 0.0) + 1.0
+            if tgname == tg.name:
+                jtc[row] = jtc.get(row, 0.0) + 1.0
         for a in plan.stopped_allocs + plan.preempted_allocs:
             if a.job_id == job.id:
                 row = cl.row_of.get(a.node_id)
                 if row is not None:
-                    jc[row] = max(jc[row] - 1, 0)
+                    jc[row] = max(jc.get(row, 0.0) - 1.0, 0.0)
                     if a.task_group == tg.name:
-                        jtc[row] = max(jtc[row] - 1, 0)
+                        jtc[row] = max(jtc.get(row, 0.0) - 1.0, 0.0)
         for node_id, tgname, _usage in plan.placed:
             row = cl.row_of.get(node_id)
             if row is not None:
-                jc[row] += 1
+                jc[row] = jc.get(row, 0.0) + 1.0
                 if tgname == tg.name:
-                    jtc[row] += 1
+                    jtc[row] = jtc.get(row, 0.0) + 1.0
         dh_counts = jc if dh_job else jtc
+        jc_idx, jc_val = _sparse_counts(dh_counts)
+        jtc_idx, jtc_val = _sparse_counts(jtc)
 
         # resource deltas: in-plan stops/preempts release, placements consume
         deltas: List[Tuple[int, np.ndarray]] = []
@@ -197,25 +181,12 @@ class TPUStack:
                 if row is not None:
                     preferred_idx[i] = row
 
-        # ask vector
-        ask = np.zeros(R_TOTAL, dtype=np.float32)
-        res = job.combined_task_resources(tg)
-        ask[0], ask[1], ask[2] = res.cpu, res.memory_mb, res.disk_mb
-        ask[3] = sum(nw.mbits for nw in tg.networks) + sum(
-            nw.mbits for t in tg.tasks for nw in t.resources.networks
-        )
-        for t in tg.tasks:
-            for dev in t.resources.devices:
-                col = self._device_ask_col(dev.name)
-                if col is not None:
-                    ask[col] += dev.count
-
-        # spread programs
-        spreads = list(tg.spreads) + list(job.spreads)
-        sp = self._compile_spreads(job, tg, spreads, plan, v)
+        # spread program: cached static tables + per-eval counts
+        sp = prog["sp_static"]
+        sp_counts0 = self._spread_counts(job, tg, prog, plan)
 
         params = TGParams(
-            ask=ask,
+            ask=prog["ask"],
             n_place=np.int32(n_place),
             desired_count=np.float32(max(tg.count, 1)),
             algorithm=np.int32(1 if self.algorithm == "spread" else 0),
@@ -228,18 +199,138 @@ class TPUStack:
             preferred_idx=preferred_idx,
             extra_mask=extra,
             distinct_hosts=np.bool_(distinct),
-            job_count0=dh_counts,
-            jobtg_count0=jtc,
+            jc_idx=jc_idx,
+            jc_val=jc_val,
+            jtc_idx=jtc_idx,
+            jtc_val=jtc_val,
             delta_idx=delta_idx,
             delta_res=delta_res,
             spread_key_idx=sp[0],
             spread_weight=sp[1],
             spread_has_targets=sp[2],
             spread_desired=sp[3],
-            spread_counts0=sp[4],
-            spread_active=sp[5],
+            spread_counts0=sp_counts0,
+            spread_active=sp[4],
         )
         return params, m
+
+    def _static_program(self, job: Job, tg: TaskGroup,
+                        volumes: Optional[list]) -> dict:
+        """Compile (or fetch) the plan-independent half of a placement
+        program: constraint/affinity LUTs, width, host-check mask, spread
+        statics, ask vector. Keyed by job identity+version; invalidated
+        when a referenced key's vocabulary grows (new values would need new
+        LUT columns) or — for host-evaluated constraints — when the node
+        set changes. This is the `compile_tg` hot path killer: the scalar
+        LUT build ran once per eval per batch before caching."""
+        cl = self.cluster
+        vocab = cl.vocab
+        key = (job.id, job.version, job.modify_index, tg.name,
+               tuple(volumes) if volumes else ())
+        ent = self._prog_cache.get(key)
+        if ent is not None:
+            sizes = tuple(len(vocab.key_vocabs[k]) for k in ent["used_keys"])
+            fresh = (sizes == ent["vocab_sizes"]
+                     and ent["n_devcols"] == len(cl.device_cols))
+            if fresh and ent["host_dep"]:
+                # node-only version: alloc churn must not evict host masks
+                fresh = ent["node_version"] == cl.node_version
+            if fresh:
+                return ent
+
+        combined = list(job.constraints) + list(tg.constraints)
+        for t in tg.tasks:
+            combined.extend(t.constraints)
+        drivers = sorted({t.driver for t in tg.tasks})
+        cc = compile_constraints(
+            combined, vocab, datacenters=job.datacenters, drivers=drivers,
+            volumes=volumes,
+        )
+        affinities = list(job.affinities) + list(tg.affinities)
+        for t in tg.tasks:
+            affinities.extend(t.affinities)
+        ca = compile_affinities(affinities, vocab)
+
+        # LUT widths can differ between the compiles (each is sized to the
+        # keys it references); normalize to a common per-program width so
+        # the kernel sees one V. Spread keys take part: their desired/count
+        # tables index by value token of their own keys.
+        spreads = list(tg.spreads) + list(job.spreads)
+        spread_keys = []
+        spread_w = 2
+        for s in spreads:
+            skey = target_to_key(s.attribute) or s.attribute
+            k = vocab.intern_key(skey)
+            spread_keys.append(k)
+            spread_w = max(spread_w, len(vocab.key_vocabs[k]) + 1)
+        v = max(cc.lut.shape[1] if cc.lut.size else 2,
+                ca.lut.shape[1] if ca.lut.size else 2,
+                _bucket(spread_w, 2))
+        feas_lut = _pad_lut(cc.lut, v, fill=False, dtype=np.bool_)
+        aff_lut = _pad_lut(ca.lut, v, fill=0.0, dtype=np.float32)
+        # Keys interned during compilation must exist as attrs columns before
+        # the device gather (token −1 everywhere for brand-new keys).
+        while vocab.num_keys > cl.k_cap:
+            cl._grow_keys()
+            cl.version += 1
+
+        # host-evaluated constraints (node-dependent RTarget) → extra mask;
+        # None ⇒ trivially all-true (materialized per call at current n_cap)
+        host_dep = bool(cc.needs_host or ca.needs_host)
+        extra = None
+        if host_dep:
+            extra = np.ones(cl.n_cap, dtype=bool)
+            for node_id, row in cl.row_of.items():
+                node = cl.nodes[node_id]
+                if cc.needs_host and not meets_constraints(node, cc.needs_host):
+                    extra[row] = False
+
+        # distinct_hosts flags (feasible.go:494-500: job level vs tg level)
+        dh_job = any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                     for c in job.constraints)
+        dh_tg = any(c.operand == CONSTRAINT_DISTINCT_HOSTS
+                    for c in tg.constraints)
+        # NB: tg-level distinct_hosts requires job+tg collision; job-level
+        # only job collision. The kernel has one count vector; encode
+        # tg-level by using the jobtg counts as the distinct counts.
+        distinct = dh_job or dh_tg
+
+        # ask vector (static: depends only on the job spec + device columns)
+        ask = np.zeros(R_TOTAL, dtype=np.float32)
+        res = job.combined_task_resources(tg)
+        ask[0], ask[1], ask[2] = res.cpu, res.memory_mb, res.disk_mb
+        ask[3] = sum(nw.mbits for nw in tg.networks) + sum(
+            nw.mbits for t in tg.tasks for nw in t.resources.networks
+        )
+        for t in tg.tasks:
+            for dev in t.resources.devices:
+                col = self._device_ask_col(dev.name)
+                if col is not None:
+                    ask[col] += dev.count
+
+        sp_static = self._compile_spreads_static(tg, spreads, spread_keys, v)
+
+        used_keys = tuple(
+            sorted({int(k) for k in cc.key_idx}
+                   | {int(k) for k in ca.key_idx} | set(spread_keys)))
+        ent = {
+            "cc": cc, "ca": ca, "v": v,
+            "feas_lut": feas_lut, "aff_lut": aff_lut,
+            "spreads": spreads, "spread_keys": spread_keys,
+            "sp_static": sp_static,
+            "dh_job": dh_job, "distinct": distinct,
+            "extra": extra, "host_dep": host_dep,
+            "ask": ask,
+            "used_keys": used_keys,
+            "vocab_sizes": tuple(len(vocab.key_vocabs[k])
+                                 for k in used_keys),
+            "n_devcols": len(cl.device_cols),
+            "node_version": cl.node_version,
+        }
+        if len(self._prog_cache) >= self._prog_cache_max:
+            self._prog_cache.pop(next(iter(self._prog_cache)))
+        self._prog_cache[key] = ent
+        return ent
 
     def _device_ask_col(self, name: str) -> Optional[int]:
         # Match the ask against registered device columns by suffix specificity
@@ -255,21 +346,21 @@ class TPUStack:
                 return col
         return None
 
-    def _compile_spreads(self, job, tg, spreads, plan: PlanContext, v: int):
+    def _compile_spreads_static(self, tg, spreads, spread_keys, v: int):
+        """Plan-independent spread tables: key indices, normalized weights,
+        per-token desired counts (spread.go target mode)."""
         cl = self.cluster
         s_n = _bucket(max(len(spreads), 1))
         key_idx = np.zeros(s_n, dtype=np.int32)
         weight = np.zeros(s_n, dtype=np.float32)
         has_targets = np.zeros(s_n, dtype=bool)
         desired = np.full((s_n, v), -1.0, dtype=np.float32)
-        counts0 = np.zeros((s_n, v), dtype=np.float32)
         active = np.zeros(s_n, dtype=bool)
         if not spreads:
-            return key_idx, weight, has_targets, desired, counts0, active
+            return key_idx, weight, has_targets, desired, active
         sum_w = sum(s.weight for s in spreads) or 1
         for i, spread in enumerate(spreads):
-            key = target_to_key(spread.attribute) or spread.attribute
-            k = cl.vocab.intern_key(key)
+            k = spread_keys[i]
             kv = cl.vocab.key_vocabs[k]
             key_idx[i] = k
             weight[i] = spread.weight / sum_w
@@ -288,7 +379,21 @@ class TPUStack:
                     dv = dc.get(value, implicit)
                     desired[i, tok] = dv if dv is not None else -1.0
                 # missing slot stays −1 (⇒ −1 penalty)
-            # current counts per value token: allocs of (job, tg) per node value
+        return key_idx, weight, has_targets, desired, active
+
+    def _spread_counts(self, job, tg, prog: dict, plan: PlanContext):
+        """Per-eval spread counts: allocs of (job, tg) per value token,
+        adjusted by in-plan stops/preemptions/placements."""
+        cl = self.cluster
+        spreads = prog["spreads"]
+        spread_keys = prog["spread_keys"]
+        v = prog["v"]
+        s_n = _bucket(max(len(spreads), 1))
+        counts0 = np.zeros((s_n, v), dtype=np.float32)
+        if not spreads:
+            return counts0
+        for i, _spread in enumerate(spreads):
+            k = spread_keys[i]
             for _aid, (row, tgname) in cl.job_allocs.get(job.id, {}).items():
                 if tgname != tg.name:
                     continue
@@ -309,7 +414,7 @@ class TPUStack:
                         tok = cl.attrs[row, k]
                         if tok != MISSING:
                             counts0[i, tok] += 1
-        return key_idx, weight, has_targets, desired, counts0, active
+        return counts0
 
     # ---- selection ----
 
@@ -346,6 +451,17 @@ class TPUStack:
             nodes_fit=[int(x) for x in np.asarray(result.nodes_fit)[:n_place]],
             raw=result,
         )
+
+
+def _sparse_counts(counts: Dict[int, float]) -> Tuple[np.ndarray, np.ndarray]:
+    """(row → count) map → bucketed (idx, val) arrays, −1-padded."""
+    b = _bucket(max(len(counts), 1))
+    idx = np.full(b, -1, dtype=np.int32)
+    val = np.zeros(b, dtype=np.float32)
+    for i, (row, cnt) in enumerate(counts.items()):
+        idx[i] = row
+        val[i] = cnt
+    return idx, val
 
 
 def _pad_lut(lut: np.ndarray, v: int, fill, dtype) -> np.ndarray:

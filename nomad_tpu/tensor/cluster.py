@@ -73,6 +73,9 @@ class ClusterTensors:
         # job -> {alloc_id: (row, task_group)} for per-eval count vectors
         self.job_allocs: Dict[str, Dict[str, Tuple[int, str]]] = {}
         self.version = 0
+        # bumped only on node-set/attribute changes (not alloc churn) —
+        # freshness oracle for cached host-evaluated constraint masks
+        self.node_version = 0
 
     # ---- nodes ----
 
@@ -173,6 +176,7 @@ class ClusterTensors:
             healthy = "1" if getattr(info, "healthy", True) else "0"
             self._set_attr(row, f"__plugin.csi.{pid}", healthy)
         self.version += 1
+        self.node_version += 1
         return row
 
     def remove_node(self, node_id: str) -> None:
@@ -187,6 +191,7 @@ class ClusterTensors:
         self.attrs[row, :] = MISSING
         self.free_rows.append(row)
         self.version += 1
+        self.node_version += 1
 
     # ---- allocations ----
 
@@ -250,20 +255,6 @@ class ClusterTensors:
         self.version += 1
 
     # ---- per-eval vectors ----
-
-    def job_count_vectors(
-        self, job_id: str, task_group: str
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """(job_counts[N], jobtg_counts[N]): live proposed-alloc counts for a
-        job / (job, tg) per node — feeds distinct_hosts (feasible.go:534) and
-        job anti-affinity (rank.go:505)."""
-        jc = np.zeros(self.n_cap, dtype=np.float32)
-        jtc = np.zeros(self.n_cap, dtype=np.float32)
-        for row, tg in self.job_allocs.get(job_id, {}).values():
-            jc[row] += 1
-            if tg == task_group:
-                jtc[row] += 1
-        return jc, jtc
 
     def rows_for_allocs(self, alloc_ids) -> List[Tuple[int, np.ndarray]]:
         out = []

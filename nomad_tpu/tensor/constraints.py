@@ -149,9 +149,15 @@ class CompiledAffinities:
     needs_host: List[Affinity] = field(default_factory=list)
 
 
-def _lut_width(vocab: AttrVocab, pad_to: int) -> int:
-    # Bucket the LUT width to limit recompilation as vocabularies grow.
-    w = max(vocab.max_vocab + 1, 2)
+def _program_width(vocab: AttrVocab, keys: Sequence[int], pad_to: int) -> int:
+    """LUT width for one compiled program: max vocab size among the keys the
+    program actually references, +1 for the missing slot, bucketed to a
+    power of two. Per-program (not global-vocab) width matters: a cluster
+    key with one value per node (e.g. node.unique.name at 10K nodes) would
+    otherwise pad EVERY program's LUTs to ~16K columns — ~50MB of per-batch
+    host→device traffic for programs that only look at small-vocab keys."""
+    w = max((len(vocab.key_vocabs[k]) for k in keys), default=0) + 1
+    w = max(w, 2)
     b = pad_to
     while b < w:
         b *= 2
@@ -172,23 +178,19 @@ def compile_constraints(
     driver checks mirror `DriverChecker` (feasible.go:398) via the tensorizer's
     `__driver.<name>` pseudo-key.
     """
-    rows: List[Tuple[int, np.ndarray]] = []
+    pending: List[Tuple[int, object]] = []  # (key token, fn(value, found))
     needs_host: List[Constraint] = []
     dh_job = False
     dh_tg = False
     dprop: List[Constraint] = []
 
-    width = _lut_width(vocab, lut_bucket)
-    miss = width - 1
-
     def add_lut_row(key: str, fn) -> None:
-        k = vocab.intern_key(key)
-        kv = vocab.key_vocabs[k]
-        row = np.zeros(width, dtype=bool)
-        for tok, value in enumerate(kv.values):
-            row[tok] = fn(value, True)
-        row[miss] = fn(None, False)
-        rows.append((k, row))
+        pending.append((vocab.intern_key(key), fn))
+
+    def add_poison() -> None:
+        # Constant-false: an always-false row on a dummy key
+        pending.append((vocab.intern_key("node.datacenter"),
+                        lambda v, found: False))
 
     if datacenters is not None:
         dcs = set(datacenters)
@@ -213,8 +215,7 @@ def compile_constraints(
             add_lut_row(f"__plugin.csi.{name}",
                         lambda v, found: found and v == "1")
         else:  # missing volume: poison
-            k = vocab.intern_key("node.datacenter")
-            rows.append((k, np.zeros(width, dtype=bool)))
+            add_poison()
 
     for c in constraints:
         if c.operand == CONSTRAINT_DISTINCT_HOSTS:
@@ -233,15 +234,12 @@ def compile_constraints(
             # Literal LTarget: constant verdict — fold in as a 0-or-all row
             verdict = check_constraint(c.operand, c.ltarget, c.rtarget, True, True)
             if not verdict:
-                # Constant-false: poison with an always-false row on a dummy key
-                k = vocab.intern_key("node.datacenter")
-                rows.append((k, np.zeros(width, dtype=bool)))
+                add_poison()
             continue
         if key == "__unresolvable__":
             verdict = check_constraint(c.operand, None, c.rtarget, False, True)
             if not verdict:
-                k = vocab.intern_key("node.datacenter")
-                rows.append((k, np.zeros(width, dtype=bool)))
+                add_poison()
             continue
         add_lut_row(
             key,
@@ -250,9 +248,15 @@ def compile_constraints(
             ),
         )
 
-    if rows:
-        key_idx = np.array([k for k, _ in rows], dtype=np.int32)
-        lut = np.stack([r for _, r in rows])
+    width = _program_width(vocab, [k for k, _ in pending], lut_bucket)
+    miss = width - 1
+    if pending:
+        key_idx = np.array([k for k, _ in pending], dtype=np.int32)
+        lut = np.zeros((len(pending), width), dtype=bool)
+        for i, (k, fn) in enumerate(pending):
+            for tok, value in enumerate(vocab.key_vocabs[k].values):
+                lut[i, tok] = fn(value, True)
+            lut[i, miss] = fn(None, False)
     else:
         key_idx = np.zeros(0, dtype=np.int32)
         lut = np.zeros((0, width), dtype=bool)
@@ -272,9 +276,7 @@ def compile_affinities(
 ) -> CompiledAffinities:
     """Compile affinities into weight LUTs (reference `NodeAffinityIterator`,
     scheduler/rank.go:589: normalized weighted sum of matches)."""
-    width = _lut_width(vocab, lut_bucket)
-    miss = width - 1
-    rows: List[Tuple[int, np.ndarray]] = []
+    pending: List[Tuple[int, object]] = []  # (key token, fn(value, found) → w)
     needs_host: List[Affinity] = []
     sum_abs = 0.0
 
@@ -289,23 +291,25 @@ def compile_affinities(
             lval = a.ltarget if key is None else None
             lfound = key is None
             verdict = check_affinity(a.operand, lval, a.rtarget, lfound, True)
-            row = np.full(width, float(a.weight) if verdict else 0.0, dtype=np.float32)
-            k = vocab.intern_key("node.datacenter")
-            rows.append((k, row))
+            w = float(a.weight) if verdict else 0.0
+            pending.append((vocab.intern_key("node.datacenter"),
+                            lambda v, found, w=w: w))
             continue
-        k = vocab.intern_key(key)
-        kv = vocab.key_vocabs[k]
-        row = np.zeros(width, dtype=np.float32)
-        for tok, value in enumerate(kv.values):
-            if check_affinity(a.operand, value, a.rtarget, True, True):
-                row[tok] = float(a.weight)
-        if check_affinity(a.operand, None, a.rtarget, False, True):
-            row[miss] = float(a.weight)
-        rows.append((k, row))
+        pending.append((
+            vocab.intern_key(key),
+            lambda v, found, op=a.operand, r=a.rtarget, w=float(a.weight):
+                w if check_affinity(op, v, r, found, True) else 0.0,
+        ))
 
-    if rows:
-        key_idx = np.array([k for k, _ in rows], dtype=np.int32)
-        lut = np.stack([r for _, r in rows])
+    width = _program_width(vocab, [k for k, _ in pending], lut_bucket)
+    miss = width - 1
+    if pending:
+        key_idx = np.array([k for k, _ in pending], dtype=np.int32)
+        lut = np.zeros((len(pending), width), dtype=np.float32)
+        for i, (k, fn) in enumerate(pending):
+            for tok, value in enumerate(vocab.key_vocabs[k].values):
+                lut[i, tok] = fn(value, True)
+            lut[i, miss] = fn(None, False)
     else:
         key_idx = np.zeros(0, dtype=np.int32)
         lut = np.zeros((0, width), dtype=np.float32)

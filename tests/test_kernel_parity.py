@@ -239,3 +239,54 @@ class TestKernelParity:
 
         job.constraints.append(Constraint("${attr.special}", "", "is_set"))
         self._run_case(job, mutate_nodes=mutate)
+
+
+class TestProgramCache:
+    """The static half of a placement program is cached per job version and
+    must survive alloc churn, but be invalidated by vocab growth and — for
+    host-evaluated constraints — node-set changes."""
+
+    def test_cache_hit_survives_alloc_churn(self):
+        from nomad_tpu import mock
+        from nomad_tpu.scheduler.stack import TPUStack
+        from nomad_tpu.synth import build_synthetic_state, synth_service_job
+        import random
+
+        state, nodes = build_synthetic_state(8, 4, seed=5)
+        job = synth_service_job(random.Random(1), count=2)
+        state.upsert_job(job)
+        stack = TPUStack(state.cluster)
+        tg = job.task_groups[0]
+        stack.compile_tg(job, tg, 2)
+        ent1 = stack._prog_cache[next(iter(stack._prog_cache))]
+        # alloc churn bumps cluster.version but not node_version
+        alloc = mock.alloc(job=job, node_id=nodes[0].id)
+        state.cluster.upsert_alloc(alloc)
+        stack.compile_tg(job, tg, 2)
+        ent2 = stack._prog_cache[next(iter(stack._prog_cache))]
+        assert ent1 is ent2  # same compiled object: cache hit
+
+    def test_cache_invalidated_by_vocab_growth(self):
+        from nomad_tpu.scheduler.stack import TPUStack
+        from nomad_tpu.synth import build_synthetic_state
+        from nomad_tpu.structs import Constraint
+        from nomad_tpu import mock
+        import random
+        from nomad_tpu.synth import synth_service_job
+
+        state, nodes = build_synthetic_state(8, 0, seed=6)
+        job = synth_service_job(random.Random(2), count=1)
+        job.constraints = [Constraint(ltarget="${node.class}", operand="=",
+                                      rtarget=nodes[0].node_class)]
+        state.upsert_job(job)
+        stack = TPUStack(state.cluster)
+        tg = job.task_groups[0]
+        stack.compile_tg(job, tg, 1)
+        ent1 = stack._prog_cache[next(iter(stack._prog_cache))]
+        # new node with a brand-new class value grows the key's vocab
+        n = mock.node()
+        n.node_class = "never-seen-class-xyz"
+        state.upsert_node(n)
+        stack.compile_tg(job, tg, 1)
+        ent2 = stack._prog_cache[next(iter(stack._prog_cache))]
+        assert ent1 is not ent2  # recompiled with wider LUT
