@@ -93,9 +93,17 @@ def bench_tpu(state, jobs, stack, count: int, batch: int) -> float:
     return rate
 
 
-def bench_oracle(state, nodes, jobs, count: int, n_evals: int) -> float:
+def bench_oracle(state, nodes, jobs, stack, count: int, n_evals: int,
+                 parity: bool = True):
     """Scalar oracle path (the measured baseline): full-node-scan Select per
-    alloc, sequential, exactly the per-node math of the reference chain."""
+    alloc, sequential, exactly the per-node math of the reference chain.
+
+    With `parity`, the same evals also run through the TPU kernel
+    (`stack.select`, identical snapshot + plan threading) and per-step
+    normalized scores / node choices are compared — the north star's
+    ≤1%-deviation half (reference normalization rank.go:696-710). Both
+    sides are exact full-scan argmax, so disagreement can only come from
+    fp associativity or ties."""
     from nomad_tpu.mock import alloc_resources
     from nomad_tpu.scheduler.oracle import OracleContext, select_option
     from nomad_tpu.structs import Allocation
@@ -103,14 +111,30 @@ def bench_oracle(state, nodes, jobs, count: int, n_evals: int) -> float:
     allocs_by_node = {
         nid: list(d.values()) for nid, d in state._allocs_by_node.items()
     }
+    devs = []
+    agree = 0
+    steps = 0
     t0 = time.time()
     total = 0
     for job in jobs[:n_evals]:
         ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
         tg = job.task_groups[0]
         res = job.combined_task_resources(tg)
-        for _ in range(count):
+        sel = stack.select(job, tg, count) if parity else None
+        for step in range(count):
             opt = select_option(ctx, job, tg)
+            if sel is not None:
+                k_node = sel.node_ids[step]
+                k_score = sel.scores[step]
+                steps += 1
+                if opt is None:
+                    agree += k_node is None
+                else:
+                    devs.append(abs(k_score - opt.final_score))
+                    # ties count as agreement: equal-score nodes are
+                    # interchangeable under the reference's shuffle
+                    agree += (k_node == opt.node.id
+                              or abs(k_score - opt.final_score) <= 1e-5)
             if opt is None:
                 continue
             fake = Allocation(
@@ -126,7 +150,21 @@ def bench_oracle(state, nodes, jobs, count: int, n_evals: int) -> float:
     dt = time.time() - t0
     rate = total / dt
     log(f"oracle: {total} evals in {dt:.2f}s = {rate:.3f} evals/s")
-    return rate
+    stats = None
+    if parity and steps:
+        stats = {
+            "score_deviation_pct": round(100.0 * (
+                sum(devs) / len(devs) if devs else 0.0), 4),
+            "score_deviation_max_pct": round(
+                100.0 * (max(devs) if devs else 0.0), 4),
+            "node_agreement_pct": round(100.0 * agree / steps, 2),
+            "parity_evals": total,
+        }
+        log(f"parity: {stats['parity_evals']} evals / {steps} placements: "
+            f"mean score dev {stats['score_deviation_pct']}% "
+            f"max {stats['score_deviation_max_pct']}% "
+            f"node agreement {stats['node_agreement_pct']}%")
+    return rate, stats
 
 
 def main() -> None:
@@ -135,7 +173,8 @@ def main() -> None:
     n_evals = int(os.environ.get("NOMAD_TPU_BENCH_EVALS", 1024))
     batch = int(os.environ.get("NOMAD_TPU_BENCH_BATCH", 128))
     count = int(os.environ.get("NOMAD_TPU_BENCH_COUNT", 8))
-    oracle_evals = int(os.environ.get("NOMAD_TPU_BENCH_ORACLE_EVALS", 3))
+    oracle_evals = int(os.environ.get("NOMAD_TPU_BENCH_ORACLE_EVALS", 64))
+    parity = os.environ.get("NOMAD_TPU_BENCH_PARITY", "1") != "0"
 
     import jax
 
@@ -153,14 +192,18 @@ def main() -> None:
     state, nodes, jobs, stack = build(n_nodes, n_allocs, n_evals + batch, count)
 
     tpu_rate = bench_tpu(state, jobs, stack, count, batch)
-    oracle_rate = bench_oracle(state, nodes, jobs, count, oracle_evals)
+    oracle_rate, parity_stats = bench_oracle(
+        state, nodes, jobs, stack, count, oracle_evals, parity=parity)
 
-    print(json.dumps({
+    out = {
         "metric": f"service_evals_per_sec_{n_nodes}_nodes",
         "value": round(tpu_rate, 2),
         "unit": "evals/s",
         "vs_baseline": round(tpu_rate / oracle_rate, 2) if oracle_rate else None,
-    }))
+    }
+    if parity_stats:
+        out.update(parity_stats)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -13,8 +13,10 @@ This is the dense-SPMD re-expression of the reference's evaluation hot loop
       feasibility = AND of LUT-gather masks           [N]
       score       = fused binpack + conditional aux terms, mean-normalized
       select      = argmax over N (exact; beats the log₂(n) sample — a
-                    documented better-scoring deviation, sampled mode kept
-                    for strict Go parity)
+                    documented better-scoring deviation). Sampled mode
+                    (`cand_idx`/`use_cand`) restricts selection to a
+                    host-shuffled candidate subset shared with the oracle's
+                    `sampled=` mode, so strict parity runs are well-defined.
       multi-alloc = lax.scan carrying (used, counts) so successive allocs of
                     one group see each other (reference: plan-relative
                     ProposedAllocs, context.go:120)
@@ -75,6 +77,21 @@ class TGParams(NamedTuple):
     # plan-relative resource deltas (stops/preemptions), sparse scatter
     delta_idx: jax.Array         # i32[D] — node row or −1
     delta_res: jax.Array         # f32[D, R] — resources to subtract
+    # sampled-candidate mode (stack.go:77-89 log₂(n) limit analog): when
+    # use_cand, selection is restricted to the cand_idx node rows — the
+    # SAME host-shuffled subset the oracle's sampled mode scans, so strict
+    # kernel-vs-oracle parity is well-defined (−1 rows are padding)
+    cand_idx: jax.Array          # i32[L]
+    use_cand: jax.Array          # bool
+    # distinct_property program (feasible.go:569 DistinctPropertyIterator,
+    # propertyset.go:14): per-constraint value-count tables; a node is
+    # feasible iff count[value] < allowed for every active constraint and
+    # the property resolves (missing ⇒ infeasible). Counts update in-scan
+    # as allocs place (PopulateProposed analog).
+    dp_key_idx: jax.Array        # i32[P]
+    dp_allowed: jax.Array        # f32[P] — RTarget count (default 1)
+    dp_counts0: jax.Array        # f32[P, V] — existing+plan combined use
+    dp_active: jax.Array         # bool[P]
     # spread program
     spread_key_idx: jax.Array    # i32[S]
     spread_weight: jax.Array     # f32[S] — weight/ΣW (target mode)
@@ -214,6 +231,10 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
     # ---- static (per-group) feasibility, computed once ----
     feas_c = _lut_gather(p.lut, p.key_idx, cluster.attrs)          # [N, C] bool
     feas = cluster.node_ok & p.extra_mask & jnp.all(feas_c, axis=1)
+    if p.cand_idx.shape[0]:
+        in_cand = jnp.any(p.cand_idx[:, None] == jnp.arange(n)[None, :],
+                          axis=0)
+        feas = feas & (in_cand | ~p.use_cand)
 
     aff_vals = _lut_gather(p.aff_lut, p.aff_key_idx, cluster.attrs)  # [N, A] f32
     aff_score = jnp.sum(aff_vals, axis=1) * p.aff_inv_sum            # [N]
@@ -225,6 +246,14 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
     else:
         stok = jnp.zeros((n, 0), dtype=jnp.int32)
         stok_oh = jnp.zeros((n, 0, s_v), dtype=jnp.float32)
+
+    d_v = p.dp_counts0.shape[1]
+    if p.dp_key_idx.shape[0]:
+        dtok = _select_tokens(cluster.attrs, p.dp_key_idx, d_v)  # [N, P]
+        dtok_oh = _onehot_tokens(dtok, d_v)        # [N, P, V]
+    else:
+        dtok = jnp.zeros((n, 0), dtype=jnp.int32)
+        dtok_oh = jnp.zeros((n, 0, d_v), dtype=jnp.float32)
 
     # plan-relative deltas (stopped/preempted allocs release resources);
     # comparison-einsum instead of scatter (−1 pads match no row)
@@ -238,7 +267,7 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
     def step(carry, xs):
         i, pen_idx, pref_idx = xs
-        used, job_cnt, tg_cnt, scounts = carry
+        used, job_cnt, tg_cnt, scounts, dcounts = carry
         active = i < p.n_place
 
         # per-step reschedule penalty nodes (rank.go:570 SetPenaltyNodes);
@@ -249,6 +278,15 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         fits = jnp.all(util <= cap, axis=1)
         ok = feas & fits
         ok = ok & ~(p.distinct_hosts & (job_cnt > 0))
+
+        # distinct_property (propertyset.go:214 SatisfiesDistinctProperties):
+        # feasible iff use count of the node's value < allowed, and the
+        # property resolves (missing slot ⇒ infeasible) — per active row
+        if dcounts.shape[0]:
+            cur_d = jnp.einsum("npv,pv->np", dtok_oh, dcounts)  # [N, P]
+            dp_row_ok = ((cur_d < p.dp_allowed[None, :])
+                         & (dtok != d_v - 1)) | ~p.dp_active[None, :]
+            ok = ok & jnp.all(dp_row_ok, axis=1)
 
         # ---- fused scoring (rank.go semantics) ----
         binpack, spreadfit = fit_scores(util, cap)
@@ -298,9 +336,16 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
                 sel_tok, scounts.shape[1], dtype=scounts.dtype,
             ) * valid[:, None]
             scounts = scounts + upd
+        if dcounts.shape[0]:
+            sel_dtok = dtok[idx]                    # [P]
+            dvalid = (sel_dtok != dcounts.shape[1] - 1) & found
+            dupd = jax.nn.one_hot(
+                sel_dtok, dcounts.shape[1], dtype=dcounts.dtype,
+            ) * dvalid[:, None]
+            dcounts = dcounts + dupd
 
         n_fit = jnp.sum((feas & fits).astype(jnp.int32))
-        return (used, job_cnt, tg_cnt, scounts), (
+        return (used, job_cnt, tg_cnt, scounts, dcounts), (
             sel,
             jnp.where(found, final[idx], 0.0),
             n_fit,
@@ -315,9 +360,9 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         "jn,j->n",
         (p.jtc_idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32),
         p.jtc_val)
-    init = (used0, job_cnt0, tg_cnt0, p.spread_counts0)
+    init = (used0, job_cnt0, tg_cnt0, p.spread_counts0, p.dp_counts0)
     xs = (jnp.arange(max_allocs), p.penalty_idx, p.preferred_idx)
-    (used_f, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
+    (used_f, _, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
         step, init, xs
     )
     return PlacementResult(
@@ -345,12 +390,12 @@ def place_task_group_jit(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
 _PACK_I32 = ("n_place", "algorithm", "key_idx", "aff_key_idx", "penalty_idx",
              "preferred_idx", "jc_idx", "jtc_idx", "delta_idx",
-             "spread_key_idx")
+             "cand_idx", "dp_key_idx", "spread_key_idx")
 _PACK_F32 = ("ask", "desired_count", "aff_lut", "aff_inv_sum", "jc_val",
-             "jtc_val", "delta_res", "spread_weight", "spread_desired",
-             "spread_counts0")
-_PACK_U8 = ("lut", "extra_mask", "distinct_hosts", "spread_has_targets",
-            "spread_active")
+             "jtc_val", "delta_res", "dp_allowed", "dp_counts0",
+             "spread_weight", "spread_desired", "spread_counts0")
+_PACK_U8 = ("lut", "extra_mask", "distinct_hosts", "use_cand", "dp_active",
+            "spread_has_targets", "spread_active")
 
 
 def pack_params(batch: TGParams):

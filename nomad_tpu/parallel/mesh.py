@@ -126,6 +126,8 @@ def pad_params(params_list: Sequence[TGParams]
     j_n = _bucket(max(p.jc_idx.shape[0] for p in ps))
     j2_n = _bucket(max(p.jtc_idx.shape[0] for p in ps))
     e_n = max(p.extra_mask.shape[0] for p in ps)
+    l_n = _bucket(max(p.cand_idx.shape[0] for p in ps))
+    dp_n = _bucket(max(p.dp_key_idx.shape[0] for p in ps))
 
     out = []
     for p in ps:
@@ -150,6 +152,11 @@ def pad_params(params_list: Sequence[TGParams]
             jc_val=_pad_rows(p.jc_val, j_n, 0.0),
             jtc_idx=_pad_rows(p.jtc_idx, j2_n, -1),
             jtc_val=_pad_rows(p.jtc_val, j2_n, 0.0),
+            cand_idx=_pad_rows(p.cand_idx, l_n, -1),
+            dp_key_idx=_pad_rows(p.dp_key_idx, dp_n, 0),
+            dp_allowed=_pad_rows(p.dp_allowed, dp_n, 0.0),
+            dp_counts0=_pad_rows(_widen_v(p.dp_counts0, v, 0.0), dp_n, 0.0),
+            dp_active=_pad_rows(p.dp_active, dp_n, False),
             delta_idx=_pad_rows(p.delta_idx, d_n, -1),
             delta_res=_pad_rows(p.delta_res, d_n, 0.0),
             spread_key_idx=_pad_rows(p.spread_key_idx, s_n, 0),

@@ -28,7 +28,8 @@ from ..structs import (
     score_fit_binpack,
     score_fit_spread,
 )
-from ..structs.job import CONSTRAINT_DISTINCT_HOSTS
+from ..structs.job import (CONSTRAINT_DISTINCT_HOSTS,
+                           CONSTRAINT_DISTINCT_PROPERTY)
 from ..tensor.constraints import check_affinity, check_constraint
 from ..tensor.vocab import target_to_key
 
@@ -136,10 +137,14 @@ def select_option(
     algorithm: str = "binpack",
     sampled: Optional[int] = None,
     csi_volumes: Optional[dict] = None,
+    candidates: Optional[List[Node]] = None,
 ) -> Optional[OracleOption]:
     """One Select(): returns the best-scoring feasible node or None.
 
     Mirrors GenericStack.Select (stack.go:116) with exact (full-scan) limit.
+    `sampled=K` scans only the first K of ctx.nodes; `candidates` scans an
+    explicit (host-shuffled) subset — pass the same rows to the kernel's
+    sampled mode (`TPUStack.select(sampled_rows=...)`) for strict parity.
     """
     penalty_nodes = penalty_nodes or set()
     combined_constraints = list(job.constraints) + list(tg.constraints)
@@ -157,6 +162,25 @@ def select_option(
     for t in tg.tasks:
         affinities.extend(t.affinities)
 
+    # distinct_property sets (DistinctPropertyIterator feasible.go:569:
+    # job-level from job.constraints, tg-level from tg.constraints;
+    # propertyset.go combined use maps built once per Select)
+    dp_sets: List[Tuple[Optional[str], Optional[float], bool]] = []
+    for c, tg_scope in ([(c, False) for c in job.constraints]
+                        + [(c, True) for c in tg.constraints]):
+        if c.operand != CONSTRAINT_DISTINCT_PROPERTY:
+            continue
+        allowed: Optional[float] = 1.0
+        if c.rtarget:
+            try:
+                allowed = float(int(c.rtarget))
+                if allowed < 0:
+                    allowed = None
+            except ValueError:
+                allowed = None  # unparsable RTarget ⇒ nothing feasible
+        dp_sets.append((c.ltarget, allowed, tg_scope))
+    dp_use_maps: Optional[List[Dict[str, int]]] = None
+
     ask = job.combined_task_resources(tg)
 
     spreads = list(tg.spreads) + list(job.spreads)
@@ -165,7 +189,8 @@ def select_option(
     # Per-select spread use maps (reference propertySet counts are maintained
     # incrementally, propertyset.go:132; build once per Select, not per node)
     spread_use_maps: Optional[List[Dict[str, int]]] = None
-    candidates = ctx.nodes if sampled is None else ctx.nodes[:sampled]
+    if candidates is None:
+        candidates = ctx.nodes if sampled is None else ctx.nodes[:sampled]
     for node in candidates:
         if not node.ready():
             continue
@@ -190,6 +215,28 @@ def select_option(
                     collision = True
                     break
             if collision:
+                continue
+
+        # DistinctProperty (feasible.go:569 via propertyset.go:214)
+        if dp_sets:
+            if dp_use_maps is None:
+                dp_use_maps = [
+                    _dp_use_map(ctx, job, tg, ltarget, tg_scope)
+                    for ltarget, _a, tg_scope in dp_sets
+                ]
+            dp_ok = True
+            for (ltarget, allowed, _scope), use in zip(dp_sets, dp_use_maps):
+                if allowed is None:
+                    dp_ok = False
+                    break
+                val, ok = resolve_target(ltarget, node)
+                if not ok:
+                    dp_ok = False  # missing property ⇒ infeasible
+                    break
+                if use.get(val, 0) >= allowed:
+                    dp_ok = False
+                    break
+            if not dp_ok:
                 continue
 
         # BinPack fit + score (rank.go:188)
@@ -263,6 +310,52 @@ def select_option(
         if best is None or final > best.final_score:
             best = OracleOption(node=node, final_score=final, scores=scores)
     return best
+
+
+def _dp_use_map(ctx: OracleContext, job: Job, tg: TaskGroup,
+                ltarget: str, tg_scope: bool) -> Dict[str, int]:
+    """Combined distinct_property use map (propertyset.go:250
+    GetCombinedUseMap): existing non-terminal allocs of the job[/tg] plus
+    plan placements, discounted by plan stops (clamped at 0, with the
+    proposed-reuse adjustment :196-207). Values are the nodes' resolved
+    property values — a literal LTarget resolves to itself on every node."""
+    node_by_id = {n.id: n for n in ctx.nodes}
+
+    def count(allocs_of_node, filter_terminal: bool) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for nid, allocs in allocs_of_node.items():
+            node = node_by_id.get(nid)
+            if node is None:
+                continue
+            val, ok = resolve_target(ltarget, node)
+            if not ok:
+                continue
+            for a in allocs:
+                if a.job_id != job.id:
+                    continue
+                if filter_terminal and a.terminal_status():
+                    continue
+                if tg_scope and a.task_group != tg.name:
+                    continue
+                out[val] = out.get(val, 0) + 1
+        return out
+
+    existing = count(ctx.allocs_by_node, True)
+    proposed = count(ctx.plan_node_alloc, True)
+    cleared = count(ctx.plan_node_update, False)
+    for val in proposed:
+        cur = cleared.get(val)
+        if cur is None:
+            continue
+        if cur == 0:
+            del cleared[val]
+        elif cur > 1:
+            cleared[val] = cur - 1
+    combined: Dict[str, int] = {}
+    for val in set(existing) | set(proposed):
+        combined[val] = max(existing.get(val, 0) + proposed.get(val, 0)
+                            - cleared.get(val, 0), 0)
+    return combined
 
 
 def _spread_use_map(ctx: OracleContext, job: Job, tg: TaskGroup, key: str

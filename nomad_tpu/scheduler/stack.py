@@ -18,7 +18,8 @@ import numpy as np
 from ..kernels.placement import ClusterArrays, PlacementResult, TGParams
 from ..utils import bucket as _shared_bucket, widen_lut
 from ..structs import Allocation, Job, TaskGroup
-from ..structs.job import CONSTRAINT_DISTINCT_HOSTS
+from ..structs.job import (CONSTRAINT_DISTINCT_HOSTS,
+                           CONSTRAINT_DISTINCT_PROPERTY)
 from ..tensor.cluster import R_TOTAL, ClusterTensors
 from ..tensor.constraints import (
     CompiledAffinities,
@@ -98,11 +99,15 @@ class TPUStack:
         plan: Optional[PlanContext] = None,
         max_allocs: Optional[int] = None,
         volumes: Optional[list] = None,
+        sampled_rows: Optional[Sequence[int]] = None,
     ) -> Tuple[TGParams, int]:
         """Build TGParams (numpy; converted on dispatch). `volumes` are
         pre-resolved feasibility entries from the scheduler (host/csi —
         the scheduler resolves CSI volume ids against state because the
-        stack itself is stateless; see constraints.compile_constraints)."""
+        stack itself is stateless; see constraints.compile_constraints).
+        `sampled_rows` restricts selection to those node rows (the log₂(n)
+        limit-iterator analog, stack.go:77-89) — pass the same shuffled
+        subset to the oracle's `sampled=` mode for strict parity."""
         plan = plan or PlanContext()
         cl = self.cluster
 
@@ -181,9 +186,25 @@ class TPUStack:
                 if row is not None:
                     preferred_idx[i] = row
 
+        # sampled-candidate restriction
+        if sampled_rows is not None:
+            cand_idx = np.full(_bucket(max(len(sampled_rows), 1)), -1,
+                               dtype=np.int32)
+            for i, row in enumerate(sampled_rows):
+                cand_idx[i] = row
+            use_cand = np.bool_(True)
+        else:
+            cand_idx = np.full(1, -1, dtype=np.int32)
+            use_cand = np.bool_(False)
+
         # spread program: cached static tables + per-eval counts
         sp = prog["sp_static"]
         sp_counts0 = self._spread_counts(job, tg, prog, plan)
+
+        # distinct_property: per-constraint combined use counts
+        # (propertyset.go:250 GetCombinedUseMap) + constant-LTarget clamp
+        dp_key_idx, dp_allowed, dp_active, dp_counts0, n_place = \
+            self._dp_program(job, tg, prog, plan, n_place)
 
         params = TGParams(
             ask=prog["ask"],
@@ -205,6 +226,12 @@ class TPUStack:
             jtc_val=jtc_val,
             delta_idx=delta_idx,
             delta_res=delta_res,
+            cand_idx=cand_idx,
+            use_cand=use_cand,
+            dp_key_idx=dp_key_idx,
+            dp_allowed=dp_allowed,
+            dp_counts0=dp_counts0,
+            dp_active=dp_active,
             spread_key_idx=sp[0],
             spread_weight=sp[1],
             spread_has_targets=sp[2],
@@ -225,9 +252,9 @@ class TPUStack:
         LUT build ran once per eval per batch before caching."""
         cl = self.cluster
         vocab = cl.vocab
-        key = (job.id, job.version, job.modify_index, tg.name,
-               tuple(volumes) if volumes else ())
-        ent = self._prog_cache.get(key)
+        cache_key = (job.id, job.version, job.modify_index, tg.name,
+                     tuple(volumes) if volumes else ())
+        ent = self._prog_cache.get(cache_key)
         if ent is not None:
             sizes = tuple(len(vocab.key_vocabs[k]) for k in ent["used_keys"])
             fresh = (sizes == ent["vocab_sizes"]
@@ -263,6 +290,41 @@ class TPUStack:
             k = vocab.intern_key(skey)
             spread_keys.append(k)
             spread_w = max(spread_w, len(vocab.key_vocabs[k]) + 1)
+
+        # distinct_property specs (feasible.go:588-622: job-level from
+        # job.constraints, tg-level from tg.constraints; propertyset.go:82:
+        # RTarget count, default 1, unparsable ⇒ nothing feasible).
+        # Constant (non-interpolated) LTargets resolve to one shared value
+        # for every node (resolveTarget on a literal), capping TOTAL
+        # placements — handled as spec key None.
+        dp_specs: List[Tuple[Optional[int], float, bool]] = []
+        for c, tg_scope in ([(c, False) for c in job.constraints]
+                            + [(c, True) for c in tg.constraints]):
+            if c.operand != CONSTRAINT_DISTINCT_PROPERTY:
+                continue
+            allowed = 1.0
+            valid = True
+            if c.rtarget:
+                try:
+                    allowed = float(int(c.rtarget))
+                    valid = allowed >= 0
+                except ValueError:
+                    valid = False
+            key = target_to_key(c.ltarget)
+            if not valid:
+                # unparsable RTarget: every node fails the check
+                dp_specs.append((vocab.intern_key("node.datacenter"),
+                                 0.0, tg_scope))
+            elif key is None or key == "__unresolvable__":
+                lit = key is None  # literal resolves; unknown interp doesn't
+                dp_specs.append((None if lit else
+                                 vocab.intern_key("node.datacenter"),
+                                 allowed if lit else 0.0, tg_scope))
+            else:
+                k = vocab.intern_key(key)
+                dp_specs.append((k, allowed, tg_scope))
+                spread_w = max(spread_w, len(vocab.key_vocabs[k]) + 1)
+
         v = max(cc.lut.shape[1] if cc.lut.size else 2,
                 ca.lut.shape[1] if ca.lut.size else 2,
                 _bucket(spread_w, 2))
@@ -312,12 +374,13 @@ class TPUStack:
 
         used_keys = tuple(
             sorted({int(k) for k in cc.key_idx}
-                   | {int(k) for k in ca.key_idx} | set(spread_keys)))
+                   | {int(k) for k in ca.key_idx} | set(spread_keys)
+                   | {k for k, _a, _s in dp_specs if k is not None}))
         ent = {
             "cc": cc, "ca": ca, "v": v,
             "feas_lut": feas_lut, "aff_lut": aff_lut,
             "spreads": spreads, "spread_keys": spread_keys,
-            "sp_static": sp_static,
+            "sp_static": sp_static, "dp_specs": dp_specs,
             "dh_job": dh_job, "distinct": distinct,
             "extra": extra, "host_dep": host_dep,
             "ask": ask,
@@ -329,7 +392,7 @@ class TPUStack:
         }
         if len(self._prog_cache) >= self._prog_cache_max:
             self._prog_cache.pop(next(iter(self._prog_cache)))
-        self._prog_cache[key] = ent
+        self._prog_cache[cache_key] = ent
         return ent
 
     def _device_ask_col(self, name: str) -> Optional[int]:
@@ -345,6 +408,92 @@ class TPUStack:
             ):
                 return col
         return None
+
+    def _dp_program(self, job, tg, prog: dict, plan: PlanContext,
+                    n_place: int):
+        """distinct_property dynamic state: combined use counts per value
+        token (existing − plan stops + plan placements, with the
+        propertyset.go:196-207 cleared-value adjustment). Constant-LTarget
+        specs share one value across all nodes, so they clamp the number
+        of placements instead of masking nodes."""
+        cl = self.cluster
+        v = prog["v"]
+        specs = prog["dp_specs"]
+        pb = _bucket(max(len(specs), 1))
+        key_idx = np.zeros(pb, dtype=np.int32)
+        allowed = np.zeros(pb, dtype=np.float32)
+        active = np.zeros(pb, dtype=bool)
+        counts0 = np.zeros((pb, v), dtype=np.float32)
+        if not specs:
+            return key_idx, allowed, active, counts0, n_place
+
+        def use_counts(k: Optional[int], tg_scope: bool):
+            existing: Dict[int, float] = {}
+            proposed: Dict[int, float] = {}
+            cleared: Dict[int, float] = {}
+
+            def tok_of(row: Optional[int]):
+                if k is None:   # constant property: one shared value
+                    return 0
+                if row is None:
+                    return None
+                t = int(cl.attrs[row, k])
+                return None if t == MISSING else t
+
+            for row, tgname in cl.job_allocs.get(job.id, {}).values():
+                if tg_scope and tgname != tg.name:
+                    continue
+                t = tok_of(row)
+                if t is not None:
+                    existing[t] = existing.get(t, 0) + 1
+            for node_id, tgname, _u in plan.placed:
+                if tg_scope and tgname != tg.name:
+                    continue
+                t = tok_of(cl.row_of.get(node_id))
+                if t is not None:
+                    proposed[t] = proposed.get(t, 0) + 1
+            # NB: stops only, NOT preemptions — the reference's propertyset
+            # gathers cleared values from Plan().NodeUpdate alone
+            # (propertyset.go:166-171), unlike ProposedAllocs/distinct_hosts
+            # which also removes NodePreemptions (context.go:134-138)
+            for a in plan.stopped_allocs:
+                if a.job_id != job.id or (tg_scope
+                                          and a.task_group != tg.name):
+                    continue
+                t = tok_of(cl.row_of.get(a.node_id))
+                if t is not None:
+                    cleared[t] = cleared.get(t, 0) + 1
+            # proposed re-use discounts cleared (propertyset.go:196-207)
+            for t in proposed:
+                cur = cleared.get(t)
+                if cur is None:
+                    continue
+                if cur == 0:
+                    del cleared[t]
+                elif cur > 1:
+                    cleared[t] = cur - 1
+            out: Dict[int, float] = {}
+            for t in set(existing) | set(proposed):
+                out[t] = max(existing.get(t, 0) + proposed.get(t, 0)
+                             - cleared.get(t, 0), 0)
+            return out
+
+        i = 0
+        for k, allow, tg_scope in specs:
+            use = use_counts(k, tg_scope)
+            if k is None:
+                # constant value: cap total placements at allowed − used
+                remaining = int(max(allow - use.get(0, 0), 0))
+                n_place = min(n_place, remaining)
+                continue
+            key_idx[i] = k
+            allowed[i] = allow
+            active[i] = True
+            for t, cnt in use.items():
+                if t < v:
+                    counts0[i, t] = cnt
+            i += 1
+        return key_idx, allowed, active, counts0, n_place
 
     def _compile_spreads_static(self, tg, spreads, spread_keys, v: int):
         """Plan-independent spread tables: key indices, normalized weights,
@@ -425,11 +574,13 @@ class TPUStack:
         n_place: int,
         plan: Optional[PlanContext] = None,
         volumes: Optional[list] = None,
+        sampled_rows: Optional[Sequence[int]] = None,
     ) -> SelectResult:
         """Place `n_place` allocs of one task group. One kernel dispatch."""
         from ..kernels.placement import place_task_group, place_task_group_jit
 
-        params, m = self.compile_tg(job, tg, n_place, plan, volumes=volumes)
+        params, m = self.compile_tg(job, tg, n_place, plan, volumes=volumes,
+                                    sampled_rows=sampled_rows)
         arrays = self.device_arrays()
         if self._jit:
             result = place_task_group_jit(arrays, _to_device(params), m)

@@ -235,12 +235,44 @@ class SystemScheduler:
             feas_mask, mask = system_feasibility(arrays, _to_device(params))
             feas_mask, mask = np.asarray(feas_mask), np.asarray(mask)
 
+            # distinct_property tracking (SystemStack includes the
+            # DistinctPropertyIterator too, stack.go:248): counts update
+            # as this loop places, host-side since placement here is
+            # per-node scalar
+            from ..tensor.vocab import MISSING
+
+            dp_active = np.asarray(params.dp_active)
+            dp_keys = np.asarray(params.dp_key_idx)
+            dp_allowed = np.asarray(params.dp_allowed)
+            dcounts = np.array(params.dp_counts0)
+            has_dp = bool(dp_active.any())
+            budget = int(params.n_place)  # < len(entries) iff constant-
+            #                               LTarget dp caps total placements
+
             for node_id, prev in entries:
                 row = self.cluster.row_of.get(node_id)
                 ok = row is not None and bool(mask[row])
+                # distinct_property gates BOTH normal and preemption
+                # placements; check before deciding to preempt, so a
+                # dp-infeasible node never evicts victims
+                dp_ok = True
+                dp_toks: List[Tuple[int, int]] = []
+                if row is not None and has_dp:
+                    for i in range(len(dp_keys)):
+                        if not dp_active[i]:
+                            continue
+                        tok = int(self.cluster.attrs[row, dp_keys[i]])
+                        if tok == MISSING or tok >= dcounts.shape[1] \
+                                or dcounts[i, tok] >= dp_allowed[i]:
+                            dp_ok = False
+                            break
+                        dp_toks.append((i, tok))
+                dp_ok = dp_ok and budget > 0
+                ok = ok and dp_ok
                 victims: List[Allocation] = []
                 if (
                     not ok
+                    and dp_ok
                     and row is not None
                     and bool(feas_mask[row])
                     and self.preemption_enabled
@@ -294,4 +326,7 @@ class SystemScheduler:
                 if prev is not None:
                     alloc.previous_allocation = prev.id
                 self.plan.append_alloc(alloc)
+                budget -= 1
+                for i, tok in dp_toks:
+                    dcounts[i, tok] += 1
         return None

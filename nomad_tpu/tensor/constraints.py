@@ -132,7 +132,6 @@ class CompiledConstraints:
     needs_host: List[Constraint] = field(default_factory=list)
     distinct_hosts_job: bool = False
     distinct_hosts_tg: bool = False
-    distinct_property: List[Constraint] = field(default_factory=list)
 
 
 @dataclass
@@ -182,7 +181,6 @@ def compile_constraints(
     needs_host: List[Constraint] = []
     dh_job = False
     dh_tg = False
-    dprop: List[Constraint] = []
 
     def add_lut_row(key: str, fn) -> None:
         pending.append((vocab.intern_key(key), fn))
@@ -222,7 +220,8 @@ def compile_constraints(
             dh_job = True  # caller splits job vs tg level
             continue
         if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
-            dprop.append(c)
+            # enforced by the scheduler stack's dp program
+            # (stack.py _dp_program / kernel dp_counts), not a LUT row
             continue
         key = target_to_key(c.ltarget)
         rkey = target_to_key(c.rtarget)
@@ -265,7 +264,6 @@ def compile_constraints(
         lut=lut,
         needs_host=needs_host,
         distinct_hosts_job=dh_job,
-        distinct_property=dprop,
     )
 
 

@@ -244,6 +244,30 @@ class TestSystemSched:
         assert all(a.node_id != bad.id for a in out)
 
 
+    def test_distinct_property_limits_per_value(self):
+        """System jobs honor distinct_property too (SystemStack includes
+        the DistinctPropertyIterator, reference stack.go:248)."""
+        from nomad_tpu.structs import Constraint
+
+        h = Harness()
+        nodes = register_nodes(h, 6)
+        for i, n in enumerate(nodes):
+            n.attributes = dict(n.attributes, rack=f"r{i % 3}")
+            h.state.upsert_node(n)
+        job = mock.system_job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        out = h.state.allocs_by_job("default", job.id)
+        assert len(out) == 3  # one per rack, not one per node
+        racks = set()
+        for a in out:
+            node = h.state.node_by_id(a.node_id)
+            racks.add(node.attributes["rack"])
+        assert len(racks) == 3
+
+
 class TestBatchSched:
     def test_batch_complete_not_replaced(self):
         h = Harness()

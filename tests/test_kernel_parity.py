@@ -290,3 +290,195 @@ class TestProgramCache:
         stack.compile_tg(job, tg, 1)
         ent2 = stack._prog_cache[next(iter(stack._prog_cache))]
         assert ent1 is not ent2  # recompiled with wider LUT
+
+
+def test_sampled_mode_matches_oracle_on_shared_candidates():
+    """Kernel sampled mode and oracle `candidates=` scan the SAME shuffled
+    subset -> identical choice and score (strict log2(n)-limit parity,
+    reference stack.go:77-89)."""
+    import random
+    import numpy as np
+    from nomad_tpu.scheduler.oracle import OracleContext, select_option
+    from nomad_tpu.scheduler.stack import TPUStack
+    from nomad_tpu.synth import build_synthetic_state, synth_service_job
+
+    state, nodes = build_synthetic_state(64, 128, seed=9)
+    rng = random.Random(10)
+    job = synth_service_job(rng, count=2, with_affinity=True)
+    state.upsert_job(job)
+    stack = TPUStack(state.cluster)
+    tg = job.task_groups[0]
+
+    shuffled = list(nodes)
+    rng.shuffle(shuffled)
+    cand_nodes = shuffled[:7]  # ~log2(64)+1 candidates
+    rows = [state.cluster.row_of[n.id] for n in cand_nodes]
+
+    sel = stack.select(job, tg, 1, sampled_rows=rows)
+    allocs_by_node = {
+        nid: list(d.values()) for nid, d in state._allocs_by_node.items()
+    }
+    ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
+    opt = select_option(ctx, job, tg, candidates=cand_nodes)
+
+    if opt is None:
+        assert sel.node_ids[0] is None
+    else:
+        assert sel.node_ids[0] == opt.node.id
+        np.testing.assert_allclose(sel.scores[0], opt.final_score, atol=1e-5)
+    # exact mode must pick a candidate at least as good
+    full = stack.select(job, tg, 1)
+    if opt is not None:
+        assert full.scores[0] >= opt.final_score - 1e-6
+
+
+class TestDistinctProperty:
+    """distinct_property enforcement, kernel vs oracle (reference
+    feasible.go:569-672 DistinctPropertyIterator + propertyset.go:14)."""
+
+    def _cluster(self, n_nodes=12, racks=3):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(n_nodes, rng)
+        for i, n in enumerate(nodes):
+            n.attributes["rack"] = f"r{i % racks}"
+            cl.upsert_node(n)
+        return cl, nodes, rng
+
+    def _parity(self, cl, nodes, job, n_place, allocs_by_node=None):
+        stack = TPUStack(cl)
+        tg = job.task_groups[0]
+        result = stack.select(job, tg, n_place)
+        ctx = OracleContext(nodes=nodes,
+                            allocs_by_node=allocs_by_node or {})
+        for i in range(n_place):
+            opt = select_option(ctx, job, tg)
+            got = result.node_ids[i]
+            if opt is None:
+                assert got is None, f"step {i}: kernel placed, oracle not"
+                continue
+            assert got is not None, f"step {i}: oracle placed, kernel not"
+            assert abs(result.scores[i] - opt.final_score) < 1e-4
+            ctx.plan_node_alloc.setdefault(got, []).append(
+                placed_alloc(job, tg, got))
+        return result
+
+    def test_job_level_distinct_rack(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        r = self._parity(cl, nodes, job, 5)
+        # only 3 racks -> at most 3 placements, all on distinct racks
+        placed = [n for n in r.node_ids if n is not None]
+        assert len(placed) == 3
+        racks = {next(nd for nd in nodes if nd.id == nid).attributes["rack"]
+                 for nid in placed}
+        assert len(racks) == 3
+
+    def test_rtarget_count_form(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "2", "distinct_property"))
+        r = self._parity(cl, nodes, job, 8)
+        placed = [n for n in r.node_ids if n is not None]
+        assert len(placed) == 6  # 3 racks x 2 allowed
+        from collections import Counter
+        rc = Counter(next(nd for nd in nodes if nd.id == nid)
+                     .attributes["rack"] for nid in placed)
+        assert all(v == 2 for v in rc.values())
+
+    def test_existing_allocs_count(self):
+        cl, nodes, rng = self._cluster()
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        # existing alloc of this job on rack r0
+        r0_node = next(n for n in nodes if n.attributes["rack"] == "r0")
+        a = mock.alloc(job=job)
+        a.job_id = job.id
+        a.node_id = r0_node.id
+        a.task_group = job.task_groups[0].name
+        a.client_status = "running"
+        cl.upsert_alloc(a)
+        abn = {r0_node.id: [a]}
+        r = self._parity(cl, nodes, job, 4, allocs_by_node=abn)
+        placed = [n for n in r.node_ids if n is not None]
+        assert len(placed) == 2  # r0 burned by the existing alloc
+        racks = {next(nd for nd in nodes if nd.id == nid).attributes["rack"]
+                 for nid in placed}
+        assert racks == {"r1", "r2"}
+
+    def test_tg_level_scope(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        r = self._parity(cl, nodes, job, 5)
+        placed = [n for n in r.node_ids if n is not None]
+        assert len(placed) == 3
+
+    def test_missing_property_infeasible(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${meta.nonexistent}", "", "distinct_property"))
+        r = self._parity(cl, nodes, job, 2)
+        assert all(n is None for n in r.node_ids[:2])
+
+    def test_invalid_rtarget_infeasible(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "not-a-number", "distinct_property"))
+        r = self._parity(cl, nodes, job, 2)
+        assert all(n is None for n in r.node_ids[:2])
+
+    def test_literal_ltarget_caps_total(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        # literal resolves to one shared value on every node -> RTarget
+        # caps TOTAL placements (reference resolveTarget on a literal)
+        job.constraints.append(
+            Constraint("fixed-value", "2", "distinct_property"))
+        r = self._parity(cl, nodes, job, 5)
+        placed = [n for n in r.node_ids if n is not None]
+        assert len(placed) == 2
+
+    def test_plan_stops_release_value(self):
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        tgname = job.task_groups[0].name
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        r0_node = next(n for n in nodes if n.attributes["rack"] == "r0")
+        a = mock.alloc(job=job)
+        a.job_id = job.id
+        a.node_id = r0_node.id
+        a.task_group = tgname
+        a.client_status = "running"
+        cl.upsert_alloc(a)
+        # plan stops that alloc -> r0 is available again
+        stack = TPUStack(cl)
+        plan = PlanContext(stopped_allocs=[a])
+        res = stack.select(job, job.task_groups[0], 3, plan)
+        placed = [n for n in res.node_ids if n is not None]
+        assert len(placed) == 3  # all three racks usable
+
+    def test_dp_job_program_cache_hits(self):
+        """The static-program cache must hit for distinct_property jobs
+        (regression: the cache key was shadowed by the dp compile loop)."""
+        cl, nodes, _ = self._cluster()
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        stack = TPUStack(cl)
+        tg = job.task_groups[0]
+        stack.compile_tg(job, tg, 2)
+        assert len(stack._prog_cache) == 1
+        k = next(iter(stack._prog_cache))
+        assert k[0] == job.id  # stored under the job tuple, not the attr
+        ent1 = stack._prog_cache[k]
+        stack.compile_tg(job, tg, 2)
+        assert stack._prog_cache[k] is ent1  # second compile is a hit
