@@ -115,20 +115,29 @@ def bench_oracle(state, nodes, jobs, stack, count: int, n_evals: int,
     agree = 0
     steps = 0
     t0 = time.time()
+    kernel_dt = 0.0  # kernel-select time excluded from the oracle rate
     total = 0
     for job in jobs[:n_evals]:
         ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
         tg = job.task_groups[0]
         res = job.combined_task_resources(tg)
-        sel = stack.select(job, tg, count) if parity else None
+        if parity:
+            tk = time.time()
+            sel = stack.select(job, tg, count)
+            kernel_dt += time.time() - tk
+        else:
+            sel = None
         for step in range(count):
             opt = select_option(ctx, job, tg)
             if sel is not None:
                 k_node = sel.node_ids[step]
                 k_score = sel.scores[step]
                 steps += 1
-                if opt is None:
-                    agree += k_node is None
+                if opt is None or k_node is None:
+                    # both-failed = agreement; one-sided placement is a
+                    # plain disagreement (the kernel's 0.0 unplaced
+                    # sentinel must not enter the deviation stats)
+                    agree += opt is None and k_node is None
                 else:
                     devs.append(abs(k_score - opt.final_score))
                     # ties count as agreement: equal-score nodes are
@@ -147,7 +156,7 @@ def bench_oracle(state, nodes, jobs, stack, count: int, n_evals: int,
             )
             ctx.plan_node_alloc.setdefault(opt.node.id, []).append(fake)
         total += 1
-    dt = time.time() - t0
+    dt = time.time() - t0 - kernel_dt
     rate = total / dt
     log(f"oracle: {total} evals in {dt:.2f}s = {rate:.3f} evals/s")
     stats = None

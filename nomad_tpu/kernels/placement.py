@@ -162,6 +162,27 @@ def _lut_gather(lut: jax.Array, key_idx: jax.Array, attrs: jax.Array) -> jax.Arr
     return out
 
 
+def _scatter_counts(idx: jax.Array, val: jax.Array, n: int) -> jax.Array:
+    """Dense f32[N] from sparse (node-row, count) pairs; −1 pads match no
+    row. Comparison-einsum instead of scatter (TPU scatters serialize)."""
+    eq = (idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32)
+    return jnp.einsum("jn,j->n", eq, val)
+
+
+def _dp_feasible(dtok: jax.Array, dtok_oh: jax.Array, dcounts: jax.Array,
+                 p: TGParams) -> jax.Array:
+    """distinct_property node mask (propertyset.go:214
+    SatisfiesDistinctProperties): feasible iff use count of the node's
+    value < allowed and the property resolves (missing slot ⇒ infeasible),
+    per active row. Shared by the placement scan (evolving counts) and the
+    preemption ranker (counts0) so the two paths can't diverge."""
+    d_v = dcounts.shape[1]
+    cur_d = jnp.einsum("npv,pv->np", dtok_oh, dcounts)          # [N, P]
+    row_ok = ((cur_d < p.dp_allowed[None, :])
+              & (dtok != d_v - 1)) | ~p.dp_active[None, :]
+    return jnp.all(row_ok, axis=1)
+
+
 def _spread_boost(
     stok: jax.Array,        # i32[N, S] normalized value tokens (miss = V−1)
     stok_oh: jax.Array,     # f32[N, S, V] one-hot of stok
@@ -279,14 +300,8 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         ok = feas & fits
         ok = ok & ~(p.distinct_hosts & (job_cnt > 0))
 
-        # distinct_property (propertyset.go:214 SatisfiesDistinctProperties):
-        # feasible iff use count of the node's value < allowed, and the
-        # property resolves (missing slot ⇒ infeasible) — per active row
         if dcounts.shape[0]:
-            cur_d = jnp.einsum("npv,pv->np", dtok_oh, dcounts)  # [N, P]
-            dp_row_ok = ((cur_d < p.dp_allowed[None, :])
-                         & (dtok != d_v - 1)) | ~p.dp_active[None, :]
-            ok = ok & jnp.all(dp_row_ok, axis=1)
+            ok = ok & _dp_feasible(dtok, dtok_oh, dcounts, p)
 
         # ---- fused scoring (rank.go semantics) ----
         binpack, spreadfit = fit_scores(util, cap)
@@ -352,14 +367,8 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
             masked,
         )
 
-    job_cnt0 = jnp.einsum(
-        "jn,j->n",
-        (p.jc_idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32),
-        p.jc_val)
-    tg_cnt0 = jnp.einsum(
-        "jn,j->n",
-        (p.jtc_idx[:, None] == jnp.arange(n)[None, :]).astype(jnp.float32),
-        p.jtc_val)
+    job_cnt0 = _scatter_counts(p.jc_idx, p.jc_val, n)
+    tg_cnt0 = _scatter_counts(p.jtc_idx, p.jtc_val, n)
     init = (used0, job_cnt0, tg_cnt0, p.spread_counts0, p.dp_counts0)
     xs = (jnp.arange(max_allocs), p.penalty_idx, p.preferred_idx)
     (used_f, _, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(

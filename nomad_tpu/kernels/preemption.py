@@ -28,7 +28,16 @@ import jax
 import jax.numpy as jnp
 
 from ..structs.funcs import PREEMPTION_SCORE_ORIGIN, PREEMPTION_SCORE_RATE
-from .placement import ClusterArrays, TGParams, _lut_gather, fit_scores
+from .placement import (
+    ClusterArrays,
+    TGParams,
+    _dp_feasible,
+    _lut_gather,
+    _onehot_tokens,
+    _scatter_counts,
+    _select_tokens,
+    fit_scores,
+)
 
 NEG_INF = -1e30
 INF_PRIO = 1e9
@@ -55,9 +64,25 @@ def preempt_rank(cluster: ClusterArrays, p: TGParams,
     cap = cluster.capacity
     n, a = cand.prio.shape
 
-    # Constraint feasibility is identical to the placement kernel's.
+    # Constraint feasibility mirrors the placement kernel's — including
+    # distinct_hosts and the distinct_property node mask: the reference
+    # keeps DistinctHosts/DistinctPropertyIterator ahead of the
+    # evict-enabled BinPackIterator (stack.go:321-411), so a preemption
+    # retry must never select a node the distinct checks would have
+    # rejected. (The literal-LTarget dp *placement clamp* is host-side:
+    # find_preemption_placement bails when params.n_place is clamped to 0.)
     feas_c = _lut_gather(p.lut, p.key_idx, cluster.attrs)
     feas = cluster.node_ok & p.extra_mask & jnp.all(feas_c, axis=1)
+
+    if p.jc_idx.shape[0]:
+        job_cnt0 = _scatter_counts(p.jc_idx, p.jc_val, n)
+        feas = feas & ~(p.distinct_hosts & (job_cnt0 > 0))
+
+    if p.dp_key_idx.shape[0]:
+        d_v = p.dp_counts0.shape[1]
+        dtok = _select_tokens(cluster.attrs, p.dp_key_idx, d_v)   # [N, P]
+        dtok_oh = _onehot_tokens(dtok, d_v)                       # [N, P, V]
+        feas = feas & _dp_feasible(dtok, dtok_oh, p.dp_counts0, p)
 
     used = cluster.used
     if p.delta_idx.shape[0]:

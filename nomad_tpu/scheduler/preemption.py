@@ -404,6 +404,12 @@ def find_preemption_placement(state, cluster, job, tg, params, plan
     from ..utils import bucket
     from .util import proposed_allocs
 
+    # A literal-LTarget distinct_property caps TOTAL placements via the
+    # n_place clamp (stack._dp_program), not a node mask — a clamp to zero
+    # means no further alloc may exist anywhere, so eviction can't help.
+    if int(params.n_place) < 1:
+        return None
+
     # Per-node eligible-victim table.
     per_row: Dict[int, List[Allocation]] = {}
     a_max = 0

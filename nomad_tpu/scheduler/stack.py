@@ -10,6 +10,7 @@ evaluations (vmap) — full-width over the node axis.
 from __future__ import annotations
 
 import math
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -67,10 +68,11 @@ class TPUStack:
         self._jit = jit
         self._snapshot_version = -1
         self._dev_arrays: Optional[ClusterArrays] = None
-        # (job.id, version, modify_index, tg, volumes) → compiled static
-        # program; re-evaluating the same job spec (retries, node-down
-        # churn, deployments) skips the LUT compile entirely
-        self._prog_cache: Dict[tuple, dict] = {}
+        # (namespace, job.id, version, modify_index, tg, volumes) →
+        # compiled static program; re-evaluating the same job spec
+        # (retries, node-down churn, deployments) skips the LUT compile
+        # entirely. LRU: hits are refreshed so hot programs survive churn.
+        self._prog_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         self._prog_cache_max = 1024
 
     # ---- device snapshot management ----
@@ -252,8 +254,8 @@ class TPUStack:
         LUT build ran once per eval per batch before caching."""
         cl = self.cluster
         vocab = cl.vocab
-        cache_key = (job.id, job.version, job.modify_index, tg.name,
-                     tuple(volumes) if volumes else ())
+        cache_key = (job.namespace, job.id, job.version, job.modify_index,
+                     tg.name, tuple(volumes) if volumes else ())
         ent = self._prog_cache.get(cache_key)
         if ent is not None:
             sizes = tuple(len(vocab.key_vocabs[k]) for k in ent["used_keys"])
@@ -263,6 +265,7 @@ class TPUStack:
                 # node-only version: alloc churn must not evict host masks
                 fresh = ent["node_version"] == cl.node_version
             if fresh:
+                self._prog_cache.move_to_end(cache_key)
                 return ent
 
         combined = list(job.constraints) + list(tg.constraints)
@@ -390,9 +393,14 @@ class TPUStack:
             "n_devcols": len(cl.device_cols),
             "node_version": cl.node_version,
         }
-        if len(self._prog_cache) >= self._prog_cache_max:
-            self._prog_cache.pop(next(iter(self._prog_cache)))
-        self._prog_cache[cache_key] = ent
+        if cache_key in self._prog_cache:
+            # stale-recompile replace: refresh recency, never evict others
+            self._prog_cache[cache_key] = ent
+            self._prog_cache.move_to_end(cache_key)
+        else:
+            if len(self._prog_cache) >= self._prog_cache_max:
+                self._prog_cache.popitem(last=False)  # evict least-recent
+            self._prog_cache[cache_key] = ent
         return ent
 
     def _device_ask_col(self, name: str) -> Optional[int]:

@@ -478,7 +478,8 @@ class TestDistinctProperty:
         stack.compile_tg(job, tg, 2)
         assert len(stack._prog_cache) == 1
         k = next(iter(stack._prog_cache))
-        assert k[0] == job.id  # stored under the job tuple, not the attr
+        # stored under the (namespace, job) tuple, not the attr
+        assert k[:2] == (job.namespace, job.id)
         ent1 = stack._prog_cache[k]
         stack.compile_tg(job, tg, 2)
         assert stack._prog_cache[k] is ent1  # second compile is a hit

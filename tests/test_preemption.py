@@ -359,6 +359,104 @@ class TestServiceSchedPreemption:
         merged = h.state.alloc_by_id(evicted[0].id)
         assert merged.desired_status == "evict"
 
+    def test_distinct_property_gates_preemption(self):
+        """A dp-constrained job must not preempt onto a node whose property
+        value the job already uses — the reference keeps
+        DistinctPropertyIterator ahead of the evict-enabled BinPackIterator
+        (stack.go:321-411), so the preemption retry sees the same dp mask."""
+        from nomad_tpu.structs.job import Constraint
+
+        h = Harness()
+        h.state.set_scheduler_config(
+            SchedulerConfiguration(preemption_service_enabled=True))
+
+        job = mock.job(priority=100)
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 2000
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.task_groups[0].networks = []
+
+        # node_c (rack r1): runs the job's own first alloc -> r1 burned.
+        node_c = mock.node()
+        node_c.attributes["rack"] = "r1"
+        h.state.upsert_node(node_c)
+        own = running_alloc(job, node_c, cpu=2000, memory_mb=2000)
+        own.name = f"{job.id}.{job.task_groups[0].name}[0]"
+
+        # node_a (rack r1): full, cheapest victim -> best preemption score.
+        node_a = mock.node()
+        node_a.attributes["rack"] = "r1"
+        h.state.upsert_node(node_a)
+        ja = lowprio_job(priority=1)
+        h.state.upsert_job(ja)
+        h.state.upsert_alloc(running_alloc(ja, node_a))
+
+        # node_b (rack r2): full, pricier victim (still delta >= 10).
+        node_b = mock.node()
+        node_b.attributes["rack"] = "r2"
+        h.state.upsert_node(node_b)
+        jb = lowprio_job(priority=50)
+        h.state.upsert_job(jb)
+        h.state.upsert_alloc(running_alloc(jb, node_b))
+
+        h.state.upsert_job(job)
+        h.state.upsert_alloc(own)
+        h.process(mock.eval_(job_id=job.id, type=job.type,
+                             priority=job.priority))
+
+        plan = h.plans[-1]
+        placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+        assert len(placed) == 1
+        # must land on node_b (r2) despite node_a's better preemption score
+        assert placed[0].node_id == node_b.id
+        assert placed[0].preempted_allocations
+
+    def test_literal_dp_cap_not_bypassed_by_preemption(self):
+        """A literal-LTarget distinct_property caps TOTAL placements via the
+        n_place clamp; the preemption retry must honor the clamp instead of
+        evicting its way past the cap."""
+        from nomad_tpu.structs.job import Constraint
+
+        h = Harness()
+        h.state.set_scheduler_config(
+            SchedulerConfiguration(preemption_service_enabled=True))
+
+        job = mock.job(priority=100)
+        job.constraints.append(
+            Constraint("literal-value", "1", "distinct_property"))
+        job.task_groups[0].count = 2
+        job.task_groups[0].tasks[0].resources.cpu = 2000
+        job.task_groups[0].tasks[0].resources.memory_mb = 2000
+        job.task_groups[0].tasks[0].resources.networks = []
+        job.task_groups[0].networks = []
+
+        # node_c runs the job's first alloc (cap of 1 reached).
+        node_c = mock.node()
+        h.state.upsert_node(node_c)
+        own = running_alloc(job, node_c, cpu=2000, memory_mb=2000)
+        own.name = f"{job.id}.{job.task_groups[0].name}[0]"
+
+        # node_a: full with an evictable low-priority victim.
+        node_a = mock.node()
+        h.state.upsert_node(node_a)
+        ja = lowprio_job(priority=1)
+        h.state.upsert_job(ja)
+        h.state.upsert_alloc(running_alloc(ja, node_a))
+
+        h.state.upsert_job(job)
+        h.state.upsert_alloc(own)
+        h.process(mock.eval_(job_id=job.id, type=job.type,
+                             priority=job.priority))
+
+        # second alloc must FAIL, not preempt past the cap
+        assert h.evals[-1].failed_tg_allocs
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert not placed
+
     def test_higher_priority_not_preempted(self):
         h = Harness()
         h.state.set_scheduler_config(SchedulerConfiguration(preemption_service_enabled=True))
