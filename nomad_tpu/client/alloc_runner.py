@@ -26,11 +26,20 @@ from .task_runner import TaskRunner
 
 class AllocRunner:
     def __init__(self, alloc: Allocation, base_dir: str, node=None,
-                 on_update: Optional[Callable[[Allocation], None]] = None
+                 on_update: Optional[Callable[[Allocation], None]] = None,
+                 on_handle: Optional[Callable] = None,
+                 recover_handles: Optional[Dict[str, dict]] = None,
+                 driver_manager=None
                  ) -> None:
         self.alloc = alloc
         self.node = node
         self.on_update = on_update
+        #: on_handle(task_name, driver, driver_state|None) → persisted by
+        #: the client for post-restart task recovery
+        self.on_handle = on_handle
+        #: task_name → driver_state persisted before an agent restart
+        self.recover_handles = recover_handles or {}
+        self.driver_manager = driver_manager
         self.alloc_dir = AllocDir(base_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_states: Dict[str, TaskState] = {}
@@ -73,6 +82,13 @@ class AllocRunner:
         # prestart tasks run to successful completion first (lifecycle
         # gating, taskrunner lifecycle.go)
         for t in prestart:
+            prev = (self.alloc.task_states or {}).get(t.name)
+            if prev is not None and prev.state == TASK_STATE_DEAD \
+                    and not prev.failed:
+                # restored alloc: prestart already succeeded pre-restart
+                with self._lock:
+                    self.task_states[t.name] = prev
+                continue
             tr = self._spawn(t)
             if not self._wait_dead([tr]):
                 return
@@ -109,12 +125,16 @@ class AllocRunner:
         return True
 
     def _spawn(self, task) -> TaskRunner:
+        rec = self.recover_handles.pop(task.name, None)
         tr = TaskRunner(
             self.alloc, task,
             task_dir=self.alloc_dir.task_dir(task.name),
             logs_dir=self.alloc_dir.logs_dir,
             node=self.node,
             on_state_change=self._task_state_changed,
+            on_handle=self.on_handle,
+            recover_state=(rec or {}).get("state"),
+            driver_manager=self.driver_manager,
         )
         with self._lock:
             self.task_runners[task.name] = tr
@@ -183,11 +203,16 @@ class AllocRunner:
             tr.kill()
 
     def shutdown(self) -> None:
-        """Client process exit: stop tasks WITHOUT reporting terminal
-        state — the alloc must restore as live on restart (alloc_runner.go
-        Shutdown vs Destroy distinction)."""
+        """Client process exit: DETACH from tasks without stopping them —
+        driver handles are persisted and the next agent run recovers the
+        still-running tasks (alloc_runner.go Shutdown vs Destroy
+        distinction; executor tasks survive because the executor plugin
+        lives in its own session)."""
         self._shutting_down = True
-        self.kill()
+        with self._lock:
+            runners = list(self.task_runners.values())
+        for tr in runners:
+            tr.detach()
 
     def destroy(self) -> None:
         self._destroyed = True

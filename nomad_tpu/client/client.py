@@ -129,6 +129,10 @@ class Client:
         self.node = self.config.node or Node(id=str(uuid.uuid4()))
         if not self.node.id:
             self.node.id = str(uuid.uuid4())
+        from .pluginmanager import DriverManager
+
+        self.driver_manager = DriverManager(
+            on_attrs=self._driver_attrs_changed)
         self.allocs: Dict[str, AllocRunner] = {}
         self._known_index: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -145,6 +149,7 @@ class Client:
         self.node.status = NODE_STATUS_READY
         self._restore()
         self.conn.node_register(self.node)
+        self.driver_manager.start()
         for fn, name in ((self._run_heartbeat, "hb"),
                          (self._run_watch, "watch"),
                          (self._run_sync, "sync")):
@@ -153,8 +158,26 @@ class Client:
             t.start()
             self._threads.append(t)
 
+    def _driver_attrs_changed(self, updates: Dict[str, str]) -> None:
+        """Driver health transition (drivermanager fingerprint loop):
+        merge attrs ('' tombstone deletes) and re-register the node."""
+        changed = False
+        for k, v in updates.items():
+            if v == "":
+                if self.node.attributes.pop(k, None) is not None:
+                    changed = True
+            elif self.node.attributes.get(k) != v:
+                self.node.attributes[k] = v
+                changed = True
+        if changed:
+            try:
+                self.conn.node_register(self.node)
+            except Exception:
+                pass  # next heartbeat/registration retries
+
     def shutdown(self) -> None:
         self._stop.set()
+        self.driver_manager.shutdown()
         with self._dirty_cv:
             self._dirty.clear()  # nothing more leaves this client
             self._dirty_cv.notify_all()
@@ -172,9 +195,10 @@ class Client:
                     or alloc.client_terminal_status():
                 self.state_db.delete_alloc(aid)
                 continue
-            # re-run the alloc (driver handle re-attach is subsumed by
-            # restart: tasks restart under the restart policy)
-            self._add_alloc(alloc)
+            # re-run the alloc; persisted driver handles let runners
+            # reattach to still-live tasks (RecoverTask); tasks whose
+            # executor died restart under the restart policy
+            self._add_alloc(alloc, recover_handles=rec.get("handles"))
 
     # ---- heartbeats (registerAndHeartbeat :1519) ----
 
@@ -225,9 +249,18 @@ class Client:
             with self._lock:
                 self._known_index[aid] = modify_index
 
-    def _add_alloc(self, alloc: Allocation) -> None:
+    def _add_alloc(self, alloc: Allocation,
+                   recover_handles: Optional[Dict[str, dict]] = None
+                   ) -> None:
+        def on_handle(task: str, driver: str, state,
+                      _aid: str = alloc.id) -> None:
+            self.state_db.put_task_handle(_aid, task, driver, state)
+
         runner = AllocRunner(alloc, self.alloc_dir_base, node=self.node,
-                             on_update=self._alloc_updated)
+                             on_update=self._alloc_updated,
+                             on_handle=on_handle,
+                             recover_handles=recover_handles,
+                             driver_manager=self.driver_manager)
         with self._lock:
             self.allocs[alloc.id] = runner
             self._known_index[alloc.id] = alloc.modify_index

@@ -1,29 +1,33 @@
 """Task-driver plugin framework.
 
 Behavioral reference: `plugins/drivers/driver.go` (DriverPlugin interface —
-Fingerprint, StartTask, WaitTask, StopTask, DestroyTask, InspectTask) and
-the in-process loader `helper/pluginutils/loader` (internal drivers run
-in-process; external ones cross a gRPC boundary). Here drivers are
-in-process classes behind the same contract; the registry mirrors the
-driver catalog, and the client fingerprinter publishes `driver.<name>`
-attributes exactly as the reference does (client/fingerprint driver
-manager path).
+Fingerprint, StartTask, WaitTask, StopTask, DestroyTask, InspectTask,
+RecoverTask, ExecTask) and the loader `helper/pluginutils/loader`. The
+mock driver runs in-process (as the reference's does for tests); exec and
+raw_exec launch their tasks under the out-of-process executor plugin
+(`nomad_tpu/plugins/executor.py`, the `drivers/shared/executor` analog) so
+tasks survive agent restarts and are recovered via persisted reattach
+records; docker delegates the task's life to the Docker daemon the same
+way. The client fingerprinter publishes `driver.<name>` attributes exactly
+as the reference does.
 """
 from __future__ import annotations
 
 from typing import Dict, Type
 
 from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
+from .docker import DockerDriver
+from .executor_driver import (ExecDriver, ExecutorBackedDriver,
+                              RawExecDriver)
 from .mock import MockDriver
-from .rawexec import RawExecDriver
-from .exec import ExecDriver
 
-#: reference BuiltinDrivers catalog (docker/java/qemu need their runtimes
-#: and register only when fingerprinting detects them; see docker.py)
+#: reference BuiltinDrivers catalog (java/qemu register when their
+#: runtimes fingerprint; docker marks itself undetected without a daemon)
 BUILTIN_DRIVERS: Dict[str, Type[DriverPlugin]] = {
     "mock_driver": MockDriver,
     "raw_exec": RawExecDriver,
     "exec": ExecDriver,
+    "docker": DockerDriver,
 }
 
 
@@ -34,6 +38,6 @@ def new_driver(name: str) -> DriverPlugin:
     return cls()
 
 
-__all__ = ["BUILTIN_DRIVERS", "DriverPlugin", "ExitResult", "MockDriver",
-           "RawExecDriver", "ExecDriver", "TaskConfig", "TaskHandle",
-           "new_driver"]
+__all__ = ["BUILTIN_DRIVERS", "DockerDriver", "DriverPlugin", "ExecDriver",
+           "ExecutorBackedDriver", "ExitResult", "MockDriver",
+           "RawExecDriver", "TaskConfig", "TaskHandle", "new_driver"]

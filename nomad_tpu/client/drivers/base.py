@@ -28,6 +28,11 @@ class TaskConfig:
     cpu_mhz: int = 0
     memory_mb: int = 0
     kill_timeout_s: float = 5.0
+    # log rotation bounds (structs LogConfig) — enforced by whoever owns
+    # the log files (executor for out-of-process drivers, LogMon sinks
+    # for in-process ones)
+    max_files: int = 10
+    max_file_size_mb: int = 10
 
 
 @dataclass
@@ -97,3 +102,15 @@ class DriverPlugin:
     def inspect_task(self, handle: TaskHandle) -> dict:
         return {"id": handle.task_id, "running": handle.is_running(),
                 "exit": None if handle.exit is None else vars(handle.exit)}
+
+    def recover_task(self, task_id: str,
+                     driver_state: dict) -> Optional[TaskHandle]:
+        """Reattach to a task started before an agent restart
+        (plugins/drivers/driver.go RecoverTask). None → task lost; the
+        caller restarts it under the restart policy."""
+        return None
+
+    def exec_task(self, handle: TaskHandle, command: str, args=None,
+                  timeout_s: float = 30.0) -> dict:
+        """Run a command in the task's context (ExecTask)."""
+        raise NotImplementedError(f"{self.name} does not support exec")

@@ -1,0 +1,283 @@
+"""docker driver — container tasks via the Docker Engine CLI.
+
+Behavioral reference: `drivers/docker/driver.go` (create/start/wait/stop
+lifecycle, resource limits, env, binds), `drivers/docker/coordinator.go`
+(concurrent image-pull dedup), `drivers/docker/ports.go` (port publishing),
+`drivers/docker/docklog/` (log streaming). The reference talks to the
+daemon over the Docker API socket with a Go client; here the CLI is the
+transport (one binary, same daemon) — the driver fingerprints as unhealthy
+when no usable `docker` is on PATH, exactly like the reference's
+fingerprint loop marks the driver undetected (`driver.go Fingerprint`).
+
+Recovery: the container outlives the agent (the daemon owns it);
+driver_state persists {container_id} and `recover_task` re-attaches via
+`docker inspect` + a fresh `docker wait` — the reference's RecoverTask.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
+
+
+def _docker_bin() -> Optional[str]:
+    return os.environ.get("NOMAD_TPU_DOCKER_BIN") or shutil.which("docker")
+
+
+class ImageCoordinator:
+    """Deduplicates concurrent pulls of one image (coordinator.go:1)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pulls: Dict[str, threading.Event] = {}
+        self._results: Dict[str, Optional[str]] = {}
+
+    def pull(self, docker: str, image: str, timeout_s: float = 300.0
+             ) -> None:
+        with self._lock:
+            ev = self._pulls.get(image)
+            if ev is None:
+                ev = threading.Event()
+                self._pulls[image] = ev
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            ev.wait(timeout_s)
+            err = self._results.get(image)
+            if err:
+                raise RuntimeError(err)
+            return
+        try:
+            r = subprocess.run([docker, "pull", image],
+                               capture_output=True, timeout=timeout_s)
+            self._results[image] = (
+                None if r.returncode == 0
+                else f"docker pull {image}: {r.stderr.decode()[:500]}")
+        except subprocess.TimeoutExpired:
+            self._results[image] = f"docker pull {image}: timeout"
+        finally:
+            ev.set()
+            with self._lock:
+                self._pulls.pop(image, None)
+        err = self._results.get(image)
+        if err:
+            raise RuntimeError(err)
+
+
+class DockerTaskHandle(TaskHandle):
+    pass
+
+
+class DockerDriver(DriverPlugin):
+    name = "docker"
+
+    _coordinator = ImageCoordinator()
+
+    def fingerprint(self) -> Dict[str, str]:
+        docker = _docker_bin()
+        if not docker:
+            return {}
+        try:
+            r = subprocess.run(
+                [docker, "version", "--format", "{{.Server.Version}}"],
+                capture_output=True, timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            return {}
+        if r.returncode != 0:
+            return {}
+        version = r.stdout.decode().strip()
+        return {"driver.docker": "1", "driver.docker.version": version}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self, docker: str, *args: str, timeout: float = 60.0
+             ) -> subprocess.CompletedProcess:
+        return subprocess.run([docker, *args], capture_output=True,
+                              timeout=timeout)
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        docker = _docker_bin()
+        if not docker:
+            raise RuntimeError("docker not available on this node")
+        rc = cfg.raw_config
+        image = rc.get("image")
+        if not image:
+            raise ValueError("docker driver requires config.image")
+
+        if rc.get("force_pull") or not self._image_present(docker, image):
+            self._coordinator.pull(docker, str(image))
+
+        name = f"nomad-{cfg.id.replace('/', '-')}"
+        argv: List[str] = ["create", "--name", name]
+        if cfg.memory_mb:
+            argv += ["--memory", f"{cfg.memory_mb}m"]
+        if cfg.cpu_mhz:
+            argv += ["--cpu-shares", str(cfg.cpu_mhz)]
+        for k, v in cfg.env.items():
+            argv += ["--env", f"{k}={v}"]
+        if cfg.task_dir:
+            # reference mounts alloc/local/secrets dirs into the container
+            argv += ["--volume", f"{cfg.task_dir}:/local"]
+        for vol in rc.get("volumes", []) or []:
+            argv += ["--volume", str(vol)]
+        for pm in rc.get("port_map", []) or []:
+            argv += ["--publish", str(pm)]
+        if rc.get("network_mode"):
+            argv += ["--network", str(rc["network_mode"])]
+        if cfg.user:
+            argv += ["--user", cfg.user]
+        if rc.get("work_dir"):
+            argv += ["--workdir", str(rc["work_dir"])]
+        argv.append(str(image))
+        if rc.get("command"):
+            argv.append(str(rc["command"]))
+            argv += [str(a) for a in rc.get("args", [])]
+
+        r = self._run(docker, *argv)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"docker create failed: {r.stderr.decode()[:500]}")
+        container_id = r.stdout.decode().strip()
+
+        r = self._run(docker, "start", container_id)
+        if r.returncode != 0:
+            self._run(docker, "rm", "-f", container_id)
+            raise RuntimeError(
+                f"docker start failed: {r.stderr.decode()[:500]}")
+
+        handle = DockerTaskHandle(
+            cfg.id, self.name,
+            {"container_id": container_id, "image": str(image)})
+        self._attach(docker, handle, cfg)
+        return handle
+
+    def _image_present(self, docker: str, image: str) -> bool:
+        r = self._run(docker, "image", "inspect", str(image), timeout=15.0)
+        return r.returncode == 0
+
+    def _attach(self, docker: str, handle: DockerTaskHandle,
+                cfg: Optional[TaskConfig]) -> None:
+        """Start the wait + log pumps for a (possibly recovered) container."""
+        cid = handle.driver_state["container_id"]
+
+        if cfg is not None and cfg.stdout_sink is not None:
+            def pump_logs():
+                # docklog analog: stream stdout/stderr since container start
+                proc = subprocess.Popen(
+                    [docker, "logs", "--follow", cid],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+                handle._log_proc = proc
+
+                def read(stream, sink):
+                    for chunk in iter(lambda: stream.read(8192), b""):
+                        try:
+                            sink(chunk)
+                        except Exception:
+                            break
+                    stream.close()
+
+                ts = [threading.Thread(
+                          target=read, args=(proc.stdout, cfg.stdout_sink),
+                          daemon=True),
+                      threading.Thread(
+                          target=read, args=(proc.stderr, cfg.stderr_sink
+                                             or cfg.stdout_sink),
+                          daemon=True)]
+                for t in ts:
+                    t.start()
+
+            threading.Thread(target=pump_logs, daemon=True).start()
+
+        def wait():
+            try:
+                r = subprocess.run([docker, "wait", cid],
+                                   capture_output=True)
+                code = int(r.stdout.decode().strip()) \
+                    if r.returncode == 0 else -1
+            except (ValueError, OSError):
+                code = -1
+            oom = False
+            ir = self._run(docker, "inspect", "--format",
+                           "{{.State.OOMKilled}}", cid, timeout=15.0)
+            if ir.returncode == 0:
+                oom = ir.stdout.decode().strip() == "true"
+            handle.set_exit(ExitResult(exit_code=code, oom_killed=oom))
+
+        threading.Thread(target=wait, daemon=True).start()
+
+    def recover_task(self, task_id: str,
+                     driver_state: dict) -> Optional[TaskHandle]:
+        docker = _docker_bin()
+        cid = (driver_state or {}).get("container_id")
+        if not docker or not cid:
+            return None
+        r = self._run(docker, "inspect", "--format",
+                      "{{.State.Running}}", cid, timeout=15.0)
+        if r.returncode != 0:
+            return None  # container gone
+        handle = DockerTaskHandle(task_id, self.name, dict(driver_state))
+        if r.stdout.decode().strip() == "true":
+            self._attach(docker, handle, None)
+        else:
+            er = self._run(docker, "inspect", "--format",
+                           "{{.State.ExitCode}}", cid, timeout=15.0)
+            code = int(er.stdout.decode().strip()) \
+                if er.returncode == 0 else -1
+            handle.set_exit(ExitResult(exit_code=code))
+        return handle
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0,
+                  signal: str = "SIGTERM") -> None:
+        docker = _docker_bin()
+        cid = handle.driver_state.get("container_id")
+        if not docker or not cid or not handle.is_running():
+            return
+        self._run(docker, "stop", "--time", str(max(1, int(timeout_s))),
+                  cid, timeout=timeout_s + 30.0)
+        handle.wait(5.0)
+
+    def destroy_task(self, handle: TaskHandle, force: bool = False) -> None:
+        docker = _docker_bin()
+        cid = handle.driver_state.get("container_id")
+        if handle.is_running() and not force:
+            raise RuntimeError("task still running; use force")
+        lp = getattr(handle, "_log_proc", None)
+        if lp is not None:
+            try:
+                lp.kill()
+            except OSError:
+                pass
+        if docker and cid:
+            self._run(docker, "rm", "-f", cid, timeout=30.0)
+
+    def inspect_task(self, handle: TaskHandle) -> dict:
+        base = super().inspect_task(handle)
+        docker = _docker_bin()
+        cid = handle.driver_state.get("container_id")
+        if docker and cid:
+            r = self._run(docker, "inspect", cid, timeout=15.0)
+            if r.returncode == 0:
+                try:
+                    base["container"] = json.loads(r.stdout.decode())[0]
+                except (ValueError, IndexError):
+                    pass
+        return base
+
+    def exec_task(self, handle: TaskHandle, command: str,
+                  args: Optional[List[str]] = None,
+                  timeout_s: float = 30.0) -> dict:
+        docker = _docker_bin()
+        cid = handle.driver_state.get("container_id")
+        if not docker or not cid:
+            raise RuntimeError("no container for task")
+        r = self._run(docker, "exec", cid, command,
+                      *[str(a) for a in args or []], timeout=timeout_s)
+        return {"exit_code": r.returncode,
+                "stdout": r.stdout.decode("utf-8", "replace"),
+                "stderr": r.stderr.decode("utf-8", "replace")}

@@ -1,0 +1,82 @@
+"""Client plugin managers.
+
+Behavioral reference: `client/pluginmanager/` — `drivermanager/manager.go`
+(driver instance ownership + periodic fingerprint loop feeding node
+attribute updates) and the manager-group lifecycle
+(`pluginmanager/group.go`). The device manager lives in
+`client/devicemanager.py` (reference `devicemanager/manager.go`).
+
+One driver instance per name per client (so e.g. the docker image-pull
+coordinator dedups across allocs on a node), health derived from the
+fingerprint result exactly like the reference's `driver.<name>` +
+`driver.<name>.version` attributes; a detected→undetected transition
+clears the attributes so the scheduler stops placing onto the node.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from .drivers import BUILTIN_DRIVERS, DriverPlugin
+
+
+class DriverManager:
+    """drivermanager/manager.go analog."""
+
+    def __init__(self,
+                 on_attrs: Optional[Callable[[Dict[str, str]], None]] = None,
+                 fingerprint_interval: float = 30.0) -> None:
+        self.on_attrs = on_attrs
+        self.fingerprint_interval = fingerprint_interval
+        self._drivers: Dict[str, DriverPlugin] = {}
+        self._last_attrs: Dict[str, Dict[str, str]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def dispense(self, name: str) -> DriverPlugin:
+        """Shared driver instance (manager.go Dispense)."""
+        with self._lock:
+            d = self._drivers.get(name)
+            if d is None:
+                cls = BUILTIN_DRIVERS.get(name)
+                if cls is None:
+                    raise ValueError(f"unknown driver {name!r}")
+                d = cls()
+                self._drivers[name] = d
+            return d
+
+    def fingerprint_once(self) -> Dict[str, str]:
+        """Run every driver's fingerprint; returns the merged attribute
+        map including explicit '' tombstones for attrs that vanished."""
+        merged: Dict[str, str] = {}
+        for name, cls in BUILTIN_DRIVERS.items():
+            try:
+                attrs = self.dispense(name).fingerprint()
+            except Exception:
+                attrs = {}
+            prev = self._last_attrs.get(name, {})
+            # clear attrs a now-undetected driver previously published
+            for k in prev:
+                if k not in attrs:
+                    merged[k] = ""
+            merged.update(attrs)
+            self._last_attrs[name] = dict(attrs)
+        return merged
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="driver-manager", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.fingerprint_interval):
+            updates = self.fingerprint_once()
+            if updates and self.on_attrs is not None:
+                try:
+                    self.on_attrs(updates)
+                except Exception:
+                    pass
+
+    def shutdown(self) -> None:
+        self._stop.set()

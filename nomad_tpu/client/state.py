@@ -36,7 +36,22 @@ class ClientStateDB:
     def put_alloc(self, alloc) -> None:
         # task_states ride inside the alloc record itself
         with self._lock:
-            self._data[alloc.id] = {"alloc": to_wire(alloc)}
+            rec = self._data.setdefault(alloc.id, {})
+            rec["alloc"] = to_wire(alloc)
+            self._flush()
+
+    def put_task_handle(self, alloc_id: str, task: str, driver: str,
+                        driver_state) -> None:
+        """Persist (or clear, when driver_state is None) a task's driver
+        handle — the reference's TaskHandle record in the client BoltDB
+        (`client/state/state_database.go` PutTaskRunnerLocalState)."""
+        with self._lock:
+            rec = self._data.setdefault(alloc_id, {})
+            handles = rec.setdefault("handles", {})
+            if driver_state is None:
+                handles.pop(task, None)
+            else:
+                handles[task] = {"driver": driver, "state": driver_state}
             self._flush()
 
     def delete_alloc(self, alloc_id: str) -> None:
@@ -46,8 +61,10 @@ class ClientStateDB:
 
     def allocs(self) -> Dict[str, Any]:
         with self._lock:
-            return {aid: {"alloc": from_wire(rec["alloc"])}
-                    for aid, rec in self._data.items()}
+            return {aid: {"alloc": from_wire(rec["alloc"]),
+                          "handles": dict(rec.get("handles") or {})}
+                    for aid, rec in self._data.items()
+                    if "alloc" in rec}
 
     def _flush(self) -> None:
         tmp = self._path + ".tmp"

@@ -64,6 +64,9 @@ class TaskRunner:
     def __init__(self, alloc: Allocation, task: Task, task_dir: str,
                  logs_dir: str, node=None,
                  on_state_change: Optional[Callable] = None,
+                 on_handle: Optional[Callable] = None,
+                 recover_state: Optional[dict] = None,
+                 driver_manager=None,
                  update_period: float = 0.0) -> None:
         self.alloc = alloc
         self.task = task
@@ -71,12 +74,21 @@ class TaskRunner:
         self.logs_dir = logs_dir
         self.node = node
         self.on_state_change = on_state_change
+        #: persists the driver handle for recovery (client state DB)
+        self.on_handle = on_handle
+        #: persisted driver_state from a previous agent run, if any
+        self.recover_state = recover_state
         self.state = TaskState()
-        self.driver: DriverPlugin = new_driver(task.driver)
+        # shared per-client driver instance when a manager is present
+        # (drivermanager Dispense) — image-pull dedup etc. work per node
+        self.driver: DriverPlugin = (
+            driver_manager.dispense(task.driver) if driver_manager
+            else new_driver(task.driver))
         self.restart_tracker = RestartTracker(self._restart_policy())
         self.logmon: Optional[LogMon] = None
         self.handle = None
         self._kill = threading.Event()
+        self._detach = False
         self._thread: Optional[threading.Thread] = None
 
     def _restart_policy(self) -> RestartPolicy:
@@ -117,28 +129,39 @@ class TaskRunner:
             self._event(EVENT_DRIVER_FAILURE, str(e))
             self._set_state(TASK_STATE_DEAD, failed=True)
             return
+        recovered = self._try_recover()
         while not self._kill.is_set():
-            try:
-                cfg = self._task_config()
-                self.handle = self.driver.start_task(cfg)
-            except Exception as e:
-                self._event(EVENT_DRIVER_FAILURE, str(e))
-                if not self._maybe_restart(failed=True):
-                    return
-                continue
-            self._event(EVENT_STARTED)
+            if recovered:
+                recovered = False  # only the first pass reattaches
+            else:
+                try:
+                    cfg = self._task_config()
+                    self.handle = self.driver.start_task(cfg)
+                    self._persist_handle()
+                except Exception as e:
+                    self._event(EVENT_DRIVER_FAILURE, str(e))
+                    if not self._maybe_restart(failed=True):
+                        return
+                    continue
+                self._event(EVENT_STARTED)
             self._set_state(TASK_STATE_RUNNING)
             result = None
             while result is None and not self._kill.is_set():
                 result = self.driver.wait_task(self.handle, timeout=0.1)
             if self._kill.is_set():
+                if self._detach:
+                    # agent shutdown: leave the task running; the handle
+                    # is persisted, the next agent recovers it
+                    return
                 if result is None:
                     self._event(EVENT_KILLING)
                     self.driver.stop_task(self.handle,
                                           self.task.kill_timeout_s)
                     self._event(EVENT_KILLED)
+                self._cleanup_handle()
                 self._set_state(TASK_STATE_DEAD, failed=False)
                 return
+            self._cleanup_handle()
             ok = result.successful()
             self._event(EVENT_TERMINATED,
                         f"Exit Code: {result.exit_code}"
@@ -148,6 +171,41 @@ class TaskRunner:
                 return
             if not self._maybe_restart(failed=True):
                 return
+
+    def _try_recover(self) -> bool:
+        """Reattach to a still-running task from a previous agent run
+        (task_runner restoration + driver RecoverTask)."""
+        if not self.recover_state:
+            return False
+        try:
+            handle = self.driver.recover_task(
+                f"{self.alloc.id}/{self.task.name}", self.recover_state)
+        except Exception as e:
+            self._event(EVENT_DRIVER_FAILURE, f"recover failed: {e}")
+            return False
+        if handle is None:
+            return False
+        self.handle = handle
+        self._event(EVENT_STARTED, "Task recovered after agent restart")
+        return True
+
+    def _persist_handle(self) -> None:
+        if self.on_handle is not None and self.handle is not None:
+            self.on_handle(self.task.name, self.task.driver,
+                           self.handle.driver_state)
+
+    def _cleanup_handle(self) -> None:
+        """Release driver-side resources for a terminally-ended task
+        (kills the per-task executor plugin; no-op for in-process
+        drivers)."""
+        if self.handle is None:
+            return
+        try:
+            self.driver.destroy_task(self.handle, force=True)
+        except Exception:
+            pass
+        if self.on_handle is not None:
+            self.on_handle(self.task.name, self.task.driver, None)
 
     def _maybe_restart(self, failed: bool) -> bool:
         delay = self.restart_tracker.next(time.time())
@@ -160,7 +218,8 @@ class TaskRunner:
         self._event(EVENT_RESTARTING, f"Task restarting in {delay:.1f}s")
         self._set_state(TASK_STATE_PENDING)
         if self._kill.wait(delay):
-            self._set_state(TASK_STATE_DEAD, failed=False)
+            if not self._detach:
+                self._set_state(TASK_STATE_DEAD, failed=False)
             return False
         return True
 
@@ -198,9 +257,18 @@ class TaskRunner:
             cpu_mhz=self.task.resources.cpu,
             memory_mb=self.task.resources.memory_mb,
             kill_timeout_s=self.task.kill_timeout_s,
+            max_files=self.task.log_config.max_files,
+            max_file_size_mb=self.task.log_config.max_file_size_mb,
         )
 
     def kill(self) -> None:
+        self._kill.set()
+
+    def detach(self) -> None:
+        """Stop the runner WITHOUT stopping the task (agent shutdown —
+        the reference leaves tasks running and recovers their handles,
+        client.go shutdown semantics)."""
+        self._detach = True
         self._kill.set()
 
     def join(self, timeout: float = 10.0) -> None:

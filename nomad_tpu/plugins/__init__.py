@@ -1,0 +1,21 @@
+"""Out-of-process plugin framework.
+
+Behavioral reference: `plugins/base/base.go` + `plugins/base/plugin.go`
+(go-plugin handshake: the plugin subprocess prints a handshake line on
+stdout naming the address it serves, the host connects and speaks RPC) and
+`drivers/shared/executor/executor_plugin.go` (the per-task executor
+plugin). The wire here is the same length-prefixed msgpack-RPC fabric the
+servers use (`nomad_tpu/rpc/transport.py`) instead of gRPC — one codec
+across the whole system.
+
+Plugins run as detached subprocesses (own session) so they survive the
+agent's death; drivers persist a reattach record {pid, addr} and recover
+live tasks after a restart exactly like the reference's
+`TaskHandle`/`RecoverTask` contract (`plugins/drivers/driver.go`,
+`task_handle.go`).
+"""
+from .base import (HANDSHAKE_MAGIC, PLUGIN_PROTOCOL_VERSION, PluginClient,
+                   PluginLaunchError, launch_plugin, reattach_plugin)
+
+__all__ = ["HANDSHAKE_MAGIC", "PLUGIN_PROTOCOL_VERSION", "PluginClient",
+           "PluginLaunchError", "launch_plugin", "reattach_plugin"]

@@ -1,0 +1,270 @@
+"""Out-of-process task executor plugin.
+
+Behavioral reference: `drivers/shared/executor/` — `executor.go` (Launch /
+Wait / Shutdown / Exec / Stats contract), `executor_plugin.go` (served as
+a plugin over the wire), `executor_linux.go` (isolation), `pid_collector.go`
+(process stats). One executor process per task; it is the task's parent,
+lives in its own session, and therefore survives the agent: after an agent
+restart the driver reattaches via the persisted {pid, addr} record and the
+task never noticed (`RecoverTask`, `plugins/drivers/driver.go`).
+
+Log capture: the executor owns the task's stdout/stderr pipes and writes
+the rotating `<task>.{stdout,stderr}.N` files itself (the reference splits
+this into a separate logmon plugin; folding it into the executor keeps one
+process per task while preserving the property that log capture survives
+agent restarts — the actual deviation is documented in client/logmon.py).
+
+Run as: python -m nomad_tpu.plugins.executor
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from . import isolation
+from .base import serve_plugin
+
+_signals = {name: getattr(_signal, name) for name in dir(_signal)
+            if name.startswith("SIG") and not name.startswith("SIG_")}
+
+
+class ExecutorService:
+    """The per-task executor endpoint (executor.go Executor interface)."""
+
+    def __init__(self) -> None:
+        self._proc: Optional[subprocess.Popen] = None
+        self._exit: Optional[Dict[str, object]] = None
+        self._exit_ev = threading.Event()
+        self._cgroup: Optional[isolation.Cgroup] = None
+        self._spec: Dict[str, object] = {}
+        self._applied: Dict[str, object] = {}
+        self._pumps: List[threading.Thread] = []
+        self._stop_plugin: Optional[threading.Event] = None
+
+    # -- contract ----------------------------------------------------------
+
+    def launch(self, spec: Dict[str, object]) -> Dict[str, object]:
+        """executor.go Launch: start the task under the requested isolation.
+
+        spec: command, args, env, cwd, user, task_id,
+              stdout_prefix/stderr_prefix (rotating file prefixes),
+              logs_dir, max_files, max_file_size_mb,
+              memory_mb, cpu_shares, pids_max,
+              isolation: {cgroup, namespaces, pid_namespace, chroot,
+                          chroot_paths, rlimit_memory, nice}
+        """
+        if self._proc is not None:
+            raise RuntimeError("task already launched")
+        self._spec = spec
+        iso = spec.get("isolation") or {}
+        caps = isolation.capabilities()
+        applied: Dict[str, object] = {"cgroup": None, "namespaces": False,
+                                      "pid_namespace": False, "chroot": False,
+                                      "rlimit_memory": False}
+
+        task_id = str(spec.get("task_id") or f"task-{os.getpid()}")
+        cg_name = task_id.replace("/", "_")
+
+        init_spec: Dict[str, object] = {
+            "command": spec["command"],
+            "args": spec.get("args") or [],
+            "env": spec.get("env") or {},
+            "cwd": spec.get("cwd") or None,
+            "user": spec.get("user") or None,
+            "nice": iso.get("nice", 0),
+        }
+
+        if iso.get("cgroup") and caps["cgroup"]:
+            self._cgroup = isolation.Cgroup(cg_name)
+            self._cgroup.create(
+                memory_mb=int(spec.get("memory_mb") or 0),
+                cpu_shares=int(spec.get("cpu_shares") or 0),
+                pids_max=int(spec.get("pids_max") or 0),
+            )
+            init_spec["cgroup"] = {"name": cg_name,
+                                   "version": self._cgroup.version}
+            applied["cgroup"] = self._cgroup.version
+        if iso.get("rlimit_memory"):
+            init_spec["rlimit_memory_mb"] = int(spec.get("memory_mb") or 0)
+            applied["rlimit_memory"] = True
+        if iso.get("namespaces") and caps["namespaces"]:
+            init_spec["namespaces"] = True
+            applied["namespaces"] = True
+            if iso.get("pid_namespace"):
+                init_spec["pid_namespace"] = True
+                applied["pid_namespace"] = True
+        if iso.get("chroot") and caps["chroot"] and applied["namespaces"]:
+            init_spec["chroot"] = iso["chroot"]
+            init_spec["chroot_paths"] = iso.get("chroot_paths")
+            init_spec["chroot_cwd"] = iso.get("chroot_cwd")
+            applied["chroot"] = True
+        self._applied = applied
+
+        import json
+
+        out = self._rotator(spec, "stdout")
+        err = self._rotator(spec, "stderr")
+        # taskinit must import nomad_tpu regardless of the task's env;
+        # the spec rides in an env var (no tempfile lifetime races)
+        boot_env = {**os.environ,
+                    "PYTHONPATH": os.pathsep.join(p for p in sys.path if p),
+                    "NOMAD_TASKINIT_SPEC": json.dumps(init_spec)}
+        boot_env.pop("PALLAS_AXON_POOL_IPS", None)  # fast bootstrap
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "nomad_tpu.plugins.taskinit"],
+            stdout=subprocess.PIPE if out else subprocess.DEVNULL,
+            stderr=subprocess.PIPE if err else subprocess.DEVNULL,
+            stdin=subprocess.DEVNULL,
+            env=boot_env,
+        )
+        for stream, rot in ((self._proc.stdout, out),
+                            (self._proc.stderr, err)):
+            if stream is None or rot is None:
+                continue
+
+            def pump(stream=stream, rot=rot):
+                for chunk in iter(lambda: stream.read(8192), b""):
+                    try:
+                        rot.write(chunk)
+                    except Exception:
+                        break
+                stream.close()
+                rot.close()
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            self._pumps.append(t)
+
+        threading.Thread(target=self._reap, daemon=True).start()
+        return {"pid": self._proc.pid, "applied": applied}
+
+    def _rotator(self, spec, stream: str):
+        from ..client.logmon import FileRotator
+
+        logs_dir = spec.get("logs_dir")
+        prefix = spec.get(f"{stream}_prefix")
+        if not logs_dir or not prefix:
+            return None
+        return FileRotator(
+            logs_dir, prefix,
+            max_files=int(spec.get("max_files") or 10),
+            max_file_size=int(spec.get("max_file_size_mb") or 10)
+            * 1024 * 1024,
+        )
+
+    def _reap(self) -> None:
+        code = self._proc.wait()
+        for t in self._pumps:
+            t.join(timeout=2.0)
+        oom = self._cgroup.oom_killed() if self._cgroup else False
+        if code < 0:
+            self._exit = {"exit_code": 0, "signal": -code,
+                          "oom_killed": oom, "err": ""}
+        else:
+            self._exit = {"exit_code": code, "signal": 0,
+                          "oom_killed": oom, "err": ""}
+        # cgroup stays for post-mortem stats; removed on destroy
+        self._exit_ev.set()
+
+    def wait(self, timeout_s: Optional[float] = None
+             ) -> Optional[Dict[str, object]]:
+        """executor.go Wait — blocks (RPC server runs one thread per
+        request, so long waits don't starve other calls)."""
+        if self._exit_ev.wait(timeout_s):
+            return self._exit
+        return None
+
+    def status(self) -> Dict[str, object]:
+        return {
+            "pid": self._proc.pid if self._proc else 0,
+            "running": self._proc is not None and self._exit is None,
+            "exit": self._exit,
+            "applied": self._applied,
+        }
+
+    def stop(self, sig: str = "SIGTERM", grace_s: float = 5.0
+             ) -> Optional[Dict[str, object]]:
+        """executor.go Shutdown: signal, grace period, then SIGKILL."""
+        if self._proc is None or self._exit is not None:
+            return self._exit
+        signum = _signals.get(sig, _signal.SIGTERM)
+        try:
+            os.killpg(self._proc.pid, signum)
+        except (ProcessLookupError, PermissionError):
+            try:
+                self._proc.send_signal(signum)
+            except ProcessLookupError:
+                pass
+        if not self._exit_ev.wait(grace_s):
+            try:
+                os.killpg(self._proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            if self._cgroup:
+                self._cgroup.kill_all()
+            self._exit_ev.wait(2.0)
+        return self._exit
+
+    def stats(self) -> Dict[str, object]:
+        """pid_collector.go analog: cgroup stats + /proc fallback."""
+        out: Dict[str, object] = {"pids": {}}
+        if self._cgroup:
+            out.update(self._cgroup.stats())
+        if self._proc and self._exit is None:
+            try:
+                with open(f"/proc/{self._proc.pid}/statm") as fh:
+                    pages = int(fh.read().split()[1])
+                out.setdefault("memory_bytes",
+                               pages * os.sysconf("SC_PAGE_SIZE"))
+            except (OSError, IndexError, ValueError):
+                pass
+        return out
+
+    def exec_cmd(self, command: str, args: List[str],
+                 timeout_s: float = 30.0) -> Dict[str, object]:
+        """executor.go Exec: run a command in the task's context (cwd +
+        env); powers `nomad alloc exec`."""
+        spec = self._spec
+        try:
+            r = subprocess.run(
+                [command] + [str(a) for a in args or []],
+                cwd=spec.get("cwd") or None,
+                env={**os.environ, **(spec.get("env") or {})},
+                capture_output=True, timeout=timeout_s,
+            )
+            return {"exit_code": r.returncode,
+                    "stdout": r.stdout.decode("utf-8", "replace"),
+                    "stderr": r.stderr.decode("utf-8", "replace")}
+        except subprocess.TimeoutExpired:
+            return {"exit_code": -1, "stdout": "", "stderr": "timeout"}
+
+    def destroy(self) -> bool:
+        """Kill the task if needed, clean the cgroup, exit the plugin."""
+        if self._proc is not None and self._exit is None:
+            self.stop("SIGKILL", 0.0)
+        if self._cgroup:
+            self._cgroup.destroy()
+        if self._stop_plugin is not None:
+            # give the RPC response a beat to flush before exiting
+            threading.Timer(0.2, self._stop_plugin.set).start()
+        return True
+
+
+def main() -> None:
+    service = ExecutorService()
+
+    def register(server) -> None:
+        stop = threading.Event()
+        server._plugin_stop = stop
+        service._stop_plugin = stop
+        server.register_endpoint("Executor", service)
+
+    serve_plugin("executor", register)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,336 @@
+"""Linux task isolation: cgroups, namespaces, chroot, rlimits.
+
+Behavioral reference: `drivers/shared/executor/executor_linux.go:27-31`
+(libcontainer-backed isolation: namespaces, cgroups, chroot) and
+`executor_universal_linux.go` (cgroup-only fallback). libcontainer is a Go
+runtime; here the same kernel surfaces are driven directly:
+
+- cgroups: v2 unified (`/sys/fs/cgroup/cgroup.controllers` present) or v1
+  split controllers; memory/cpu/pids limits from the scheduler's resource
+  dimensions, OOM-kill detection from memory events.
+- namespaces: mount/IPC/UTS via `os.unshare` in the task bootstrap
+  (`taskinit.py`); PID via an extra fork layer (CLONE_NEWPID applies to
+  children of the unshare caller, so the bootstrap forwards exit/signals).
+- chroot: bind-mounts a configured host-path list into the task dir and
+  chroots (the reference's chroot_env, `executor_linux.go` chroot deps).
+
+Everything degrades gracefully: `capabilities()` reports what this host
+can enforce, and the executor records what was actually applied so tests
+(and operators) can see the difference.
+"""
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import resource
+import signal
+from typing import Dict, List, Optional
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+PARENT_GROUP = "nomad_tpu"
+
+#: reference client config `chroot_env` defaults
+DEFAULT_CHROOT_PATHS = ["/bin", "/etc", "/lib", "/lib32", "/lib64",
+                        "/run/resolvconf", "/sbin", "/usr", "/dev"]
+
+MS_BIND = 0x1000
+MS_REC = 0x4000
+MS_PRIVATE = 1 << 18
+
+_libc = None
+
+
+def _get_libc():
+    global _libc
+    if _libc is None:
+        # NEVER ctypes.util.find_library here: it spawns helper
+        # subprocesses, and after unshare(CLONE_NEWPID) the first child
+        # becomes the namespace's init — when that throwaway helper
+        # exits, the pid namespace dies and every later fork fails
+        # ENOMEM. Plain dlopen by soname spawns nothing.
+        try:
+            _libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        except OSError:
+            _libc = ctypes.CDLL(ctypes.util.find_library("c"),
+                                use_errno=True)
+    return _libc
+
+
+def bind_mount(src: str, dst: str, recursive: bool = True) -> None:
+    libc = _get_libc()
+    flags = MS_BIND | (MS_REC if recursive else 0)
+    if libc.mount(src.encode(), dst.encode(), b"none", flags, None) != 0:
+        e = ctypes.get_errno()
+        raise OSError(e, f"bind mount {src} -> {dst}: {os.strerror(e)}")
+
+
+def make_mounts_private() -> None:
+    """mount --make-rprivate / so binds don't propagate to the host."""
+    libc = _get_libc()
+    if libc.mount(b"none", b"/", None, MS_REC | MS_PRIVATE, None) != 0:
+        e = ctypes.get_errno()
+        raise OSError(e, f"make-rprivate /: {os.strerror(e)}")
+
+
+def mount_proc(target: str = "/proc") -> None:
+    libc = _get_libc()
+    if libc.mount(b"proc", target.encode(), b"proc", 0, None) != 0:
+        e = ctypes.get_errno()
+        raise OSError(e, f"mount proc at {target}: {os.strerror(e)}")
+
+
+# ---------------------------------------------------------------------------
+# Capability detection
+# ---------------------------------------------------------------------------
+
+def cgroup_version() -> Optional[str]:
+    if os.path.exists(os.path.join(CGROUP_ROOT, "cgroup.controllers")):
+        return "v2"
+    if os.path.isdir(os.path.join(CGROUP_ROOT, "memory")):
+        return "v1"
+    return None
+
+
+def capabilities() -> Dict[str, object]:
+    """What isolation this host can actually enforce."""
+    root = os.geteuid() == 0
+    cg = cgroup_version()
+    cg_writable = False
+    if cg == "v2":
+        cg_writable = os.access(CGROUP_ROOT, os.W_OK)
+    elif cg == "v1":
+        cg_writable = os.access(os.path.join(CGROUP_ROOT, "memory"), os.W_OK)
+    ns = root and hasattr(os, "unshare")
+    return {
+        "root": root,
+        "cgroup": cg if (cg and cg_writable and root) else None,
+        "namespaces": ns,
+        "chroot": root,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cgroup management (executor side — created before launch, pid added by
+# the task bootstrap, stats/oom read by the executor)
+# ---------------------------------------------------------------------------
+
+class Cgroup:
+    """One task's cgroup across v1/v2 (libcontainer cgroup manager analog).
+
+    v2: one dir under /sys/fs/cgroup/nomad_tpu/<name>/ with memory.max,
+    cpu.weight, pids.max. v1: a dir per controller (memory/cpu/pids).
+    """
+
+    def __init__(self, name: str, version: Optional[str] = None) -> None:
+        self.name = name
+        self.version = version or cgroup_version()
+        self.paths: List[str] = []
+
+    def _v1_path(self, controller: str) -> str:
+        return os.path.join(CGROUP_ROOT, controller, PARENT_GROUP, self.name)
+
+    def _v2_path(self) -> str:
+        return os.path.join(CGROUP_ROOT, PARENT_GROUP, self.name)
+
+    @staticmethod
+    def _write(path: str, value: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(value)
+
+    def create(self, memory_mb: int = 0, cpu_shares: int = 0,
+               pids_max: int = 0) -> None:
+        if self.version == "v2":
+            parent = os.path.join(CGROUP_ROOT, PARENT_GROUP)
+            os.makedirs(parent, exist_ok=True)
+            # delegate controllers to the parent before making children
+            try:
+                ctrls = open(os.path.join(CGROUP_ROOT,
+                                          "cgroup.controllers")).read().split()
+                want = " ".join(f"+{c}" for c in ("memory", "cpu", "pids")
+                                if c in ctrls)
+                if want:
+                    self._write(os.path.join(parent, "cgroup.subtree_control"),
+                                want)
+            except OSError:
+                pass
+            path = self._v2_path()
+            os.makedirs(path, exist_ok=True)
+            self.paths = [path]
+            if memory_mb:
+                try:
+                    self._write(os.path.join(path, "memory.max"),
+                                str(memory_mb * 1024 * 1024))
+                except OSError:
+                    pass
+            if cpu_shares:
+                # v2 cpu.weight ∈ [1, 10000]; reference maps CPU shares
+                # (cgroup v1 1024-based) linearly
+                weight = max(1, min(10000, cpu_shares * 10000 // 262144))
+                try:
+                    self._write(os.path.join(path, "cpu.weight"), str(weight))
+                except OSError:
+                    pass
+            if pids_max:
+                try:
+                    self._write(os.path.join(path, "pids.max"), str(pids_max))
+                except OSError:
+                    pass
+        elif self.version == "v1":
+            self.paths = []
+            for ctrl in ("memory", "cpu", "pids"):
+                base = os.path.join(CGROUP_ROOT, ctrl)
+                if not os.path.isdir(base):
+                    continue
+                path = os.path.join(base, PARENT_GROUP, self.name)
+                try:
+                    os.makedirs(path, exist_ok=True)
+                except OSError:
+                    continue
+                self.paths.append(path)
+                try:
+                    if ctrl == "memory" and memory_mb:
+                        self._write(os.path.join(path,
+                                                 "memory.limit_in_bytes"),
+                                    str(memory_mb * 1024 * 1024))
+                    elif ctrl == "cpu" and cpu_shares:
+                        self._write(os.path.join(path, "cpu.shares"),
+                                    str(max(2, cpu_shares)))
+                    elif ctrl == "pids" and pids_max:
+                        self._write(os.path.join(path, "pids.max"),
+                                    str(pids_max))
+                except OSError:
+                    pass
+
+    def add_pid(self, pid: int) -> None:
+        fname = "cgroup.procs"
+        for path in self.paths:
+            try:
+                self._write(os.path.join(path, fname), str(pid))
+            except OSError:
+                pass
+
+    def pids(self) -> List[int]:
+        out: List[int] = []
+        for path in self.paths[:1]:
+            try:
+                with open(os.path.join(path, "cgroup.procs")) as fh:
+                    out = [int(x) for x in fh.read().split()]
+            except OSError:
+                pass
+        return out
+
+    def oom_killed(self) -> bool:
+        """memory.events (v2) oom_kill > 0 / memory.oom_control (v1)."""
+        try:
+            if self.version == "v2" and self.paths:
+                with open(os.path.join(self.paths[0], "memory.events")) as fh:
+                    for line in fh:
+                        k, _, v = line.partition(" ")
+                        if k == "oom_kill":
+                            return int(v) > 0
+            elif self.version == "v1":
+                mem = self._v1_path("memory")
+                with open(os.path.join(mem, "memory.oom_control")) as fh:
+                    for line in fh:
+                        k, _, v = line.partition(" ")
+                        if k == "oom_kill":
+                            return int(v) > 0
+        except OSError:
+            pass
+        return False
+
+    def stats(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        try:
+            if self.version == "v2" and self.paths:
+                p = self.paths[0]
+                out["memory_bytes"] = int(
+                    open(os.path.join(p, "memory.current")).read())
+                for line in open(os.path.join(p, "cpu.stat")):
+                    k, _, v = line.partition(" ")
+                    if k == "usage_usec":
+                        out["cpu_usec"] = int(v)
+            elif self.version == "v1":
+                mem = self._v1_path("memory")
+                out["memory_bytes"] = int(
+                    open(os.path.join(mem, "memory.usage_in_bytes")).read())
+                cpu = os.path.join(CGROUP_ROOT, "cpuacct", PARENT_GROUP,
+                                   self.name)
+                if os.path.isdir(cpu):
+                    out["cpu_usec"] = int(
+                        open(os.path.join(cpu, "cpuacct.usage")).read()
+                    ) // 1000
+        except (OSError, ValueError):
+            pass
+        return out
+
+    def kill_all(self) -> None:
+        for pid in self.pids():
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def destroy(self) -> None:
+        self.kill_all()
+        for path in self.paths:
+            try:
+                os.rmdir(path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Task-bootstrap helpers (run inside taskinit, between fork and exec)
+# ---------------------------------------------------------------------------
+
+def apply_rlimits(memory_mb: int = 0, nofile: int = 0) -> None:
+    if memory_mb:
+        b = memory_mb * 1024 * 1024
+        try:
+            resource.setrlimit(resource.RLIMIT_AS, (b, b))
+        except (ValueError, OSError):
+            pass
+    if nofile:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (nofile, nofile))
+        except (ValueError, OSError):
+            pass
+
+
+def drop_user(user: str) -> None:
+    import grp  # noqa: F401 — ensures mod loaded pre-chroot
+    import pwd
+
+    ent = pwd.getpwnam(user)
+    os.initgroups(user, ent.pw_gid)
+    os.setgid(ent.pw_gid)
+    os.setuid(ent.pw_uid)
+
+
+def setup_chroot(task_dir: str,
+                 paths: Optional[List[str]] = None) -> None:
+    """Bind the chroot_env host paths into the task dir and chroot.
+
+    Caller must already be in a private mount namespace (unshare NEWNS +
+    make_mounts_private) so the binds never leak to the host.
+    """
+    for src in (paths if paths is not None else DEFAULT_CHROOT_PATHS):
+        if not os.path.exists(src):
+            continue
+        dst = os.path.join(task_dir, src.lstrip("/"))
+        if os.path.isdir(src):
+            os.makedirs(dst, exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            if not os.path.exists(dst):
+                open(dst, "a").close()
+        try:
+            bind_mount(src, dst)
+        except OSError as e:
+            if e.errno not in (errno.EINVAL, errno.ENOENT):
+                raise
+    os.chroot(task_dir)
+    os.chdir("/")

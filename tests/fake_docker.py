@@ -1,0 +1,235 @@
+#!/usr/bin/env python3
+"""A minimal fake `docker` CLI for driver tests (no daemon in CI).
+
+Emulates the subcommands the docker driver uses — version, image inspect,
+pull, create, start, wait, logs, inspect, stop, rm, exec — backed by a
+state dir ($FAKE_DOCKER_STATE) and real local processes, so the driver's
+full lifecycle (including recovery after "agent restart") is exercised
+without a Docker daemon.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+STATE = os.environ.get("FAKE_DOCKER_STATE", "/tmp/fake-docker")
+
+
+def cdir(cid):
+    return os.path.join(STATE, cid)
+
+
+def load(cid):
+    with open(os.path.join(cdir(cid), "meta.json")) as fh:
+        return json.load(fh)
+
+
+def save(cid, meta):
+    with open(os.path.join(cdir(cid), "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+
+
+def resolve(name_or_id):
+    if os.path.isdir(cdir(name_or_id)):
+        return name_or_id
+    for cid in os.listdir(STATE):
+        try:
+            if load(cid).get("name") == name_or_id:
+                return cid
+        except (OSError, ValueError):
+            continue
+    sys.stderr.write(f"No such container: {name_or_id}\n")
+    sys.exit(1)
+
+
+def exit_code(cid):
+    p = os.path.join(cdir(cid), "exit")
+    if os.path.exists(p):
+        return int(open(p).read().strip() or 0)
+    return None
+
+
+def main():
+    os.makedirs(STATE, exist_ok=True)
+    args = sys.argv[1:]
+    cmd = args[0] if args else ""
+
+    if cmd == "version":
+        print("99.0-fake")
+        return 0
+
+    if cmd == "image":
+        # image inspect <img>: present iff previously pulled
+        img = args[2]
+        ok = os.path.exists(os.path.join(STATE, "images",
+                                         img.replace("/", "_")))
+        if not ok:
+            sys.stderr.write("No such image\n")
+        return 0 if ok else 1
+
+    if cmd == "pull":
+        time.sleep(float(os.environ.get("FAKE_DOCKER_PULL_DELAY", "0")))
+        img = args[1]
+        os.makedirs(os.path.join(STATE, "images"), exist_ok=True)
+        with open(os.path.join(STATE, "images", img.replace("/", "_")),
+                  "a") as fh:
+            fh.write(f"{time.time()}\n")  # pull count for dedup asserts
+        return 0
+
+    if cmd == "create":
+        it = iter(args[1:])
+        meta = {"name": "", "env": {}, "image": "", "cmd": [],
+                "memory": "", "cpu_shares": "", "volumes": []}
+        for a in it:
+            if a == "--name":
+                meta["name"] = next(it)
+            elif a == "--env":
+                k, _, v = next(it).partition("=")
+                meta["env"][k] = v
+            elif a == "--memory":
+                meta["memory"] = next(it)
+            elif a == "--cpu-shares":
+                meta["cpu_shares"] = next(it)
+            elif a in ("--volume", "--publish", "--network", "--user",
+                       "--workdir"):
+                meta.setdefault(a.lstrip("-"), []).append(next(it))
+            else:
+                if not meta["image"]:
+                    meta["image"] = a
+                else:
+                    meta["cmd"].append(a)
+        cid = uuid.uuid4().hex[:12]
+        os.makedirs(cdir(cid))
+        save(cid, meta)
+        print(cid)
+        return 0
+
+    if cmd == "start":
+        cid = resolve(args[1])
+        meta = load(cid)
+        out = open(os.path.join(cdir(cid), "stdout"), "ab")
+        run = meta["cmd"] or ["/bin/true"]
+        env = {**os.environ, **meta["env"]}
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c",
+             'ec=0; "$@" || ec=$?; echo $ec > "$0"/exit',
+             cdir(cid)] + run,
+            stdout=out, stderr=out, env=env, start_new_session=True)
+        meta["pid"] = proc.pid
+        save(cid, meta)
+        print(cid)
+        return 0
+
+    if cmd == "wait":
+        cid = resolve(args[1])
+        while True:
+            ec = exit_code(cid)
+            if ec is not None:
+                print(ec)
+                return 0
+            time.sleep(0.05)
+
+    if cmd == "logs":
+        follow = "--follow" in args
+        cid = resolve(args[-1])
+        path = os.path.join(cdir(cid), "stdout")
+        pos = 0
+        while True:
+            if os.path.exists(path):
+                with open(path, "rb") as fh:
+                    fh.seek(pos)
+                    chunk = fh.read()
+                if chunk:
+                    sys.stdout.buffer.write(chunk)
+                    sys.stdout.buffer.flush()
+                    pos += len(chunk)
+            if not follow or exit_code(cid) is not None:
+                return 0
+            time.sleep(0.05)
+
+    if cmd == "inspect":
+        fmt = None
+        rest = []
+        it = iter(args[1:])
+        for a in it:
+            if a == "--format":
+                fmt = next(it)
+            else:
+                rest.append(a)
+        cid = resolve(rest[0])
+        meta = load(cid)
+        running = exit_code(cid) is None and meta.get("pid")
+        if fmt == "{{.State.Running}}":
+            print("true" if running else "false")
+        elif fmt == "{{.State.ExitCode}}":
+            print(exit_code(cid) or 0)
+        elif fmt == "{{.State.OOMKilled}}":
+            print("false")
+        else:
+            print(json.dumps([{"Id": cid, "Config": meta,
+                               "State": {"Running": bool(running)}}]))
+        return 0
+
+    if cmd == "stop":
+        it = iter(args[1:])
+        grace = 10
+        target = None
+        for a in it:
+            if a == "--time":
+                grace = int(next(it))
+            else:
+                target = a
+        cid = resolve(target)
+        meta = load(cid)
+        pid = meta.get("pid")
+        if pid and exit_code(cid) is None:
+            try:
+                os.killpg(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            deadline = time.time() + grace
+            while time.time() < deadline and exit_code(cid) is None:
+                time.sleep(0.05)
+            if exit_code(cid) is None:
+                try:
+                    os.killpg(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                with open(os.path.join(cdir(cid), "exit"), "w") as fh:
+                    fh.write("137")
+        print(cid)
+        return 0
+
+    if cmd == "rm":
+        cid = resolve(args[-1])
+        meta = load(cid)
+        pid = meta.get("pid")
+        if pid and exit_code(cid) is None:
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        import shutil
+
+        shutil.rmtree(cdir(cid), ignore_errors=True)
+        print(cid)
+        return 0
+
+    if cmd == "exec":
+        cid = resolve(args[1])
+        meta = load(cid)
+        r = subprocess.run(args[2:], env={**os.environ, **meta["env"]},
+                           capture_output=True)
+        sys.stdout.buffer.write(r.stdout)
+        sys.stderr.buffer.write(r.stderr)
+        return r.returncode
+
+    sys.stderr.write(f"fake docker: unknown command {cmd}\n")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
