@@ -226,6 +226,34 @@ class HTTPApi:
                 require(acl.allow_namespace_operation(job.namespace,
                                                       "submit-job"))
                 return self._job_plan(server, job)
+            if sub == "scale":
+                # Reference: Job.Scale RPC (nomad/job_endpoint.go:969),
+                # routed at command/agent/job_endpoint.go jobScale.
+                if method == "GET":
+                    require(acl.allow_namespace_operation(ns, "read-job")
+                            or acl.allow_namespace_operation(
+                                ns, "read-job-scaling"))
+                    try:
+                        return server.job_scale_status(ns, job_id)
+                    except ValueError as e:
+                        raise HttpError(404, str(e))
+                if method in ("PUT", "POST"):
+                    require(acl.allow_namespace_operation(ns, "scale-job")
+                            or acl.allow_namespace_operation(
+                                ns, "submit-job"))
+                    target = body.get("Target", {}) or {}
+                    group = target.get("Group", "")
+                    if body.get("Count") is None:
+                        raise HttpError(400, "missing Count")
+                    try:
+                        ev = server.job_scale(
+                            ns, job_id, group, int(body["Count"]),
+                            message=body.get("Message", ""))
+                    except ValueError as e:
+                        raise HttpError(400, str(e))
+                    return {"eval_id": ev.id if ev else "",
+                            "eval_create_index": state.index.value,
+                            "job_modify_index": state.index.value}
         # /v1/nodes
         if parts == ["nodes"]:
             require(acl.allow_node_read())
@@ -353,9 +381,24 @@ class HTTPApi:
                 require(acl.allow_operator_write())
                 state.set_scheduler_config(from_wire(body))
                 return {"updated": True}
-        # /v1/job/<id>/scale handled above via parts[2]; /v1/volumes,
-        # /v1/volume/csi/<id>, /v1/plugins, /v1/search, /v1/scaling/policies,
-        # /v1/event/stream below
+        # /v1/scaling/policies + /v1/scaling/policy/<id>
+        # (command/agent/scaling_endpoint.go; state/schema.go:793)
+        if parts == ["scaling", "policies"]:
+            require(acl.allow_namespace_operation(ns, "list-scaling-policies")
+                    or acl.allow_namespace_operation(ns, "read-job"))
+            return blocking(lambda snap: (
+                snap.index_at,
+                [to_wire(sp) for sp in server.scaling_policies()
+                 if ns_visible(sp.target.get("Namespace", "default"),
+                               "read-job")]))
+        if parts and parts[0] == "scaling" and len(parts) >= 3 \
+                and parts[1] == "policy":
+            sp = server.scaling_policy(parts[2])
+            if sp is None:
+                raise HttpError(404, f"scaling policy {parts[2]!r} not found")
+            require(acl.allow_namespace_operation(
+                sp.target.get("Namespace", "default"), "read-job"))
+            return to_wire(sp)
         if parts == ["volumes"]:
             require_ns("csi-list-volume")
             return blocking(lambda snap: (

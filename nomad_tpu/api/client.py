@@ -107,6 +107,30 @@ class NomadClient:
                             params={"namespace": namespace})
         return out.get("eval_id", "")
 
+    # ---- scaling (api/scaling.go, api/jobs.go Scale) ----
+
+    def job_scale(self, job_id: str, group: str, count: int,
+                  message: str = "", namespace: str = "default") -> str:
+        out = self._request("PUT", f"/v1/job/{job_id}/scale",
+                            params={"namespace": namespace},
+                            body={"Count": count,
+                                  "Target": {"Group": group},
+                                  "Message": message})
+        return out.get("eval_id", "")
+
+    def job_scale_status(self, job_id: str,
+                         namespace: str = "default") -> dict:
+        return self._request("GET", f"/v1/job/{job_id}/scale",
+                             params={"namespace": namespace})
+
+    def scaling_policies(self) -> List[Any]:
+        res = self._request("GET", "/v1/scaling/policies")
+        return [from_wire(p) for p in self._unblock(res)[1]]
+
+    def scaling_policy(self, policy_id: str):
+        return from_wire(
+            self._request("GET", f"/v1/scaling/policy/{policy_id}"))
+
     # ---- nodes (api/nodes.go) ----
 
     def nodes(self) -> List[Any]:

@@ -144,6 +144,32 @@ def cmd_job_plan(args) -> int:
     return 0
 
 
+def cmd_job_scale(args) -> int:
+    api = _client(args)
+    if args.count is None:
+        group, count = None, int(args.group_or_count)
+    else:
+        group, count = args.group_or_count, args.count
+    if group is None:
+        # Single-group jobs may omit the group (command/job_scale.go).
+        job = api.job(args.job_id, namespace=args.namespace)
+        if len(job.task_groups) != 1:
+            print("error: job has multiple groups; specify one",
+                  file=sys.stderr)
+            return 1
+        group = job.task_groups[0].name
+    try:
+        eval_id = api.job_scale(args.job_id, group, count,
+                                namespace=args.namespace)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f'Scaled group "{group}" of job "{args.job_id}" to {count}')
+    if eval_id and not args.detach:
+        return _monitor(api, eval_id)
+    return 0
+
+
 def cmd_job_inspect(args) -> int:
     from .structs.codec import to_wire
 
@@ -418,6 +444,13 @@ def build_parser() -> argparse.ArgumentParser:
     jp = job.add_parser("plan")
     jp.add_argument("spec")
     jp.set_defaults(fn=cmd_job_plan)
+    jsc = job.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group_or_count")
+    jsc.add_argument("count", nargs="?", type=int, default=None)
+    jsc.add_argument("-namespace", default="default")
+    jsc.add_argument("-detach", action="store_true")
+    jsc.set_defaults(fn=cmd_job_scale)
     ji = job.add_parser("inspect")
     ji.add_argument("job_id")
     ji.add_argument("-namespace", default="default")

@@ -152,6 +152,18 @@ def _parse_group(name: str, body: Dict[str, Any], job: Job) -> TaskGroup:
         )
     for svc in _many(body.get("service")):
         tg.services.append(_parse_service(svc))
+    if "scaling" in body:
+        # Reference jobspec group scaling stanza (jobspec/parse_group.go
+        # parseScalingPolicy); min defaults to the group count.
+        s = _one(body["scaling"])
+        job.scaling_policies.append(ScalingPolicy(
+            target={"Namespace": job.namespace, "Job": job.id,
+                    "Group": name},
+            policy=dict(_one(s.get("policy", {})) or {}),
+            min=int(s.get("min", tg.count)),
+            max=int(s.get("max", tg.count)),
+            enabled=bool(s.get("enabled", True)),
+        ))
     tasks = body.get("task")
     for t in _many(tasks):
         (tname, tbody), = t.items()

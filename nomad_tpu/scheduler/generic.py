@@ -54,6 +54,7 @@ from .util import (
     SetStatusError,
     State,
     adjust_queued_allocations,
+    generic_alloc_update_fn,
     progress_made,
     proposed_allocs,
     ready_nodes_in_dcs,
@@ -217,6 +218,7 @@ class GenericScheduler:
             tainted_nodes=tainted,
             eval_id=ev.id,
             deployment=self.deployment,
+            alloc_update_fn=generic_alloc_update_fn,
         )
         results = reconciler.compute()
 

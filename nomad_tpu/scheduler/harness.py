@@ -119,6 +119,16 @@ class InMemState:
                     self.upsert_alloc(merged)
         for allocs in result.node_allocation.values():
             for a in allocs:
+                existing = self._allocs.get(a.id)
+                if existing is not None:
+                    # Re-upserting a live alloc (in-place update): keep the
+                    # client-owned fields — the plan's copy is a stale
+                    # scheduler snapshot (reference upsertAllocsImpl,
+                    # state_store.go: ClientStatus/TaskStates carried over).
+                    a = copy.copy(a)
+                    a.client_status = existing.client_status
+                    a.client_description = existing.client_description
+                    a.task_states = existing.task_states
                 if a.job is None:
                     # WAL replay strips the embedded job; reattach the
                     # VERSION the alloc was placed with, not the current

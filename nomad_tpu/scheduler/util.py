@@ -235,3 +235,43 @@ def update_reschedule_tracker(alloc: Allocation, prev: Allocation,
     if policy is not None and policy.unlimited and len(events) > 5:
         events = events[-5:]
     alloc.reschedule_tracker = RescheduleTracker(events=events)
+
+
+def tasks_updated(j1, j2, group_name: str) -> bool:
+    """Reference tasksUpdated (scheduler/util.go:413): True when the group's
+    spec differs in a way that requires destructive (stop + replace) updates.
+    Count, restart/reschedule/migrate/update policies, constraints and
+    scaling are placement-/client-side knobs, not destructive changes."""
+    from ..structs.codec import to_wire
+
+    a = j1.lookup_task_group(group_name) if j1 is not None else None
+    b = j2.lookup_task_group(group_name) if j2 is not None else None
+    if a is None or b is None:
+        return True
+
+    def sig(tg):
+        w = to_wire(tg)
+        for k in ("count", "restart_policy", "reschedule_policy",
+                  "migrate_strategy", "update", "constraints", "affinities",
+                  "spreads", "meta"):
+            w.pop(k, None)
+        return w
+
+    return sig(a) != sig(b)
+
+
+def generic_alloc_update_fn(alloc, job, tg):
+    """Reference genericAllocUpdateFn (scheduler/util.go:849): same job
+    version → ignore; task spec changed → destructive; otherwise update the
+    alloc in place to reference the new job version (resources unchanged, so
+    the existing placement still fits — the reference's stack re-check is a
+    no-op in that case)."""
+    import copy
+
+    if alloc.job is not None and alloc.job.version == job.version:
+        return True, False, None
+    if alloc.job is None or tasks_updated(alloc.job, job, tg.name):
+        return False, True, None
+    updated = copy.copy(alloc)
+    updated.job = job
+    return False, False, updated

@@ -102,6 +102,9 @@ class Server:
         self._gc_thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
         self._running = False
+        # (ns, job_id) → group → bounded scale-event history
+        # (structs.JobScalingEvents, state_store.go UpsertJob scaling events)
+        self._scaling_events: Dict[Tuple[str, str], Dict[str, List[Dict]]] = {}
 
     @property
     def acl(self):
@@ -257,6 +260,14 @@ class Server:
             from .periodic import CronExpr
 
             CronExpr.parse(job.periodic.spec)
+        for sp in job.scaling_policies:
+            # Policy IDs are server-assigned at register time
+            # (job_endpoint.go Register → ScalingPolicy canonicalization,
+            # state/schema.go:793 scaling_policy table keyed by ID).
+            if not sp.id:
+                sp.id = str(uuid.uuid4())
+            sp.target.setdefault("Namespace", job.namespace)
+            sp.target.setdefault("Job", job.id)
         existing = self.state.job_by_id(job.namespace, job.id)
         if existing is not None and existing.job_modify_index:
             if not job.spec_changed(existing):
@@ -543,15 +554,56 @@ class Server:
                     raise ValueError(
                         f"count {count} outside scaling policy bounds "
                         f"[{sp.min}, {sp.max}]")
+        previous = tg.count
         job = copy.deepcopy(job)
         job.lookup_task_group(group).count = count
         job.version += 1
         self.state.upsert_job(job)
-        return self._create_eval(
+        ev = self._create_eval(
             namespace=namespace, priority=job.priority, type=job.type,
             triggered_by="job-scaling", job_id=job_id,
             job_modify_index=job.modify_index, status=EVAL_STATUS_PENDING,
         )
+        events = self._scaling_events.setdefault((namespace, job_id), {})
+        events.setdefault(group, []).append({
+            "Time": int(time.time() * 1e9),
+            "Count": count,
+            "PreviousCount": previous,
+            "Message": message,
+            "EvalID": ev.id if ev else "",
+        })
+        del events[group][:-10]  # bounded history (structs.JobScalingEvents)
+        self._publish("Job", "JobScaled", job_id, namespace)
+        return ev
+
+    def job_scale_status(self, namespace: str, job_id: str) -> Dict:
+        """Reference `Job.ScaleStatus` (job_endpoint.go:1125) — per-group
+        desired/placed/running/healthy counts plus recorded scale events."""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        allocs = self.state.allocs_by_job(namespace, job_id)
+        groups: Dict[str, Dict] = {}
+        for tg in job.task_groups:
+            groups[tg.name] = {
+                "Desired": tg.count, "Placed": 0, "Running": 0,
+                "Healthy": 0, "Unhealthy": 0,
+                "Events": list(self._scaling_events
+                               .get((namespace, job_id), {})
+                               .get(tg.name, [])),
+            }
+        for a in allocs:
+            g = groups.get(a.task_group)
+            if g is None or a.terminal_status():
+                continue
+            g["Placed"] += 1
+            if a.client_status == "running":
+                g["Running"] += 1
+            ds = getattr(a, "deployment_status", None)
+            if ds is not None and getattr(ds, "healthy", None) is not None:
+                g["Healthy" if ds.healthy else "Unhealthy"] += 1
+        return {"JobID": job_id, "Namespace": namespace,
+                "JobStopped": job.stop, "TaskGroups": groups}
 
     def scaling_policies(self, namespace: Optional[str] = None) -> List:
         out = []
@@ -561,6 +613,12 @@ class Server:
             for sp in job.scaling_policies:
                 out.append(sp)
         return out
+
+    def scaling_policy(self, policy_id: str):
+        for sp in self.scaling_policies():
+            if sp.id == policy_id:
+                return sp
+        return None
 
     # ---- search (nomad/search_endpoint.go fuzzy/prefix search) ----
 
