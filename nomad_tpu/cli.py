@@ -147,7 +147,12 @@ def cmd_job_plan(args) -> int:
 def cmd_job_scale(args) -> int:
     api = _client(args)
     if args.count is None:
-        group, count = None, int(args.group_or_count)
+        try:
+            group, count = None, int(args.group_or_count)
+        except ValueError:
+            print("error: missing count (usage: job scale <job> "
+                  "[group] <count>)", file=sys.stderr)
+            return 1
     else:
         group, count = args.group_or_count, args.count
     if group is None:

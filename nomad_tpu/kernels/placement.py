@@ -46,6 +46,8 @@ class ClusterArrays(NamedTuple):
     used: jax.Array       # f32[N, R]
     node_ok: jax.Array    # bool[N]
     attrs: jax.Array      # i32[N, K]
+    ports_used: jax.Array  # u32[N, 2048] — packed used-port bitmap
+    dyn_free: jax.Array   # f32[N] — free dynamic-range ports
 
 
 class TGParams(NamedTuple):
@@ -92,6 +94,18 @@ class TGParams(NamedTuple):
     dp_allowed: jax.Array        # f32[P] — RTarget count (default 1)
     dp_counts0: jax.Array        # f32[P, V] — existing+plan combined use
     dp_active: jax.Array         # bool[P]
+    # port feasibility (reference rank.go:231-320 — AssignPorts inside
+    # BinPackIterator ranks out port-infeasible nodes; here the asks are
+    # static per TG so the checks fold into the node mask). Plan-relative
+    # port deltas ship as sparse (node-row, port) pairs: pclr_* release
+    # ports of in-plan stopped/preempted allocs, pset_* consume ports of
+    # in-plan placements (the NetworkIndex plan threading of rank.go:240).
+    res_ports: jax.Array         # i32[PP] — static host-port asks, −1 pad
+    n_dyn: jax.Array             # f32 — dynamic ports requested per alloc
+    pclr_idx: jax.Array          # i32[PC] — node rows releasing a port, −1 pad
+    pclr_port: jax.Array         # i32[PC] — the released port
+    pset_idx: jax.Array          # i32[PS] — node rows consuming a port, −1 pad
+    pset_port: jax.Array         # i32[PS] — the consumed port
     # spread program
     spread_key_idx: jax.Array    # i32[S]
     spread_weight: jax.Array     # f32[S] — weight/ΣW (target mode)
@@ -181,6 +195,52 @@ def _dp_feasible(dtok: jax.Array, dtok_oh: jax.Array, dcounts: jax.Array,
     row_ok = ((cur_d < p.dp_allowed[None, :])
               & (dtok != d_v - 1)) | ~p.dp_active[None, :]
     return jnp.all(row_ok, axis=1)
+
+
+def _reserved_ports_free(cluster: ClusterArrays, p: TGParams) -> jax.Array:
+    """bool[N]: every statically-asked host port is free on the node
+    (reference AssignPorts inside BinPackIterator, rank.go:231-320 +
+    network.go:316 — a taken port ranks the node out). −1 rows are padding.
+    Word lookup is a small take along the packed axis (PP ≤ a few ports).
+    Plan-relative adjustments: a port released by an in-plan stop/preempt
+    (pclr) reads as free; one consumed by an in-plan placement (pset) reads
+    as taken — mirroring the proposed-alloc NetworkIndex (rank.go:240)."""
+    n = cluster.ports_used.shape[0]
+    if p.res_ports.shape[0] == 0:
+        return jnp.ones(n, dtype=bool)
+    rp = jnp.maximum(p.res_ports, 0)
+    words = jnp.take(cluster.ports_used, rp >> 5, axis=1)        # [N, PP]
+    bit = (words >> (rp & 31).astype(jnp.uint32)[None, :]) & jnp.uint32(1)
+    taken = bit != 0                                             # [N, PP]
+    if p.pclr_idx.shape[0]:
+        cleared = jnp.any(
+            (p.pclr_idx[:, None, None] == jnp.arange(n)[None, :, None])
+            & (p.pclr_port[:, None, None] == p.res_ports[None, None, :]),
+            axis=0)                                              # [N, PP]
+        taken = taken & ~cleared
+    if p.pset_idx.shape[0]:
+        pset = jnp.any(
+            (p.pset_idx[:, None, None] == jnp.arange(n)[None, :, None])
+            & (p.pset_port[:, None, None] == p.res_ports[None, None, :]),
+            axis=0)
+        taken = taken | pset
+    free = ~taken | (p.res_ports < 0)[None, :]
+    return jnp.all(free, axis=1)
+
+
+def _dyn_free_adjusted(cluster: ClusterArrays, p: TGParams) -> jax.Array:
+    """f32[N]: free dynamic-port counts with plan-relative credit/debit."""
+    n = cluster.dyn_free.shape[0]
+    dyn = cluster.dyn_free
+    if p.pclr_idx.shape[0]:
+        in_rng = ((p.pclr_port >= 20000) & (p.pclr_port <= 32000)
+                  ).astype(jnp.float32)
+        dyn = dyn + _scatter_counts(p.pclr_idx, in_rng, n)
+    if p.pset_idx.shape[0]:
+        in_rng = ((p.pset_port >= 20000) & (p.pset_port <= 32000)
+                  ).astype(jnp.float32)
+        dyn = dyn - _scatter_counts(p.pset_idx, in_rng, n)
+    return dyn
 
 
 def _spread_boost(
@@ -286,9 +346,17 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
     nodes_feasible = jnp.sum(feas.astype(jnp.int32))
 
+    # port feasibility (rank-time, so failures count as "exhausted" like the
+    # reference's BinPack rank-out, not constraint-"filtered"): static asks
+    # against the packed bitmap once; dynamic-count and same-node-reuse
+    # tracked in the scan as this group's own placements consume ports
+    res_free = _reserved_ports_free(cluster, p)
+    dyn_free = _dyn_free_adjusted(cluster, p)
+    has_res_ask = jnp.any(p.res_ports >= 0)
+
     def step(carry, xs):
         i, pen_idx, pref_idx = xs
-        used, job_cnt, tg_cnt, scounts, dcounts = carry
+        used, job_cnt, tg_cnt, scounts, dcounts, splaced = carry
         active = i < p.n_place
 
         # per-step reschedule penalty nodes (rank.go:570 SetPenaltyNodes);
@@ -297,6 +365,9 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
         util = used + p.ask[None, :]                       # [N, R]
         fits = jnp.all(util <= cap, axis=1)
+        ports_ok = (dyn_free - splaced * p.n_dyn) >= p.n_dyn
+        ports_ok = ports_ok & res_free & ~(has_res_ask & (splaced > 0))
+        fits = fits & ports_ok
         ok = feas & fits
         ok = ok & ~(p.distinct_hosts & (job_cnt > 0))
 
@@ -342,6 +413,7 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         used = used + jnp.where(onehot[:, None], p.ask[None, :], 0.0)
         job_cnt = job_cnt + onehot
         tg_cnt = tg_cnt + onehot
+        splaced = splaced + onehot.astype(jnp.float32)
         if scounts.shape[0]:
             sel_tok = stok[idx]                     # [S], normalized
             # missing values never enter the use map (spread.go:326);
@@ -360,7 +432,7 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
             dcounts = dcounts + dupd
 
         n_fit = jnp.sum((feas & fits).astype(jnp.int32))
-        return (used, job_cnt, tg_cnt, scounts, dcounts), (
+        return (used, job_cnt, tg_cnt, scounts, dcounts, splaced), (
             sel,
             jnp.where(found, final[idx], 0.0),
             n_fit,
@@ -369,9 +441,11 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
     job_cnt0 = _scatter_counts(p.jc_idx, p.jc_val, n)
     tg_cnt0 = _scatter_counts(p.jtc_idx, p.jtc_val, n)
-    init = (used0, job_cnt0, tg_cnt0, p.spread_counts0, p.dp_counts0)
+    splaced0 = jnp.zeros(n, dtype=jnp.float32)
+    init = (used0, job_cnt0, tg_cnt0, p.spread_counts0, p.dp_counts0,
+            splaced0)
     xs = (jnp.arange(max_allocs), p.penalty_idx, p.preferred_idx)
-    (used_f, _, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
+    (used_f, _, _, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
         step, init, xs
     )
     return PlacementResult(
@@ -399,10 +473,11 @@ def place_task_group_jit(cluster: ClusterArrays, p: TGParams, max_allocs: int
 
 _PACK_I32 = ("n_place", "algorithm", "key_idx", "aff_key_idx", "penalty_idx",
              "preferred_idx", "jc_idx", "jtc_idx", "delta_idx",
-             "cand_idx", "dp_key_idx", "spread_key_idx")
+             "cand_idx", "dp_key_idx", "spread_key_idx", "res_ports",
+             "pclr_idx", "pclr_port", "pset_idx", "pset_port")
 _PACK_F32 = ("ask", "desired_count", "aff_lut", "aff_inv_sum", "jc_val",
              "jtc_val", "delta_res", "dp_allowed", "dp_counts0",
-             "spread_weight", "spread_desired", "spread_counts0")
+             "spread_weight", "spread_desired", "spread_counts0", "n_dyn")
 _PACK_U8 = ("lut", "extra_mask", "distinct_hosts", "use_cand", "dp_active",
             "spread_has_targets", "spread_active")
 
@@ -482,4 +557,6 @@ def system_feasibility(cluster: ClusterArrays, p: TGParams
         used = used - jnp.einsum("dn,dr->nr", eq, p.delta_res)
     util = used + p.ask[None, :]
     fits = jnp.all(util <= cluster.capacity, axis=1)
+    fits = fits & (_dyn_free_adjusted(cluster, p) >= p.n_dyn) \
+        & _reserved_ports_free(cluster, p)
     return feas, feas & fits

@@ -69,7 +69,8 @@ def cluster_sharding(mesh: Mesh) -> ClusterArrays:
     replicated over the eval batch."""
     row = NamedSharding(mesh, P(NODE_AXIS))
     mat = NamedSharding(mesh, P(NODE_AXIS, None))
-    return ClusterArrays(capacity=mat, used=mat, node_ok=row, attrs=mat)
+    return ClusterArrays(capacity=mat, used=mat, node_ok=row, attrs=mat,
+                         ports_used=mat, dyn_free=row)
 
 
 def params_sharding(mesh: Mesh, batched: bool = True) -> TGParams:
@@ -128,6 +129,9 @@ def pad_params(params_list: Sequence[TGParams]
     e_n = max(p.extra_mask.shape[0] for p in ps)
     l_n = _bucket(max(p.cand_idx.shape[0] for p in ps))
     dp_n = _bucket(max(p.dp_key_idx.shape[0] for p in ps))
+    rp_n = _bucket(max(p.res_ports.shape[0] for p in ps))
+    pc_n = _bucket(max(p.pclr_idx.shape[0] for p in ps))
+    pst_n = _bucket(max(p.pset_idx.shape[0] for p in ps))
 
     out = []
     for p in ps:
@@ -153,6 +157,11 @@ def pad_params(params_list: Sequence[TGParams]
             jtc_idx=_pad_rows(p.jtc_idx, j2_n, -1),
             jtc_val=_pad_rows(p.jtc_val, j2_n, 0.0),
             cand_idx=_pad_rows(p.cand_idx, l_n, -1),
+            res_ports=_pad_rows(p.res_ports, rp_n, -1),
+            pclr_idx=_pad_rows(p.pclr_idx, pc_n, -1),
+            pclr_port=_pad_rows(p.pclr_port, pc_n, -1),
+            pset_idx=_pad_rows(p.pset_idx, pst_n, -1),
+            pset_port=_pad_rows(p.pset_port, pst_n, -1),
             dp_key_idx=_pad_rows(p.dp_key_idx, dp_n, 0),
             dp_allowed=_pad_rows(p.dp_allowed, dp_n, 0.0),
             dp_counts0=_pad_rows(_widen_v(p.dp_counts0, v, 0.0), dp_n, 0.0),

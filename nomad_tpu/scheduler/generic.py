@@ -54,6 +54,7 @@ from .util import (
     SetStatusError,
     State,
     adjust_queued_allocations,
+    fail_network_exhausted,
     generic_alloc_update_fn,
     progress_made,
     proposed_allocs,
@@ -363,6 +364,17 @@ class GenericScheduler:
                     # generic_sched.go:742).
                     for v in victims:
                         self.plan.append_preempted_alloc(v, alloc_id)
+                alloc_res, net_err = self._allocated_resources(tg, node)
+                if net_err is not None:
+                    # Offer-time port assignment failed: the reference ranks
+                    # such a node out inside BinPack (rank.go:256-267); the
+                    # kernel's port mask makes this rare, but when the precise
+                    # NetworkIndex disagrees the placement must FAIL, never
+                    # place the alloc without its ports.
+                    fail_network_exhausted(
+                        self.plan, node_id, node, victims, metrics,
+                        self.failed_tg_allocs, tg.name, net_err)
+                    continue
                 alloc = Allocation(
                     id=alloc_id,
                     namespace=self.job.namespace,
@@ -375,7 +387,7 @@ class GenericScheduler:
                     node_id=node_id,
                     node_name=node.name if node else "",
                     deployment_id=dep_id,
-                    allocated_resources=self._allocated_resources(tg, node),
+                    allocated_resources=alloc_res,
                     desired_status=ALLOC_DESIRED_RUN,
                     client_status=ALLOC_CLIENT_PENDING,
                     job_version=self.job.version,
@@ -415,6 +427,7 @@ class GenericScheduler:
                     continue  # in-place updates already counted in state
                 usage = self.cluster.usage_row(a)
                 ctx.placed.append((node_id, a.task_group, usage))
+                ctx.placed_allocs.append(a)
 
         sticky = tg.ephemeral_disk.sticky
         for p, prev, _dest in entries:
@@ -432,17 +445,21 @@ class GenericScheduler:
             ctx.preferred_node_ids.append(preferred)
         return ctx
 
-    def _allocated_resources(self, tg: TaskGroup, node) -> AllocatedResources:
+    def _allocated_resources(self, tg: TaskGroup, node):
         return allocated_resources(self.state, self.plan, tg, node)
 
 
-def allocated_resources(state: State, plan: Plan, tg: TaskGroup, node
-                        ) -> AllocatedResources:
+def allocated_resources(state: State, plan: Plan, tg: TaskGroup, node):
     """Grant resources + assign ports for a placement (reference:
     BinPackIterator's per-task network/port assignment, rank.go:231-320).
     Port assignment happens host-side against the node's NetworkIndex built
     from plan-relative proposed allocs — otherwise two allocs of one eval on
-    one node double-book dynamic ports and the plan applier rejects it."""
+    one node double-book dynamic ports and the plan applier rejects it.
+
+    Returns (resources, error): a non-None error means the node cannot
+    satisfy the group's port asks and the placement MUST fail (the reference
+    ranks such nodes out, rank.go:256-267 — an alloc is never placed with
+    its ports silently dropped)."""
     tasks: Dict[str, AllocatedTaskResources] = {}
     shared = AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb)
 
@@ -459,15 +476,17 @@ def allocated_resources(state: State, plan: Plan, tg: TaskGroup, node
         for ask in t.resources.networks:
             if net_idx is not None:
                 offer, err = net_idx.assign_network(ask)
-                if offer is not None:
-                    net_idx.add_reserved(offer)
-                    tr.networks.append(offer)
+                if offer is None:
+                    return None, err or f"task {t.name}: no network offer"
+                net_idx.add_reserved(offer)
+                tr.networks.append(offer)
         tasks[t.name] = tr
 
     for ask in tg.networks:
         if net_idx is not None:
             offer, err = net_idx.assign_network(ask)
-            if offer is not None:
-                net_idx.add_reserved(offer)
-                shared.networks.append(offer)
-    return AllocatedResources(tasks=tasks, shared=shared)
+            if offer is None:
+                return None, err or "group network: no offer"
+            net_idx.add_reserved(offer)
+            shared.networks.append(offer)
+    return AllocatedResources(tasks=tasks, shared=shared), None

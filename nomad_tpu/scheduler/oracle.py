@@ -65,6 +65,43 @@ def meets_constraints(node: Node, constraints: Sequence[Constraint]) -> bool:
     return True
 
 
+def ports_available(node: Node, proposed, tg) -> bool:
+    """Scalar mirror of the kernel's port mask (rank.go:231-320 AssignPorts):
+    reserved host-port asks must be free and enough dynamic-range ports must
+    remain, against the union-across-IPs used-port set (node reserved ports,
+    network.go:110-139, plus proposed allocs' offers, network.go:144)."""
+    from ..structs.network import (MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT,
+                                   parse_port_ranges)
+
+    used = set(parse_port_ranges(node.reserved_resources.reserved_ports))
+    for a in proposed:
+        ar = a.allocated_resources
+        if ar is None:
+            continue
+        nets = [nw for tr in ar.tasks.values() for nw in tr.networks]
+        nets += list(ar.shared.networks)
+        for nw in nets:
+            for pt in list(nw.reserved_ports) + list(nw.dynamic_ports):
+                if pt.value >= 0:
+                    used.add(pt.value)
+
+    asks = [tg.networks] + [t.resources.networks for t in tg.tasks]
+    n_dyn = 0
+    for nets in asks:
+        for nw in nets:
+            n_dyn += len(nw.dynamic_ports)
+            for pt in nw.reserved_ports:
+                if pt.value in used:
+                    return False
+    if n_dyn:
+        dyn_used = sum(1 for pv in used
+                       if MIN_DYNAMIC_PORT <= pv <= MAX_DYNAMIC_PORT)
+        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+        if span - dyn_used < n_dyn:
+            return False
+    return True
+
+
 def volumes_ok(node: Node, tg, csi_volumes: Optional[dict] = None) -> bool:
     """HostVolumeChecker (feasible.go:117) + CSIVolumeChecker's per-node
     half (feasible.go:194). `csi_volumes` maps volume id → CSIVolume."""
@@ -261,6 +298,12 @@ def select_option(
         used_bw = sum(nw.mbits for a in proposed for nw in a.comparable_resources().networks)
         avail_bw = sum(nw.mbits for nw in node.node_resources.networks)
         if used_bw + ask_bw > avail_bw:
+            continue
+
+        # Port feasibility (rank.go:231-320: AssignPorts ranks out
+        # port-infeasible nodes). Union-across-IPs used-port set — same
+        # semantics as the kernel's packed bitmap, so parity holds.
+        if not ports_available(node, proposed, tg):
             continue
 
         scores: List[float] = []

@@ -440,6 +440,8 @@ def find_preemption_placement(state, cluster, job, tg, params, plan
         used=jnp.asarray(snap.used),
         node_ok=jnp.asarray(snap.node_ok),
         attrs=jnp.asarray(snap.attrs),
+        ports_used=jnp.asarray(snap.ports_used),
+        dyn_free=jnp.asarray(snap.dyn_free),
     )
     dev_params = _to_device(params)
     result = preempt_rank_jit(

@@ -45,6 +45,9 @@ class PlanContext:
     preempted_allocs: List[Allocation] = field(default_factory=list)
     placed: List[Tuple[str, str, np.ndarray]] = field(default_factory=list)
     # (node_id, task_group, usage_row) for in-plan placements of this job
+    placed_allocs: List[Allocation] = field(default_factory=list)
+    # full in-plan placements (any job) — port consumption for the kernel's
+    # plan-relative port mask (rank.go:240 proposed-alloc NetworkIndex)
     penalty_node_ids: List[frozenset] = field(default_factory=list)  # per step
     preferred_node_ids: List[Optional[str]] = field(default_factory=list)  # per step
 
@@ -87,6 +90,8 @@ class TPUStack:
                 used=jnp.asarray(snap.used),
                 node_ok=jnp.asarray(snap.node_ok),
                 attrs=jnp.asarray(snap.attrs),
+                ports_used=jnp.asarray(snap.ports_used),
+                dyn_free=jnp.asarray(snap.dyn_free),
             )
             self._snapshot_version = self.cluster.version
         return self._dev_arrays
@@ -188,6 +193,33 @@ class TPUStack:
                 if row is not None:
                     preferred_idx[i] = row
 
+        # plan-relative port deltas: stops/preempts release their ports,
+        # in-plan placements consume theirs (proposed-alloc NetworkIndex,
+        # rank.go:240); sparse (row, port) pairs, −1 padded
+        pclr_pairs: List[Tuple[int, int]] = []
+        for a in plan.stopped_allocs + plan.preempted_allocs:
+            row = cl.row_of.get(a.node_id)
+            if row is not None:
+                for port in ClusterTensors._alloc_port_list(a):
+                    pclr_pairs.append((row, port))
+        pset_pairs: List[Tuple[int, int]] = []
+        for a in plan.placed_allocs:
+            row = cl.row_of.get(a.node_id)
+            if row is not None:
+                for port in ClusterTensors._alloc_port_list(a):
+                    pset_pairs.append((row, port))
+
+        def _pairs(pairs):
+            b = _bucket(max(len(pairs), 1))
+            idx = np.full(b, -1, dtype=np.int32)
+            prt = np.full(b, -1, dtype=np.int32)
+            for i, (row, port) in enumerate(pairs):
+                idx[i], prt[i] = row, port
+            return idx, prt
+
+        pclr_idx, pclr_port = _pairs(pclr_pairs)
+        pset_idx, pset_port = _pairs(pset_pairs)
+
         # sampled-candidate restriction
         if sampled_rows is not None:
             cand_idx = np.full(_bucket(max(len(sampled_rows), 1)), -1,
@@ -230,6 +262,12 @@ class TPUStack:
             delta_res=delta_res,
             cand_idx=cand_idx,
             use_cand=use_cand,
+            res_ports=prog["res_ports"],
+            n_dyn=np.float32(prog["n_dyn"]),
+            pclr_idx=pclr_idx,
+            pclr_port=pclr_port,
+            pset_idx=pset_idx,
+            pset_port=pset_port,
             dp_key_idx=dp_key_idx,
             dp_allowed=dp_allowed,
             dp_counts0=dp_counts0,
@@ -373,6 +411,23 @@ class TPUStack:
                 if col is not None:
                     ask[col] += dev.count
 
+        # static port asks (group + task networks): reserved host ports and
+        # dynamic-port count feed the kernel's rank-time port mask
+        res_asks = [pt.value
+                    for nets in ([tg.networks]
+                                 + [t.resources.networks for t in tg.tasks])
+                    for nw in nets for pt in nw.reserved_ports
+                    if 0 <= pt.value < 65536]
+        res_ports = np.full(_bucket(max(len(res_asks), 1)), -1,
+                            dtype=np.int32)
+        for i, pt in enumerate(res_asks):
+            res_ports[i] = pt
+        n_dyn = float(sum(
+            len(nw.dynamic_ports)
+            for nets in ([tg.networks]
+                         + [t.resources.networks for t in tg.tasks])
+            for nw in nets))
+
         sp_static = self._compile_spreads_static(tg, spreads, spread_keys, v)
 
         used_keys = tuple(
@@ -386,7 +441,7 @@ class TPUStack:
             "sp_static": sp_static, "dp_specs": dp_specs,
             "dh_job": dh_job, "distinct": distinct,
             "extra": extra, "host_dep": host_dep,
-            "ask": ask,
+            "ask": ask, "res_ports": res_ports, "n_dyn": n_dyn,
             "used_keys": used_keys,
             "vocab_sizes": tuple(len(vocab.key_vocabs[k])
                                  for k in used_keys),

@@ -39,6 +39,7 @@ from .util import (
     Planner,
     SetStatusError,
     State,
+    fail_network_exhausted,
     progress_made,
     ready_nodes_in_dcs,
     retry_max,
@@ -303,6 +304,16 @@ class SystemScheduler:
                     # preemptions precede the NetworkIndex build.
                     for v in victims:
                         self.plan.append_preempted_alloc(v, alloc_id)
+                alloc_res, net_err = allocated_resources(
+                    self.state, self.plan, tg, node
+                )
+                if net_err is not None:
+                    # Port-exhausted node: fail the per-node placement
+                    # rather than placing without ports (rank.go:256-267)
+                    fail_network_exhausted(
+                        self.plan, node_id, node, victims, metrics,
+                        self.failed_tg_allocs, tg.name, net_err)
+                    continue
                 alloc = Allocation(
                     id=alloc_id,
                     namespace=self.job.namespace,
@@ -314,9 +325,7 @@ class SystemScheduler:
                     metrics=metrics,
                     node_id=node_id,
                     node_name=node.name if node else "",
-                    allocated_resources=allocated_resources(
-                        self.state, self.plan, tg, node
-                    ),
+                    allocated_resources=alloc_res,
                     desired_status=ALLOC_DESIRED_RUN,
                     client_status=ALLOC_CLIENT_PENDING,
                     job_version=self.job.version,

@@ -274,4 +274,24 @@ def generic_alloc_update_fn(alloc, job, tg):
         return False, True, None
     updated = copy.copy(alloc)
     updated.job = job
+    updated.job_version = job.version
     return False, False, updated
+
+
+def fail_network_exhausted(plan, node_id: str, node, victims,
+                           metrics, failed_tg_allocs, tg_name: str,
+                           net_err: str) -> None:
+    """Shared failure path when offer-time port assignment fails on a
+    selected node (rank.go:256-267 would have ranked it out): roll back any
+    in-plan victims, record the exhausted dimension, coalesce repeats."""
+    if victims:
+        pres = plan.node_preemptions.get(node_id, [])
+        vset = {v.id for v in victims}
+        plan.node_preemptions[node_id] = [
+            a for a in pres if a.id not in vset]
+    metrics.exhausted_node(node, f"network: {net_err}")
+    existing = failed_tg_allocs.get(tg_name)
+    if existing is not None:
+        existing.coalesced_failures += 1
+    else:
+        failed_tg_allocs[tg_name] = metrics

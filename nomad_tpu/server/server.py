@@ -103,7 +103,10 @@ class Server:
         self._stop_event = threading.Event()
         self._running = False
         # (ns, job_id) → group → bounded scale-event history
-        # (structs.JobScalingEvents, state_store.go UpsertJob scaling events)
+        # (structs.JobScalingEvents, state_store.go UpsertJob scaling
+        # events). Advisory + in-memory only: not WAL-journaled, cleared on
+        # restart and on job deregister; the scaled COUNT itself is durable
+        # via the job table.
         self._scaling_events: Dict[Tuple[str, str], Dict[str, List[Dict]]] = {}
 
     @property
@@ -260,15 +263,21 @@ class Server:
             from .periodic import CronExpr
 
             CronExpr.parse(job.periodic.spec)
+        existing = self.state.job_by_id(job.namespace, job.id)
+        prior_policies = {
+            sp.target.get("Group", ""): sp.id
+            for sp in (existing.scaling_policies if existing else ())}
         for sp in job.scaling_policies:
-            # Policy IDs are server-assigned at register time
+            # Policy IDs are server-assigned and STABLE across re-registers
             # (job_endpoint.go Register → ScalingPolicy canonicalization,
-            # state/schema.go:793 scaling_policy table keyed by ID).
+            # state/schema.go:793 table keyed by ID): carry the existing
+            # ID over by target group so an identical resubmit stays
+            # spec-unchanged (idempotent register path below).
             if not sp.id:
-                sp.id = str(uuid.uuid4())
+                sp.id = (prior_policies.get(sp.target.get("Group", ""))
+                         or str(uuid.uuid4()))
             sp.target.setdefault("Namespace", job.namespace)
             sp.target.setdefault("Job", job.id)
-        existing = self.state.job_by_id(job.namespace, job.id)
         if existing is not None and existing.job_modify_index:
             if not job.spec_changed(existing):
                 # Idempotent re-register: keep the version AND the version's
@@ -309,6 +318,7 @@ class Server:
         job.stop = True
         self.state.upsert_job(job)
         self._publish("Job", "JobDeregistered", job.id, job.namespace)
+        self._scaling_events.pop((namespace, job_id), None)
         if job.is_periodic():
             self.periodic.remove(namespace, job_id)
         return self._create_eval(

@@ -5,8 +5,12 @@ Behavioral reference: `nomad/structs/network.go` — `NetworkIndex` :30,
 dynamic range 20000–32000 (:11-15), precise vs stochastic pickers (:487,:529).
 
 The used-port set is a numpy bool bitmap per IP (the tensor-friendly mirror of
-reference `structs.Bitmap`, nomad/structs/bitmap.go:6); the tensorizer exports
-it as packed `u32[N, 2048]` rows for the on-device port-feasibility kernel.
+reference `structs.Bitmap`, nomad/structs/bitmap.go:6). The tensorizer
+(`tensor/cluster.py`) maintains the selection-time analog: a packed
+union-across-IPs `u32[N, 2048]` bitmap plus a free-dynamic-port count per
+node, consumed by the placement kernel's port mask; this NetworkIndex stays
+the precise per-IP authority at offer time (scheduler/generic.py
+allocated_resources fails the placement when no offer exists).
 """
 from __future__ import annotations
 

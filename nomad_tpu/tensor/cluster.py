@@ -31,6 +31,17 @@ BASE_RESOURCES = 4
 MAX_DEVICE_COLS = 4
 R_TOTAL = BASE_RESOURCES + MAX_DEVICE_COLS
 
+# Port-feasibility columns (reference structs.Bitmap over 65536 ports,
+# nomad/structs/bitmap.go:6, indexed by NetworkIndex network.go:30):
+# packed u32[N, 2048] used-port bitmap + free-dynamic-port count. The bitmap
+# is the union across the node's IPs — slightly conservative vs the
+# reference's per-IP maps; host-side assign_network stays the final
+# authority at offer time.
+PORT_WORDS = 2048                 # 65536 / 32
+MIN_DYNAMIC_PORT = 20000          # reference network.go:12
+MAX_DYNAMIC_PORT = 32000          # reference network.go:15
+DYN_PORT_SPAN = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+
 
 def _bucket(n: int, lo: int = 64) -> int:
     b = lo
@@ -47,6 +58,8 @@ class ClusterSnapshot:
     used: np.ndarray       # f32[N, R]
     node_ok: np.ndarray    # bool[N]
     attrs: np.ndarray      # i32[N, K]
+    ports_used: np.ndarray  # u32[N, PORT_WORDS] packed used-port bitmap
+    dyn_free: np.ndarray   # f32[N] free ports in the dynamic range
     n_rows: int            # live row count (≤ N)
     row_to_node_id: List[Optional[str]]
 
@@ -62,6 +75,13 @@ class ClusterTensors:
         self.used = np.zeros((n_cap, R_TOTAL), dtype=np.float32)
         self.node_ok = np.zeros(n_cap, dtype=bool)
         self.attrs = np.full((n_cap, k_cap), MISSING, dtype=np.int32)
+        self.ports_used = np.zeros((n_cap, PORT_WORDS), dtype=np.uint32)
+        self.dyn_free = np.zeros(n_cap, dtype=np.float32)
+        # per-row port refcounts from allocs + node-reserved base sets
+        self.port_refs: List[Dict[int, int]] = [dict() for _ in range(n_cap)]
+        self.base_ports: List[frozenset] = [frozenset()] * n_cap
+        # alloc_id -> (row, port list) for release on update/removal
+        self.alloc_ports: Dict[str, Tuple[int, List[int]]] = {}
         self.row_of: Dict[str, int] = {}
         self.node_of_row: List[Optional[str]] = [None] * n_cap
         self.nodes: Dict[str, Node] = {}
@@ -89,6 +109,14 @@ class ClusterTensors:
         ok = np.zeros(new_cap, dtype=bool)
         ok[: self.n_cap] = self.node_ok
         self.node_ok = ok
+        pw = np.zeros((new_cap, PORT_WORDS), dtype=np.uint32)
+        pw[: self.n_cap] = self.ports_used
+        self.ports_used = pw
+        df = np.zeros(new_cap, dtype=np.float32)
+        df[: self.n_cap] = self.dyn_free
+        self.dyn_free = df
+        self.port_refs.extend(dict() for _ in range(new_cap - self.n_cap))
+        self.base_ports.extend([frozenset()] * (new_cap - self.n_cap))
         at = np.full((new_cap, self.k_cap), MISSING, dtype=np.int32)
         at[: self.n_cap] = self.attrs
         self.attrs = at
@@ -108,6 +136,61 @@ class ClusterTensors:
         while k >= self.k_cap:
             self._grow_keys()
         self.attrs[row, k] = tok
+
+    # ---- port bitmap maintenance ----
+
+    def _set_port(self, row: int, port: int) -> None:
+        self.ports_used[row, port >> 5] |= np.uint32(1 << (port & 31))
+        if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
+            self.dyn_free[row] -= 1.0
+
+    def _clear_port(self, row: int, port: int) -> None:
+        self.ports_used[row, port >> 5] &= np.uint32(
+            ~(1 << (port & 31)) & 0xFFFFFFFF)
+        if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
+            self.dyn_free[row] += 1.0
+
+    def _add_alloc_ports(self, alloc_id: str, row: int,
+                         ports: List[int]) -> None:
+        refs = self.port_refs[row]
+        for port in ports:
+            prev = refs.get(port, 0)
+            refs[port] = prev + 1
+            if prev == 0 and port not in self.base_ports[row]:
+                self._set_port(row, port)
+        self.alloc_ports[alloc_id] = (row, ports)
+
+    def _release_alloc_ports(self, alloc_id: str) -> None:
+        entry = self.alloc_ports.pop(alloc_id, None)
+        if entry is None:
+            return
+        row, ports = entry
+        refs = self.port_refs[row]
+        for port in ports:
+            cur = refs.get(port, 0)
+            if cur <= 1:
+                refs.pop(port, None)
+                if port not in self.base_ports[row]:
+                    self._clear_port(row, port)
+            else:
+                refs[port] = cur - 1
+
+    @staticmethod
+    def _alloc_port_list(alloc: Allocation) -> List[int]:
+        """Host ports held by an alloc's offers (reference
+        NetworkIndex.AddAllocs walking AllocatedResources networks,
+        network.go:144)."""
+        out: List[int] = []
+        ar = alloc.allocated_resources
+        if ar is None:
+            return out
+        nets = [n for tr in ar.tasks.values() for n in tr.networks]
+        nets += list(ar.shared.networks)
+        for net in nets:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                if 0 <= p.value < PORT_WORDS * 32:
+                    out.append(p.value)
+        return out
 
     def device_col(self, device_id: str) -> Optional[int]:
         col = self.device_cols.get(device_id)
@@ -142,6 +225,20 @@ class ClusterTensors:
                 cap[col] = sum(1 for i in dev.instances if i.healthy)
         self.capacity[row] = cap
         self.node_ok[row] = node.ready()
+        # ports: rebuild the row bitmap from the node's reserved ports
+        # (network.go:110-139) plus live alloc refcounts
+        from ..structs.network import parse_port_ranges
+
+        base = frozenset(p for p in parse_port_ranges(
+            rsv.reserved_ports) if 0 <= p < PORT_WORDS * 32)
+        self.base_ports[row] = base
+        self.ports_used[row, :] = 0
+        self.dyn_free[row] = DYN_PORT_SPAN
+        for port in base:
+            self._set_port(row, port)
+        for port in self.port_refs[row]:
+            if port not in base:
+                self._set_port(row, port)
         # attributes
         self.attrs[row, :] = MISSING
         self._set_attr(row, "node.unique.id", node.id)
@@ -189,6 +286,20 @@ class ClusterTensors:
         self.used[row] = 0
         self.node_ok[row] = False
         self.attrs[row, :] = MISSING
+        self.ports_used[row, :] = 0
+        self.dyn_free[row] = 0.0
+        self.base_ports[row] = frozenset()
+        self.port_refs[row] = {}
+        # Drop alloc accounting pointing at the freed row — otherwise a
+        # later release would mutate whatever node reuses the row, and the
+        # upsert_node rebuild would resurrect stale ports/usage.
+        for aid in [a for a, (r, _p) in self.alloc_ports.items() if r == row]:
+            del self.alloc_ports[aid]
+        for aid in [a for a, (r, _u) in self.alloc_usage.items() if r == row]:
+            del self.alloc_usage[aid]
+        for japs in self.job_allocs.values():
+            for aid in [a for a, (r, _tg) in japs.items() if r == row]:
+                del japs[aid]
         self.free_rows.append(row)
         self.version += 1
         self.node_version += 1
@@ -221,6 +332,7 @@ class ClusterTensors:
         if prev is not None:
             row, usage = prev
             self.used[row] -= usage
+        self._release_alloc_ports(alloc.id)
         japs = self.job_allocs.setdefault(alloc.job_id, {})
         japs.pop(alloc.id, None)
 
@@ -237,6 +349,7 @@ class ClusterTensors:
         usage = self.usage_row(alloc)
         self.used[row] += usage
         self.alloc_usage[alloc.id] = (row, usage)
+        self._add_alloc_ports(alloc.id, row, self._alloc_port_list(alloc))
         japs[alloc.id] = (row, alloc.task_group)
         self.version += 1
 
@@ -245,6 +358,7 @@ class ClusterTensors:
         if prev is not None:
             row, usage = prev
             self.used[row] -= usage
+        self._release_alloc_ports(alloc_id)
         if job_id and job_id in self.job_allocs:
             self.job_allocs[job_id].pop(alloc_id, None)
         else:
@@ -272,6 +386,8 @@ class ClusterTensors:
             used=self.used,
             node_ok=self.node_ok,
             attrs=self.attrs,
+            ports_used=self.ports_used,
+            dyn_free=self.dyn_free,
             n_rows=self.n_cap - len(self.free_rows),
             row_to_node_id=list(self.node_of_row),
         )

@@ -288,3 +288,82 @@ class TestBatchSched:
             if not a.client_terminal_status()
         ]
         assert len(live) == 0
+
+
+class TestPortExhaustionPlacement:
+    """A node that cannot satisfy the group's port asks must FAIL the
+    placement — an alloc is never placed with its ports silently dropped
+    (reference rank.go:231-320 ranks such nodes out)."""
+
+    def _port_job(self, count=1, port=8080):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.tasks[0].resources.networks = [NetworkResource(
+            mbits=1, reserved_ports=[Port("http", port)])]
+        return job
+
+    def test_networkless_node_fails_placement(self):
+        h = Harness()
+        node = mock.node()
+        node.node_resources.networks = []  # no IP → no offer possible
+        h.state.upsert_node(node)
+        job = self._port_job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert placed == []
+        # blocked eval created for the failed group
+        assert any(e.status == "blocked" for e in h.create_evals)
+
+    def test_placed_alloc_always_carries_its_ports(self):
+        h = Harness()
+        register_nodes(h, 2)
+        job = self._port_job(count=2)
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 2
+        for a in placed:
+            ports = [pt.value
+                     for tr in a.allocated_resources.tasks.values()
+                     for nw in tr.networks for pt in nw.reserved_ports]
+            assert ports == [8080]
+        # and they land on distinct nodes (same static port)
+        assert len({a.node_id for a in placed}) == 2
+
+    def test_destructive_update_reuses_ports_same_node(self):
+        """In-plan stops release their ports for the replacement (the
+        proposed-alloc NetworkIndex of rank.go:240; kernel pclr credit):
+        a destructive update on a single node must not dead-lock on the
+        static port the outgoing alloc still holds in state."""
+        h = Harness()
+        register_nodes(h, 1)
+        job = self._port_job()
+        h.state.upsert_job(job)
+        h.process(eval_for(job))
+        first = [a for p in h.plans for allocs in p.node_allocation.values()
+                 for a in allocs]
+        assert len(first) == 1
+
+        import copy
+
+        job2 = copy.deepcopy(job)
+        job2.version = 1
+        job2.task_groups[0].tasks[0].config = {"run_for": 9.9}  # destructive
+        h.state.upsert_job(job2)
+        h.process(eval_for(job2))
+        last = h.plans[-1]
+        stops = [a for allocs in last.node_update.values() for a in allocs]
+        placed = [a for allocs in last.node_allocation.values()
+                  for a in allocs]
+        assert len(stops) == 1 and stops[0].id == first[0].id
+        assert len(placed) == 1 and placed[0].node_id == first[0].node_id
+        ports = [pt.value
+                 for tr in placed[0].allocated_resources.tasks.values()
+                 for nw in tr.networks for pt in nw.reserved_ports]
+        assert ports == [8080]

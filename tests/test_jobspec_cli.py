@@ -123,12 +123,17 @@ class TestJobspec:
         s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0))
         s.start()
         try:
+            # Two nodes: the group asks static port 8080, so its two allocs
+            # cannot share one host (rank.go:231-320 port feasibility).
+            s.node_register(mock.node())
             s.node_register(mock.node())
             job = parse(SPEC)
             ev = s.job_register(job)
             done = s.wait_for_eval(ev.id)
             assert done.status == "complete"
-            assert len(s.state.allocs_by_job("default", "example")) == 2
+            allocs = s.state.allocs_by_job("default", "example")
+            assert len(allocs) == 2
+            assert len({a.node_id for a in allocs}) == 2
         finally:
             s.shutdown()
 
@@ -194,7 +199,10 @@ class TestCli:
     def test_job_plan_and_stop(self, cli_agent, tmp_path):
         a, addr = cli_agent
         spec = tmp_path / "example.nomad"
-        spec.write_text(SPEC)
+        # all-dynamic ports: the dev agent has ONE node and count=2 with a
+        # static port cannot share a host (rank.go:231-320)
+        spec.write_text(SPEC.replace('port "admin" { static = 8080 }',
+                                     'port "admin" {}'))
         rc, out = _run_cli(addr, "job", "plan", str(spec))
         assert rc == 0 and "Placements: 2" in out
         _run_cli(addr, "job", "run", str(spec))

@@ -483,3 +483,120 @@ class TestDistinctProperty:
         ent1 = stack._prog_cache[k]
         stack.compile_tg(job, tg, 2)
         assert stack._prog_cache[k] is ent1  # second compile is a hit
+
+
+class TestPortFeasibility:
+    """Rank-time port masks (reference rank.go:231-320: AssignPorts inside
+    BinPackIterator ranks out port-infeasible nodes) — kernel vs oracle."""
+
+    def _port_job(self, port=8080):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.networks = [NetworkResource(
+            mbits=1, reserved_ports=[Port("http", port)])]
+        return job
+
+    def _holding_alloc(self, job, node, port):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        a = mock.alloc(job=job)
+        a.job_id = job.id
+        a.node_id = node.id
+        a.client_status = "running"
+        a.allocated_resources = mock.alloc_resources(
+            networks=[NetworkResource(
+                ip=node.node_resources.networks[0].ip, mbits=1,
+                reserved_ports=[Port("http", port)])])
+        return a
+
+    def test_reserved_port_conflict_never_selected(self):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(4, rng)
+        other = mock.job()
+        # every node but nodes[2] already holds :8080
+        held = []
+        for n in nodes:
+            if n is nodes[2]:
+                continue
+            a = self._holding_alloc(other, n, 8080)
+            cl.upsert_alloc(a)
+            held.append(a)
+        job = self._port_job(8080)
+        tg = job.task_groups[0]
+        stack = TPUStack(cl)
+        result = stack.select(job, tg, 1)
+        assert result.node_ids[0] == nodes[2].id
+
+        allocs_by_node = {}
+        for a in held:
+            allocs_by_node.setdefault(a.node_id, []).append(a)
+        ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
+        opt = select_option(ctx, job, tg)
+        assert opt is not None and opt.node.id == nodes[2].id
+        assert abs(result.scores[0] - opt.final_score) < 1e-4
+
+    def test_all_nodes_port_exhausted_fails(self):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(3, rng)
+        other = mock.job()
+        held = []
+        for n in nodes:
+            a = self._holding_alloc(other, n, 9001)
+            cl.upsert_alloc(a)
+            held.append(a)
+        job = self._port_job(9001)
+        tg = job.task_groups[0]
+        result = TPUStack(cl).select(job, tg, 1)
+        assert result.node_ids[0] is None
+
+        allocs_by_node = {}
+        for a in held:
+            allocs_by_node.setdefault(a.node_id, []).append(a)
+        ctx = OracleContext(nodes=nodes, allocs_by_node=allocs_by_node)
+        assert select_option(ctx, job, tg) is None
+
+    def test_same_group_reserved_ports_spread_across_nodes(self):
+        """Two allocs of one group asking the same static port cannot share
+        a node: the in-scan port carry must push the second alloc off."""
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(2, rng)
+        job = self._port_job(7070)
+        tg = job.task_groups[0]
+        result = TPUStack(cl).select(job, tg, 2)
+        assert result.node_ids[0] is not None
+        assert result.node_ids[1] is not None
+        assert result.node_ids[0] != result.node_ids[1]
+
+        # third alloc has nowhere to go
+        result3 = TPUStack(cl).select(job, tg, 3)
+        assert result3.node_ids[2] is None
+
+    def test_dynamic_port_exhaustion(self):
+        from nomad_tpu.structs import NetworkResource, Port
+
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(2, rng)
+        # nodes[0]: whole dynamic range reserved by the host → dyn_free 0
+        nodes[0].reserved_resources.reserved_ports = "20000-32000"
+        cl.upsert_node(nodes[0])
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.networks = [NetworkResource(
+            mbits=1, dynamic_ports=[Port("rpc", 0)])]
+        result = TPUStack(cl).select(job, tg, 1)
+        assert result.node_ids[0] == nodes[1].id
+
+    def test_ports_released_on_alloc_removal(self):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(1, rng)
+        other = mock.job()
+        a = self._holding_alloc(other, nodes[0], 8088)
+        cl.upsert_alloc(a)
+        job = self._port_job(8088)
+        tg = job.task_groups[0]
+        assert TPUStack(cl).select(job, tg, 1).node_ids[0] is None
+        a.client_status = "complete"
+        cl.upsert_alloc(a)
+        assert TPUStack(cl).select(job, tg, 1).node_ids[0] == nodes[0].id

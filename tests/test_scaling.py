@@ -12,7 +12,7 @@ from nomad_tpu.api import ApiError, NomadClient
 from nomad_tpu.structs.job import ScalingPolicy
 
 
-def _wait(cond, timeout=15.0, every=0.05):
+def _wait(cond, timeout=40.0, every=0.05):
     dl = time.time() + timeout
     while time.time() < dl:
         if cond():
