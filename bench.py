@@ -36,9 +36,15 @@ def build(n_nodes: int, n_allocs: int, n_evals: int, count: int, seed: int = 11)
     rng = random.Random(seed + 1)
     jobs = []
     for i in range(n_evals):
+        # Eval mix over the BASELINE configs: 1 (plain bin-pack),
+        # 2 (constraint+affinity), 3 (spread + distinct_hosts),
+        # 5 (nvidia/gpu device asks). Config 4 (system+preemption) runs in
+        # its own harness below — the system scheduler is per-node, not
+        # ranked selection.
         job = synth_service_job(
             rng, count=count,
             with_affinity=(i % 2 == 0), with_spread=(i % 3 == 0),
+            distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0),
         )
         state.upsert_job(job)
         jobs.append(job)
@@ -154,6 +160,19 @@ def bench_oracle(state, nodes, jobs, stack, count: int, n_evals: int,
                 ),
                 desired_status="run", client_status="pending",
             )
+            if any(t.resources.devices for t in tg.tasks):
+                # carry real instance IDs so the next step's accounting
+                # matches the kernel's in-scan device-column consumption
+                from nomad_tpu.scheduler.device import (DeviceAllocator,
+                                                        assign_task_devices)
+
+                da = DeviceAllocator(opt.node,
+                                     ctx.proposed_allocs(opt.node.id))
+                offers, _ = assign_task_devices(da, tg)
+                if offers:
+                    tr = next(iter(fake.allocated_resources.tasks.values()))
+                    tr.devices.extend(d for offs in offers.values()
+                                      for d in offs)
             ctx.plan_node_alloc.setdefault(opt.node.id, []).append(fake)
         total += 1
     dt = time.time() - t0 - kernel_dt

@@ -136,6 +136,54 @@ def tpu_fingerprint(node: Node) -> None:
     node.attributes["tpu.type"] = getattr(devs[0], "device_kind",
                                           devs[0].platform)
     node.attributes["driver.tpu"] = "1"
+    # Publish chips as a schedulable device group (the device-plugin
+    # fingerprint stream analog, plugins/device/device.go Fingerprint +
+    # devices/gpu/nvidia/nvml/client.go:52-78) so jobs can ask
+    # device "google/tpu" { count = N } and get instance IDs assigned.
+    from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
+
+    kind = str(getattr(devs[0], "device_kind", devs[0].platform))
+    name = kind.lower().replace(" ", "-")
+    node.node_resources.devices = [
+        d for d in node.node_resources.devices
+        if not (d.vendor == "google" and d.type == "tpu")
+    ] + [NodeDeviceResource(
+        vendor="google", type="tpu", name=name,
+        instances=[NodeDeviceInstance(id=str(d.id), healthy=True)
+                   for d in devs],
+        attributes={"kind": kind},
+    )]
+
+
+def device_env_fingerprint(node: Node) -> None:
+    """Declarative device groups from NOMAD_TPU_FAKE_DEVICES — the test/dev
+    stand-in for out-of-process device plugins (plugins/device/device.go).
+    Format: "vendor/type/name:count[,...]", e.g. "nvidia/gpu/1080ti:4"."""
+    spec = os.environ.get("NOMAD_TPU_FAKE_DEVICES", "")
+    if not spec:
+        return
+    from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
+
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        ident, _, cnt = part.rpartition(":")
+        bits = ident.split("/")
+        try:
+            count = int(cnt)
+        except ValueError:
+            continue
+        if len(bits) != 3 or count <= 0:
+            continue
+        # re-run-safe: replace a previously-registered identical group
+        node.node_resources.devices = [
+            d for d in node.node_resources.devices if d.id() != ident
+        ] + [NodeDeviceResource(
+            vendor=bits[0], type=bits[1], name=bits[2],
+            instances=[NodeDeviceInstance(id=f"{ident}-{i}", healthy=True)
+                       for i in range(count)],
+        )]
 
 
 def driver_fingerprints(node: Node) -> None:
@@ -152,7 +200,7 @@ FINGERPRINTERS: List[Callable[[Node], None]] = [
     arch_fingerprint, os_fingerprint, cpu_fingerprint, memory_fingerprint,
     storage_fingerprint, network_fingerprint, host_fingerprint,
     nomad_fingerprint, signal_fingerprint, tpu_fingerprint,
-    driver_fingerprints,
+    device_env_fingerprint, driver_fingerprints,
 ]
 
 

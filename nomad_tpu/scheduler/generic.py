@@ -366,15 +366,24 @@ class GenericScheduler:
                         self.plan.append_preempted_alloc(v, alloc_id)
                 alloc_res, net_err = self._allocated_resources(tg, node)
                 if net_err is not None:
-                    # Offer-time port assignment failed: the reference ranks
-                    # such a node out inside BinPack (rank.go:256-267); the
-                    # kernel's port mask makes this rare, but when the precise
-                    # NetworkIndex disagrees the placement must FAIL, never
-                    # place the alloc without its ports.
-                    fail_network_exhausted(
-                        self.plan, node_id, node, victims, metrics,
-                        self.failed_tg_allocs, tg.name, net_err)
-                    continue
+                    # Offer-time assignment (ports/devices) failed on the
+                    # selected node: the reference would have ranked it out
+                    # (rank.go:256-267) and moved to the next candidate —
+                    # retry selection with the node excluded, then fail.
+                    if victims:
+                        pres = self.plan.node_preemptions.get(node_id, [])
+                        vset = {v.id for v in victims}
+                        self.plan.node_preemptions[node_id] = [
+                            a for a in pres if a.id not in vset]
+                        victims = []
+                    node_id, node, score, alloc_res, net_err = \
+                        self._reselect_excluding(
+                            tg, (p, prev, _dest), {node_id}, net_err)
+                    if net_err is not None:
+                        fail_network_exhausted(
+                            self.plan, node_id, node, victims, metrics,
+                            self.failed_tg_allocs, tg.name, net_err)
+                        continue
                 alloc = Allocation(
                     id=alloc_id,
                     namespace=self.job.namespace,
@@ -448,6 +457,34 @@ class GenericScheduler:
     def _allocated_resources(self, tg: TaskGroup, node):
         return allocated_resources(self.state, self.plan, tg, node)
 
+    def _reselect_excluding(self, tg: TaskGroup, entry, excluded: set,
+                            first_err: str):
+        """Offer-time failure recovery: re-run selection with the failed
+        nodes masked out (via the candidate-restriction mode) and re-offer,
+        up to 3 nodes deep. The reference's BinPackIterator simply continues
+        to the next candidate (rank.go:256-267); the batched kernel can't
+        see precise offer-time state, so disagreements re-enter selection
+        here instead of failing the placement outright."""
+        err = first_err
+        volumes = resolve_volume_asks(self.state, self.job.namespace, tg)
+        for _ in range(3):
+            rows = [row for nid, row in self.cluster.row_of.items()
+                    if nid not in excluded]
+            if not rows:
+                break
+            plan_ctx = self._plan_context_for(tg, [entry])
+            sel = self.stack.select(self.job, tg, 1, plan_ctx,
+                                    volumes=volumes, sampled_rows=rows)
+            node_id = sel.node_ids[0]
+            if node_id is None:
+                break
+            node = self.state.node_by_id(node_id)
+            alloc_res, err = self._allocated_resources(tg, node)
+            if err is None:
+                return node_id, node, sel.scores[0], alloc_res, None
+            excluded.add(node_id)
+        return None, None, 0.0, None, err
+
 
 def allocated_resources(state: State, plan: Plan, tg: TaskGroup, node):
     """Grant resources + assign ports for a placement (reference:
@@ -460,18 +497,27 @@ def allocated_resources(state: State, plan: Plan, tg: TaskGroup, node):
     satisfy the group's port asks and the placement MUST fail (the reference
     ranks such nodes out, rank.go:256-267 — an alloc is never placed with
     its ports silently dropped)."""
+    from .device import DeviceAllocator, assign_task_devices
+
     tasks: Dict[str, AllocatedTaskResources] = {}
     shared = AllocatedSharedResources(disk_mb=tg.ephemeral_disk.size_mb)
 
     net_idx: Optional[NetworkIndex] = None
+    dev_offers: Dict[str, list] = {}
     if node is not None:
+        proposed = proposed_allocs(state, plan, node.id)
         net_idx = NetworkIndex()
         net_idx.set_node(node)
-        net_idx.add_allocs(proposed_allocs(state, plan, node.id))
+        net_idx.add_allocs(proposed)
+        offers, derr = assign_task_devices(DeviceAllocator(node, proposed), tg)
+        if offers is None:
+            return None, derr
+        dev_offers = offers
 
     for t in tg.tasks:
         tr = AllocatedTaskResources(
-            cpu=t.resources.cpu, memory_mb=t.resources.memory_mb
+            cpu=t.resources.cpu, memory_mb=t.resources.memory_mb,
+            devices=list(dev_offers.get(t.name, ())),
         )
         for ask in t.resources.networks:
             if net_idx is not None:

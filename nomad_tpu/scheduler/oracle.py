@@ -306,6 +306,18 @@ def select_option(
         if not ports_available(node, proposed, tg):
             continue
 
+        # Device feasibility + capacity vs proposed (DeviceChecker
+        # feasible.go:1138 + AssignDevice at rank time, device.go:32).
+        # Mirrors the kernel: feasibility mask + count fit; affinity score
+        # stays within the chosen node (documented deviation).
+        if any(t.resources.devices for t in tg.tasks):
+            from .device import DeviceAllocator, assign_task_devices
+
+            offers, _derr = assign_task_devices(
+                DeviceAllocator(node, proposed), tg)
+            if offers is None:
+                continue
+
         scores: List[float] = []
         if algorithm == "spread":
             fitness = score_fit_spread(node, util)

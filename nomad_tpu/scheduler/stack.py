@@ -378,14 +378,26 @@ class TPUStack:
             cl.version += 1
 
         # host-evaluated constraints (node-dependent RTarget) → extra mask;
-        # None ⇒ trivially all-true (materialized per call at current n_cap)
-        host_dep = bool(cc.needs_host or ca.needs_host)
+        # None ⇒ trivially all-true (materialized per call at current n_cap).
+        # Device asks host-check (DeviceChecker, feasible.go:1138) ONLY when
+        # the pool columns can't express them: constrained asks,
+        # model-specific (3-part) asks, or asks matching no registered pool
+        # — unconstrained vendor/type asks are exactly the capacity column.
+        dev_asks = [d for t in tg.tasks for d in t.resources.devices]
+        dev_host = [d for d in dev_asks
+                    if d.constraints or len(d.name.split("/")) == 3
+                    or self._device_ask_col(d.name) is None]
+        host_dep = bool(cc.needs_host or ca.needs_host) or bool(dev_host)
         extra = None
         if host_dep:
+            from .device import node_devices_feasible
+
             extra = np.ones(cl.n_cap, dtype=bool)
             for node_id, row in cl.row_of.items():
                 node = cl.nodes[node_id]
                 if cc.needs_host and not meets_constraints(node, cc.needs_host):
+                    extra[row] = False
+                elif dev_host and not node_devices_feasible(node, dev_host):
                     extra[row] = False
 
         # distinct_hosts flags (feasible.go:494-500: job level vs tg level)
@@ -459,15 +471,18 @@ class TPUStack:
         return ent
 
     def _device_ask_col(self, name: str) -> Optional[int]:
-        # Match the ask against registered device columns by suffix specificity
-        # (structs.RequestedDevice matching)
-        for dev_id, col in self.cluster.device_cols.items():
-            vendor, dtype, dname = dev_id.split("/")
+        # Match the ask against the registered vendor/type device pools
+        # (structs.RequestedDevice.ID, structs.go:2552-2554: <type>,
+        # <vendor>/<type>, <vendor>/<type>/<name>). Model-specific 3-part
+        # asks charge their pool's column; the exact group is resolved
+        # host-side (DeviceAllocator) with offer-retry on mismatch.
+        for pool, col in self.cluster.device_cols.items():
+            vendor, dtype = pool.split("/")
             parts = name.split("/")
             if (
                 (len(parts) == 1 and parts[0] == dtype)
-                or (len(parts) == 2 and parts == [dtype, dname])
-                or (len(parts) == 3 and parts == [vendor, dtype, dname])
+                or (len(parts) >= 2 and parts[0] == vendor
+                    and parts[1] == dtype)
             ):
                 return col
         return None

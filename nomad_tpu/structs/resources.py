@@ -118,14 +118,14 @@ class NodeDeviceResource:
         return f"{self.vendor}/{self.type}/{self.name}"
 
     def matches(self, ask_name: str) -> bool:
-        """Suffix-specificity matching per reference `nodeDeviceIDMatches`
-        (scheduler/feasible.go device matching / structs.go:3119 `RequestedDevice`):
-        `<type>`, `<type>/<name>`, or `<vendor>/<type>/<name>`."""
+        """Specificity matching per reference `RequestedDevice.ID`
+        (structs.go:2552-2554 / :2599): `<type>`, `<vendor>/<type>`, or
+        `<vendor>/<type>/<name>`."""
         parts = ask_name.split("/")
         if len(parts) == 1:
             return self.type == parts[0]
         if len(parts) == 2:
-            return self.type == parts[0] and self.name == parts[1]
+            return self.vendor == parts[0] and self.type == parts[1]
         if len(parts) == 3:
             return (
                 self.vendor == parts[0]

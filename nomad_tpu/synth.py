@@ -20,6 +20,7 @@ from .structs import (
     Node,
     NodeReservedResources,
     NodeResources,
+    RequestedDevice,
     Resources,
     Task,
     TaskGroup,
@@ -65,6 +66,17 @@ def synth_node(rng: random.Random, i: int) -> Node:
             cpu=100, memory_mb=256, disk_mb=4 * 1024, reserved_ports="22"
         ),
     )
+    if i % 4 == 0:
+        # Every 4th node carries GPUs (BASELINE config 5: device-plugin
+        # nvidia/gpu requests + per-node reserved resources)
+        from .structs.resources import NodeDeviceInstance, NodeDeviceResource
+
+        node.node_resources.devices = [NodeDeviceResource(
+            vendor="nvidia", type="gpu", name="1080ti",
+            instances=[NodeDeviceInstance(id=f"gpu-{i}-{k}", healthy=True)
+                       for k in range(4)],
+            attributes={"memory": 11, "cuda_cores": 3584},
+        )]
     node.compute_class()
     return node
 
@@ -72,9 +84,10 @@ def synth_node(rng: random.Random, i: int) -> Node:
 def synth_service_job(rng: random.Random, count: int = 8,
                       with_affinity: bool = False,
                       with_spread: bool = False,
-                      distinct_hosts: bool = False) -> Job:
+                      distinct_hosts: bool = False,
+                      with_devices: bool = False) -> Job:
     """One service job: 1 task group, CPU+MiB bin-pack ask (BASELINE config 1),
-    optionally the batch/spread config stanzas (configs 2-3)."""
+    optionally the batch/spread/distinct_hosts/device stanzas (configs 2-5)."""
     jid = f"svc-{uuid.uuid4().hex[:12]}"
     constraints = [Constraint(ltarget="${attr.kernel.name}", rtarget="linux",
                               operand="=")]
@@ -117,6 +130,9 @@ def synth_service_job(rng: random.Random, count: int = 8,
                         resources=Resources(
                             cpu=rng.choice((250, 500, 1000)),
                             memory_mb=rng.choice((128, 256, 512)),
+                            devices=([RequestedDevice(name="nvidia/gpu",
+                                                      count=1)]
+                                     if with_devices else []),
                         ),
                     )
                 ],

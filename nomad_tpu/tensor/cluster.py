@@ -193,12 +193,19 @@ class ClusterTensors:
         return out
 
     def device_col(self, device_id: str) -> Optional[int]:
-        col = self.device_cols.get(device_id)
+        """Column for a device *pool*, keyed by vendor/type (groups of the
+        same vendor/type share a column — matches the 1-/2-part ask forms of
+        RequestedDevice.ID, structs.go:2552-2554; model-specific 3-part or
+        constrained asks are resolved host-side by DeviceAllocator with
+        offer-retry)."""
+        parts = device_id.split("/")
+        pool = "/".join(parts[:2]) if len(parts) >= 2 else device_id
+        col = self.device_cols.get(pool)
         if col is None:
             if len(self.device_cols) >= MAX_DEVICE_COLS:
                 return None
             col = BASE_RESOURCES + len(self.device_cols)
-            self.device_cols[device_id] = col
+            self.device_cols[pool] = col
         return col
 
     def upsert_node(self, node: Node) -> int:
@@ -222,7 +229,8 @@ class ClusterTensors:
         for dev in res.devices:
             col = self.device_col(dev.id())
             if col is not None:
-                cap[col] = sum(1 for i in dev.instances if i.healthy)
+                # accumulate: same-pool groups (vendor/type) share a column
+                cap[col] += sum(1 for i in dev.instances if i.healthy)
         self.capacity[row] = cap
         self.node_ok[row] = node.ready()
         # ports: rebuild the row bitmap from the node's reserved ports
@@ -318,8 +326,7 @@ class ClusterTensors:
         if alloc.allocated_resources is not None:
             for tr in alloc.allocated_resources.tasks.values():
                 for dev in tr.devices:
-                    key = f"{dev.vendor}/{dev.type}/{dev.name}"
-                    col = self.device_cols.get(key)
+                    col = self.device_cols.get(f"{dev.vendor}/{dev.type}")
                     if col is not None:
                         u[col] += len(dev.device_ids)
         return u

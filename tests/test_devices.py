@@ -1,0 +1,252 @@
+"""Device allocation end-to-end (reference scheduler/device.go:13,32
+deviceAllocator/AssignDevice, feasible.go:1138 DeviceChecker,
+devices/gpu/nvidia fingerprint) — BASELINE config 5."""
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.device import DeviceAllocator, node_device_feasible
+from nomad_tpu.scheduler.harness import Harness
+from nomad_tpu.scheduler.oracle import OracleContext, select_option
+from nomad_tpu.scheduler.stack import TPUStack
+from nomad_tpu.structs import Constraint, RequestedDevice
+from nomad_tpu.structs.job import Affinity
+from nomad_tpu.tensor.cluster import ClusterTensors
+
+
+def gpu_job(count=1, ask="nvidia/gpu", dev_count=1, constraints=None,
+            affinities=None):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.devices = [RequestedDevice(
+        name=ask, count=dev_count, constraints=constraints or [],
+        affinities=affinities or [])]
+    return job
+
+
+class TestDeviceAllocator:
+    def test_assign_returns_instance_ids(self):
+        node = mock.nvidia_node()
+        da = DeviceAllocator(node, [])
+        offer, err = da.assign(RequestedDevice(name="nvidia/gpu", count=2))
+        assert err == ""
+        assert offer.vendor == "nvidia" and offer.type == "gpu"
+        assert len(offer.device_ids) == 2
+        assert len(set(offer.device_ids)) == 2
+
+    def test_assign_consumes_instances(self):
+        node = mock.nvidia_node()
+        da = DeviceAllocator(node, [])
+        ids = set()
+        for _ in range(2):
+            offer, _ = da.assign(RequestedDevice(name="nvidia/gpu", count=2))
+            assert offer is not None
+            ids.update(offer.device_ids)
+        assert len(ids) == 4
+        offer, err = da.assign(RequestedDevice(name="nvidia/gpu", count=1))
+        assert offer is None and "no devices" in err
+
+    def test_proposed_allocs_count(self):
+        node = mock.nvidia_node()
+        first = DeviceAllocator(node, []).assign(
+            RequestedDevice(name="nvidia/gpu", count=3))[0]
+        holder = mock.alloc()
+        holder.node_id = node.id
+        holder.client_status = "running"
+        next(iter(holder.allocated_resources.tasks.values())).devices = [first]
+        da = DeviceAllocator(node, [holder])
+        offer, err = da.assign(RequestedDevice(name="nvidia/gpu", count=2))
+        assert offer is None
+        offer, err = da.assign(RequestedDevice(name="nvidia/gpu", count=1))
+        assert offer is not None
+        assert offer.device_ids[0] not in first.device_ids
+
+    def test_constraints_on_device_attributes(self):
+        node = mock.nvidia_node()
+        ok = RequestedDevice(name="nvidia/gpu", count=1, constraints=[
+            Constraint("${device.attr.cuda_cores}", "3584", "=")])
+        bad = RequestedDevice(name="nvidia/gpu", count=1, constraints=[
+            Constraint("${device.attr.cuda_cores}", "9999", "=")])
+        da = DeviceAllocator(node, [])
+        assert da.assign(ok)[0] is not None
+        assert da.assign(bad)[0] is None
+        assert node_device_feasible(node, gpu_job(
+            constraints=[Constraint("${device.model}", "1080ti", "=")]
+        ).task_groups[0])
+        assert not node_device_feasible(node, gpu_job(
+            constraints=[Constraint("${device.model}", "2080ti", "=")]
+        ).task_groups[0])
+
+    def test_affinity_prefers_matching_group(self):
+        from nomad_tpu.structs.resources import (NodeDeviceInstance,
+                                                 NodeDeviceResource)
+
+        node = mock.nvidia_node()
+        node.node_resources.devices.append(NodeDeviceResource(
+            vendor="nvidia", type="gpu", name="2080ti",
+            instances=[NodeDeviceInstance(id=f"b-{k}", healthy=True)
+                       for k in range(4)]))
+        da = DeviceAllocator(node, [])
+        offer, _ = da.assign(RequestedDevice(
+            name="nvidia/gpu", count=1,
+            affinities=[Affinity("${device.model}", "2080ti", "=", 100)]))
+        assert offer is not None and offer.name == "2080ti"
+
+
+class TestDeviceKernelOracleParity:
+    def _cluster(self, n=6):
+        cl = ClusterTensors()
+        nodes = []
+        for i in range(n):
+            node = mock.nvidia_node() if i % 2 == 0 else mock.node()
+            cl.upsert_node(node)
+            nodes.append(node)
+        return cl, nodes
+
+    def test_only_gpu_nodes_selected(self):
+        cl, nodes = self._cluster()
+        job = gpu_job(count=3)
+        tg = job.task_groups[0]
+        result = TPUStack(cl).select(job, tg, 3)
+        gpu_ids = {n.id for i, n in enumerate(nodes) if i % 2 == 0}
+        assert all(nid in gpu_ids for nid in result.node_ids)
+
+        ctx = OracleContext(nodes=nodes, allocs_by_node={})
+        opt = select_option(ctx, job, tg)
+        assert opt is not None and opt.node.id in gpu_ids
+        assert abs(result.scores[0] - opt.final_score) < 1e-4
+
+    def test_device_capacity_exhaustion_blocks(self):
+        cl, nodes = self._cluster(2)  # one gpu node (4 instances), one plain
+        job = gpu_job(dev_count=4)
+        tg = job.task_groups[0]
+        # first placement takes all 4 instances
+        r1 = TPUStack(cl).select(job, tg, 2)
+        assert r1.node_ids[0] == nodes[0].id
+        assert r1.node_ids[1] is None  # in-scan column consumption
+
+    def test_unmatched_ask_infeasible_everywhere(self):
+        cl, nodes = self._cluster(2)
+        job = gpu_job(ask="amd/gpu")
+        tg = job.task_groups[0]
+        assert TPUStack(cl).select(job, tg, 1).node_ids[0] is None
+        ctx = OracleContext(nodes=nodes, allocs_by_node={})
+        assert select_option(ctx, job, tg) is None
+
+
+class TestDeviceE2E:
+    def test_placed_alloc_carries_instance_ids(self):
+        h = Harness()
+        node = mock.nvidia_node()
+        h.state.upsert_node(node)
+        job = gpu_job(count=2, dev_count=2)
+        h.state.upsert_job(job)
+        ev = mock.eval_(job_id=job.id, type=job.type)
+        h.process(ev)
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 2
+        seen = set()
+        for a in placed:
+            devs = [d for tr in a.allocated_resources.tasks.values()
+                    for d in tr.devices]
+            assert len(devs) == 1 and len(devs[0].device_ids) == 2
+            seen.update(devs[0].device_ids)
+        assert len(seen) == 4  # disjoint instances across the two allocs
+
+    def test_exhausted_devices_block_eval(self):
+        h = Harness()
+        node = mock.nvidia_node()  # 4 instances
+        h.state.upsert_node(node)
+        job = gpu_job(count=3, dev_count=2)  # needs 6 > 4
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type=job.type))
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 2
+        assert any(e.status == "blocked" for e in h.create_evals)
+
+
+class TestDeviceFingerprint:
+    def test_fake_devices_env(self, monkeypatch):
+        from nomad_tpu.client.fingerprint import device_env_fingerprint
+
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICES", "nvidia/gpu/1080ti:4")
+        node = mock.node()
+        node.node_resources.devices = []
+        device_env_fingerprint(node)
+        assert len(node.node_resources.devices) == 1
+        dev = node.node_resources.devices[0]
+        assert dev.id() == "nvidia/gpu/1080ti"
+        assert len(dev.instances) == 4
+
+
+class TestMultiGroupNodes:
+    """Review repro: nodes carrying several groups of one vendor/type pool.
+    The kernel charges the pool column (aggregate across groups); the exact
+    group resolves host-side with offer-retry on mismatch."""
+
+    def _two_group_node(self):
+        from nomad_tpu.structs.resources import (NodeDeviceInstance,
+                                                 NodeDeviceResource)
+
+        node = mock.nvidia_node()  # 1080ti x4
+        node.node_resources.devices.append(NodeDeviceResource(
+            vendor="nvidia", type="gpu", name="2080ti",
+            instances=[NodeDeviceInstance(id=f"b-{k}", healthy=True)
+                       for k in range(4)]))
+        return node
+
+    def test_pool_ask_uses_free_group_when_one_exhausted(self):
+        h = Harness()
+        node = self._two_group_node()
+        h.state.upsert_node(node)
+        # exhaust the 1080ti group with a running alloc
+        holder = mock.alloc()
+        holder.node_id = node.id
+        holder.client_status = "running"
+        first = DeviceAllocator(node, []).assign(
+            RequestedDevice(name="nvidia/gpu/1080ti", count=4))[0]
+        next(iter(holder.allocated_resources.tasks.values())).devices = [
+            first]
+        h.state.upsert_alloc(holder)
+
+        job = gpu_job(dev_count=2)  # pool-level ask
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type=job.type))
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 1
+        devs = [d for tr in placed[0].allocated_resources.tasks.values()
+                for d in tr.devices]
+        assert devs[0].name == "2080ti"
+
+    def test_constrained_ask_retries_to_next_node(self):
+        """Ask pinned to a group that is exhausted on the best node but free
+        on another: offer-retry must land on the other node, not block."""
+        h = Harness()
+        n1 = self._two_group_node()
+        h.state.upsert_node(n1)
+        n2 = mock.nvidia_node()  # 1080ti x4, free
+        h.state.upsert_node(n2)
+        # exhaust n1's 1080ti group (2080ti stays free so the pool column
+        # still shows capacity on n1)
+        holder = mock.alloc()
+        holder.node_id = n1.id
+        holder.client_status = "running"
+        first = DeviceAllocator(n1, []).assign(
+            RequestedDevice(name="nvidia/gpu/1080ti", count=4))[0]
+        next(iter(holder.allocated_resources.tasks.values())).devices = [
+            first]
+        h.state.upsert_alloc(holder)
+
+        job = gpu_job(dev_count=1, constraints=[
+            Constraint("${device.model}", "1080ti", "=")])
+        h.state.upsert_job(job)
+        h.process(mock.eval_(job_id=job.id, type=job.type))
+        placed = [a for p in h.plans for allocs in p.node_allocation.values()
+                  for a in allocs]
+        assert len(placed) == 1
+        assert placed[0].node_id == n2.id
