@@ -100,6 +100,159 @@ int nomad_count_free_ports(const uint8_t* used, int min_port, int max_port) {
     return n;
 }
 
-int nomad_core_abi_version() { return 1; }
+// One full evaluation of the scalar select loop — the compiled baseline
+// for the bench (the analog of the reference's Go `Stack.Select` hot loop,
+// scheduler/stack.go:116 + rank.go:188 + feasible.go:1026, measured by
+// scheduler/stack_test.go:14-55). Per alloc: full-node scan evaluating
+// the tokenized constraint LUT program, bin-pack fit + score,
+// job-anti-affinity, node affinity, spread target boosts, mean
+// normalization, argmax; then in-loop accounting (used/jc/jtc/spread
+// counts) exactly like the plan-relative threading of the TPU kernel.
+//
+// Layouts (row-major): capacity/used f32[N,R]; attrs i32[N,K];
+// lut u8[C,V] with key_idx i32[C]; aff_lut f32[A,V]; spread tables
+// f32[S,V]. Token normalization: tok<0 or tok>=V → V-1 (missing slot).
+void nomad_select_eval(
+    const float* capacity, float* used, int n, int R, const float* ask,
+    const int32_t* attrs, int K,
+    const int32_t* key_idx, const uint8_t* lut, int C, int V,
+    const int32_t* aff_key_idx, const float* aff_lut, int A,
+    float aff_inv_sum,
+    const int32_t* s_key, const float* s_weight, const uint8_t* s_has_t,
+    const uint8_t* s_active, const float* s_desired, float* s_counts, int S,
+    int distinct_hosts, float* jc, float* jtc, float desired_count,
+    const uint8_t* node_ok, const uint8_t* extra_mask, int extra_n,
+    int n_allocs, int32_t* out_sel, float* out_score) {
+    if (desired_count < 1.f) desired_count = 1.f;
+    // even-mode spread statistics, recomputed per alloc step (counts only
+    // change on placement): min/max of seen (>0) counts per spread row
+    // (kernels/placement.py _spread_boost even branch / spread.go:178)
+    float* minc = S > 0 ? new float[S] : nullptr;
+    float* maxc = S > 0 ? new float[S] : nullptr;
+    uint8_t* any_seen = S > 0 ? new uint8_t[S] : nullptr;
+    for (int a = 0; a < n_allocs; ++a) {
+        for (int s = 0; s < S; ++s) {
+            float mn = 3.4e38f, mx = -3.4e38f;
+            uint8_t seen = 0;
+            for (int v2 = 0; v2 < V; ++v2) {
+                float c = s_counts[(size_t)s * V + v2];
+                if (c > 0.f) {
+                    seen = 1;
+                    if (c < mn) mn = c;
+                    if (c > mx) mx = c;
+                }
+            }
+            minc[s] = mn; maxc[s] = mx; any_seen[s] = seen;
+        }
+        int best = -1;
+        float best_score = -1e30f;
+        for (int i = 0; i < n; ++i) {
+            if (!node_ok[i]) continue;
+            if (extra_n > 1 && !extra_mask[i]) continue;
+            if (extra_n == 1 && !extra_mask[0]) continue;
+            if (distinct_hosts && jc[i] > 0.f) continue;
+            const int32_t* at = attrs + (size_t)i * K;
+            bool ok = true;
+            for (int c = 0; c < C && ok; ++c) {
+                int tok = at[key_idx[c]];
+                if (tok < 0 || tok >= V) tok = V - 1;
+                ok = lut[(size_t)c * V + tok] != 0;
+            }
+            if (!ok) continue;
+            const float* cap = capacity + (size_t)i * R;
+            float* use = used + (size_t)i * R;
+            bool fits = true;
+            for (int r = 0; r < R && fits; ++r)
+                fits = use[r] + ask[r] <= cap[r];
+            if (!fits) continue;
+
+            // fused scoring (rank.go conditional inclusion + mean norm);
+            // 10^x as exp2(x·log2 10) — same fast form the kernel uses,
+            // so the compiled baseline is not handicapped by powf
+            float tc = cap[0] > 1.f ? cap[0] : 1.f;
+            float tm = cap[1] > 1.f ? cap[1] : 1.f;
+            float free_cpu = 1.f - (use[0] + ask[0]) / tc;
+            float free_mem = 1.f - (use[1] + ask[1]) / tm;
+            float total = std::exp2(free_cpu * 3.321928094887362f)
+                        + std::exp2(free_mem * 3.321928094887362f);
+            float binpack = 20.f - total;
+            if (binpack > 18.f) binpack = 18.f;
+            if (binpack < 0.f) binpack = 0.f;
+            float ssum = binpack / 18.f;
+            float scnt = 1.f;
+            if (jtc[i] > 0.f) {
+                ssum += -(jtc[i] + 1.f) / desired_count;
+                scnt += 1.f;
+            }
+            if (A > 0) {
+                float aff = 0.f;
+                for (int c = 0; c < A; ++c) {
+                    int tok = at[aff_key_idx[c]];
+                    if (tok < 0 || tok >= V) tok = V - 1;
+                    aff += aff_lut[(size_t)c * V + tok];
+                }
+                aff *= aff_inv_sum;
+                if (aff != 0.f) { ssum += aff; scnt += 1.f; }
+            }
+            if (S > 0) {
+                float boost = 0.f;
+                for (int s = 0; s < S; ++s) {
+                    if (!s_active[s]) continue;
+                    int tok = at[s_key[s]];
+                    if (tok < 0 || tok >= V) tok = V - 1;
+                    if (s_has_t[s]) {
+                        // target mode (spread.go:120-174)
+                        float desired = s_desired[(size_t)s * V + tok];
+                        float cur = s_counts[(size_t)s * V + tok] + 1.f;
+                        boost += desired > 0.f
+                            ? (desired - cur) / desired * s_weight[s]
+                            : -1.f;
+                    } else {
+                        // even mode (evenSpreadScoreBoost, spread.go:178;
+                        // mirrors kernels/placement.py _spread_boost)
+                        if (!any_seen[s]) continue;
+                        float cur = s_counts[(size_t)s * V + tok];
+                        float mn = minc[s], mx = maxc[s];
+                        float mn_safe = mn > 0.f ? mn : 1.f;
+                        float ev;
+                        if (cur != mn) {
+                            ev = mn == 0.f ? -1.f : (mn - cur) / mn_safe;
+                        } else if (mn == mx) {
+                            ev = -1.f;
+                        } else if (mn == 0.f) {
+                            ev = 1.f;
+                        } else {
+                            ev = (mx - mn) / mn_safe;
+                        }
+                        if (tok == V - 1) ev = -1.f;
+                        boost += ev;
+                    }
+                }
+                if (boost != 0.f) { ssum += boost; scnt += 1.f; }
+            }
+            float score = ssum / scnt;
+            if (score > best_score) { best_score = score; best = i; }
+        }
+        out_sel[a] = best;
+        out_score[a] = best < 0 ? 0.f : best_score;
+        if (best < 0) continue;
+        float* use = used + (size_t)best * R;
+        for (int r = 0; r < R; ++r) use[r] += ask[r];
+        jc[best] += 1.f;
+        jtc[best] += 1.f;
+        const int32_t* at = attrs + (size_t)best * K;
+        for (int s = 0; s < S; ++s) {
+            int tok = at[s_key[s]];
+            if (tok < 0 || tok >= V) tok = V - 1;
+            if (tok == V - 1) continue;  // missing never enters the use map
+            s_counts[(size_t)s * V + tok] += 1.f;
+        }
+    }
+    delete[] minc;
+    delete[] maxc;
+    delete[] any_seen;
+}
+
+int nomad_core_abi_version() { return 2; }
 
 }  // extern "C"

@@ -60,7 +60,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.nomad_first_fit_ports.restype = ctypes.c_int
         lib.nomad_count_free_ports.restype = ctypes.c_int
         lib.nomad_core_abi_version.restype = ctypes.c_int
-        if lib.nomad_core_abi_version() != 1:
+        if lib.nomad_core_abi_version() != 2:
             return None
         _lib = lib
         return _lib
@@ -179,3 +179,103 @@ def count_free_ports(used: np.ndarray, min_port: int, max_port: int) -> int:
     used = np.ascontiguousarray(used, dtype=np.bool_)
     return lib.nomad_count_free_ports(_ptr(used, ctypes.c_uint8),
                                       min_port, max_port)
+
+
+# ---- compiled scalar select (the bench's compiled baseline) ----
+
+def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
+                attrs: np.ndarray, key_idx: np.ndarray, lut: np.ndarray,
+                aff_key_idx: np.ndarray, aff_lut: np.ndarray,
+                aff_inv_sum: float,
+                s_key: np.ndarray, s_weight: np.ndarray,
+                s_has_targets: np.ndarray, s_active: np.ndarray,
+                s_desired: np.ndarray, s_counts: np.ndarray,
+                distinct_hosts: bool, dh_counts: np.ndarray,
+                jtc: np.ndarray,
+                desired_count: float, node_ok: np.ndarray,
+                extra_mask: np.ndarray, n_allocs: int):
+    """One evaluation through the compiled scalar select loop
+    (native `nomad_select_eval`) — full-node scan per alloc with in-loop
+    accounting. MUTATES used/dh_counts/jtc/s_counts. `dh_counts` is the
+    distinct-hosts gate vector (job-level counts for job-scoped
+    distinct_hosts, job+tg counts for tg-scoped — stack.py dh_counts).
+    Returns (sel i32[M], score f32[M]) or None when the native library is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    capacity = np.ascontiguousarray(capacity, dtype=np.float32)
+    for buf in (used, s_counts, dh_counts, jtc):
+        assert buf.flags.c_contiguous and buf.dtype == np.float32, (
+            "mutated buffers must be contiguous float32")
+    ask = np.ascontiguousarray(ask, dtype=np.float32)
+    attrs = np.ascontiguousarray(attrs, dtype=np.int32)
+    key_idx = np.ascontiguousarray(key_idx, dtype=np.int32)
+    lut_u8 = np.ascontiguousarray(lut, dtype=np.uint8)
+    aff_key_idx = np.ascontiguousarray(aff_key_idx, dtype=np.int32)
+    aff_lut = np.ascontiguousarray(aff_lut, dtype=np.float32)
+    s_key = np.ascontiguousarray(s_key, dtype=np.int32)
+    s_weight = np.ascontiguousarray(s_weight, dtype=np.float32)
+    s_has = np.ascontiguousarray(s_has_targets, dtype=np.uint8)
+    s_act = np.ascontiguousarray(s_active, dtype=np.uint8)
+    s_desired = np.ascontiguousarray(s_desired, dtype=np.float32)
+    node_ok_u8 = np.ascontiguousarray(node_ok, dtype=np.uint8)
+    extra_u8 = np.ascontiguousarray(extra_mask, dtype=np.uint8)
+    n, r = capacity.shape
+    v = lut_u8.shape[1] if lut_u8.size else (
+        aff_lut.shape[1] if aff_lut.size else s_desired.shape[1])
+    out_sel = np.empty(n_allocs, dtype=np.int32)
+    out_score = np.empty(n_allocs, dtype=np.float32)
+    lib.nomad_select_eval(
+        _ptr(capacity, ctypes.c_float), _ptr(used, ctypes.c_float), n, r,
+        _ptr(ask, ctypes.c_float),
+        _ptr(attrs, ctypes.c_int32), attrs.shape[1],
+        _ptr(key_idx, ctypes.c_int32), _ptr(lut_u8, ctypes.c_uint8),
+        lut_u8.shape[0], v,
+        _ptr(aff_key_idx, ctypes.c_int32), _ptr(aff_lut, ctypes.c_float),
+        aff_lut.shape[0], ctypes.c_float(aff_inv_sum),
+        _ptr(s_key, ctypes.c_int32), _ptr(s_weight, ctypes.c_float),
+        _ptr(s_has, ctypes.c_uint8), _ptr(s_act, ctypes.c_uint8),
+        _ptr(s_desired, ctypes.c_float),
+        _ptr(s_counts, ctypes.c_float), s_key.shape[0],
+        int(distinct_hosts), _ptr(dh_counts, ctypes.c_float),
+        _ptr(jtc, ctypes.c_float), ctypes.c_float(desired_count),
+        _ptr(node_ok_u8, ctypes.c_uint8), _ptr(extra_u8, ctypes.c_uint8),
+        extra_u8.shape[0], n_allocs,
+        _ptr(out_sel, ctypes.c_int32), _ptr(out_score, ctypes.c_float))
+    return out_sel, out_score
+
+
+def compiled_select(stack, job, tg, n_allocs: int):
+    """Marshal one (job, task-group) placement through the compiled scalar
+    select loop — the single entry the bench's compiled baseline AND its
+    parity test share, so the benchmarked path is the tested path. Returns
+    (sel i32[M], score f32[M]) or None when the native lib is missing."""
+    if _load() is None:
+        return None
+    cl = stack.cluster
+    prog = stack._static_program(job, tg, None)
+    used = cl.used.astype(np.float32, copy=True)
+    jc = np.zeros(cl.n_cap, dtype=np.float32)
+    jtc = np.zeros(cl.n_cap, dtype=np.float32)
+    for row, tgname in cl.job_allocs.get(job.id, {}).values():
+        jc[row] += 1.0
+        if tgname == tg.name:
+            jtc[row] += 1.0
+    # tg-scoped distinct_hosts gates on job+tg collisions, job-scoped on
+    # job collisions (feasible.go:494-500; stack.py dh_counts)
+    dh_counts = jc if prog["dh_job"] else jtc.copy()
+    sp_key, sp_w, sp_has, sp_desired, sp_active = prog["sp_static"]
+    s_counts = np.zeros_like(sp_desired, dtype=np.float32)
+    extra = prog["extra"]
+    if extra is None:
+        extra = np.ones(1, dtype=bool)
+    return select_eval(
+        np.ascontiguousarray(cl.capacity, np.float32), used,
+        prog["ask"], np.ascontiguousarray(cl.attrs, np.int32),
+        prog["cc"].key_idx, prog["feas_lut"],
+        prog["ca"].key_idx, prog["aff_lut"],
+        prog["ca"].inv_sum_abs_weight,
+        sp_key, sp_w, sp_has, sp_active, sp_desired, s_counts,
+        prog["distinct"], dh_counts, jtc, float(max(tg.count, 1)),
+        np.ascontiguousarray(cl.node_ok, np.uint8), extra, n_allocs)

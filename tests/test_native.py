@@ -121,3 +121,52 @@ class TestNetworkIndexIntegration:
         vals = [p.value for p in offer.dynamic_ports]
         assert len(set(vals)) == 2
         assert all(20000 <= v < 32000 for v in vals)
+
+
+class TestCompiledSelect:
+    """The C++ select loop (nomad_select_eval) must agree with the TPU
+    kernel / Python oracle on node choice and normalized score — it is the
+    bench's compiled baseline and must not measure a different algorithm."""
+
+    @pytest.mark.skipif(not native.available(), reason="no native lib")
+    def test_agrees_with_kernel(self):
+        import random
+
+        from nomad_tpu.scheduler.stack import TPUStack
+        from nomad_tpu.synth import build_synthetic_state, synth_service_job
+
+        state, nodes = build_synthetic_state(64, 100, seed=3)
+        rng = random.Random(5)
+        cl = state.cluster
+        from nomad_tpu.structs import Spread
+
+        for i, variant in enumerate([
+            dict(),
+            dict(with_affinity=True),
+            dict(with_spread=True),
+            dict(distinct_hosts=True),
+            dict(with_affinity=True, with_spread=True, distinct_hosts=True),
+            "even_spread",
+        ]):
+            if variant == "even_spread":
+                job = synth_service_job(rng, count=4)
+                job.spreads.append(Spread(attribute="${node.datacenter}",
+                                          weight=100))
+            else:
+                job = synth_service_job(rng, count=4, **variant)
+            tg = job.task_groups[0]
+            stack = TPUStack(cl)
+            sel_k = stack.select(job, tg, 4)
+            out = native.compiled_select(stack, job, tg, 4)
+            assert out is not None
+            sel_c, score_c = out
+            for step in range(4):
+                k_node = sel_k.node_ids[step]
+                c_node = (cl.node_of_row[sel_c[step]]
+                          if sel_c[step] >= 0 else None)
+                if k_node is None or c_node is None:
+                    assert k_node is None and c_node is None, (i, step)
+                    continue
+                assert abs(sel_k.scores[step] - score_c[step]) < 1e-4, (
+                    i, step, k_node, c_node,
+                    sel_k.scores[step], score_c[step])
