@@ -45,6 +45,7 @@ def build(n_nodes: int, n_allocs: int, n_evals: int, count: int, seed: int = 11)
             rng, count=count,
             with_affinity=(i % 2 == 0), with_spread=(i % 3 == 0),
             distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0),
+            distinct_property=(i % 7 == 0),
         )
         state.upsert_job(job)
         jobs.append(job)
@@ -229,6 +230,91 @@ def bench_compiled_oracle(state, jobs, count: int, n_evals: int):
     return rate
 
 
+def bench_system(state, nodes, n_evals: int):
+    """BASELINE config 4: system scheduler with priority-based preemption.
+    Each eval places one alloc per eligible node (system_sched.go:45);
+    parity check = the kernel-masked placement set must equal a scalar
+    recomputation of per-node feasibility+fit, and every preemption-backed
+    placement must name only lower-priority victims that actually free
+    enough capacity. Runs LAST: processing mutates the shared state."""
+    from nomad_tpu.scheduler.harness import Harness
+    from nomad_tpu.scheduler.oracle import driver_ok, meets_constraints
+    from nomad_tpu.structs import Evaluation
+    from nomad_tpu.synth import synth_system_job
+
+    rng = random.Random(97)
+    h = Harness(state)
+    agree = 0
+    checked = 0
+    preempt_placements = 0
+    preempt_ok = 0
+    t0 = time.time()
+    for i in range(n_evals):
+        job = synth_system_job(rng)
+        tg = job.task_groups[0]
+        ask = job.combined_task_resources(tg)
+
+        # scalar expectation BEFORE the plan mutates state
+        feasible, fit = set(), set()
+        for n in nodes:
+            if not n.ready() or n.datacenter not in job.datacenters:
+                continue
+            if not all(driver_ok(n, t.driver) for t in tg.tasks):
+                continue
+            if not meets_constraints(n, list(job.constraints)
+                                     + list(tg.constraints)):
+                continue
+            feasible.add(n.id)
+            util = ask.copy()
+            avail = n.comparable_resources()
+            avail.subtract(n.comparable_reserved_resources())
+            for a in state.allocs_by_node(n.id):
+                if not a.terminal_status():
+                    util.add(a.comparable_resources())
+            if avail.superset(util)[0]:
+                fit.add(n.id)
+
+        state.upsert_job(job)
+        n_plans = len(h.plans)
+        h.process(Evaluation(id=uuid.uuid4().hex, namespace="default",
+                             job_id=job.id, type="system", priority=job.priority,
+                             triggered_by="job-register", status="pending"))
+        if len(h.plans) == n_plans:
+            # no-op plan is not submitted (system.py): zero placements
+            plain, with_victims = set(), []
+        else:
+            plan = h.plans[-1]
+            plain = {a.node_id for allocs in plan.node_allocation.values()
+                     for a in allocs if not a.preempted_allocations}
+            with_victims = [a for allocs in plan.node_allocation.values()
+                            for a in allocs if a.preempted_allocations]
+        checked += 1
+        if plain == fit:
+            agree += 1
+        preempt_placements += len(with_victims)
+        for a in with_victims:
+            vids = set(a.preempted_allocations)
+            victims = [v for vs in plan.node_preemptions.values()
+                       for v in vs if v.id in vids]
+            if (a.node_id in feasible - fit
+                    and victims
+                    and all((v.job.priority if v.job else 50) < job.priority
+                            for v in victims)):
+                preempt_ok += 1
+    dt = time.time() - t0
+    rate = checked / dt if dt else 0.0
+    log(f"system: {checked} evals in {dt:.2f}s = {rate:.2f} evals/s; "
+        f"node-set agreement {agree}/{checked}; preemption placements "
+        f"{preempt_placements} (valid {preempt_ok})")
+    return {
+        "system_evals_per_sec": round(rate, 2),
+        "system_node_agreement_pct": round(100.0 * agree / max(checked, 1),
+                                           2),
+        "system_preemption_placements": preempt_placements,
+        "system_preemption_valid": preempt_ok,
+    }
+
+
 def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
               workers: int, seed: int = 23):
     """End-to-end scheduler benchmark: the same synthetic workload driven
@@ -346,6 +432,10 @@ def main() -> None:
         out["vs_compiled_oracle"] = round(tpu_rate / compiled_rate, 2)
     if parity_stats:
         out.update(parity_stats)
+
+    system_evals = int(os.environ.get("NOMAD_TPU_BENCH_SYSTEM_EVALS", 8))
+    if system_evals:
+        out.update(bench_system(state, nodes, system_evals))
 
     e2e_evals = int(os.environ.get("NOMAD_TPU_BENCH_E2E_EVALS", 128))
     if e2e_evals:

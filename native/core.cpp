@@ -120,6 +120,7 @@ void nomad_select_eval(
     float aff_inv_sum,
     const int32_t* s_key, const float* s_weight, const uint8_t* s_has_t,
     const uint8_t* s_active, const float* s_desired, float* s_counts, int S,
+    const int32_t* dp_key, const float* dp_allowed, float* dp_counts, int P,
     int distinct_hosts, float* jc, float* jtc, float desired_count,
     const uint8_t* node_ok, const uint8_t* extra_mask, int extra_n,
     int n_allocs, int32_t* out_sel, float* out_score) {
@@ -157,6 +158,15 @@ void nomad_select_eval(
                 int tok = at[key_idx[c]];
                 if (tok < 0 || tok >= V) tok = V - 1;
                 ok = lut[(size_t)c * V + tok] != 0;
+            }
+            if (!ok) continue;
+            // distinct_property (propertyset.go:214): value use count must
+            // stay under allowed; unresolved property ⇒ infeasible
+            for (int p = 0; p < P && ok; ++p) {
+                int tok = at[dp_key[p]];
+                if (tok < 0 || tok >= V) tok = V - 1;
+                ok = tok != V - 1
+                     && dp_counts[(size_t)p * V + tok] < dp_allowed[p];
             }
             if (!ok) continue;
             const float* cap = capacity + (size_t)i * R;
@@ -247,12 +257,18 @@ void nomad_select_eval(
             if (tok == V - 1) continue;  // missing never enters the use map
             s_counts[(size_t)s * V + tok] += 1.f;
         }
+        for (int p = 0; p < P; ++p) {
+            int tok = at[dp_key[p]];
+            if (tok < 0 || tok >= V) tok = V - 1;
+            if (tok == V - 1) continue;
+            dp_counts[(size_t)p * V + tok] += 1.f;
+        }
     }
     delete[] minc;
     delete[] maxc;
     delete[] any_seen;
 }
 
-int nomad_core_abi_version() { return 2; }
+int nomad_core_abi_version() { return 3; }
 
 }  // extern "C"

@@ -60,7 +60,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.nomad_first_fit_ports.restype = ctypes.c_int
         lib.nomad_count_free_ports.restype = ctypes.c_int
         lib.nomad_core_abi_version.restype = ctypes.c_int
-        if lib.nomad_core_abi_version() != 2:
+        if lib.nomad_core_abi_version() != 3:
             return None
         _lib = lib
         return _lib
@@ -190,6 +190,8 @@ def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
                 s_key: np.ndarray, s_weight: np.ndarray,
                 s_has_targets: np.ndarray, s_active: np.ndarray,
                 s_desired: np.ndarray, s_counts: np.ndarray,
+                dp_key: np.ndarray, dp_allowed: np.ndarray,
+                dp_counts: np.ndarray,
                 distinct_hosts: bool, dh_counts: np.ndarray,
                 jtc: np.ndarray,
                 desired_count: float, node_ok: np.ndarray,
@@ -205,9 +207,11 @@ def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
     if lib is None:
         return None
     capacity = np.ascontiguousarray(capacity, dtype=np.float32)
-    for buf in (used, s_counts, dh_counts, jtc):
+    for buf in (used, s_counts, dp_counts, dh_counts, jtc):
         assert buf.flags.c_contiguous and buf.dtype == np.float32, (
             "mutated buffers must be contiguous float32")
+    dp_key = np.ascontiguousarray(dp_key, dtype=np.int32)
+    dp_allowed = np.ascontiguousarray(dp_allowed, dtype=np.float32)
     ask = np.ascontiguousarray(ask, dtype=np.float32)
     attrs = np.ascontiguousarray(attrs, dtype=np.int32)
     key_idx = np.ascontiguousarray(key_idx, dtype=np.int32)
@@ -238,6 +242,8 @@ def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
         _ptr(s_has, ctypes.c_uint8), _ptr(s_act, ctypes.c_uint8),
         _ptr(s_desired, ctypes.c_float),
         _ptr(s_counts, ctypes.c_float), s_key.shape[0],
+        _ptr(dp_key, ctypes.c_int32), _ptr(dp_allowed, ctypes.c_float),
+        _ptr(dp_counts, ctypes.c_float), dp_key.shape[0],
         int(distinct_hosts), _ptr(dh_counts, ctypes.c_float),
         _ptr(jtc, ctypes.c_float), ctypes.c_float(desired_count),
         _ptr(node_ok_u8, ctypes.c_uint8), _ptr(extra_u8, ctypes.c_uint8),
@@ -267,6 +273,16 @@ def compiled_select(stack, job, tg, n_allocs: int):
     dh_counts = jc if prog["dh_job"] else jtc.copy()
     sp_key, sp_w, sp_has, sp_desired, sp_active = prog["sp_static"]
     s_counts = np.zeros_like(sp_desired, dtype=np.float32)
+    # distinct_property: reuse the stack's own program builder so existing
+    # allocs seed the counts and literal-LTarget specs clamp n_allocs
+    # exactly as the kernel path does (stack._dp_program)
+    from ..scheduler.stack import PlanContext
+
+    dpk, dpa, dpact, dpc0, n_allocs = stack._dp_program(
+        job, tg, prog, PlanContext(), n_allocs)
+    dp_key = np.ascontiguousarray(dpk[dpact], dtype=np.int32)
+    dp_allowed = np.ascontiguousarray(dpa[dpact], dtype=np.float32)
+    dp_counts = np.ascontiguousarray(dpc0[dpact], dtype=np.float32)
     extra = prog["extra"]
     if extra is None:
         extra = np.ones(1, dtype=bool)
@@ -277,5 +293,6 @@ def compiled_select(stack, job, tg, n_allocs: int):
         prog["ca"].key_idx, prog["aff_lut"],
         prog["ca"].inv_sum_abs_weight,
         sp_key, sp_w, sp_has, sp_active, sp_desired, s_counts,
+        dp_key, dp_allowed, dp_counts,
         prog["distinct"], dh_counts, jtc, float(max(tg.count, 1)),
         np.ascontiguousarray(cl.node_ok, np.uint8), extra, n_allocs)

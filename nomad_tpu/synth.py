@@ -85,14 +85,19 @@ def synth_service_job(rng: random.Random, count: int = 8,
                       with_affinity: bool = False,
                       with_spread: bool = False,
                       distinct_hosts: bool = False,
-                      with_devices: bool = False) -> Job:
+                      with_devices: bool = False,
+                      distinct_property: bool = False) -> Job:
     """One service job: 1 task group, CPU+MiB bin-pack ask (BASELINE config 1),
-    optionally the batch/spread/distinct_hosts/device stanzas (configs 2-5)."""
+    optionally the batch/spread/distinct_hosts/device/distinct_property
+    stanzas (configs 2-5)."""
     jid = f"svc-{uuid.uuid4().hex[:12]}"
     constraints = [Constraint(ltarget="${attr.kernel.name}", rtarget="linux",
                               operand="=")]
     if distinct_hosts:
         constraints.append(Constraint(operand="distinct_hosts"))
+    if distinct_property:
+        constraints.append(Constraint(ltarget="${attr.rack}", rtarget="2",
+                                      operand="distinct_property"))
     affinities = []
     if with_affinity:
         affinities.append(
@@ -133,6 +138,39 @@ def synth_service_job(rng: random.Random, count: int = 8,
                             devices=([RequestedDevice(name="nvidia/gpu",
                                                       count=1)]
                                      if with_devices else []),
+                        ),
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def synth_system_job(rng: random.Random, priority: int = 80) -> Job:
+    """One system job (BASELINE config 4): one alloc per eligible node,
+    priority above the synthetic filler allocs so priority-based preemption
+    (system_sched.go:268) can evict on full nodes."""
+    jid = f"sys-{uuid.uuid4().hex[:12]}"
+    return Job(
+        id=jid,
+        name=jid,
+        type="system",
+        priority=priority,
+        datacenters=list(DATACENTERS),
+        constraints=[Constraint(ltarget="${attr.kernel.name}",
+                                rtarget="linux", operand="=")],
+        task_groups=[
+            TaskGroup(
+                name="mon",
+                count=1,
+                ephemeral_disk=EphemeralDisk(size_mb=50),
+                tasks=[
+                    Task(
+                        name="mon",
+                        driver="exec",
+                        resources=Resources(
+                            cpu=rng.choice((500, 1000)),
+                            memory_mb=rng.choice((128, 256)),
                         ),
                     )
                 ],

@@ -146,6 +146,7 @@ class TestCompiledSelect:
             dict(with_spread=True),
             dict(distinct_hosts=True),
             dict(with_affinity=True, with_spread=True, distinct_hosts=True),
+            dict(distinct_property=True),
             "even_spread",
         ]):
             if variant == "even_spread":
