@@ -59,18 +59,47 @@ def bench_tpu(state, jobs, stack, count: int, batch: int) -> float:
     """Batched kernel path: per-eval program compile (host, numpy) + one
     vmapped device dispatch per batch of evaluations. Dispatches are left
     async (JAX dispatch model) so batch i+1's host compile and transfer
-    overlap batch i's device execution; one sync at the end."""
+    overlap batch i's device execution; one sync at the end.
+
+    With >1 device present the node axis is sharded over the mesh's node
+    ring and the eval batch over its batch axis (parallel/mesh.py) — the
+    single-chip path instead uses packed transport to minimize tunneled
+    host→device round trips."""
     import jax
     import numpy as np
 
     from nomad_tpu.kernels.placement import pack_params, place_packed_batch
-    from nomad_tpu.parallel import stack_params
+    from nomad_tpu.parallel import (make_mesh, place_batch_sharded,
+                                    shard_cluster, stack_params)
+
+    use_mesh = (len(jax.devices()) > 1
+                and os.environ.get("NOMAD_TPU_BENCH_MESH", "1") != "0")
+    mesh = make_mesh() if use_mesh else None
+    if mesh is not None and batch % mesh.devices.shape[0] != 0:
+        # the eval batch shards over the mesh batch axis; an indivisible
+        # batch would fail GSPMD partitioning — fall back to single-device
+        log(f"mesh: batch {batch} not divisible by mesh batch axis "
+            f"{mesh.devices.shape[0]}; using single-device path")
+        mesh = None
+    if mesh is not None:
+        log(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    sharded_fns = {}
+    sharded_cluster = {"version": -1, "arrays": None}
 
     def dispatch(job_batch):
         params = [
             stack.compile_tg(j, j.task_groups[0], count)[0] for j in job_batch
         ]
         batched, m = stack_params(params)
+        if mesh is not None:
+            if sharded_cluster["version"] != stack.cluster.version:
+                sharded_cluster["arrays"] = shard_cluster(
+                    stack.device_arrays(), mesh)
+                sharded_cluster["version"] = stack.cluster.version
+            fn = sharded_fns.get(m)
+            if fn is None:
+                fn = sharded_fns[m] = place_batch_sharded(mesh, m)
+            return fn(sharded_cluster["arrays"], batched).sel_idx
         ibuf, fbuf, ubuf, spec = pack_params(batched)
         arrays = stack.device_arrays()
         sel, _scores = place_packed_batch(arrays, ibuf, fbuf, ubuf, spec, m)
@@ -237,9 +266,10 @@ def bench_system(state, nodes, n_evals: int):
     recomputation of per-node feasibility+fit, and every preemption-backed
     placement must name only lower-priority victims that actually free
     enough capacity. Runs LAST: processing mutates the shared state."""
+    from nomad_tpu.mock import alloc_resources
     from nomad_tpu.scheduler.harness import Harness
     from nomad_tpu.scheduler.oracle import driver_ok, meets_constraints
-    from nomad_tpu.structs import Evaluation
+    from nomad_tpu.structs import Allocation, Evaluation, allocs_fit
     from nomad_tpu.synth import synth_system_job
 
     rng = random.Random(97)
@@ -265,13 +295,14 @@ def bench_system(state, nodes, n_evals: int):
                                      + list(tg.constraints)):
                 continue
             feasible.add(n.id)
-            util = ask.copy()
-            avail = n.comparable_resources()
-            avail.subtract(n.comparable_reserved_resources())
-            for a in state.allocs_by_node(n.id):
-                if not a.terminal_status():
-                    util.add(a.comparable_resources())
-            if avail.superset(util)[0]:
+            probe = Allocation(
+                id="probe", job_id=job.id, job=job, task_group=tg.name,
+                node_id=n.id,
+                allocated_resources=alloc_resources(
+                    cpu=ask.cpu, memory_mb=ask.memory_mb,
+                    disk_mb=ask.disk_mb),
+                desired_status="run", client_status="pending")
+            if allocs_fit(n, state.allocs_by_node(n.id) + [probe])[0]:
                 fit.add(n.id)
 
         state.upsert_job(job)
@@ -296,10 +327,18 @@ def bench_system(state, nodes, n_evals: int):
             vids = set(a.preempted_allocations)
             victims = [v for vs in plan.node_preemptions.values()
                        for v in vs if v.id in vids]
+            node = next((n for n in nodes if n.id == a.node_id), None)
+            # valid = node was feasible-but-full, victims are strictly
+            # lower priority, AND evicting them actually makes the
+            # placement fit. The plan is already applied: state holds the
+            # new alloc and the victims are terminal (evicted), so
+            # allocs_fit over the node's current allocs IS the
+            # post-eviction fit check.
             if (a.node_id in feasible - fit
-                    and victims
+                    and victims and node is not None
                     and all((v.job.priority if v.job else 50) < job.priority
-                            for v in victims)):
+                            for v in victims)
+                    and allocs_fit(node, state.allocs_by_node(a.node_id))[0]):
                 preempt_ok += 1
     dt = time.time() - t0
     rate = checked / dt if dt else 0.0

@@ -110,3 +110,54 @@ def test_scheduler_step_advances_state(problem):
         for b in range(len(params))
     )
     np.testing.assert_allclose(used_delta.sum(), total_ask, rtol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def bench_scale_problem():
+    """Bench-shaped fixture: 10K nodes (bucketed to a 16384-row axis that
+    actually shards over the mesh's node ring), full eval mix (affinity /
+    spread / distinct_hosts / devices / distinct_property)."""
+    state, nodes = build_synthetic_state(10_000, 2_000, seed=9)
+    rng = random.Random(10)
+    stack = TPUStack(state.cluster)
+    params = []
+    for i in range(8):
+        job = synth_service_job(
+            rng, count=4,
+            with_affinity=(i % 2 == 0), with_spread=(i % 3 == 0),
+            distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0),
+            distinct_property=(i % 7 == 0),
+        )
+        state.upsert_job(job)
+        p, _m = stack.compile_tg(job, job.task_groups[0], 4)
+        params.append(p)
+    return state, stack, params
+
+
+def test_sharded_matches_single_device_at_bench_scale(bench_scale_problem):
+    """VERDICT r2 #4: the sharded==single-device equality must hold at the
+    scale where sharding matters — a 10K-node axis split over the node
+    ring, not a toy fixture."""
+    _state, stack, params = bench_scale_problem
+    mesh = make_mesh(8)
+    arrays = stack.device_arrays()
+    assert arrays.capacity.shape[0] >= 16384  # row bucket for 10K nodes
+    batched, m = stack_params(params)
+
+    single = place_task_group_batch(arrays, batched, m)
+
+    sharded_cluster = shard_cluster(arrays, mesh)
+    sharded_params = jax.tree_util.tree_map(
+        jax.device_put, batched, params_sharding(mesh, batched=True)
+    )
+    sharded = place_batch_sharded(mesh, m)(sharded_cluster, sharded_params)
+
+    np.testing.assert_array_equal(
+        np.asarray(single.sel_idx), np.asarray(sharded.sel_idx)
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.sel_score), np.asarray(sharded.sel_score),
+        rtol=1e-5,
+    )
+    placed = int((np.asarray(single.sel_idx) >= 0).sum())
+    assert placed == len(params) * 4  # everything placed at this scale
