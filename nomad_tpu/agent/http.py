@@ -95,6 +95,85 @@ class HTTPApi:
         self.httpd.shutdown()
         self.httpd.server_close()
 
+    # ---- client filesystem endpoints (client/fs_endpoint.go) ----
+
+    def _client_fs(self, op: str, alloc_id: str, query: Dict[str, str],
+                   token: Optional[str] = None):
+        import os
+
+        from ..client.fs import (FsError, fs_list, fs_read_at, fs_stat,
+                                 logs_read)
+
+        client = self.agent.client
+        if client is None:
+            raise HttpError(501, "this agent is not running a client")
+        # alloc_id comes off the URL: confine it to one directory level
+        # under the allocs root before any filesystem access
+        if not re.fullmatch(r"[0-9a-zA-Z-]{1,64}", alloc_id):
+            raise HttpError(400, f"invalid alloc id {alloc_id!r}")
+        # resolve the alloc for its namespace (ACL scope); unknown allocs
+        # are 404 even if a stray directory exists
+        alloc = None
+        runner = client.alloc_runner(alloc_id)
+        if runner is not None:
+            alloc = runner.alloc
+        elif self.agent.server is not None:
+            alloc = self.agent.server.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise HttpError(404, f"alloc {alloc_id!r} not on this agent")
+        # ACL: read-fs / read-logs in the ALLOC'S job namespace when a
+        # server (token store) is attached; client-only dev agents are
+        # open like /v1/agent/self
+        if self.agent.server is not None:
+            from ..acl import ACLError
+
+            try:
+                acl = self.agent.server.resolve_token(token)
+            except ACLError as e:
+                raise HttpError(403, str(e))
+            cap = "read-logs" if op == "logs" else "read-fs"
+            if not acl.allow_namespace_operation(alloc.namespace, cap):
+                raise HttpError(403, "Permission denied")
+        root = os.path.join(client.alloc_dir_base, alloc_id)
+        if not os.path.isdir(root):
+            raise HttpError(404, f"alloc {alloc_id!r} not on this agent")
+        path = query.get("path", "/")
+        try:
+            if op == "ls":
+                return fs_list(root, path)
+            if op == "stat":
+                return fs_stat(root, path)
+            if op in ("cat", "readat"):
+                offset = int(query.get("offset", 0))
+                limit = (int(query["limit"]) if "limit" in query else None)
+                data, size = fs_read_at(root, path, offset, limit)
+                return {"Data": data, "FileSize": size, "Offset": offset}
+            if op == "logs":
+                logs_dir = os.path.join(root, "alloc", "logs")
+                limit = (int(query["limit"]) if "limit" in query else None)
+                if "frame" in query:
+                    # stable follow cursor (frames survive rotation reaps)
+                    from ..client.fs import logs_read_from
+
+                    data, frame, pos = logs_read_from(
+                        logs_dir, task=query.get("task", ""),
+                        logtype=query.get("type", "stdout"),
+                        frame=int(query["frame"]),
+                        pos=int(query.get("pos", 0)), limit=limit)
+                    return {"Data": data, "Frame": frame, "Pos": pos}
+                data, total = logs_read(
+                    logs_dir,
+                    task=query.get("task", ""),
+                    logtype=query.get("type", "stdout"),
+                    offset=int(query.get("offset", 0)),
+                    origin=query.get("origin", "start"),
+                    limit=limit,
+                )
+                return {"Data": data, "FileSize": total}
+        except FsError as e:
+            raise HttpError(e.code, str(e))
+        raise HttpError(404, f"unknown fs op {op!r}")
+
     # ---- routing (http.go:253 registerHandlers) ----
 
     def route(self, method: str, path: str, query: Dict[str, str],
@@ -107,6 +186,12 @@ class HTTPApi:
             return self.agent.self_info()
         if parts0[1:] == ["metrics"]:
             return self.agent.metrics()
+        # /v1/client/fs/* — served by the agent hosting the alloc
+        # (client/fs_endpoint.go; servers in the reference proxy to the
+        # node — here the caller talks to the owning agent directly)
+        if parts0[1:2] == ["client"] and parts0[2:3] == ["fs"] \
+                and len(parts0) >= 5:
+            return self._client_fs(parts0[3], parts0[4], query, token)
         server = self.agent.server
         if server is None:
             raise HttpError(501,

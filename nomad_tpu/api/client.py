@@ -165,6 +165,51 @@ class NomadClient:
     def allocation(self, alloc_id: str):
         return from_wire(self._request("GET", f"/v1/allocation/{alloc_id}"))
 
+    # ---- alloc fs / logs (api/fs.go over client/fs_endpoint.go) ----
+
+    def alloc_fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:
+        return self._request("GET", f"/v1/client/fs/ls/{alloc_id}",
+                             params={"path": path})
+
+    def alloc_fs_stat(self, alloc_id: str, path: str) -> dict:
+        return self._request("GET", f"/v1/client/fs/stat/{alloc_id}",
+                             params={"path": path})
+
+    def alloc_fs_cat(self, alloc_id: str, path: str) -> bytes:
+        out = self._request("GET", f"/v1/client/fs/cat/{alloc_id}",
+                            params={"path": path})
+        return out.get("Data", b"")
+
+    def alloc_fs_read_at(self, alloc_id: str, path: str, offset: int = 0,
+                         limit: Optional[int] = None) -> bytes:
+        params = {"path": path, "offset": str(offset)}
+        if limit is not None:
+            params["limit"] = str(limit)
+        out = self._request("GET", f"/v1/client/fs/readat/{alloc_id}",
+                            params=params)
+        return out.get("Data", b"")
+
+    def alloc_logs(self, alloc_id: str, task: str, type: str = "stdout",
+                   offset: int = 0, origin: str = "start",
+                   limit: Optional[int] = None) -> bytes:
+        params = {"task": task, "type": type, "offset": str(offset),
+                  "origin": origin}
+        if limit is not None:
+            params["limit"] = str(limit)
+        out = self._request("GET", f"/v1/client/fs/logs/{alloc_id}",
+                            params=params)
+        return out.get("Data", b"")
+
+    def alloc_logs_from(self, alloc_id: str, task: str,
+                        type: str = "stdout", frame: int = -1,
+                        pos: int = 0) -> Tuple[bytes, int, int]:
+        """Cursor-based log read (stable across logmon rotation reaps):
+        returns (data, frame, pos) — pass the cursor back to continue."""
+        out = self._request("GET", f"/v1/client/fs/logs/{alloc_id}",
+                            params={"task": task, "type": type,
+                                    "frame": str(frame), "pos": str(pos)})
+        return out.get("Data", b""), out.get("Frame", -1), out.get("Pos", 0)
+
     def evaluations(self) -> List[Any]:
         _, data = self._unblock(self._request("GET", "/v1/evaluations"))
         return [from_wire(e) for e in data]

@@ -272,6 +272,78 @@ def cmd_alloc_status(args) -> int:
     return 0
 
 
+def _resolve_alloc(api, prefix: str):
+    matches = [a for a in api.allocations() if a.id.startswith(prefix)]
+    if len(matches) != 1:
+        print(f"{len(matches)} allocations match {prefix!r}",
+              file=sys.stderr)
+        return None
+    return matches[0]
+
+
+def cmd_alloc_logs(args) -> int:
+    """Reference `nomad alloc logs` (command/alloc_logs.go): print a task's
+    stdout/stderr; -f tails by polling the log endpoint."""
+    api = _client(args)
+    a = _resolve_alloc(api, args.alloc_id)
+    if a is None:
+        return 1
+    task = args.task
+    if not task:
+        tasks = list((a.task_states or {}).keys()) or (
+            [t.name for tg in (a.job.task_groups if a.job else [])
+             if tg.name == a.task_group for t in tg.tasks])
+        if len(tasks) != 1:
+            print("error: allocation has multiple tasks; specify one",
+                  file=sys.stderr)
+            return 1
+        task = tasks[0]
+    logtype = "stderr" if args.stderr else "stdout"
+    try:
+        data, frame, pos = api.alloc_logs_from(a.id, task, type=logtype)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(data.decode(errors="replace"))
+    while args.follow:
+        # (frame, pos) cursor survives log rotation reaps, unlike
+        # concatenation offsets
+        time.sleep(1.0)
+        try:
+            data, frame, pos = api.alloc_logs_from(
+                a.id, task, type=logtype, frame=frame, pos=pos)
+        except ApiError:
+            break
+        if data:
+            sys.stdout.write(data.decode(errors="replace"))
+            sys.stdout.flush()
+    return 0
+
+
+def cmd_alloc_fs(args) -> int:
+    """Reference `nomad alloc fs` (command/alloc_fs.go): ls/cat inside the
+    alloc dir."""
+    api = _client(args)
+    a = _resolve_alloc(api, args.alloc_id)
+    if a is None:
+        return 1
+    path = args.path or "/"
+    try:
+        st = api.alloc_fs_stat(a.id, path)
+        if st["IsDir"]:
+            entries = api.alloc_fs_list(a.id, path)
+            rows = [[("d" if e["IsDir"] else "-"), str(e["Size"]),
+                     e["Name"]] for e in entries]
+            print(_columns(rows, ["Mode", "Size", "Name"]))
+        else:
+            sys.stdout.write(
+                api.alloc_fs_cat(a.id, path).decode(errors="replace"))
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_eval_status(args) -> int:
     api = _client(args)
     ev = api.evaluation(args.eval_id)
@@ -491,6 +563,16 @@ def build_parser() -> argparse.ArgumentParser:
     als = al.add_parser("status")
     als.add_argument("alloc_id")
     als.set_defaults(fn=cmd_alloc_status)
+    all_ = al.add_parser("logs")
+    all_.add_argument("alloc_id")
+    all_.add_argument("task", nargs="?", default="")
+    all_.add_argument("-stderr", action="store_true")
+    all_.add_argument("-f", dest="follow", action="store_true")
+    all_.set_defaults(fn=cmd_alloc_logs)
+    alf = al.add_parser("fs")
+    alf.add_argument("alloc_id")
+    alf.add_argument("path", nargs="?", default="/")
+    alf.set_defaults(fn=cmd_alloc_fs)
 
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(
         dest="sub", required=True)

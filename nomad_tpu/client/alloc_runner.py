@@ -44,6 +44,12 @@ class AllocRunner:
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_states: Dict[str, TaskState] = {}
         self._lock = threading.Lock()
+        # Serializes status recompute + publish: without it, a thread that
+        # READ task states before a transition can PUBLISH its stale
+        # status after the fresh one, and the client sync batch keeps the
+        # stale value (the alloc then sits "pending" on the server until
+        # the next transition — observed under CPU load on scale-ups).
+        self._status_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._destroyed = False
         self._shutting_down = False
@@ -158,24 +164,29 @@ class AllocRunner:
         self._recompute_status()
 
     def _recompute_status(self) -> None:
-        with self._lock:
-            states = list(self.task_states.values())
-        if not states:
-            status = ALLOC_CLIENT_PENDING
-        elif any(s.failed for s in states):
-            status = ALLOC_CLIENT_FAILED
-        elif all(s.state == TASK_STATE_DEAD for s in states):
-            status = ALLOC_CLIENT_COMPLETE
-        elif any(s.state == "running" for s in states):
-            status = ALLOC_CLIENT_RUNNING
-        else:
-            status = ALLOC_CLIENT_PENDING
-        self.client_status = status
-        if self.on_update is not None and not self._shutting_down:
-            # Fires on every task-state transition (not just status flips):
-            # the server needs restart counts and events too; the client
-            # sync loop coalesces bursts.
-            self.on_update(self.snapshot_alloc())
+        # _status_lock spans read→derive→publish so concurrent transitions
+        # can't publish out of order (reference handleTaskStateUpdates is a
+        # single fan-in goroutine, alloc_runner.go:443 — this lock is the
+        # same serialization)
+        with self._status_lock:
+            with self._lock:
+                states = list(self.task_states.values())
+            if not states:
+                status = ALLOC_CLIENT_PENDING
+            elif any(s.failed for s in states):
+                status = ALLOC_CLIENT_FAILED
+            elif all(s.state == TASK_STATE_DEAD for s in states):
+                status = ALLOC_CLIENT_COMPLETE
+            elif any(s.state == "running" for s in states):
+                status = ALLOC_CLIENT_RUNNING
+            else:
+                status = ALLOC_CLIENT_PENDING
+            self.client_status = status
+            if self.on_update is not None and not self._shutting_down:
+                # Fires on every task-state transition (not just status
+                # flips): the server needs restart counts and events too;
+                # the client sync loop coalesces bursts.
+                self.on_update(self.snapshot_alloc())
 
     def snapshot_alloc(self) -> Allocation:
         """Client-side view for allocSync (client.go:1898)."""

@@ -1,0 +1,82 @@
+"""Artifact fetching — the task-runner artifacts hook.
+
+Behavioral reference: `client/allocrunner/taskrunner/artifact_hook.go` +
+`.../getter/getter.go` (go-getter): each `artifact{}` stanza downloads
+`getter_source` into the task dir at `relative_dest` before the task
+starts; a `checksum` getter option ("md5:<hex>" / "sha256:<hex>" /
+"sha512:<hex>") is verified after download. Supported schemes: http(s),
+file://, and bare local paths (the go-getter detectors this build needs —
+S3/git stay out until an egress path exists)."""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.parse
+import urllib.request
+
+
+class ArtifactError(Exception):
+    pass
+
+
+def _verify_checksum(path: str, spec: str) -> None:
+    algo, _, want = spec.partition(":")
+    algo = algo.lower()
+    if algo not in ("md5", "sha1", "sha256", "sha512") or not want:
+        raise ArtifactError(f"unsupported checksum spec {spec!r}")
+    h = hashlib.new(algo)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    if h.hexdigest() != want.lower():
+        raise ArtifactError(
+            f"checksum mismatch for {os.path.basename(path)}: "
+            f"got {algo}:{h.hexdigest()}, want {spec}")
+
+
+def fetch_artifact(artifact, task_dir: str) -> str:
+    """Download one TaskArtifact into `task_dir`; returns the local path.
+    Destination confinement mirrors the alloc-dir fencing of fs.py."""
+    src = artifact.getter_source
+    if not src:
+        raise ArtifactError("artifact has no source")
+    dest_dir = os.path.normpath(
+        os.path.join(task_dir, artifact.relative_dest or "local/"))
+    if not (dest_dir == task_dir
+            or dest_dir.startswith(task_dir + os.sep)):
+        raise ArtifactError(
+            f"artifact destination escapes task dir: "
+            f"{artifact.relative_dest!r}")
+    os.makedirs(dest_dir, exist_ok=True)
+
+    parsed = urllib.parse.urlparse(src)
+    name = os.path.basename(parsed.path or "") or "artifact"
+    out = os.path.join(dest_dir, name)
+    try:
+        if parsed.scheme in ("http", "https"):
+            with urllib.request.urlopen(src, timeout=30) as resp, \
+                    open(out, "wb") as f:
+                shutil.copyfileobj(resp, f)
+        elif parsed.scheme == "file" or not parsed.scheme:
+            local = parsed.path if parsed.scheme == "file" else src
+            shutil.copy(local, out)
+        else:
+            raise ArtifactError(
+                f"unsupported artifact scheme {parsed.scheme!r}")
+    except ArtifactError:
+        raise
+    except Exception as e:  # noqa: BLE001 — normalize fetch failures
+        raise ArtifactError(f"failed to fetch {src!r}: {e}")
+
+    checksum = (artifact.getter_options or {}).get("checksum", "")
+    if checksum:
+        try:
+            _verify_checksum(out, checksum)
+        except ArtifactError:
+            os.unlink(out)
+            raise
+    mode = (artifact.getter_options or {}).get("mode", "")
+    if mode:
+        os.chmod(out, int(mode, 8))
+    return out

@@ -1,0 +1,165 @@
+"""Allocation filesystem access — the agent-local half of the reference's
+FileSystem endpoints (`client/fs_endpoint.go`: List :109, Stat :139,
+ReadAt via stream framer :179, Logs :292). Serves files under an alloc's
+directory tree (allocdir.py layout) with path confinement; task logs read
+across logmon's rotated files (`client/logmon/logging/`) as one logical
+stream."""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+
+class FsError(Exception):
+    def __init__(self, code: int, msg: str) -> None:
+        super().__init__(msg)
+        self.code = code
+
+
+def _resolve(root: str, rel: str) -> str:
+    """Confine `rel` inside `root` (the reference relies on the chroot /
+    alloc-dir layout; here symlink-free normalization does the fencing)."""
+    p = os.path.normpath(os.path.join(root, rel.lstrip("/")))
+    real_root = os.path.realpath(root)
+    if os.path.realpath(p) != real_root and not os.path.realpath(p).startswith(
+            real_root + os.sep):
+        raise FsError(403, f"path escapes alloc dir: {rel!r}")
+    return p
+
+
+def _entry(path: str, name: str) -> Dict:
+    st = os.lstat(path)
+    return {
+        "Name": name,
+        "IsDir": os.path.isdir(path),
+        "Size": int(st.st_size),
+        "FileMode": oct(st.st_mode & 0o7777),
+        "ModTime": st.st_mtime,
+    }
+
+
+def fs_list(root: str, rel: str) -> List[Dict]:
+    p = _resolve(root, rel or "/")
+    if not os.path.isdir(p):
+        raise FsError(404, f"not a directory: {rel!r}")
+    return [_entry(os.path.join(p, n), n) for n in sorted(os.listdir(p))]
+
+
+def fs_stat(root: str, rel: str) -> Dict:
+    p = _resolve(root, rel)
+    if not os.path.exists(p):
+        raise FsError(404, f"no such file: {rel!r}")
+    return _entry(p, os.path.basename(p))
+
+
+def fs_read_at(root: str, rel: str, offset: int = 0,
+               limit: Optional[int] = None) -> Tuple[bytes, int]:
+    """Read [offset, offset+limit) of a file; negative offset is from the
+    end (fs_endpoint.go ReadAt / the `origin=end` convention). Returns
+    (data, file size)."""
+    p = _resolve(root, rel)
+    if not os.path.isfile(p):
+        raise FsError(404, f"no such file: {rel!r}")
+    size = os.path.getsize(p)
+    if offset < 0:
+        offset = max(size + offset, 0)
+    with open(p, "rb") as f:
+        f.seek(offset)
+        data = f.read(size if limit is None else max(limit, 0))
+    return data, size
+
+
+_LOG_RE = re.compile(r"^(?P<task>.+)\.(?P<type>stdout|stderr)\.(?P<idx>\d+)$")
+
+
+def _log_frames(logs_dir: str, task: str, logtype: str
+                ) -> List[Tuple[int, str, int]]:
+    """Rotation-ordered (index, path, size) frames for one task stream."""
+    if logtype not in ("stdout", "stderr"):
+        raise FsError(400, f"invalid log type {logtype!r}")
+    try:
+        names = os.listdir(logs_dir)
+    except OSError:
+        raise FsError(404, "no logs directory")
+    frames = []
+    for n in names:
+        m = _LOG_RE.match(n)
+        if m and m.group("task") == task and m.group("type") == logtype:
+            p = os.path.join(logs_dir, n)
+            try:
+                frames.append((int(m.group("idx")), p, os.path.getsize(p)))
+            except OSError:
+                pass  # reaped between listdir and stat
+    if not frames:
+        raise FsError(404, f"no {logtype} logs for task {task!r}")
+    frames.sort()
+    return frames
+
+
+def _read_slice(path: str, start: int, length: int) -> bytes:
+    with open(path, "rb") as f:
+        f.seek(start)
+        return f.read(length)
+
+
+def logs_read(logs_dir: str, task: str, logtype: str = "stdout",
+              offset: int = 0, origin: str = "start",
+              limit: Optional[int] = None) -> Tuple[bytes, int]:
+    """Task log stream across logmon's rotated frames
+    (`<task>.<stdout|stderr>.N`, fs_endpoint.go Logs :292). `origin` is
+    "start" or "end"; offset is relative to it. Only the requested slice is
+    read from disk (frame sizes map the offset to (frame, position)).
+    Returns (data, total). NOTE: offsets address the concatenation of the
+    frames currently on disk — once the rotator reaps an old frame they
+    shift; follow-mode uses the stable (frame, pos) cursor of
+    `logs_read_from` instead."""
+    frames = _log_frames(logs_dir, task, logtype)
+    total = sum(sz for _i, _p, sz in frames)
+    start = (max(total - offset, 0) if origin == "end"
+             else min(offset, total))
+    end = total if limit is None else min(start + max(limit, 0), total)
+    out = []
+    pos = 0
+    for _i, path, sz in frames:
+        if pos + sz > start and pos < end:
+            lo = max(start - pos, 0)
+            out.append(_read_slice(path, lo, min(end - pos, sz) - lo))
+        pos += sz
+        if pos >= end:
+            break
+    return b"".join(out), total
+
+
+def logs_read_from(logs_dir: str, task: str, logtype: str = "stdout",
+                   frame: int = -1, pos: int = 0,
+                   limit: Optional[int] = None
+                   ) -> Tuple[bytes, int, int]:
+    """Cursor-based log read for follow mode: return everything after
+    (frame, pos) and the new cursor. Frame indices are monotonic across
+    rotation and a surpassed frame is immutable (logmon FileRotator), so
+    the cursor stays valid even when old frames are reaped — unlike
+    concatenation offsets. frame=-1 starts from the oldest frame."""
+    frames = _log_frames(logs_dir, task, logtype)
+    out = []
+    budget = None if limit is None else max(limit, 0)
+    cur_frame, cur_pos = frame, pos
+    for idx, path, sz in frames:
+        if idx < frame:
+            continue
+        lo = pos if idx == frame else 0
+        if lo >= sz and idx == frame:
+            cur_frame, cur_pos = idx, sz
+            continue
+        n = sz - lo
+        if budget is not None:
+            n = min(n, budget)
+        if n <= 0:
+            break
+        out.append(_read_slice(path, lo, n))
+        cur_frame, cur_pos = idx, lo + n
+        if budget is not None:
+            budget -= n
+            if budget <= 0:
+                break
+    return b"".join(out), cur_frame, cur_pos

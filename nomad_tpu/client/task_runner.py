@@ -21,6 +21,7 @@ from typing import Callable, Dict, Optional
 from ..structs import (TASK_STATE_DEAD, TASK_STATE_PENDING,
                        TASK_STATE_RUNNING, Allocation, TaskEvent, TaskState)
 from ..structs.job import RestartPolicy, Task
+from .artifacts import fetch_artifact
 from .drivers import DriverPlugin, TaskConfig, new_driver
 from .logmon import LogMon
 from .taskenv import build_env, interpolate_config
@@ -233,6 +234,16 @@ class TaskRunner:
             max_files=self.task.log_config.max_files,
             max_file_size_mb=self.task.log_config.max_file_size_mb,
         )
+        # artifacts hook (taskrunner/artifact_hook.go + getter/getter.go):
+        # fetch each artifact into the task dir before the first start;
+        # a fetch or checksum failure fails the task setup. Skipped when
+        # recovering a live task after agent restart (the reference marks
+        # the hook done in persisted hook state) — re-downloading over a
+        # running task's files, or failing on a now-dead source, must not
+        # kill the recovered task.
+        if not self.recover_state:
+            for art in self.task.artifacts:
+                fetch_artifact(art, self.task_dir)
         # template hook (template/template.go, minimal: render env-style
         # templates into files was out of scope; env assembled below)
 
