@@ -165,6 +165,26 @@ class NomadClient:
     def allocation(self, alloc_id: str):
         return from_wire(self._request("GET", f"/v1/allocation/{alloc_id}"))
 
+    # ---- CSI volumes (api/csi.go) ----
+
+    def csi_volumes(self) -> List[Any]:
+        res = self._request("GET", "/v1/volumes")
+        return [from_wire(v) for v in self._unblock(res)[1]]
+
+    def csi_volume(self, vol_id: str, namespace: str = "default"):
+        return from_wire(self._request(
+            "GET", f"/v1/volume/csi/{vol_id}",
+            params={"namespace": namespace}))
+
+    def csi_volume_register(self, vol) -> None:
+        self._request("PUT", f"/v1/volume/csi/{vol.id}",
+                      body=to_wire(vol))
+
+    def csi_volume_deregister(self, vol_id: str,
+                              namespace: str = "default") -> None:
+        self._request("DELETE", f"/v1/volume/csi/{vol_id}",
+                      params={"namespace": namespace})
+
     # ---- alloc fs / logs (api/fs.go over client/fs_endpoint.go) ----
 
     def alloc_fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..structs import (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
                        ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING,
@@ -24,12 +24,16 @@ from .allocdir import AllocDir
 from .task_runner import TaskRunner
 
 
+class _AllocHalted(Exception):
+    """Setup interrupted by destroy/shutdown — clean exit, not a failure."""
+
+
 class AllocRunner:
     def __init__(self, alloc: Allocation, base_dir: str, node=None,
                  on_update: Optional[Callable[[Allocation], None]] = None,
                  on_handle: Optional[Callable] = None,
                  recover_handles: Optional[Dict[str, dict]] = None,
-                 driver_manager=None
+                 driver_manager=None, csi_manager=None, conn=None
                  ) -> None:
         self.alloc = alloc
         self.node = node
@@ -40,6 +44,12 @@ class AllocRunner:
         #: task_name → driver_state persisted before an agent restart
         self.recover_handles = recover_handles or {}
         self.driver_manager = driver_manager
+        self.csi_manager = csi_manager
+        self.conn = conn
+        #: volume name → host path, filled by the volumes hook; task
+        #: runners materialize task.volume_mounts from it
+        self.volume_paths: Dict[str, str] = {}
+        self._csi_mounted: List[Tuple[str, str]] = []  # (plugin, vol)
         self.alloc_dir = AllocDir(base_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_states: Dict[str, TaskState] = {}
@@ -70,6 +80,23 @@ class AllocRunner:
         tasks = self._tasks()
         # allocDir hook (alloc_runner_hooks.go allocDirHook)
         self.alloc_dir.build([t.name for t in tasks])
+        # volumes hook: host volumes resolve to fingerprinted paths, CSI
+        # volumes claim + node-stage/publish through the csimanager
+        # (alloc_runner csi_hook.go; csimanager/volume.go MountVolume)
+        try:
+            self._mount_volumes()
+        except _AllocHalted:
+            return  # destroyed/shutdown mid-setup: not a failure
+        except Exception as e:  # noqa: BLE001 — setup failure fails alloc
+            with self._lock:
+                for t in tasks:
+                    ts = TaskState(state=TASK_STATE_DEAD, failed=True)
+                    self.task_states[t.name] = ts
+            # events first: _recompute_status publishes the snapshot the
+            # server will keep, so the failure reason must already be there
+            self._event_all(f"volume setup failed: {e}")
+            self._recompute_status()
+            return
 
         def hook(t):
             return t.lifecycle.hook if t.lifecycle is not None else ""
@@ -119,6 +146,67 @@ class AllocRunner:
                     return
         self._recompute_status()
 
+    def _mount_volumes(self) -> None:
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        for name, req in ((tg.volumes or {}) if tg else {}).items():
+            if req.type == "host":
+                cfg = (self.node.host_volumes or {}).get(req.source) \
+                    if self.node else None
+                if cfg is None or not cfg.path:
+                    raise RuntimeError(
+                        f"host volume {req.source!r} not on node")
+                self.volume_paths[name] = cfg.path
+            elif req.type == "csi":
+                if self.csi_manager is None or self.conn is None:
+                    raise RuntimeError("no CSI manager on this client")
+                vol = self.conn.csi_volume_get(self.alloc.namespace,
+                                               req.source)
+                if vol is None:
+                    raise RuntimeError(f"CSI volume {req.source!r} missing")
+                mode = "read" if req.read_only else "write"
+                # Claims of terminal allocs are reaped asynchronously by
+                # the server's volumewatcher; retry with backoff before
+                # failing (reference csi_hook claimWithRetry)
+                claimed = False
+                delay = 0.2
+                for _attempt in range(6):
+                    if self.conn.csi_volume_claim(
+                            self.alloc.namespace, req.source,
+                            self.alloc.id, mode):
+                        claimed = True
+                        break
+                    if self._halted():
+                        raise _AllocHalted()
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+                if not claimed:
+                    raise RuntimeError(
+                        f"CSI claim rejected for {req.source!r} ({mode})")
+                path = self.csi_manager.mount_volume(
+                    vol.plugin_id, vol.id, self.alloc.id,
+                    readonly=req.read_only)
+                self.volume_paths[name] = path
+                self._csi_mounted.append((vol.plugin_id, vol.id))
+
+    def _unmount_volumes(self) -> None:
+        if self.csi_manager is None:
+            return
+        for plugin_id, vol_id in self._csi_mounted:
+            try:
+                self.csi_manager.unmount_volume(plugin_id, vol_id,
+                                                self.alloc.id)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._csi_mounted.clear()
+
+    def _event_all(self, message: str) -> None:
+        for ts in self.task_states.values():
+            from ..structs import TaskEvent
+
+            ts.events.append(TaskEvent(type="Setup Failure",
+                                       time=time.time(), message=message))
+
     def _halted(self) -> bool:
         return self._destroyed or self._shutting_down
 
@@ -141,6 +229,7 @@ class AllocRunner:
             on_handle=self.on_handle,
             recover_state=(rec or {}).get("state"),
             driver_manager=self.driver_manager,
+            volume_paths=self.volume_paths,
         )
         with self._lock:
             self.task_runners[task.name] = tr
@@ -230,6 +319,7 @@ class AllocRunner:
         self.kill()
         for tr in list(self.task_runners.values()):
             tr.join(timeout=5.0)
+        self._unmount_volumes()
         self.alloc_dir.destroy()
 
     def wait(self, timeout: float = 10.0) -> bool:

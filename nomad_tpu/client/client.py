@@ -56,6 +56,13 @@ class InProcConn:
     def node_update_allocs(self, updates):
         return self.server.node_update_allocs(updates)
 
+    def csi_volume_claim(self, namespace, vol_id, alloc_id, mode):
+        return self.server.csi_volume_claim(namespace, vol_id, alloc_id,
+                                            mode)
+
+    def csi_volume_get(self, namespace, vol_id):
+        return self.server.csi_volume_get(namespace, vol_id)
+
 
 class RpcConn:
     """Server connection over the msgpack-RPC fabric with failover across
@@ -100,6 +107,13 @@ class RpcConn:
     def node_update_allocs(self, updates):
         return self._call("node_update_allocs", updates)
 
+    def csi_volume_claim(self, namespace, vol_id, alloc_id, mode):
+        return self._call("csi_volume_claim", namespace, vol_id,
+                          alloc_id, mode)
+
+    def csi_volume_get(self, namespace, vol_id):
+        return self._call("csi_volume_get", namespace, vol_id)
+
 
 class ClientConfig:
     def __init__(self, data_dir: Optional[str] = None,
@@ -133,6 +147,16 @@ class Client:
 
         self.driver_manager = DriverManager(
             on_attrs=self._driver_attrs_changed)
+        # CSI node plugins (client/pluginmanager/csimanager/): the builtin
+        # hostpath plugin stands in for container-hosted CSI services and
+        # is advertised on the node so CSIVolumeChecker feasibility passes
+        from .csi import CsiManager, HostPathCsiPlugin
+
+        self.csi = CsiManager(os.path.join(self.data_dir, "csi"))
+        self.csi.register(HostPathCsiPlugin(
+            "hostpath", os.path.join(self.data_dir, "csi", "hostpath")))
+        for pid in self.csi.plugins:
+            self.node.csi_node_plugins.setdefault(pid, {"healthy": True})
         self.allocs: Dict[str, AllocRunner] = {}
         self._known_index: Dict[str, int] = {}
         self._lock = threading.Lock()
@@ -260,7 +284,8 @@ class Client:
                              on_update=self._alloc_updated,
                              on_handle=on_handle,
                              recover_handles=recover_handles,
-                             driver_manager=self.driver_manager)
+                             driver_manager=self.driver_manager,
+                             csi_manager=self.csi, conn=self.conn)
         with self._lock:
             self.allocs[alloc.id] = runner
             self._known_index[alloc.id] = alloc.modify_index

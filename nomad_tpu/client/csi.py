@@ -1,0 +1,144 @@
+"""Client-side CSI: the csimanager analog.
+
+Behavioral reference: `client/pluginmanager/csimanager/volume.go` —
+`MountVolume` :1 drives the CSI node RPCs (NodeStageVolume →
+NodePublishVolume) producing a per-alloc mount path; `UnmountVolume`
+unpublishes and unstages when the last usage drops. The plugin contract
+mirrors `plugins/csi/plugin.go`'s node client surface.
+
+Plugins here are in-process objects registered with the manager (the
+reference runs them as gRPC services inside task containers and dials
+their sockets; the contract is the same — see `plugins/base.py` for the
+out-of-process transport this build uses for task drivers). The built-in
+`hostpath` plugin is a functional stand-in (the `plugins/csi/fake`
+analog): volumes are directories under the plugin root, stage is a mkdir,
+publish is a symlink bind-mount analog — no privileges required."""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+
+class CsiError(Exception):
+    pass
+
+
+class CsiNodePlugin:
+    """Node-service contract (plugins/csi/plugin.go NodeStageVolume /
+    NodePublishVolume / NodeUnpublishVolume / NodeUnstageVolume)."""
+
+    plugin_id = ""
+
+    def node_stage_volume(self, volume_id: str, staging_path: str) -> None:
+        raise NotImplementedError
+
+    def node_publish_volume(self, volume_id: str, staging_path: str,
+                            target_path: str, readonly: bool) -> None:
+        raise NotImplementedError
+
+    def node_unpublish_volume(self, volume_id: str,
+                              target_path: str) -> None:
+        raise NotImplementedError
+
+    def node_unstage_volume(self, volume_id: str,
+                            staging_path: str) -> None:
+        raise NotImplementedError
+
+
+class HostPathCsiPlugin(CsiNodePlugin):
+    """Functional hostpath plugin: volume data lives under
+    `<root>/<volume_id>`; publish symlinks the target at the backing dir
+    (the bind-mount analog that needs no privileges)."""
+
+    def __init__(self, plugin_id: str, root: str) -> None:
+        self.plugin_id = plugin_id
+        self.root = root
+
+    def _backing(self, volume_id: str) -> str:
+        return os.path.join(self.root, volume_id)
+
+    def node_stage_volume(self, volume_id: str, staging_path: str) -> None:
+        os.makedirs(self._backing(volume_id), exist_ok=True)
+
+    def node_publish_volume(self, volume_id: str, staging_path: str,
+                            target_path: str, readonly: bool) -> None:
+        backing = self._backing(volume_id)
+        os.makedirs(os.path.dirname(target_path), exist_ok=True)
+        if os.path.islink(target_path):
+            os.unlink(target_path)
+        os.symlink(backing, target_path)
+
+    def node_unpublish_volume(self, volume_id: str,
+                              target_path: str) -> None:
+        if os.path.islink(target_path):
+            os.unlink(target_path)
+
+    def node_unstage_volume(self, volume_id: str,
+                            staging_path: str) -> None:
+        pass  # backing dir persists (volume data outlives allocs)
+
+
+@dataclass
+class _VolumeUsage:
+    staging_path: str
+    allocs: Set[str] = field(default_factory=set)
+
+
+class CsiManager:
+    """Per-client volume mount lifecycle (csimanager/volume.go):
+    stage-once per (plugin, volume), publish per alloc, unstage when the
+    last alloc unmounts."""
+
+    def __init__(self, base_dir: str) -> None:
+        self.base_dir = base_dir  # <data_dir>/csi
+        self.plugins: Dict[str, CsiNodePlugin] = {}
+        self._usage: Dict[str, _VolumeUsage] = {}  # "<plugin>/<vol>"
+        self._lock = threading.Lock()
+
+    def register(self, plugin: CsiNodePlugin) -> None:
+        self.plugins[plugin.plugin_id] = plugin
+
+    def _target(self, alloc_id: str, volume_id: str) -> str:
+        return os.path.join(self.base_dir, "per-alloc", alloc_id,
+                            volume_id, "mount")
+
+    def mount_volume(self, plugin_id: str, volume_id: str, alloc_id: str,
+                     readonly: bool = False) -> str:
+        plugin = self.plugins.get(plugin_id)
+        if plugin is None:
+            raise CsiError(f"no CSI plugin {plugin_id!r} on this node")
+        key = f"{plugin_id}/{volume_id}"
+        with self._lock:
+            usage = self._usage.get(key)
+            if usage is None:
+                staging = os.path.join(self.base_dir, "staging", plugin_id,
+                                       volume_id)
+                os.makedirs(staging, exist_ok=True)
+                plugin.node_stage_volume(volume_id, staging)
+                usage = self._usage[key] = _VolumeUsage(staging)
+            target = self._target(alloc_id, volume_id)
+            plugin.node_publish_volume(volume_id, usage.staging_path,
+                                       target, readonly)
+            usage.allocs.add(alloc_id)
+        return target
+
+    def unmount_volume(self, plugin_id: str, volume_id: str,
+                       alloc_id: str) -> None:
+        plugin = self.plugins.get(plugin_id)
+        key = f"{plugin_id}/{volume_id}"
+        with self._lock:
+            usage = self._usage.get(key)
+            target = self._target(alloc_id, volume_id)
+            if plugin is not None:
+                plugin.node_unpublish_volume(volume_id, target)
+            shutil.rmtree(os.path.dirname(target), ignore_errors=True)
+            if usage is not None:
+                usage.allocs.discard(alloc_id)
+                if not usage.allocs:
+                    if plugin is not None:
+                        plugin.node_unstage_volume(volume_id,
+                                                   usage.staging_path)
+                    del self._usage[key]

@@ -68,7 +68,8 @@ class TaskRunner:
                  on_handle: Optional[Callable] = None,
                  recover_state: Optional[dict] = None,
                  driver_manager=None,
-                 update_period: float = 0.0) -> None:
+                 update_period: float = 0.0,
+                 volume_paths: Optional[Dict[str, str]] = None) -> None:
         self.alloc = alloc
         self.task = task
         self.task_dir = task_dir
@@ -79,6 +80,8 @@ class TaskRunner:
         self.on_handle = on_handle
         #: persisted driver_state from a previous agent run, if any
         self.recover_state = recover_state
+        #: volume name → host path (alloc runner volumes hook)
+        self.volume_paths = volume_paths or {}
         self.state = TaskState()
         # shared per-client driver instance when a manager is present
         # (drivermanager Dispense) — image-pull dedup etc. work per node
@@ -244,6 +247,26 @@ class TaskRunner:
         if not self.recover_state:
             for art in self.task.artifacts:
                 fetch_artifact(art, self.task_dir)
+        # volume_mounts hook (taskrunner volume_hook.go): materialize each
+        # mount inside the task dir — the privilege-free bind-mount analog
+        # is a symlink at the destination
+        import os
+
+        for vm in self.task.volume_mounts:
+            src = self.volume_paths.get(vm.volume)
+            if src is None:
+                raise RuntimeError(
+                    f"task {self.task.name}: volume {vm.volume!r} "
+                    f"not mounted on alloc")
+            dest = os.path.normpath(os.path.join(
+                self.task_dir, vm.destination.lstrip("/")))
+            if dest != self.task_dir and not dest.startswith(
+                    self.task_dir + os.sep):
+                raise RuntimeError(
+                    f"volume mount escapes task dir: {vm.destination!r}")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            if not os.path.islink(dest) and not os.path.exists(dest):
+                os.symlink(src, dest)
         # template hook (template/template.go, minimal: render env-style
         # templates into files was out of scope; env assembled below)
 

@@ -112,6 +112,17 @@ class RaftStateStore(StateStore):
             self.raft.apply({"op": name, "args": _encode_args(name, args)})
             # The committed entry has been applied locally (apply blocks
             # until last_applied covers it); reads now see the write.
+            if name == "csi_volume_claim":
+                # the op's bool result can't ride the raft apply — read it
+                # back: a rejected claim leaves the alloc out of the
+                # volume's claim maps (CSIVolume.claim)
+                ns, vol_id, alloc_id, mode = args[:4]
+                vol = self.csi_volume(ns, vol_id)
+                if vol is None:
+                    return False
+                claims = (vol.read_claims if mode == "read"
+                          else vol.write_claims)
+                return alloc_id in claims
             look = self._LOOKUP.get(name)
             if look is None:
                 return None
@@ -151,6 +162,7 @@ FORWARDED = (
     "job_register", "job_deregister", "node_register", "node_update_status",
     "node_update_drain", "node_update_eligibility", "node_heartbeat",
     "node_update_allocs", "node_get_client_allocs", "alloc_get", "run_gc",
+    "csi_volume_claim", "csi_volume_get",
 )
 
 

@@ -546,6 +546,10 @@ class Server:
         return self.state.csi_volume_claim(namespace, vol_id, alloc_id,
                                            mode)
 
+    def csi_volume_get(self, namespace: str, vol_id: str):
+        """Client fetches a volume for the mount path (CSIVolume.Get)."""
+        return self.state.csi_volume(namespace, vol_id)
+
     # ---- scaling (nomad/job_endpoint.go:969 Scale + scaling policies) ----
 
     def job_scale(self, namespace: str, job_id: str, group: str,

@@ -162,3 +162,24 @@ class TestCluster:
             assert a0.task_states["web"].state == "dead"
         finally:
             client.shutdown()
+
+
+class TestClusterCsiClaim:
+    def test_claim_result_survives_raft_routing(self, cluster):
+        """csi_volume_claim's boolean must come back through the Raft
+        route (the op itself rides the log; the result is a post-apply
+        read-back)."""
+        from nomad_tpu.structs.csi import CSIVolume
+
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        srv = leader.server
+        srv.csi_volume_register(CSIVolume(
+            id="cv", name="cv", plugin_id="hostpath"))
+        assert srv.csi_volume_claim("default", "cv", "alloc-1", "write") \
+            is True
+        # single-writer: a second writer must see False, not None
+        assert srv.csi_volume_claim("default", "cv", "alloc-2", "write") \
+            is False
+        vol = srv.csi_volume_get("default", "cv")
+        assert "alloc-1" in vol.write_claims

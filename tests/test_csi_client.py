@@ -1,0 +1,125 @@
+"""Client-side CSI mount path (reference
+client/pluginmanager/csimanager/volume.go MountVolume/UnmountVolume,
+plugins/csi/plugin.go node service, alloc_runner csi_hook.go,
+taskrunner volume_hook.go)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import NomadClient
+from nomad_tpu.structs.csi import CSIVolume
+from nomad_tpu.structs.job import VolumeMount, VolumeRequest
+
+
+def _wait(cond, timeout=40.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+    yield a, api
+    a.shutdown()
+
+
+def csi_job(script, vol_source="vol0", read_only=False):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.volumes = {"data": VolumeRequest(
+        name="data", type="csi", source=vol_source, read_only=read_only)}
+    t = tg.tasks[0]
+    t.driver = "raw_exec"
+    t.config = {"command": "/bin/sh", "args": ["-c", script]}
+    t.volume_mounts = [VolumeMount(volume="data", destination="/data")]
+    return job
+
+
+class TestCsiMountPath:
+    def test_unit_manager_stage_publish(self, tmp_path):
+        from nomad_tpu.client.csi import CsiManager, HostPathCsiPlugin
+
+        mgr = CsiManager(str(tmp_path / "csi"))
+        mgr.register(HostPathCsiPlugin("hp", str(tmp_path / "backing")))
+        p1 = mgr.mount_volume("hp", "v1", "alloc-a")
+        p2 = mgr.mount_volume("hp", "v1", "alloc-b")
+        assert os.path.islink(p1) and os.path.islink(p2)
+        with open(os.path.join(p1, "f"), "w") as f:
+            f.write("shared")
+        assert open(os.path.join(p2, "f")).read() == "shared"
+        mgr.unmount_volume("hp", "v1", "alloc-a")
+        assert not os.path.lexists(p1)
+        assert os.path.islink(p2)  # still staged for alloc-b
+        mgr.unmount_volume("hp", "v1", "alloc-b")
+        assert mgr._usage == {}
+        with pytest.raises(Exception):
+            mgr.mount_volume("nope", "v1", "a")
+
+    def test_task_sees_mount_and_data_persists(self, agent):
+        a, api = agent
+        vol = CSIVolume(id="vol0", name="vol0", plugin_id="hostpath")
+        api.csi_volume_register(vol)
+
+        writer = csi_job("echo persisted > data/out.txt")
+        api.wait_for_eval(api.register_job(writer))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(writer.id)))
+
+        # a second job over the same volume sees the first job's data
+        reader = csi_job("cat data/out.txt")
+        api.wait_for_eval(api.register_job(reader))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(reader.id)))
+        alloc = next(al for al in api.job_allocations(reader.id)
+                     if al.client_status == "complete")
+        assert b"persisted" in api.alloc_logs(alloc.id, "web")
+
+        # the volume carries the claims of both allocs until reaped
+        got = api.csi_volume("vol0")
+        assert got.plugin_id == "hostpath"
+
+    def test_missing_volume_fails_placement_or_alloc(self, agent):
+        a, api = agent
+        job = csi_job("true", vol_source="missing-vol")
+        ev_id = api.register_job(job)
+        ev = api.wait_for_eval(ev_id)
+        # scheduler-side: unknown CSI volume poisons feasibility → blocked
+        assert ev.status in ("complete", "blocked")
+        assert not any(al.client_status == "complete"
+                       for al in api.job_allocations(job.id))
+
+    def test_host_volume_mount(self, agent, tmp_path):
+        from nomad_tpu.structs.node import ClientHostVolumeConfig
+
+        a, api = agent
+        hv = tmp_path / "hostvol"
+        hv.mkdir()
+        (hv / "seed.txt").write_text("from-host")
+        # fingerprint the host volume onto the node and re-register
+        a.client.node.host_volumes = {
+            "shared": ClientHostVolumeConfig(name="shared", path=str(hv))}
+        a.client.conn.node_register(a.client.node)
+
+        job = csi_job("cat data/seed.txt")
+        job.task_groups[0].volumes = {"data": VolumeRequest(
+            name="data", type="host", source="shared")}
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(job.id)))
+        alloc = api.job_allocations(job.id)[0]
+        assert b"from-host" in api.alloc_logs(alloc.id, "web")
