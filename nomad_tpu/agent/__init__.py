@@ -15,6 +15,43 @@ from typing import Any, Dict, Optional
 from .http import HTTPApi
 
 
+class _LogRingHandler:
+    """Process-wide logging handler fanning records out to the live
+    agents' monitor rings (attach once; agents register/unregister)."""
+
+    _instance = None
+
+
+def _ring_handler():
+    import logging
+
+    if _LogRingHandler._instance is None:
+        class Handler(logging.Handler):
+            def __init__(self):
+                super().__init__(level=logging.INFO)
+                self.rings = []
+
+            def emit(self, record):
+                try:
+                    rec = {
+                        "Time": record.created,
+                        "Level": record.levelname,
+                        "Name": record.name,
+                        "Message": record.getMessage(),
+                    }
+                    for ring in list(self.rings):
+                        ring.append(rec)
+                except Exception:  # noqa: BLE001 — logging must not raise
+                    pass
+
+        handler = Handler()
+        root = logging.getLogger("nomad_tpu")
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        _LogRingHandler._instance = handler
+    return _LogRingHandler._instance
+
+
 class AgentConfig:
     def __init__(self, server: bool = True, client: bool = True,
                  http_host: str = "127.0.0.1", http_port: int = 0,
@@ -22,7 +59,8 @@ class AgentConfig:
                  num_schedulers: int = 1, heartbeat_ttl: float = 30.0,
                  node_name: str = "", datacenter: str = "dc1",
                  region: str = "global",
-                 server_addrs=None, acl_enabled: bool = False) -> None:
+                 server_addrs=None, acl_enabled: bool = False,
+                 host_volumes=None, node_meta=None) -> None:
         self.server = server
         self.client = client
         self.http_host = http_host
@@ -35,6 +73,60 @@ class AgentConfig:
         self.region = region
         self.server_addrs = server_addrs or []  # client-only mode targets
         self.acl_enabled = acl_enabled
+        #: name → {path, read_only} (agent config client.host_volume)
+        self.host_volumes = host_volumes or {}
+        self.node_meta = node_meta or {}
+
+    @classmethod
+    def from_hcl(cls, text: str) -> "AgentConfig":
+        """Agent configuration file (reference command/agent/config.go +
+        config_parse.go): top-level keys plus server{}, client{}, ports{}
+        and acl{} blocks."""
+        from ..jobspec.hcl import parse_hcl
+
+        def one(v):
+            return v[0] if isinstance(v, list) and v else (v or {})
+
+        tree = parse_hcl(text)
+        # modes are opt-in via their blocks (reference defaults: both off)
+        cfg = cls(server=False, client=False)
+        for k in ("data_dir", "datacenter", "region"):
+            if k in tree:
+                setattr(cfg, k, tree[k])
+        if "name" in tree:
+            cfg.node_name = tree["name"]
+        if "bind_addr" in tree:
+            cfg.http_host = tree["bind_addr"]
+        srv = one(tree.get("server"))
+        if srv:
+            cfg.server = bool(srv.get("enabled", True))
+            if "num_schedulers" in srv:
+                cfg.num_schedulers = int(srv["num_schedulers"])
+            if "heartbeat_grace" in srv:
+                from ..jobspec.parse import _seconds
+
+                cfg.heartbeat_ttl = _seconds(srv["heartbeat_grace"])
+        cl = one(tree.get("client"))
+        if cl:
+            cfg.client = bool(cl.get("enabled", True))
+            if "servers" in cl:
+                cfg.server_addrs = [
+                    (h, int(p)) for h, _, p in
+                    (s.partition(":") for s in cl["servers"])]
+            for hv in (cl.get("host_volume") or []):
+                (name, body), = hv.items()
+                b = one(body)
+                cfg.host_volumes[name] = {
+                    "path": b.get("path", ""),
+                    "read_only": bool(b.get("read_only", False))}
+            cfg.node_meta.update(one(cl.get("meta", {})) or {})
+        ports = one(tree.get("ports"))
+        if ports and "http" in ports:
+            cfg.http_port = int(ports["http"])
+        acl = one(tree.get("acl"))
+        if acl:
+            cfg.acl_enabled = bool(acl.get("enabled", False))
+        return cfg
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "AgentConfig":
@@ -52,6 +144,14 @@ class Agent:
         self.client = None
         self.cluster = None
         self._started_at = time.time()
+        # agent log ring for /v1/agent/monitor (hclog → monitor stream):
+        # one process-wide handler fans out to the live agents' rings
+        import collections
+        import logging
+
+        self._log_ring = collections.deque(maxlen=2000)
+        _ring_handler().rings.append(self._log_ring)
+        logging.getLogger("nomad_tpu.agent").info("agent starting")
         if self.config.server:
             from ..server import Server, ServerConfig
 
@@ -67,6 +167,16 @@ class Agent:
 
             node = Node(name=self.config.node_name,
                         datacenter=self.config.datacenter)
+            if self.config.node_meta:
+                node.meta.update(self.config.node_meta)
+            if self.config.host_volumes:
+                from ..structs.node import ClientHostVolumeConfig
+
+                node.host_volumes = {
+                    name: ClientHostVolumeConfig(
+                        name=name, path=hv.get("path", ""),
+                        read_only=bool(hv.get("read_only", False)))
+                    for name, hv in self.config.host_volumes.items()}
             if self.server is not None:
                 conn = InProcConn(self.server)
             elif self.config.server_addrs:
@@ -97,6 +207,9 @@ class Agent:
         self.http.start()
 
     def shutdown(self) -> None:
+        h = _ring_handler()
+        if self._log_ring in h.rings:
+            h.rings.remove(self._log_ring)
         self.http.shutdown()
         if self.client is not None:
             self.client.shutdown()
@@ -104,6 +217,20 @@ class Agent:
             self.server.shutdown()
 
     # ---- introspection (agent_endpoint.go) ----
+
+    def monitor_logs(self, since: float = 0.0, level: str = "") -> list:
+        """Recent agent log records (reference /v1/agent/monitor,
+        command/agent/agent_endpoint.go Monitor — polling JSON frames
+        instead of a chunked stream)."""
+        want = level.upper()
+        out = []
+        for rec in list(self._log_ring):
+            if rec["Time"] <= since:
+                continue
+            if want and rec["Level"] != want:
+                continue
+            out.append(rec)
+        return out
 
     def self_info(self) -> Dict[str, Any]:
         from .. import __version__

@@ -95,6 +95,21 @@ class HTTPApi:
         self.httpd.shutdown()
         self.httpd.server_close()
 
+    def _require_local(self, token, cap: str) -> None:
+        """ACL gate for agent-local routes: enforced when a token store
+        (server) is attached; client-only dev agents stay open (the
+        /v1/agent/self precedent)."""
+        if self.agent.server is None:
+            return
+        from ..acl import ACLError
+
+        try:
+            acl = self.agent.server.resolve_token(token)
+        except ACLError as e:
+            raise HttpError(403, str(e))
+        if not getattr(acl, f"allow_{cap}")():
+            raise HttpError(403, "Permission denied")
+
     # ---- client filesystem endpoints (client/fs_endpoint.go) ----
 
     def _client_fs(self, op: str, alloc_id: str, query: Dict[str, str],
@@ -192,6 +207,20 @@ class HTTPApi:
         if parts0[1:2] == ["client"] and parts0[2:3] == ["fs"] \
                 and len(parts0) >= 5:
             return self._client_fs(parts0[3], parts0[4], query, token)
+        # /v1/client/stats — host statistics (client/stats_endpoint.go;
+        # node:read when a token store is attached)
+        if parts0[1:] == ["client", "stats"]:
+            if self.agent.client is None:
+                raise HttpError(501, "this agent is not running a client")
+            self._require_local(token, "node_read")
+            return self.agent.client.host_stats()
+        # /v1/agent/monitor — agent-local log ring (agent_endpoint.go
+        # Monitor; agent:read)
+        if parts0[1:] == ["agent", "monitor"]:
+            self._require_local(token, "agent_read")
+            return self.agent.monitor_logs(
+                since=float(query.get("since", 0) or 0),
+                level=query.get("log_level", ""))
         server = self.agent.server
         if server is None:
             raise HttpError(501,
@@ -457,6 +486,30 @@ class HTTPApi:
             require(acl.allow_operator_write())
             server.run_gc("force-gc")
             return {}
+        # /v1/operator/snapshot — full-state archive save/restore
+        # (nomad/operator_endpoint.go SnapshotSave/SnapshotRestore,
+        # helper/snapshot)
+        if parts == ["operator", "snapshot"]:
+            import msgpack
+
+            from ..server.fsm import restore_state, snapshot_state
+
+            if method == "GET":
+                require(acl.allow_operator_read())
+                with state.transact():  # quiescent store while serializing
+                    blob = msgpack.packb(snapshot_state(state),
+                                         use_bin_type=True)
+                return {"Data": blob, "Index": state.index.value}
+            if method == "PUT":
+                require(acl.allow_operator_write())
+                blob = body.get("Data") if isinstance(body, dict) else None
+                if not blob:
+                    raise HttpError(400, "missing Data")
+                tree = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+                with state.transact():
+                    restore_state(state, tree)
+                server._restore_evals()  # pending evals re-enter the broker
+                return {"Index": state.index.value}
         # /v1/operator/scheduler/configuration
         if parts == ["operator", "scheduler", "configuration"]:
             if method == "GET":

@@ -165,6 +165,23 @@ class NomadClient:
     def allocation(self, alloc_id: str):
         return from_wire(self._request("GET", f"/v1/allocation/{alloc_id}"))
 
+    def operator_snapshot_save(self) -> bytes:
+        out = self._request("GET", "/v1/operator/snapshot")
+        return out.get("Data", b"")
+
+    def operator_snapshot_restore(self, data: bytes) -> None:
+        self._request("PUT", "/v1/operator/snapshot", body={"Data": data})
+
+    def agent_monitor(self, since: float = 0.0,
+                      log_level: str = "") -> List[dict]:
+        return self._request("GET", "/v1/agent/monitor",
+                             params={"since": str(since),
+                                     "log_level": log_level})
+
+    def client_stats(self) -> dict:
+        """Host statistics of the agent's client (api/nodes.go Stats)."""
+        return self._request("GET", "/v1/client/stats")
+
     # ---- CSI volumes (api/csi.go) ----
 
     def csi_volumes(self) -> List[Any]:

@@ -344,6 +344,42 @@ def cmd_alloc_fs(args) -> int:
     return 0
 
 
+def cmd_operator_snapshot(args) -> int:
+    """Reference `nomad operator snapshot save|restore`
+    (command/operator_snapshot_*.go)."""
+    api = _client(args)
+    if args.action == "save":
+        data = api.operator_snapshot_save()
+        with open(args.file, "wb") as f:
+            f.write(data)
+        print(f"Snapshot written to {args.file} ({len(data)} bytes)")
+        return 0
+    with open(args.file, "rb") as f:
+        api.operator_snapshot_restore(f.read())
+    print(f"Snapshot restored from {args.file}")
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Reference `nomad monitor` (command/monitor.go): tail agent logs."""
+    api = _client(args)
+    since = 0.0
+    try:
+        while True:
+            for rec in api.agent_monitor(since=since,
+                                         log_level=args.log_level):
+                stamp = time.strftime("%H:%M:%S",
+                                      time.localtime(rec["Time"]))
+                print(f"{stamp} [{rec['Level']}] {rec['Name']}: "
+                      f"{rec['Message']}")
+                since = max(since, rec["Time"])
+            if not args.follow:
+                return 0
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_eval_status(args) -> int:
     api = _client(args)
     ev = api.evaluation(args.eval_id)
@@ -453,24 +489,38 @@ def cmd_version(args) -> int:
 def cmd_agent(args) -> int:
     from .agent import Agent, AgentConfig
 
-    if not (args.dev or args.server or args.client):
+    if not (args.dev or args.server or args.client or args.config):
         print("Error: must have at least client or server mode enabled "
-              "(-dev | -server | -client)", file=sys.stderr)
+              "(-dev | -server | -client | -config)", file=sys.stderr)
         return 1
-    cfg = AgentConfig(
-        server=args.dev or args.server,
-        client=args.dev or args.client,
-        http_host=args.bind, http_port=args.http_port,
-        data_dir=args.data_dir,
-    )
     if args.config:
-        from .jobspec.hcl import parse_hcl
-
+        # HCL agent configuration file (command/agent/config_parse.go);
+        # explicit flags override file values
         with open(args.config) as fh:
-            tree = parse_hcl(fh.read())
-        for k, v in tree.items():
-            if hasattr(cfg, k):
-                setattr(cfg, k, v)
+            cfg = AgentConfig.from_hcl(fh.read())
+        if args.dev or args.server:
+            cfg.server = True
+        if args.dev or args.client:
+            cfg.client = True
+        if args.bind is not None:
+            cfg.http_host = args.bind
+        if args.http_port is not None:
+            cfg.http_port = args.http_port
+        if args.data_dir:
+            cfg.data_dir = args.data_dir
+        if not (cfg.server or cfg.client):
+            print("Error: config enables neither server nor client",
+                  file=sys.stderr)
+            return 1
+    else:
+        cfg = AgentConfig(
+            server=args.dev or args.server,
+            client=args.dev or args.client,
+            http_host=args.bind if args.bind is not None else "127.0.0.1",
+            http_port=(args.http_port if args.http_port is not None
+                       else 4646),
+            data_dir=args.data_dir,
+        )
     agent = Agent(cfg)
     agent.start()
     host, port = agent.http_addr
@@ -497,8 +547,8 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-dev", action="store_true")
     ag.add_argument("-server", action="store_true")
     ag.add_argument("-client", action="store_true")
-    ag.add_argument("-bind", default="127.0.0.1")
-    ag.add_argument("-http-port", type=int, default=4646)
+    ag.add_argument("-bind", default=None)
+    ag.add_argument("-http-port", type=int, default=None)
     ag.add_argument("-data-dir", default=None)
     ag.add_argument("-config", default=None)
     ag.set_defaults(fn=cmd_agent)
@@ -602,6 +652,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     op = sub.add_parser("operator", help="operator commands").add_subparsers(
         dest="sub", required=True)
+    osn = op.add_parser("snapshot")
+    osn.add_argument("action", choices=["save", "restore"])
+    osn.add_argument("file")
+    osn.set_defaults(fn=cmd_operator_snapshot)
     osg = op.add_parser("scheduler-get-config")
     osg.set_defaults(fn=cmd_operator_scheduler_get)
     oss = op.add_parser("scheduler-set-config")
@@ -615,6 +669,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status", help="cluster status")
     st.set_defaults(fn=cmd_status)
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", default="", dest="log_level")
+    mon.add_argument("-f", dest="follow", action="store_true")
+    mon.set_defaults(fn=cmd_monitor)
     vp = sub.add_parser("version")
     vp.set_defaults(fn=cmd_version)
     return p

@@ -20,7 +20,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..structs import (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
                        ALLOC_CLIENT_PENDING, ALLOC_CLIENT_RUNNING,
                        TASK_STATE_DEAD, Allocation, TaskState)
-from .allocdir import AllocDir
+from .allocdir import SHARED_ALLOC_DIR, AllocDir
 from .task_runner import TaskRunner
 
 
@@ -50,6 +50,7 @@ class AllocRunner:
         #: runners materialize task.volume_mounts from it
         self.volume_paths: Dict[str, str] = {}
         self._csi_mounted: List[Tuple[str, str]] = []  # (plugin, vol)
+        self._base_dir = base_dir
         self.alloc_dir = AllocDir(base_dir, alloc.id)
         self.task_runners: Dict[str, TaskRunner] = {}
         self.task_states: Dict[str, TaskState] = {}
@@ -80,6 +81,12 @@ class AllocRunner:
         tasks = self._tasks()
         # allocDir hook (alloc_runner_hooks.go allocDirHook)
         self.alloc_dir.build([t.name for t in tasks])
+        # prev-alloc watcher / disk migration hook (client/allocwatcher/):
+        # sticky or migrate ephemeral disks carry the previous alloc's
+        # shared data forward when it lives on this node (the reference
+        # streams cross-node over the node FS API; sticky placement makes
+        # same-node the dominant case)
+        self._migrate_prev_alloc_data()
         # volumes hook: host volumes resolve to fingerprinted paths, CSI
         # volumes claim + node-stage/publish through the csimanager
         # (alloc_runner csi_hook.go; csimanager/volume.go MountVolume)
@@ -145,6 +152,47 @@ class AllocRunner:
                 if not self._wait_dead([tr]):
                     return
         self._recompute_status()
+
+    def _migrate_prev_alloc_data(self) -> None:
+        import os
+        import shutil
+
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        disk = tg.ephemeral_disk if tg else None
+        prev_id = self.alloc.previous_allocation
+        if disk is None or prev_id == "" or not (disk.sticky or disk.migrate):
+            return
+        # Wait for the previous alloc to go terminal before copying — the
+        # reference allocwatcher blocks on prev-alloc completion
+        # (client/allocwatcher/) so a still-running task can't write under
+        # the copy. Bounded: proceed best-effort on timeout.
+        if self.conn is not None:
+            deadline = time.time() + 30.0
+            while time.time() < deadline and not self._halted():
+                try:
+                    prev = self.conn.alloc_get(prev_id)
+                except Exception:  # noqa: BLE001 — server flake: retry
+                    prev = None
+                if prev is None or prev.client_status in (
+                        "complete", "failed", "lost"):
+                    break
+                time.sleep(0.2)
+        prev_data = os.path.join(self._base_dir, prev_id,
+                                 SHARED_ALLOC_DIR, "data")
+        dest = os.path.join(self.alloc_dir.shared_dir, "data")
+        if not os.path.isdir(prev_data):
+            return
+        for name in os.listdir(prev_data):
+            src = os.path.join(prev_data, name)
+            dst = os.path.join(dest, name)
+            try:
+                if os.path.isdir(src):
+                    shutil.copytree(src, dst, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(src, dst)
+            except OSError:
+                pass  # best-effort, matching the reference's move fallback
 
     def _mount_volumes(self) -> None:
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \

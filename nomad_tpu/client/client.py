@@ -159,6 +159,7 @@ class Client:
             self.node.csi_node_plugins.setdefault(pid, {"healthy": True})
         self.allocs: Dict[str, AllocRunner] = {}
         self._known_index: Dict[str, int] = {}
+        self._last_heartbeat_ok = time.time()
         self._lock = threading.Lock()
         self._dirty: Dict[str, Allocation] = {}
         self._dirty_cv = threading.Condition()
@@ -232,8 +233,27 @@ class Client:
                 ok = self.conn.node_heartbeat(self.node.id)
                 if not ok:  # server lost us: re-register (client.go:1605)
                     self.conn.node_register(self.node)
+                self._last_heartbeat_ok = time.time()
             except Exception:
                 pass  # retry next tick; server failover handled by conn
+            self._heartbeat_stop_check()
+
+    def _heartbeat_stop_check(self) -> None:
+        """heartbeatStop (client/heartbeatstop.go): task groups with
+        `stop_after_client_disconnect` get their allocs stopped locally
+        once the client has been unable to heartbeat for that long —
+        the split-brain guard for service jobs that must not run twice."""
+        silent_for = time.time() - self._last_heartbeat_ok
+        with self._lock:
+            runners = list(self.allocs.values())
+        for r in runners:
+            tg = (r.alloc.job.lookup_task_group(r.alloc.task_group)
+                  if r.alloc.job else None)
+            limit = getattr(tg, "stop_after_client_disconnect_s", None) \
+                if tg else None
+            if limit is not None and silent_for > limit \
+                    and r.client_status == "running":
+                r.kill()
 
     # ---- alloc watching (watchAllocations :1961) ----
 
@@ -329,6 +349,15 @@ class Client:
     def alloc_runner(self, alloc_id: str) -> Optional[AllocRunner]:
         with self._lock:
             return self.allocs.get(alloc_id)
+
+    def host_stats(self) -> dict:
+        """Reference client/stats host collector via /v1/client/stats."""
+        from .stats import HostStatsCollector
+
+        if not hasattr(self, "_stats_collector"):
+            self._stats_collector = HostStatsCollector(
+                paths=[self.data_dir])
+        return self._stats_collector.collect()
 
     def num_allocs(self) -> int:
         with self._lock:

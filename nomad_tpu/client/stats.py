@@ -1,0 +1,92 @@
+"""Host resource statistics (reference `client/stats/host.go` — the
+gopsutil-based HostStatsCollector feeding `/v1/client/stats` and node
+telemetry). Linux procfs readers with graceful degradation elsewhere."""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+
+def _meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                val = rest.strip().split()
+                if val:
+                    out[key] = int(val[0]) * 1024  # kB → bytes
+    except OSError:
+        pass
+    return out
+
+
+def _cpu_times() -> Optional[List[int]]:
+    try:
+        with open("/proc/stat") as f:
+            first = f.readline().split()
+        return [int(x) for x in first[1:]] if first[:1] == ["cpu"] else None
+    except OSError:
+        return None
+
+
+class HostStatsCollector:
+    """Snapshot collector: cpu %, memory, disk for tracked paths, uptime
+    (host.go Collect)."""
+
+    def __init__(self, paths: Optional[List[str]] = None) -> None:
+        self.paths = paths or ["/"]
+        self._prev_cpu = _cpu_times()
+        self._prev_t = time.time()
+
+    def collect(self) -> Dict:
+        mem = _meminfo()
+        cur = _cpu_times()
+        cpu_pct = 0.0
+        if cur is not None and self._prev_cpu is not None:
+            dt = [c - p for c, p in zip(cur, self._prev_cpu)]
+            total = sum(dt)
+            idle = dt[3] + (dt[4] if len(dt) > 4 else 0)  # idle + iowait
+            if total > 0:
+                cpu_pct = 100.0 * (total - idle) / total
+        self._prev_cpu, self._prev_t = cur, time.time()
+
+        disks = []
+        for p in self.paths:
+            try:
+                du = shutil.disk_usage(p)
+                disks.append({"Device": p, "Size": du.total,
+                              "Used": du.used, "Available": du.free,
+                              "UsedPercent": (100.0 * du.used / du.total
+                                              if du.total else 0.0)})
+            except OSError:
+                pass
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        return {
+            "Timestamp": int(time.time() * 1e9),
+            "CPUTicksConsumed": cpu_pct,
+            "CPU": [{"CPU": "cpu-total", "Total": cpu_pct}],
+            "Memory": {
+                "Total": mem.get("MemTotal", 0),
+                "Available": mem.get("MemAvailable", 0),
+                "Used": max(mem.get("MemTotal", 0)
+                            - mem.get("MemAvailable", 0), 0),
+                "Free": mem.get("MemFree", 0),
+            },
+            "DiskStats": disks,
+            "LoadAvg": [load1, load5, load15],
+            "Uptime": _uptime(),
+        }
+
+
+def _uptime() -> float:
+    try:
+        with open("/proc/uptime") as f:
+            return float(f.read().split()[0])
+    except OSError:
+        return 0.0

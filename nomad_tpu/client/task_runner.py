@@ -265,10 +265,47 @@ class TaskRunner:
                 raise RuntimeError(
                     f"volume mount escapes task dir: {vm.destination!r}")
             os.makedirs(os.path.dirname(dest), exist_ok=True)
-            if not os.path.islink(dest) and not os.path.exists(dest):
-                os.symlink(src, dest)
-        # template hook (template/template.go, minimal: render env-style
-        # templates into files was out of scope; env assembled below)
+            if os.path.islink(dest):
+                continue  # restart: already materialized
+            if os.path.isdir(dest) and not os.listdir(dest):
+                os.rmdir(dest)  # pre-created empty dir (allocdir build)
+            elif os.path.exists(dest):
+                raise RuntimeError(
+                    f"volume mount destination exists and is not empty: "
+                    f"{vm.destination!r}")
+            os.symlink(src, dest)
+        # template hook (taskrunner/template/template.go): render each
+        # template's content with task-env interpolation into dest_path.
+        # The consul-template language is out of scope (no Consul/Vault);
+        # `${...}` env/node interpolation covers the jobspec-local uses.
+        if self.task.templates:
+            import os
+
+            from .taskenv import build_env, interpolate
+
+            tenv = build_env(self.alloc, self.task, self.node,
+                             task_dir=self.task_dir,
+                             shared_dir=f"{self.task_dir}/alloc")
+            for tmpl in self.task.templates:
+                content = tmpl.embedded_tmpl
+                if not content and tmpl.source_path:
+                    src = os.path.normpath(os.path.join(
+                        self.task_dir, tmpl.source_path.lstrip("/")))
+                    if not src.startswith(self.task_dir + os.sep):
+                        raise RuntimeError(
+                            f"template source escapes task dir: "
+                            f"{tmpl.source_path!r}")
+                    with open(src) as f:
+                        content = f.read()
+                dest = os.path.normpath(os.path.join(
+                    self.task_dir, tmpl.dest_path.lstrip("/")))
+                if not dest.startswith(self.task_dir + os.sep):
+                    raise RuntimeError(
+                        f"template dest escapes task dir: "
+                        f"{tmpl.dest_path!r}")
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                with open(dest, "w") as f:
+                    f.write(interpolate(content, tenv, self.node))
 
     def _task_config(self) -> TaskConfig:
         env = build_env(

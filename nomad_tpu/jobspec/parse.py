@@ -8,8 +8,8 @@ from ..structs.job import (Affinity, Constraint, EphemeralDisk, Job,
                            ParameterizedJobConfig, PeriodicConfig,
                            ReschedulePolicy, RestartPolicy, ScalingPolicy,
                            Service, Spread, SpreadTarget, Task, TaskArtifact,
-                           TaskGroup, TaskLifecycle, UpdateStrategy,
-                           VolumeMount, VolumeRequest)
+                           TaskGroup, TaskLifecycle, Template,
+                           UpdateStrategy, VolumeMount, VolumeRequest)
 from ..structs.resources import (NetworkResource, Port, RequestedDevice,
                                  Resources)
 from .hcl import HclError, parse_hcl
@@ -152,6 +152,9 @@ def _parse_group(name: str, body: Dict[str, Any], job: Job) -> TaskGroup:
         )
     for svc in _many(body.get("service")):
         tg.services.append(_parse_service(svc))
+    if "stop_after_client_disconnect" in body:
+        tg.stop_after_client_disconnect_s = _seconds(
+            body["stop_after_client_disconnect"])
     if "scaling" in body:
         # Reference jobspec group scaling stanza (jobspec/parse_group.go
         # parseScalingPolicy); min defaults to the group count.
@@ -205,6 +208,14 @@ def _parse_task(name: str, body: Dict[str, Any]) -> Task:
             getter_source=art.get("source", ""),
             getter_options=dict(_one(art.get("options", {})) or {}),
             relative_dest=art.get("destination", "local/"),
+        ))
+    for tm in _many(body.get("template")):
+        task.templates.append(Template(
+            source_path=tm.get("source", ""),
+            dest_path=tm.get("destination", ""),
+            embedded_tmpl=tm.get("data", ""),
+            change_mode=tm.get("change_mode", "restart"),
+            change_signal=tm.get("change_signal", ""),
         ))
     for vm in _many(body.get("volume_mount")):
         task.volume_mounts.append(VolumeMount(

@@ -1,0 +1,198 @@
+"""Client-depth features: host stats, heartbeatStop, template hook,
+sticky-disk data migration (reference client/stats/host.go,
+client/heartbeatstop.go, taskrunner/template/template.go,
+client/allocwatcher/)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import NomadClient
+
+
+def _wait(cond, timeout=40.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+    yield a, api
+    a.shutdown()
+
+
+class TestHostStats:
+    def test_client_stats_endpoint(self, agent):
+        a, api = agent
+        stats = api.client_stats()
+        assert stats["Memory"]["Total"] > 0
+        assert stats["Uptime"] > 0
+        assert stats["DiskStats"] and stats["DiskStats"][0]["Size"] > 0
+
+
+class TestHeartbeatStop:
+    def test_disconnect_stops_marked_groups(self, agent):
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.stop_after_client_disconnect_s = 1.0
+        t = tg.tasks[0]
+        t.driver = "mock_driver"
+        t.config = {"run_for": 60.0}
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "running"
+            for al in api.job_allocations(job.id)))
+        # simulate heartbeat silence past the group's limit
+        a.client._last_heartbeat_ok = time.time() - 5.0
+        a.client._heartbeat_stop_check()
+        assert _wait(lambda: all(
+            al.client_status in ("complete", "failed")
+            for al in api.job_allocations(job.id)))
+
+
+class TestTemplateHook:
+    def test_embedded_template_rendered(self, agent):
+        from nomad_tpu.structs.job import Template
+
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", "cat local/conf.ini"]}
+        t.env = {"PORT_HINT": "8080"}
+        t.templates = [Template(
+            embedded_tmpl=("listen=${PORT_HINT}\n"
+                           "dc=${node.datacenter}\n"),
+            dest_path="local/conf.ini")]
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(job.id)))
+        alloc = next(al for al in api.job_allocations(job.id)
+                     if al.client_status == "complete")
+        out = api.alloc_logs(alloc.id, "web")
+        assert b"listen=8080" in out
+        assert b"dc=dc1" in out
+
+
+class TestStickyDiskMigration:
+    def test_destructive_update_carries_shared_data(self, agent):
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.ephemeral_disk.sticky = True
+        tg.ephemeral_disk.migrate = True
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        # keep v0 running so the update is destructive (stop + replace
+        # with previous_allocation linkage)
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c",
+                             "echo v0-state > alloc/data/state.txt; "
+                             "sleep 60"]}
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "running"
+            for al in api.job_allocations(job.id)))
+
+        import copy
+
+        job2 = copy.deepcopy(job)
+        job2.version = 1
+        job2.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": ["-c", "cat alloc/data/state.txt"]}
+        api.wait_for_eval(api.register_job(job2))
+        assert _wait(lambda: any(
+            al.client_status == "complete" and al.job_version == 1
+            for al in api.job_allocations(job.id)))
+        alloc = next(al for al in api.job_allocations(job.id)
+                     if al.client_status == "complete"
+                     and al.job_version == 1)
+        assert alloc.previous_allocation
+        assert b"v0-state" in api.alloc_logs(alloc.id, "web")
+
+
+class TestAgentConfigFile:
+    def test_hcl_config_round_trip(self, tmp_path):
+        from nomad_tpu.agent import AgentConfig
+
+        cfg = AgentConfig.from_hcl('''
+        data_dir = "/var/lib/nomad-tpu"
+        datacenter = "dc2"
+        name = "edge-1"
+        bind_addr = "0.0.0.0"
+        server {
+          enabled = true
+          num_schedulers = 3
+        }
+        client {
+          enabled = true
+          meta { rack = "r9" }
+          host_volume "certs" {
+            path = "/etc/certs"
+            read_only = true
+          }
+        }
+        ports { http = 14646 }
+        acl { enabled = true }
+        ''')
+        assert cfg.data_dir == "/var/lib/nomad-tpu"
+        assert cfg.datacenter == "dc2" and cfg.node_name == "edge-1"
+        assert cfg.server and cfg.num_schedulers == 3
+        assert cfg.client and cfg.node_meta == {"rack": "r9"}
+        assert cfg.host_volumes["certs"]["read_only"] is True
+        assert cfg.http_port == 14646 and cfg.acl_enabled
+        # mode blocks are opt-in
+        cfg2 = AgentConfig.from_hcl('client { enabled = true }')
+        assert cfg2.client and not cfg2.server
+
+
+class TestOperatorSnapshot:
+    def test_save_restore_round_trip(self, agent, tmp_path):
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "mock_driver"
+        tg.tasks[0].config = {"run_for": 0.1}
+        api.wait_for_eval(api.register_job(job))
+        data = api.operator_snapshot_save()
+        assert len(data) > 100
+
+        # wipe the job, then restore the archive
+        api.deregister_job(job.id)
+        api.operator_snapshot_restore(data)
+        got = api.job(job.id)
+        assert got.id == job.id and not got.stop
+
+
+class TestAgentMonitor:
+    def test_monitor_returns_recent_logs(self, agent):
+        import logging
+
+        a, api = agent
+        logging.getLogger("nomad_tpu.test").info("hello-monitor")
+        recs = api.agent_monitor()
+        assert any("agent starting" in r["Message"] or
+                   "hello-monitor" in r["Message"] for r in recs)
+        # level filter + since pagination
+        t = max(r["Time"] for r in recs)
+        assert api.agent_monitor(since=t) == []
