@@ -47,7 +47,9 @@ def _ring_handler():
         handler = Handler()
         root = logging.getLogger("nomad_tpu")
         root.addHandler(handler)
-        root.setLevel(logging.INFO)
+        if root.level == logging.NOTSET:
+            # don't clobber an embedder's explicit level choice
+            root.setLevel(logging.INFO)
         _LogRingHandler._instance = handler
     return _LogRingHandler._instance
 
@@ -88,8 +90,10 @@ class AgentConfig:
             return v[0] if isinstance(v, list) and v else (v or {})
 
         tree = parse_hcl(text)
-        # modes are opt-in via their blocks (reference defaults: both off)
-        cfg = cls(server=False, client=False)
+        # modes are opt-in via their blocks (reference defaults: both
+        # off); HTTP binds the documented default port unless ports{}
+        # overrides (the constructor's 0 = ephemeral is a test affordance)
+        cfg = cls(server=False, client=False, http_port=4646)
         for k in ("data_dir", "datacenter", "region"):
             if k in tree:
                 setattr(cfg, k, tree[k])
@@ -222,12 +226,21 @@ class Agent:
         """Recent agent log records (reference /v1/agent/monitor,
         command/agent/agent_endpoint.go Monitor — polling JSON frames
         instead of a chunked stream)."""
-        want = level.upper()
+        import logging
+
+        floor = 0
+        if level:
+            name = {"warn": "WARNING", "err": "ERROR"}.get(
+                level.lower(), level.upper())
+            lv = logging.getLevelName(name)
+            floor = lv if isinstance(lv, int) else 0
         out = []
         for rec in list(self._log_ring):
             if rec["Time"] <= since:
                 continue
-            if want and rec["Level"] != want:
+            lv = logging.getLevelName(rec["Level"])
+            # minimum severity, reference log_level semantics
+            if floor and (not isinstance(lv, int) or lv < floor):
                 continue
             out.append(rec)
         return out

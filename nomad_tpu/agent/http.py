@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -95,6 +96,21 @@ class HTTPApi:
         self.httpd.shutdown()
         self.httpd.server_close()
 
+    def _require_namespace_cap(self, token, namespace: str,
+                               cap: str) -> None:
+        """Namespace-capability ACL gate for agent-local client routes
+        (enforced only when a token store / server is attached)."""
+        if self.agent.server is None:
+            return
+        from ..acl import ACLError
+
+        try:
+            acl = self.agent.server.resolve_token(token)
+        except ACLError as e:
+            raise HttpError(403, str(e))
+        if not acl.allow_namespace_operation(namespace, cap):
+            raise HttpError(403, "Permission denied")
+
     def _require_local(self, token, cap: str) -> None:
         """ACL gate for agent-local routes: enforced when a token store
         (server) is attached; client-only dev agents stay open (the
@@ -109,6 +125,56 @@ class HTTPApi:
             raise HttpError(403, str(e))
         if not getattr(acl, f"allow_{cap}")():
             raise HttpError(403, "Permission denied")
+
+    # ---- client allocation endpoints (client/alloc_endpoint.go) ----
+
+    def _client_alloc_op(self, alloc_id: str, op: str,
+                         query: Dict[str, str], body,
+                         token: Optional[str] = None):
+        client = self.agent.client
+        if client is None:
+            raise HttpError(501, "this agent is not running a client")
+        runner = client.alloc_runner(alloc_id)
+        if runner is None:
+            raise HttpError(404, f"alloc {alloc_id!r} not on this agent")
+        self._require_namespace_cap(
+            token, runner.alloc.namespace,
+            "alloc-exec" if op == "exec" else "read-job")
+        if op == "stats":
+            # Allocations.Stats: per-task driver/executor usage fan-in
+            tasks = {}
+            for name, tr in runner.task_runners.items():
+                usage = {}
+                if tr.handle is not None:
+                    try:
+                        usage = tr.driver.inspect_task(tr.handle).get(
+                            "stats", {}) or {}
+                    except Exception:  # noqa: BLE001 — driver may be dead
+                        usage = {}
+                tasks[name] = {
+                    "ResourceUsage": usage,
+                    "Timestamp": int(time.time() * 1e9),
+                }
+            return {"Tasks": tasks}
+        if op == "exec":
+            cmd = (body or {}).get("Cmd") or []
+            if not cmd:
+                raise HttpError(400, "missing Cmd")
+            task = query.get("task", "")
+            if not task:
+                if len(runner.task_runners) != 1:
+                    raise HttpError(400, "multiple tasks; pass ?task=")
+                task = next(iter(runner.task_runners))
+            tr = runner.task_runners.get(task)
+            if tr is None or tr.handle is None:
+                raise HttpError(404, f"no running task {task!r}")
+            try:
+                return tr.driver.exec_task(
+                    tr.handle, cmd[0], list(cmd[1:]),
+                    timeout_s=float(query.get("timeout", 30)))
+            except Exception as e:  # noqa: BLE001 — surface driver errors
+                raise HttpError(500, f"exec failed: {e}")
+        raise HttpError(404, f"unknown allocation op {op!r}")
 
     # ---- client filesystem endpoints (client/fs_endpoint.go) ----
 
@@ -136,19 +202,10 @@ class HTTPApi:
             alloc = self.agent.server.state.alloc_by_id(alloc_id)
         if alloc is None:
             raise HttpError(404, f"alloc {alloc_id!r} not on this agent")
-        # ACL: read-fs / read-logs in the ALLOC'S job namespace when a
-        # server (token store) is attached; client-only dev agents are
-        # open like /v1/agent/self
-        if self.agent.server is not None:
-            from ..acl import ACLError
-
-            try:
-                acl = self.agent.server.resolve_token(token)
-            except ACLError as e:
-                raise HttpError(403, str(e))
-            cap = "read-logs" if op == "logs" else "read-fs"
-            if not acl.allow_namespace_operation(alloc.namespace, cap):
-                raise HttpError(403, "Permission denied")
+        # ACL: read-fs / read-logs in the ALLOC'S job namespace
+        self._require_namespace_cap(
+            token, alloc.namespace,
+            "read-logs" if op == "logs" else "read-fs")
         root = os.path.join(client.alloc_dir_base, alloc_id)
         if not os.path.isdir(root):
             raise HttpError(404, f"alloc {alloc_id!r} not on this agent")
@@ -214,6 +271,11 @@ class HTTPApi:
                 raise HttpError(501, "this agent is not running a client")
             self._require_local(token, "node_read")
             return self.agent.client.host_stats()
+        # /v1/client/allocation/<id>/{exec,stats} — on the hosting agent
+        # (client/alloc_endpoint.go Allocations.Exec/Stats)
+        if parts0[1:3] == ["client", "allocation"] and len(parts0) >= 5:
+            return self._client_alloc_op(parts0[3], parts0[4], query, body,
+                                         token)
         # /v1/agent/monitor — agent-local log ring (agent_endpoint.go
         # Monitor; agent:read)
         if parts0[1:] == ["agent", "monitor"]:
@@ -506,8 +568,15 @@ class HTTPApi:
                 if not blob:
                     raise HttpError(400, "missing Data")
                 tree = msgpack.unpackb(blob, raw=False, strict_map_key=False)
+                # flush broker/blocked queues BEFORE restore (SetEnabled
+                # false→true, eval_broker.go precedent): pre-restore evals
+                # must not be dispatched against the restored state
+                server.broker.set_enabled(False)
+                server.blocked.set_enabled(False)
                 with state.transact():
                     restore_state(state, tree)
+                server.broker.set_enabled(True)
+                server.blocked.set_enabled(True)
                 server._restore_evals()  # pending evals re-enter the broker
                 return {"Index": state.index.value}
         # /v1/operator/scheduler/configuration

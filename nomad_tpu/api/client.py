@@ -165,6 +165,19 @@ class NomadClient:
     def allocation(self, alloc_id: str):
         return from_wire(self._request("GET", f"/v1/allocation/{alloc_id}"))
 
+    def alloc_exec(self, alloc_id: str, cmd: List[str], task: str = "",
+                   timeout: float = 30.0) -> dict:
+        """Run a command inside a running task (api/allocations.go Exec,
+        non-streaming): returns {exit_code, stdout, stderr}."""
+        return self._request(
+            "PUT", f"/v1/client/allocation/{alloc_id}/exec",
+            params={"task": task, "timeout": str(timeout)},
+            body={"Cmd": list(cmd)})
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        return self._request(
+            "GET", f"/v1/client/allocation/{alloc_id}/stats")
+
     def operator_snapshot_save(self) -> bytes:
         out = self._request("GET", "/v1/operator/snapshot")
         return out.get("Data", b"")

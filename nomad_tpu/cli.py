@@ -320,6 +320,29 @@ def cmd_alloc_logs(args) -> int:
     return 0
 
 
+def cmd_alloc_exec(args) -> int:
+    """Reference `nomad alloc exec` (command/alloc_exec.go),
+    non-streaming: run, print output, propagate the exit code."""
+    api = _client(args)
+    a = _resolve_alloc(api, args.alloc_id)
+    if a is None:
+        return 1
+    cmd = [c for c in args.cmd if c != "--"]
+    if not cmd:
+        print("error: no command given", file=sys.stderr)
+        return 1
+    try:
+        out = api.alloc_exec(a.id, cmd, task=args.task)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if out.get("stdout"):
+        sys.stdout.write(out["stdout"])
+    if out.get("stderr"):
+        sys.stderr.write(out["stderr"])
+    return int(out.get("exit_code", 0))
+
+
 def cmd_alloc_fs(args) -> int:
     """Reference `nomad alloc fs` (command/alloc_fs.go): ls/cat inside the
     alloc dir."""
@@ -623,6 +646,13 @@ def build_parser() -> argparse.ArgumentParser:
     alf.add_argument("alloc_id")
     alf.add_argument("path", nargs="?", default="/")
     alf.set_defaults(fn=cmd_alloc_fs)
+    alx = al.add_parser("exec")
+    alx.add_argument("-task", default="")
+    alx.add_argument("alloc_id")
+    # REMAINDER so commands with their own flags pass through unparsed
+    # (`alloc exec <id> /bin/sh -c '...'`)
+    alx.add_argument("cmd", nargs=argparse.REMAINDER)
+    alx.set_defaults(fn=cmd_alloc_exec)
 
     ev = sub.add_parser("eval", help="eval commands").add_subparsers(
         dest="sub", required=True)

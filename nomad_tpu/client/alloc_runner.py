@@ -163,6 +163,12 @@ class AllocRunner:
         prev_id = self.alloc.previous_allocation
         if disk is None or prev_id == "" or not (disk.sticky or disk.migrate):
             return
+        # Nothing to do unless the previous alloc's data lives on this
+        # node (remote-node migration is out of scope — sticky placement
+        # makes same-node the dominant case)
+        if not os.path.isdir(os.path.join(self._base_dir, prev_id,
+                                          SHARED_ALLOC_DIR, "data")):
+            return
         # Wait for the previous alloc to go terminal before copying — the
         # reference allocwatcher blocks on prev-alloc completion
         # (client/allocwatcher/) so a still-running task can't write under

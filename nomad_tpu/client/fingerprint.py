@@ -186,6 +186,28 @@ def device_env_fingerprint(node: Node) -> None:
         )]
 
 
+def cgroup_fingerprint(node: Node) -> None:
+    """cgroup availability (client/fingerprint/cgroup_linux.go): version +
+    mountpoint — the exec driver's isolation depends on it."""
+    if os.path.isdir("/sys/fs/cgroup"):
+        v2 = os.path.exists("/sys/fs/cgroup/cgroup.controllers")
+        node.attributes["unique.cgroup.mountpoint"] = "/sys/fs/cgroup"
+        node.attributes["unique.cgroup.version"] = "v2" if v2 else "v1"
+
+
+def bridge_fingerprint(node: Node) -> None:
+    """bridge kernel module (client/fingerprint/bridge_linux.go) — group
+    network mode "bridge" feasibility."""
+    try:
+        with open("/proc/modules") as f:
+            mods = f.read()
+        if "\nbridge " in mods or mods.startswith("bridge "):
+            node.attributes["nomad.bridge.hairpin_mode"] = "false"
+            node.attributes["plugins.cni.version.bridge"] = "builtin"
+    except OSError:
+        pass
+
+
 def driver_fingerprints(node: Node) -> None:
     from .drivers import BUILTIN_DRIVERS
 
@@ -200,7 +222,8 @@ FINGERPRINTERS: List[Callable[[Node], None]] = [
     arch_fingerprint, os_fingerprint, cpu_fingerprint, memory_fingerprint,
     storage_fingerprint, network_fingerprint, host_fingerprint,
     nomad_fingerprint, signal_fingerprint, tpu_fingerprint,
-    device_env_fingerprint, driver_fingerprints,
+    device_env_fingerprint, cgroup_fingerprint, bridge_fingerprint,
+    driver_fingerprints,
 ]
 
 

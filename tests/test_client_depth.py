@@ -196,3 +196,49 @@ class TestAgentMonitor:
         # level filter + since pagination
         t = max(r["Time"] for r in recs)
         assert api.agent_monitor(since=t) == []
+
+
+class TestAllocExecAndStats:
+    def test_exec_into_running_task(self, agent):
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh", "args": ["-c", "sleep 30"]}
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "running"
+            for al in api.job_allocations(job.id)))
+        alloc = api.job_allocations(job.id)[0]
+        out = api.alloc_exec(alloc.id, ["/bin/sh", "-c", "echo in-task"])
+        assert out["exit_code"] == 0
+        assert "in-task" in out["stdout"]
+        # exit codes propagate
+        out = api.alloc_exec(alloc.id, ["/bin/sh", "-c", "exit 3"])
+        assert out["exit_code"] == 3
+
+        stats = api.alloc_stats(alloc.id)
+        assert "web" in stats["Tasks"]
+
+    def test_cli_alloc_exec(self, agent, capsys):
+        from nomad_tpu.cli import main
+
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh", "args": ["-c", "sleep 30"]}
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "running"
+            for al in api.job_allocations(job.id)))
+        alloc = api.job_allocations(job.id)[0]
+        addr = f"http://{a.http_addr[0]}:{a.http_addr[1]}"
+        rc = main(["-address", addr, "alloc", "exec", alloc.id[:8],
+                   "/bin/echo", "via-cli"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "via-cli" in out
