@@ -62,7 +62,7 @@ class AgentConfig:
                  node_name: str = "", datacenter: str = "dc1",
                  region: str = "global",
                  server_addrs=None, acl_enabled: bool = False,
-                 host_volumes=None, node_meta=None) -> None:
+                 host_volumes=None, node_meta=None, tls=None) -> None:
         self.server = server
         self.client = client
         self.http_host = http_host
@@ -78,6 +78,7 @@ class AgentConfig:
         #: name → {path, read_only} (agent config client.host_volume)
         self.host_volumes = host_volumes or {}
         self.node_meta = node_meta or {}
+        self.tls = tls  # lib.tlsutil.TLSConfig | None
 
     @classmethod
     def from_hcl(cls, text: str) -> "AgentConfig":
@@ -130,6 +131,20 @@ class AgentConfig:
         acl = one(tree.get("acl"))
         if acl:
             cfg.acl_enabled = bool(acl.get("enabled", False))
+        tls = one(tree.get("tls"))
+        if tls:
+            from ..lib.tlsutil import TLSConfig
+
+            cfg.tls = TLSConfig(
+                enabled=bool(tls.get("http", tls.get("enabled", True))),
+                ca_file=tls.get("ca_file", ""),
+                cert_file=tls.get("cert_file", ""),
+                key_file=tls.get("key_file", ""),
+                verify_incoming=bool(tls.get("verify_https_client",
+                                             tls.get("verify_incoming",
+                                                     False))),
+                rpc=bool(tls.get("rpc", False)),
+            )
         return cfg
 
     @classmethod
@@ -197,7 +212,7 @@ class Agent:
                 data_dir=client_dir, node=node,
                 heartbeat_interval=max(self.config.heartbeat_ttl / 3, 0.5)))
         self.http = HTTPApi(self, self.config.http_host,
-                            self.config.http_port)
+                            self.config.http_port, tls=self.config.tls)
 
     @property
     def http_addr(self):

@@ -34,7 +34,8 @@ class HTTPApi:
     """Routes /v1/* to server endpoints. `agent` carries .server (leader
     methods), optional .client, and optional .cluster (ClusterServer)."""
 
-    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0,
+                 tls=None) -> None:
         self.agent = agent
         api = self
 
@@ -84,6 +85,19 @@ class HTTPApi:
                 self._handle("DELETE")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls is not None and tls.enabled:
+            # HTTPS listener (helper/tlsutil via command/agent/http.go).
+            # Deferred handshake: with do_handshake_on_connect the
+            # handshake would run inside accept() on the single
+            # serve_forever thread, letting one stalled client freeze the
+            # whole API; deferring moves it to the per-connection handler
+            # thread's first read.
+            from ..lib.tlsutil import server_context
+
+            self.httpd.socket = server_context(tls).wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
+        self.tls_enabled = bool(tls is not None and tls.enabled)
         self.addr = self.httpd.server_address
         self._thread: Optional[threading.Thread] = None
 

@@ -18,18 +18,41 @@ class ApiError(Exception):
 
 class NomadClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 4646,
-                 timeout: float = 70.0, token: Optional[str] = None) -> None:
+                 timeout: float = 70.0, token: Optional[str] = None,
+                 ca_cert: Optional[str] = None,
+                 client_cert: Optional[str] = None,
+                 client_key: Optional[str] = None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
         self.token = token  # X-Nomad-Token (api.Client SetSecretID)
+        # TLS (api.Client TLSConfig: NOMAD_CACERT/NOMAD_CLIENT_CERT/KEY)
+        self._ssl_ctx = None
+        if client_cert and not ca_cert:
+            raise ValueError(
+                "client_cert given without ca_cert — refusing to fall "
+                "back to plaintext")
+        if ca_cert:
+            from ..lib.tlsutil import TLSConfig, client_context
+
+            self._ssl_ctx = client_context(TLSConfig(
+                enabled=True, ca_file=ca_cert,
+                cert_file=client_cert or "", key_file=client_key or ""))
 
     # ---- transport ----
 
     def _request(self, method: str, path: str,
                  params: Optional[Dict[str, Any]] = None,
                  body: Any = None) -> Any:
-        conn = HTTPConnection(self.host, self.port, timeout=self.timeout)
+        if self._ssl_ctx is not None:
+            from http.client import HTTPSConnection
+
+            conn = HTTPSConnection(self.host, self.port,
+                                   timeout=self.timeout,
+                                   context=self._ssl_ctx)
+        else:
+            conn = HTTPConnection(self.host, self.port,
+                                  timeout=self.timeout)
         try:
             qs = f"?{urlencode(params)}" if params else ""
             payload = json.dumps(to_json_tree(body)) \

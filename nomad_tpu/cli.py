@@ -36,11 +36,20 @@ def _client(args) -> NomadClient:
         print(f"Error: malformed address {addr!r} "
               "(expected [http://]host[:port])", file=sys.stderr)
         raise SystemExit(1)
-    if m.group("scheme") == "https":
-        print("Error: TLS is not supported by this build; use http://",
+    ca_cert = (getattr(args, "ca_cert", None)
+               or os.environ.get("NOMAD_CACERT"))
+    if m.group("scheme") == "https" and not ca_cert:
+        print("Error: https address needs -ca-cert or $NOMAD_CACERT",
               file=sys.stderr)
         raise SystemExit(1)
-    return NomadClient(m.group("host"), int(m.group("port") or 4646))
+    return NomadClient(
+        m.group("host"), int(m.group("port") or 4646),
+        token=os.environ.get("NOMAD_TOKEN"),
+        ca_cert=ca_cert if m.group("scheme") == "https" else None,
+        client_cert=(getattr(args, "client_cert", None)
+                     or os.environ.get("NOMAD_CLIENT_CERT")),
+        client_key=(getattr(args, "client_key", None)
+                    or os.environ.get("NOMAD_CLIENT_KEY")))
 
 
 def _columns(rows: List[List[str]], header: List[str]) -> str:
@@ -549,8 +558,9 @@ def cmd_agent(args) -> int:
     host, port = agent.http_addr
     mode = "+".join(m for m, on in (("server", cfg.server),
                                     ("client", cfg.client)) if on)
+    scheme = "https" if agent.http.tls_enabled else "http"
     print(f"==> nomad-tpu agent started ({mode}); "
-          f"HTTP on http://{host}:{port}")
+          f"HTTP on {scheme}://{host}:{port}")
     try:
         while True:
             time.sleep(1)
@@ -564,6 +574,12 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
     p.add_argument("-address", default=None,
                    help="HTTP API address (default $NOMAD_ADDR)")
+    p.add_argument("-ca-cert", dest="ca_cert", default=None,
+                   help="CA certificate for https ($NOMAD_CACERT)")
+    p.add_argument("-client-cert", dest="client_cert", default=None,
+                   help="client certificate ($NOMAD_CLIENT_CERT)")
+    p.add_argument("-client-key", dest="client_key", default=None,
+                   help="client key ($NOMAD_CLIENT_KEY)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser("agent", help="run an agent")

@@ -62,13 +62,20 @@ def write_frame(sock: socket.socket, obj: Any,
 class RpcServer:
     """Listens on (host, port); dispatches requests to named handlers."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tls=None) -> None:
         self._handlers: Dict[str, Callable] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(128)
         self.addr: Tuple[str, int] = self._sock.getsockname()
+        # mTLS wrap of accepted conns (nomad/rpc.go:225-260 RpcTLS)
+        self._tls_ctx = None
+        if tls is not None and tls.enabled:
+            from ..lib.tlsutil import server_context
+
+            self._tls_ctx = server_context(tls)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -109,6 +116,19 @@ class RpcServer:
                              daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._tls_ctx is not None:
+            # handshake in the per-connection thread with a deadline — a
+            # stalled peer costs its own thread, never the accept loop
+            try:
+                conn.settimeout(10.0)
+                conn = self._tls_ctx.wrap_socket(conn, server_side=True)
+                conn.settimeout(None)
+            except Exception:  # noqa: BLE001 — bad/slow handshake: drop
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         wlock = threading.Lock()
         try:
             while not self._stop.is_set():
@@ -154,12 +174,17 @@ class RpcClient:
     """One pipelined connection to a peer; thread-safe call()."""
 
     def __init__(self, host: str, port: int,
-                 connect_timeout: float = 5.0) -> None:
+                 connect_timeout: float = 5.0, tls=None) -> None:
         self.addr = (host, port)
         self._sock = socket.create_connection(self.addr,
                                               timeout=connect_timeout)
-        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if tls is not None and tls.enabled:
+            # wrap while connect_timeout still bounds the handshake
+            from ..lib.tlsutil import client_context
+
+            self._sock = client_context(tls).wrap_socket(self._sock)
+        self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
         self._pending: Dict[int, _Pending] = {}
@@ -226,15 +251,16 @@ class ConnPool:
     """Shared RpcClient per address with reconnect-on-failure
     (helper/pool/pool.go:130)."""
 
-    def __init__(self) -> None:
+    def __init__(self, tls=None) -> None:
         self._lock = threading.Lock()
         self._conns: Dict[Tuple[str, int], RpcClient] = {}
+        self._tls = tls
 
     def _get(self, addr: Tuple[str, int]) -> RpcClient:
         with self._lock:
             c = self._conns.get(addr)
             if c is None or c._closed:
-                c = RpcClient(addr[0], addr[1])
+                c = RpcClient(addr[0], addr[1], tls=self._tls)
                 self._conns[addr] = c
             return c
 

@@ -147,11 +147,12 @@ class RaftStateStore(StateStore):
 
 class ClusterServerConfig(ServerConfig):
     def __init__(self, node_id: str = "node", host: str = "127.0.0.1",
-                 port: int = 0, **kw):
+                 port: int = 0, tls=None, **kw):
         super().__init__(**kw)
         self.node_id = node_id
         self.host = host
         self.port = port
+        self.tls = tls  # lib.tlsutil.TLSConfig | None (RPC fabric mTLS)
 
 
 #: endpoint methods a follower forwards to the leader (write RPCs plus the
@@ -172,8 +173,10 @@ class ClusterServer:
     def __init__(self, config: ClusterServerConfig,
                  peers: Optional[Dict[str, Tuple[str, int]]] = None) -> None:
         self.config = config
-        self.rpc = RpcServer(config.host, config.port)
-        self.pool = ConnPool()
+        # mTLS on the server fabric when configured (nomad/rpc.go:225-260)
+        self.rpc = RpcServer(config.host, config.port,
+                             tls=getattr(config, "tls", None))
+        self.pool = ConnPool(tls=getattr(config, "tls", None))
         self.addr = self.rpc.addr
         self.peers = dict(peers) if peers else {config.node_id: self.addr}
 

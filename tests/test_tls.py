@@ -1,0 +1,129 @@
+"""TLS: mini-CA, HTTPS API, mTLS RPC fabric (reference helper/tlsutil,
+nomad/rpc.go:225-260 RpcTLS, command/agent/http.go HTTPS)."""
+import time
+
+import pytest
+
+from nomad_tpu.lib.tlsutil import (TLSConfig, generate_ca, issue_cert)
+
+
+def _wait(cond, timeout=30.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+@pytest.fixture()
+def pki(tmp_path):
+    ca_cert, ca_key = generate_ca(str(tmp_path / "pki"))
+    srv_cert, srv_key = issue_cert(str(tmp_path / "pki"), ca_cert, ca_key,
+                                   "server.global.nomad", name="server")
+    cli_cert, cli_key = issue_cert(str(tmp_path / "pki"), ca_cert, ca_key,
+                                   "cli.global.nomad", name="cli")
+    return {"ca": ca_cert, "ca_key": ca_key,
+            "srv_cert": srv_cert, "srv_key": srv_key,
+            "cli_cert": cli_cert, "cli_key": cli_key}
+
+
+class TestHttpsAgent:
+    def test_https_api_round_trip(self, tmp_path, pki):
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import ApiError, NomadClient
+
+        cfg = AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0,
+                          tls=TLSConfig(enabled=True, ca_file=pki["ca"],
+                                        cert_file=pki["srv_cert"],
+                                        key_file=pki["srv_key"],
+                                        verify_incoming=False))
+        a = Agent(cfg)
+        a.start()
+        try:
+            api = NomadClient(a.http_addr[0], a.http_addr[1],
+                              ca_cert=pki["ca"])
+            assert _wait(lambda: len(api.nodes()) == 1)
+            assert api.agent_self()
+
+            # plaintext client against the TLS listener must fail
+            plain = NomadClient(a.http_addr[0], a.http_addr[1])
+            with pytest.raises(Exception):
+                plain.nodes()
+
+            # wrong CA must fail verification
+            other_ca, _k = generate_ca(str(tmp_path / "pki2"), cn="other")
+            bad = NomadClient(a.http_addr[0], a.http_addr[1],
+                              ca_cert=other_ca)
+            with pytest.raises(Exception):
+                bad.nodes()
+        finally:
+            a.shutdown()
+
+    def test_mtls_http_requires_client_cert(self, tmp_path, pki):
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import NomadClient
+
+        cfg = AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0,
+                          tls=TLSConfig(enabled=True, ca_file=pki["ca"],
+                                        cert_file=pki["srv_cert"],
+                                        key_file=pki["srv_key"],
+                                        verify_incoming=True))
+        a = Agent(cfg)
+        a.start()
+        try:
+            with_cert = NomadClient(a.http_addr[0], a.http_addr[1],
+                                    ca_cert=pki["ca"],
+                                    client_cert=pki["cli_cert"],
+                                    client_key=pki["cli_key"])
+            assert with_cert.agent_self()
+            without = NomadClient(a.http_addr[0], a.http_addr[1],
+                                  ca_cert=pki["ca"])
+            with pytest.raises(Exception):
+                without.agent_self()
+        finally:
+            a.shutdown()
+
+
+class TestRpcTls:
+    def test_mtls_rpc_round_trip(self, pki):
+        from nomad_tpu.rpc.transport import ConnPool, RpcServer
+
+        tls = TLSConfig(enabled=True, ca_file=pki["ca"],
+                        cert_file=pki["srv_cert"],
+                        key_file=pki["srv_key"], verify_incoming=True)
+        srv = RpcServer("127.0.0.1", 0, tls=tls)
+        srv.register("Test.echo", lambda x: x)
+        srv.start()
+        try:
+            cli_tls = TLSConfig(enabled=True, ca_file=pki["ca"],
+                                cert_file=pki["cli_cert"],
+                                key_file=pki["cli_key"])
+            pool = ConnPool(tls=cli_tls)
+            assert pool.call(srv.addr, "Test.echo", "hi") == "hi"
+
+            # plaintext dial against the TLS fabric fails
+            plain = ConnPool()
+            with pytest.raises(Exception):
+                plain.call(srv.addr, "Test.echo", "nope", timeout=3.0)
+        finally:
+            srv.shutdown()
+
+    def test_hcl_tls_block(self, pki):
+        from nomad_tpu.agent import AgentConfig
+
+        cfg = AgentConfig.from_hcl(f'''
+        client {{ enabled = true }}
+        tls {{
+          http = true
+          ca_file = "{pki['ca']}"
+          cert_file = "{pki['srv_cert']}"
+          key_file = "{pki['srv_key']}"
+          verify_https_client = false
+        }}
+        ''')
+        assert cfg.tls is not None and cfg.tls.enabled
+        assert cfg.tls.ca_file == pki["ca"]
+        assert cfg.tls.verify_incoming is False
