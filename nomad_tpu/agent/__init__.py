@@ -79,6 +79,8 @@ class AgentConfig:
         self.host_volumes = host_volumes or {}
         self.node_meta = node_meta or {}
         self.tls = tls  # lib.tlsutil.TLSConfig | None
+        self.statsd_address = ""  # telemetry{statsd_address}
+        self.telemetry_interval = 10.0
 
     @classmethod
     def from_hcl(cls, text: str) -> "AgentConfig":
@@ -131,6 +133,14 @@ class AgentConfig:
         acl = one(tree.get("acl"))
         if acl:
             cfg.acl_enabled = bool(acl.get("enabled", False))
+        tel = one(tree.get("telemetry"))
+        if tel:
+            cfg.statsd_address = tel.get("statsd_address", "")
+            if "collection_interval" in tel:
+                from ..jobspec.parse import _seconds
+
+                cfg.telemetry_interval = _seconds(
+                    tel["collection_interval"])
         tls = one(tree.get("tls"))
         if tls:
             from ..lib.tlsutil import TLSConfig
@@ -213,6 +223,15 @@ class Agent:
                 heartbeat_interval=max(self.config.heartbeat_ttl / 3, 0.5)))
         self.http = HTTPApi(self, self.config.http_host,
                             self.config.http_port, tls=self.config.tls)
+        # telemetry push (command/agent/command.go:952 setupTelemetry):
+        # statsd gauges from the same tree /v1/metrics serves
+        self._telemetry = None
+        if self.config.statsd_address:
+            from ..lib.metrics import StatsdSink, TelemetryEmitter
+
+            self._telemetry = TelemetryEmitter(
+                self.metrics, StatsdSink(self.config.statsd_address),
+                interval=self.config.telemetry_interval)
 
     @property
     def http_addr(self):
@@ -224,8 +243,12 @@ class Agent:
         if self.client is not None:
             self.client.start()
         self.http.start()
+        if self._telemetry is not None:
+            self._telemetry.start()
 
     def shutdown(self) -> None:
+        if self._telemetry is not None:
+            self._telemetry.stop()
         h = _ring_handler()
         if self._log_ring in h.rings:
             h.rings.remove(self._log_ring)

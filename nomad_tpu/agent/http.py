@@ -554,8 +554,15 @@ class HTTPApi:
         if parts == ["agent", "members"]:
             require(acl.allow_agent_read())
             cluster = getattr(self.agent, "cluster", None)
+            if cluster is not None and hasattr(cluster, "membership"):
+                # live gossip view: status + incarnation per member
+                return {"members": [
+                    {"name": m.name, "addr": list(m.addr),
+                     "status": m.status, "incarnation": m.incarnation}
+                    for m in cluster.membership.members()]}
             peers = cluster.peers if cluster is not None else {}
-            return {"members": [{"name": pid, "addr": list(addr)}
+            return {"members": [{"name": pid, "addr": list(addr),
+                                 "status": "alive"}
                                 for pid, addr in peers.items()]}
         # /v1/system/gc
         if parts == ["system", "gc"] and method == "PUT":

@@ -1,0 +1,76 @@
+"""Telemetry sinks — the go-metrics fanout analog.
+
+Behavioral reference: `command/agent/command.go:952-1012` setupTelemetry
+(armon/go-metrics with inmem + statsd/statsite sinks). The agent's
+`/v1/metrics` inmem view already exists; this module adds the push side:
+a background emitter flattens the metrics tree to `gauge` lines and ships
+them over UDP statsd (`nomad.<path>:<value>|g`) at an interval."""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Dict, Optional
+
+
+def flatten(tree: Dict, prefix: str = "nomad") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in tree.items():
+        key = f"{prefix}.{k}"
+        if isinstance(v, dict):
+            out.update(flatten(v, key))
+        elif isinstance(v, bool):
+            out[key] = 1.0 if v else 0.0
+        elif isinstance(v, (int, float)):
+            out[key] = float(v)
+    return out
+
+
+class StatsdSink:
+    """UDP statsd gauge emitter (go-metrics statsd sink)."""
+
+    def __init__(self, addr: str) -> None:
+        host, _, port = addr.partition(":")
+        self.addr = (host, int(port or 8125))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def emit(self, gauges: Dict[str, float]) -> None:
+        lines = [f"{k}:{v:g}|g" for k, v in sorted(gauges.items())]
+        payload = "\n".join(lines).encode()
+        try:
+            self._sock.sendto(payload, self.addr)
+        except OSError:
+            pass  # telemetry is best-effort
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TelemetryEmitter:
+    """Periodic collector→sink pump (setupTelemetry's inmem fanout)."""
+
+    def __init__(self, collect: Callable[[], Dict], sink: StatsdSink,
+                 interval: float = 10.0) -> None:
+        self.collect = collect
+        self.sink = sink
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="telemetry", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.sink.close()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sink.emit(flatten(self.collect()))
+            except Exception:  # noqa: BLE001 — telemetry must not kill
+                pass

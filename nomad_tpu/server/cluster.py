@@ -206,14 +206,27 @@ class ClusterServer:
         self._leader_enabled = False
         self._server_used = False
         self._leader_lock = threading.Lock()
+        # serf analog: anti-entropy membership + failure detection over
+        # the RPC fabric (nomad/serf.go setupSerf; server.go:1363)
+        from .gossip import Membership
+
+        self.membership = Membership(config.node_id, self.addr, self.pool)
+        self.rpc.register("Gossip.exchange", self.membership.exchange)
 
     # ---- lifecycle ----
 
     def start(self) -> None:
         self.rpc.start()
         self.raft.start()
+        seeds = [a for pid, a in self.peers.items()
+                 if pid != self.config.node_id]
+        if seeds:
+            # async retry-join: down seeds must not block startup
+            self.membership.join_async(seeds)
+        self.membership.start()
 
     def shutdown(self) -> None:
+        self.membership.leave()
         with self._leader_lock:
             if self._leader_enabled:
                 self._leader_enabled = False
