@@ -434,10 +434,9 @@ def main() -> None:
     n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
     n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_ALLOCS", 100_000))
     # throughput scales with batch well past 128 (dispatch amortization):
-    # 1288 evals/s @128 → 4425 @1024 on the 10K-node workload; 512 balances
-    # rate against per-batch host compile latency
-    n_evals = int(os.environ.get("NOMAD_TPU_BENCH_EVALS", 4096))
-    batch = int(os.environ.get("NOMAD_TPU_BENCH_BATCH", 512))
+    # 1288 evals/s @128 → 3076 @512 → 4425 @1024 on the 10K-node workload
+    n_evals = int(os.environ.get("NOMAD_TPU_BENCH_EVALS", 8192))
+    batch = int(os.environ.get("NOMAD_TPU_BENCH_BATCH", 1024))
     count = int(os.environ.get("NOMAD_TPU_BENCH_COUNT", 8))
     # the scalar Python oracle runs ~0.12 evals/s at full size; 32 evals
     # (256 placements) keeps the parity sample meaningful at ~4.5 min
