@@ -98,6 +98,7 @@ class HTTPApi:
                 self.httpd.socket, server_side=True,
                 do_handshake_on_connect=False)
         self.tls_enabled = bool(tls is not None and tls.enabled)
+        self._tls_cfg = tls
         self.addr = self.httpd.server_address
         self._thread: Optional[threading.Thread] = None
 
@@ -105,6 +106,143 @@ class HTTPApi:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         name="http", daemon=True)
         self._thread.start()
+        # advertise the HTTP base URL through gossip so other regions can
+        # forward API requests here (serf tags carry addresses in the
+        # reference; nomad/server.go:1380). A wildcard bind is not
+        # connectable from remote hosts — fall back to the RPC fabric's
+        # host, which peers already reach.
+        cluster = getattr(self.agent, "cluster", None)
+        if cluster is not None and hasattr(cluster, "membership"):
+            host = self.addr[0]
+            if host in ("0.0.0.0", "::", ""):
+                host = cluster.addr[0]
+            scheme = "https" if self.tls_enabled else "http"
+            cluster.membership.set_tag(
+                "http_addr", f"{scheme}://{host}:{self.addr[1]}")
+
+    def _maybe_multiregion_register(self, server, job, local_region: str,
+                                    token: Optional[str]) -> Optional[Any]:
+        """Multiregion register decision, shared by both register routes
+        (PUT /v1/jobs and PUT /v1/job/<id>). Returns None when the job is
+        a plain single-region register.
+
+        Semantics: a submitted multiregion job must leave `region` unset
+        (the reference validates the two stanzas as mutually exclusive,
+        nomad/structs/structs.go Job.Validate); a copy whose region names
+        one of its own blocks is a fan-out product arriving from the
+        originating region and registers plainly."""
+        mr = job.multiregion
+        if mr is None or not mr.regions:
+            return None
+        names = [r.get("name") for r in mr.regions]
+        if job.region in ("", "global"):
+            return self._register_multiregion(server, job, local_region,
+                                              token)
+        if job.region not in names:
+            raise HttpError(
+                400, "multiregion job must not set region "
+                f"(got {job.region!r}; blocks: {names})")
+        return None  # region-stamped copy from the fan-out: plain register
+
+    def _register_multiregion(self, server, job, local_region: str,
+                              token: Optional[str]) -> Any:
+        """Fan a multiregion job out: one region-stamped copy per
+        `multiregion.region` block, registered in its region (the
+        reference parses the stanza in OSS — jobspec/parse_multiregion.go
+        — and deploys per-region copies in ent; this build always
+        deploys). A block's count overrides every group count; its
+        datacenters/meta override the job's.
+
+        Fan-out is best-effort per region (every block is attempted):
+        failures land in the `errors` map instead of aborting regions
+        that already committed — the response always reports what
+        actually happened where."""
+        import copy as _copy
+
+        results = {}
+        errors = {}
+        local_eval = ""
+        for rb in job.multiregion.regions:
+            rname = rb.get("name", "")
+            jc = _copy.deepcopy(job)
+            jc.region = rname
+            if rb.get("count"):
+                for tg in jc.task_groups:
+                    tg.count = int(rb["count"])
+            if rb.get("datacenters"):
+                jc.datacenters = list(rb["datacenters"])
+            if rb.get("meta"):
+                jc.meta.update(rb["meta"])
+            try:
+                if rname == local_region:
+                    ev = server.job_register(jc)
+                    local_eval = ev.id if ev else ""
+                    results[rname] = local_eval
+                else:
+                    out = self._forward_region(
+                        rname, "PUT", "/v1/jobs",
+                        {"region": rname, "namespace": jc.namespace},
+                        {"job": to_wire(jc)}, token)
+                    results[rname] = (out or {}).get("eval_id", "")
+            except (HttpError, OSError, ValueError) as e:
+                errors[rname] = str(e)
+        out = {"eval_id": local_eval, "regions": results}
+        if errors:
+            out["errors"] = errors
+        return out
+
+    def _forward_region(self, region: str, method: str, path: str,
+                        query: Dict[str, str], body: Any,
+                        token: Optional[str]) -> Any:
+        """Proxy the request to an alive server agent of `region`
+        (nomad/rpc.go forwardRegion → here an HTTP hop, since the remote
+        region's agent serves the identical API)."""
+        import random
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        cluster = getattr(self.agent, "cluster", None)
+        cands = []
+        if cluster is not None:
+            from ..server.gossip import STATUS_ALIVE
+
+            cands = [m.tags["http_addr"]
+                     for m in cluster.membership.members()
+                     if m.region == region and m.status == STATUS_ALIVE
+                     and m.tags.get("http_addr")]
+        if not cands:
+            raise HttpError(500, f"no path to region {region!r}")
+        target = random.choice(cands)  # scheme-qualified base URL
+        if "://" not in target:
+            target = f"http://{target}"
+        qs = urllib.parse.urlencode(query)
+        url = f"{target}{path}" + (f"?{qs}" if qs else "")
+        ssl_ctx = None
+        if target.startswith("https://"):
+            if self._tls_cfg is None or not self._tls_cfg.enabled:
+                raise HttpError(
+                    500, f"region {region!r} serves TLS but this agent "
+                    "has no tls{} config to dial it with")
+            from ..lib.tlsutil import client_context
+
+            ssl_ctx = client_context(self._tls_cfg)
+        data = json.dumps(to_json_tree(body)).encode() \
+            if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if token:
+            req.add_header("X-Nomad-Token", token)
+        try:
+            with urllib.request.urlopen(req, timeout=15,
+                                        context=ssl_ctx) as resp:
+                return json.loads(resp.read() or b"null")
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            raise HttpError(e.code, msg)
 
     def shutdown(self) -> None:
         self.httpd.shutdown()
@@ -297,6 +435,20 @@ class HTTPApi:
             return self.agent.monitor_logs(
                 since=float(query.get("since", 0) or 0),
                 level=query.get("log_level", ""))
+        # /v1/regions + cross-region forwarding (regions_endpoint.go;
+        # http.go wrap() forwards any request whose ?region= differs from
+        # the local one to a server of that region)
+        cluster = getattr(self.agent, "cluster", None)
+        local_region = (cluster.config.region if cluster is not None
+                        else getattr(getattr(self.agent, "config", None),
+                                     "region", "global"))
+        if parts0[1:] == ["regions"]:
+            return cluster.regions() if cluster is not None \
+                else [local_region]
+        req_region = query.get("region", "")
+        if req_region and req_region != local_region:
+            return self._forward_region(req_region, method, path, query,
+                                        body, token)
         server = self.agent.server
         if server is None:
             raise HttpError(501,
@@ -362,6 +514,10 @@ class HTTPApi:
                 job = from_wire(body["job"] if "job" in body else body)
                 require(acl.allow_namespace_operation(job.namespace,
                                                       "submit-job"))
+                mr_out = self._maybe_multiregion_register(
+                    server, job, local_region, token)
+                if mr_out is not None:
+                    return mr_out
                 ev = server.job_register(job)
                 return {"eval_id": ev.id if ev else "",
                         "job_modify_index": job.job_modify_index}
@@ -384,6 +540,10 @@ class HTTPApi:
                     job = from_wire(body["job"] if "job" in body else body)
                     require(acl.allow_namespace_operation(job.namespace,
                                                           "submit-job"))
+                    mr_out = self._maybe_multiregion_register(
+                        server, job, local_region, token)
+                    if mr_out is not None:
+                        return mr_out
                     ev = server.job_register(job)
                     return {"eval_id": ev.id if ev else ""}
             if sub == "allocations":

@@ -21,11 +21,13 @@ class NomadClient:
                  timeout: float = 70.0, token: Optional[str] = None,
                  ca_cert: Optional[str] = None,
                  client_cert: Optional[str] = None,
-                 client_key: Optional[str] = None) -> None:
+                 client_key: Optional[str] = None,
+                 region: Optional[str] = None) -> None:
         self.host = host
         self.port = port
         self.timeout = timeout
         self.token = token  # X-Nomad-Token (api.Client SetSecretID)
+        self.region = region  # every request carries ?region= (api.Config)
         # TLS (api.Client TLSConfig: NOMAD_CACERT/NOMAD_CLIENT_CERT/KEY)
         self._ssl_ctx = None
         if client_cert and not ca_cert:
@@ -54,6 +56,8 @@ class NomadClient:
             conn = HTTPConnection(self.host, self.port,
                                   timeout=self.timeout)
         try:
+            if self.region and not (params or {}).get("region"):
+                params = dict(params or {}, region=self.region)
             qs = f"?{urlencode(params)}" if params else ""
             payload = json.dumps(to_json_tree(body)) \
                 if body is not None else None
@@ -341,6 +345,10 @@ class NomadClient:
 
     def status_leader(self):
         return self._request("GET", "/v1/status/leader")
+
+    def regions(self) -> list:
+        """Federated region names (api/regions.go List)."""
+        return self._request("GET", "/v1/regions")
 
     # ---- ACLs (api/acl.go) ----
 

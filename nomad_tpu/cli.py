@@ -49,7 +49,9 @@ def _client(args) -> NomadClient:
         client_cert=(getattr(args, "client_cert", None)
                      or os.environ.get("NOMAD_CLIENT_CERT")),
         client_key=(getattr(args, "client_key", None)
-                    or os.environ.get("NOMAD_CLIENT_KEY")))
+                    or os.environ.get("NOMAD_CLIENT_KEY")),
+        region=(getattr(args, "region", None)
+                or os.environ.get("NOMAD_REGION")))
 
 
 def _columns(rows: List[List[str]], header: List[str]) -> str:
@@ -468,6 +470,13 @@ def cmd_deployment_fail(args) -> int:
 
 # ---- operator / misc ----
 
+def cmd_regions_list(args) -> int:
+    """`nomad-tpu regions list` (command/regions.go)."""
+    for r in _client(args).regions():
+        print(r)
+    return 0
+
+
 def cmd_server_members(args) -> int:
     api = _client(args)
     out = api._request("GET", "/v1/agent/members")
@@ -580,7 +589,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="client certificate ($NOMAD_CLIENT_CERT)")
     p.add_argument("-client-key", dest="client_key", default=None,
                    help="client key ($NOMAD_CLIENT_KEY)")
+    p.add_argument("-region", default=None,
+                   help="route to this federated region ($NOMAD_REGION)")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    rg = sub.add_parser("regions", help="region commands").add_subparsers(
+        dest="sub", required=True)
+    rgl = rg.add_parser("list")
+    rgl.set_defaults(fn=cmd_regions_list)
 
     ag = sub.add_parser("agent", help="run an agent")
     ag.add_argument("-dev", action="store_true")

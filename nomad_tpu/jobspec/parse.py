@@ -90,6 +90,28 @@ def _parse_job(job_id: str, body: Dict[str, Any]) -> Job:
             meta_required=list(p.get("meta_required", [])),
             meta_optional=list(p.get("meta_optional", [])),
         )
+    if "multiregion" in body:
+        # reference jobspec/parse_multiregion.go: strategy{} + region
+        # blocks with count/datacenters/meta overrides
+        from ..structs.job import Multiregion
+
+        mr = _one(body["multiregion"])
+        strategy = None
+        if "strategy" in mr:
+            s = _one(mr["strategy"])
+            strategy = {"max_parallel": int(s.get("max_parallel", 0)),
+                        "on_failure": s.get("on_failure", "")}
+        regions = []
+        for r in _many(mr.get("region")):
+            (rname, rbody), = r.items()
+            rb = _one(rbody)
+            regions.append({
+                "name": rname,
+                "count": int(rb.get("count", 0)),
+                "datacenters": list(rb.get("datacenters", [])),
+                "meta": dict(_one(rb.get("meta", {})) or {}),
+            })
+        job.multiregion = Multiregion(strategy=strategy, regions=regions)
     groups = body.get("group")
     if not groups:
         raise HclError(f"job {job_id!r} needs at least one group")

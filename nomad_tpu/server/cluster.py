@@ -35,6 +35,15 @@ from .state import StateStore
 from .wal import _encode_args
 
 
+class NoRegionPathError(Exception):
+    """No known alive server in the requested region (the reference's
+    structs.ErrNoRegionPath, nomad/rpc.go:282 forwardRegion)."""
+
+    def __init__(self, region: str) -> None:
+        super().__init__(f"no path to region {region!r}")
+        self.region = region
+
+
 class _DirectView:
     """Unrouted mutator access for the FSM applier (the fsm.go Apply path
     writes straight to memdb, never back through raftApply). Marks the
@@ -147,12 +156,13 @@ class RaftStateStore(StateStore):
 
 class ClusterServerConfig(ServerConfig):
     def __init__(self, node_id: str = "node", host: str = "127.0.0.1",
-                 port: int = 0, tls=None, **kw):
+                 port: int = 0, tls=None, region: str = "global", **kw):
         super().__init__(**kw)
         self.node_id = node_id
         self.host = host
         self.port = port
         self.tls = tls  # lib.tlsutil.TLSConfig | None (RPC fabric mTLS)
+        self.region = region  # WAN federation (nomad/server.go Region)
 
 
 #: endpoint methods a follower forwards to the leader (write RPCs plus the
@@ -210,7 +220,13 @@ class ClusterServer:
         # the RPC fabric (nomad/serf.go setupSerf; server.go:1363)
         from .gossip import Membership
 
-        self.membership = Membership(config.node_id, self.addr, self.pool)
+        # serf-style member name "<node>.<region>" (nomad/server.go:1374:
+        # serf names are node.region) — bare node ids may collide across
+        # federated regions, which would make the gossip table clobber or
+        # drop the remote region's servers
+        self.membership = Membership(
+            f"{config.node_id}.{config.region}", self.addr, self.pool,
+            tags={"region": config.region})
         self.rpc.register("Gossip.exchange", self.membership.exchange)
 
     # ---- lifecycle ----
@@ -263,7 +279,11 @@ class ClusterServer:
 
     def _make_handler(self, method: str):
         def handler(*wire_args):
-            return to_wire(self._invoke_local(method, wire_args))
+            # an inbound endpoint RPC may land on a follower (a client's
+            # failover list, or a cross-region entry server); the handler
+            # leader-forwards within its own region exactly as every
+            # reference endpoint does via forward() (nomad/rpc.go)
+            return to_wire(self._call_wire(method, wire_args))
 
         handler.__name__ = method
         return handler
@@ -274,16 +294,79 @@ class ClusterServer:
 
     # ---- client-facing call (forwarding; rpc.go forward()) ----
 
-    def call(self, method: str, *args, timeout: float = 10.0):
-        """Invoke an endpoint, forwarding to the leader when needed."""
+    def _call_wire(self, method: str, wire_args, timeout: float = 10.0):
+        """Leader-forwarded invoke with already-wire-encoded args.
+
+        Retries while the leader is unknown or moves mid-call, like the
+        reference's forward() loop (nomad/rpc.go:225-260) which backs off
+        up to rpcHoldTimeout on ErrNoLeader instead of failing the first
+        RPC after an election."""
+        deadline = time.time() + timeout
+        leader = None
+        while True:
+            try:
+                if self.is_leader():
+                    return self._invoke_local(method, wire_args)
+                leader = self.raft.leader()
+                if leader is not None and leader in self.peers \
+                        and leader != self.config.node_id:
+                    res = self.pool.call(
+                        self.peers[leader], f"Server.{method}", *wire_args,
+                        timeout=max(0.1, deadline - time.time()))
+                    return from_wire(res)
+            except NotLeaderError:
+                pass  # lost leadership between check and invoke; re-resolve
+            except RpcError as e:
+                # remote believed-leader had already stepped down
+                if "NotLeaderError" not in str(e):
+                    raise
+            if time.time() >= deadline:
+                raise NotLeaderError(leader)
+            time.sleep(0.05)
+
+    def call(self, method: str, *args, timeout: float = 10.0,
+             region: Optional[str] = None):
+        """Invoke an endpoint, forwarding to the leader — or, when
+        `region` names a different federated region, to any alive server
+        of that region (forwardRegion, nomad/rpc.go:282; the remote entry
+        server leader-forwards within its own region)."""
         if method not in FORWARDED:
             raise ValueError(f"unknown endpoint {method!r}")
         wire_args = [to_wire(a) for a in args]
-        if self.is_leader():
-            return self._invoke_local(method, wire_args)
-        leader = self.raft.leader()
-        if leader is None or leader not in self.peers:
-            raise NotLeaderError(leader)
-        res = self.pool.call(self.peers[leader], f"Server.{method}",
-                             *wire_args, timeout=timeout)
-        return from_wire(res)
+        if region is not None and region != self.config.region:
+            from .gossip import STATUS_ALIVE
+
+            remote = [m for m in self.membership.members()
+                      if m.region == region and m.status == STATUS_ALIVE
+                      and m.name != self.membership.name]
+            if not remote:
+                raise NoRegionPathError(region)
+            import random as _random
+
+            target = _random.choice(remote)
+            res = self.pool.call(target.addr, f"Server.{method}",
+                                 *wire_args, timeout=timeout)
+            return from_wire(res)
+        return self._call_wire(method, wire_args, timeout=timeout)
+
+    # ---- WAN federation (server.go:1363 serf WAN; regions_endpoint.go) --
+
+    def join_wan(self, addr) -> bool:
+        """Federate with another region through any of its servers (the
+        reference's `server join` over the WAN serf pool). Gossip then
+        spreads both regions' member tables everywhere."""
+        return self.membership.join([tuple(addr)])
+
+    def regions(self) -> List[str]:
+        """Sorted federated region names (regions_endpoint.go List)."""
+        from .gossip import STATUS_LEFT
+
+        return sorted({m.region for m in self.membership.members()
+                       if m.status != STATUS_LEFT}
+                      | {self.config.region})
+
+    def region_servers(self, region: str) -> List[Tuple[str, int]]:
+        from .gossip import STATUS_ALIVE
+
+        return [m.addr for m in self.membership.members()
+                if m.region == region and m.status == STATUS_ALIVE]

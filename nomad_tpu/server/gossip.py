@@ -34,10 +34,20 @@ class Member:
     status: str = STATUS_ALIVE
     incarnation: int = 0
     last_seen: float = field(default_factory=time.time)
+    #: serf-style tags (the reference advertises region/dc/rpc_addr/etc.
+    #: through serf member tags; nomad/server.go:1380 setupSerf). The
+    #: build uses "region" for WAN federation and "http_addr" for
+    #: cross-region HTTP forwarding.
+    tags: Dict[str, str] = field(default_factory=dict)
 
     def wire(self) -> dict:
         return {"name": self.name, "addr": list(self.addr),
-                "status": self.status, "incarnation": self.incarnation}
+                "status": self.status, "incarnation": self.incarnation,
+                "tags": dict(self.tags)}
+
+    @property
+    def region(self) -> str:
+        return self.tags.get("region", "global")
 
 
 class Membership:
@@ -46,8 +56,8 @@ class Membership:
     def __init__(self, name: str, addr: Tuple[str, int], pool,
                  interval: float = 1.0, suspect_after: float = 3.0,
                  failed_after: float = 6.0,
-                 on_change: Optional[Callable[[Member], None]] = None
-                 ) -> None:
+                 on_change: Optional[Callable[[Member], None]] = None,
+                 tags: Optional[Dict[str, str]] = None) -> None:
         self.name = name
         self.pool = pool
         self.interval = interval
@@ -56,7 +66,7 @@ class Membership:
         self.on_change = on_change
         self._lock = threading.Lock()
         self._members: Dict[str, Member] = {
-            name: Member(name, tuple(addr))}
+            name: Member(name, tuple(addr), tags=dict(tags or {}))}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -95,7 +105,8 @@ class Membership:
                 inc = int(w.get("incarnation", 0))
                 status = w.get("status", STATUS_ALIVE)
                 if cur is None:
-                    cur = Member(name, tuple(w["addr"]), status, inc, now)
+                    cur = Member(name, tuple(w["addr"]), status, inc, now,
+                                 tags=dict(w.get("tags", {}) or {}))
                     self._members[name] = cur
                     if cur.status == STATUS_ALIVE:
                         changed.append(cur)
@@ -113,6 +124,8 @@ class Membership:
                     cur.incarnation = inc
                     cur.status = status
                     cur.addr = tuple(w["addr"])
+                    if w.get("tags"):
+                        cur.tags = dict(w["tags"])
                     if status == STATUS_ALIVE and inc > 0:
                         cur.last_seen = now  # rebuttal: direct evidence
                     if cur.status != was:
@@ -246,4 +259,13 @@ class Membership:
     def members(self) -> List[Member]:
         with self._lock:
             return [Member(m.name, m.addr, m.status, m.incarnation,
-                           m.last_seen) for m in self._members.values()]
+                           m.last_seen, dict(m.tags))
+                    for m in self._members.values()]
+
+    def set_tag(self, key: str, value: str) -> None:
+        """Update a local tag and bump incarnation so it propagates
+        (serf SetTags re-broadcasts the member with fresh tags)."""
+        with self._lock:
+            me = self._members[self.name]
+            me.tags[key] = value
+            me.incarnation += 1

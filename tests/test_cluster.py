@@ -57,7 +57,9 @@ class TestCluster:
     def test_leader_elected_and_subsystems_enabled(self, cluster):
         assert _wait(lambda: leader_of(cluster) is not None)
         leader = leader_of(cluster)
-        assert leader.server._running
+        # the leadership callback enables subsystems asynchronously after
+        # the raft term is won — wait for it rather than racing it
+        assert _wait(lambda: leader.server._running)
         followers = [a for a in cluster if a is not leader]
         assert all(not f.server._running for f in followers)
 
