@@ -135,14 +135,18 @@ class HTTPApi:
         if mr is None or not mr.regions:
             return None
         names = [r.get("name") for r in mr.regions]
+        # a copy stamped with one of its own block names is a fan-out
+        # product arriving from the originating region — this check comes
+        # FIRST so a region literally named "global" can't re-trigger
+        # fan-out (infinite cross-region ping-pong)
+        if job.region in names:
+            return None
         if job.region in ("", "global"):
             return self._register_multiregion(server, job, local_region,
                                               token)
-        if job.region not in names:
-            raise HttpError(
-                400, "multiregion job must not set region "
-                f"(got {job.region!r}; blocks: {names})")
-        return None  # region-stamped copy from the fan-out: plain register
+        raise HttpError(
+            400, "multiregion job must not set region "
+            f"(got {job.region!r}; blocks: {names})")
 
     def _register_multiregion(self, server, job, local_region: str,
                               token: Optional[str]) -> Any:
@@ -521,10 +525,23 @@ class HTTPApi:
                 ev = server.job_register(job)
                 return {"eval_id": ev.id if ev else "",
                         "job_modify_index": job.job_modify_index}
-        # /v1/job/<id>[/...]
+        # /v1/job/<id>[/...] — job ids may CONTAIN slashes (dispatched
+        # children "<parent>/dispatch-...", periodic children
+        # "<parent>/periodic-<ts>"; structs.go:3995): the sub-route is
+        # recognized from the path TAIL, everything before it is the id
+        # (the reference's mux strips the known suffixes the same way,
+        # command/agent/job_endpoint.go JobSpecificRequest)
         if parts and parts[0] == "job" and len(parts) >= 2:
-            job_id = parts[1]
-            sub = parts[2] if len(parts) > 2 else ""
+            _job_subs = {"allocations", "evaluations", "deployments",
+                         "summary", "plan", "scale", "dispatch"}
+            rest = parts[1:]
+            if len(rest) >= 3 and rest[-2:] == ["periodic", "force"]:
+                job_id, sub = "/".join(rest[:-2]), "periodic"
+                parts = ["job", job_id, "periodic", "force"]
+            elif len(rest) >= 2 and rest[-1] in _job_subs:
+                job_id, sub = "/".join(rest[:-1]), rest[-1]
+            else:
+                job_id, sub = "/".join(rest), ""
             if not sub:
                 if method == "GET":
                     require(acl.allow_namespace_operation(ns, "read-job"))
@@ -571,6 +588,31 @@ class HTTPApi:
                 if ev is None:
                     raise HttpError(404, "not a periodic job or overlapped")
                 return {"eval_id": ev.id}
+            if sub == "dispatch" and method in ("PUT", "POST"):
+                # Job.Dispatch (job_endpoint.go:1634; HTTP route
+                # command/agent/job_endpoint.go jobDispatchRequest)
+                require(acl.allow_namespace_operation(ns, "dispatch-job")
+                        or acl.allow_namespace_operation(ns, "submit-job"))
+                payload = (body or {}).get("Payload") or b""
+                if isinstance(payload, str):
+                    import base64
+                    import binascii
+
+                    try:
+                        payload = base64.b64decode(payload,
+                                                   validate=True)
+                    except binascii.Error as e:
+                        raise HttpError(400, f"bad Payload base64: {e}")
+                meta = dict((body or {}).get("Meta") or {})
+                try:
+                    child, ev = server.job_dispatch(ns, job_id, payload,
+                                                    meta)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"dispatched_job_id": child.id,
+                        "eval_id": ev.id if ev else "",
+                        "eval_create_index": state.index.value,
+                        "job_create_index": state.index.value}
             if sub == "plan":
                 job = from_wire(body["job"] if "job" in body else body)
                 require(acl.allow_namespace_operation(job.namespace,
@@ -769,6 +811,57 @@ class HTTPApi:
                 require(acl.allow_operator_write())
                 state.set_scheduler_config(from_wire(body))
                 return {"updated": True}
+        # /v1/operator/autopilot/{configuration,health}
+        # (operator_endpoint.go AutopilotGetConfiguration :240,
+        # AutopilotSetConfiguration :270, ServerHealth :300)
+        if parts == ["operator", "autopilot", "configuration"]:
+            if method == "GET":
+                require(acl.allow_operator_read())
+                return to_wire(state.autopilot_config())
+            if method == "PUT":
+                require(acl.allow_operator_write())
+                state.set_autopilot_config(from_wire(body))
+                return {"updated": True}
+        if parts == ["operator", "autopilot", "health"]:
+            require(acl.allow_operator_read())
+            if cluster is not None:
+                return cluster.autopilot.server_health()
+            # single-server dev agent: trivially healthy
+            return {"healthy": True, "failure_tolerance": 0,
+                    "servers": [{"id": "self", "address": "local",
+                                 "leader": True, "voter": True,
+                                 "healthy": True}]}
+        # /v1/operator/raft/{configuration,peer}
+        # (operator_endpoint.go RaftGetConfiguration :33,
+        # RaftRemovePeerByID :120)
+        if parts == ["operator", "raft", "configuration"]:
+            require(acl.allow_operator_read())
+            if cluster is None:
+                return {"servers": [{"id": "self", "address": "local",
+                                     "leader": True, "voter": True}],
+                        "index": state.index.value}
+            leader = cluster.raft.leader() or ""
+            return {"servers": [
+                {"id": pid, "address": f"{a[0]}:{a[1]}",
+                 "leader": pid == leader, "voter": True}
+                for pid, a in sorted(cluster.raft.peers.items())],
+                "index": state.index.value}
+        if parts == ["operator", "raft", "peer"] and method == "DELETE":
+            require(acl.allow_operator_write())
+            if cluster is None:
+                raise HttpError(400, "not a raft cluster member")
+            peer_id = query.get("id", "")
+            if not peer_id:
+                raise HttpError(400, "missing ?id=")
+            from ..raft import NotLeaderError
+
+            try:
+                cluster.raft.remove_peer(peer_id)
+            except ValueError as e:
+                raise HttpError(400, str(e))
+            except NotLeaderError as e:
+                raise HttpError(400, f"not the leader: {e}")
+            return {"removed": peer_id}
         # /v1/scaling/policies + /v1/scaling/policy/<id>
         # (command/agent/scaling_endpoint.go; state/schema.go:793)
         if parts == ["scaling", "policies"]:

@@ -145,6 +145,19 @@ class NomadClient:
                                   "Message": message})
         return out.get("eval_id", "")
 
+    def job_dispatch(self, job_id: str, payload: bytes = b"",
+                     meta: Optional[Dict[str, str]] = None,
+                     namespace: str = "default") -> dict:
+        """Dispatch a parameterized job (api/jobs.go Dispatch)."""
+        import base64
+
+        return self._request(
+            "PUT", f"/v1/job/{job_id}/dispatch",
+            params={"namespace": namespace},
+            body={"Payload": base64.b64encode(payload).decode()
+                  if payload else "",
+                  "Meta": dict(meta or {})})
+
     def job_scale_status(self, job_id: str,
                          namespace: str = "default") -> dict:
         return self._request("GET", f"/v1/job/{job_id}/scale",
@@ -349,6 +362,26 @@ class NomadClient:
     def regions(self) -> list:
         """Federated region names (api/regions.go List)."""
         return self._request("GET", "/v1/regions")
+
+    # ---- operator (api/operator.go) ----
+
+    def raft_configuration(self) -> dict:
+        return self._request("GET", "/v1/operator/raft/configuration")
+
+    def raft_remove_peer(self, peer_id: str) -> dict:
+        return self._request("DELETE", "/v1/operator/raft/peer",
+                             params={"id": peer_id})
+
+    def autopilot_config(self):
+        return from_wire(self._request(
+            "GET", "/v1/operator/autopilot/configuration"))
+
+    def set_autopilot_config(self, config) -> None:
+        self._request("PUT", "/v1/operator/autopilot/configuration",
+                      body=to_wire(config))
+
+    def autopilot_health(self) -> dict:
+        return self._request("GET", "/v1/operator/autopilot/health")
 
     # ---- ACLs (api/acl.go) ----
 

@@ -195,6 +195,35 @@ def cmd_job_inspect(args) -> int:
     return 0
 
 
+def cmd_job_dispatch(args) -> int:
+    """`nomad-tpu job dispatch [-meta k=v]... <job> [payload-file]`
+    (command/job_dispatch.go; '-' reads the payload from stdin)."""
+    api = _client(args)
+    payload = b""
+    if args.payload_file == "-":
+        payload = sys.stdin.buffer.read()
+    elif args.payload_file:
+        with open(args.payload_file, "rb") as f:
+            payload = f.read()
+    meta = {}
+    for kv in args.meta or []:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            print(f"Error: -meta expects key=value, got {kv!r}",
+                  file=sys.stderr)
+            return 1
+        meta[k] = v
+    out = api.job_dispatch(args.job_id, payload, meta,
+                           namespace=args.namespace)
+    print(f"Dispatched job {out['dispatched_job_id']!r}")
+    ev = out.get("eval_id", "")
+    if ev:
+        print(f"Evaluation ID: {ev[:8]}")
+        if not args.detach:
+            return _monitor(api, ev)
+    return 0
+
+
 def cmd_job_periodic_force(args) -> int:
     api = _client(args)
     eval_id = api.periodic_force(args.job_id, namespace=args.namespace)
@@ -486,6 +515,58 @@ def cmd_server_members(args) -> int:
     return 0
 
 
+def cmd_operator_raft_list(args) -> int:
+    """`operator raft list-peers` (command/operator_raft_list.go)."""
+    cfg = _client(args).raft_configuration()
+    print(_columns(
+        [[s["id"], s["address"], "leader" if s["leader"] else "follower",
+          str(s["voter"]).lower()] for s in cfg["servers"]],
+        ["Node", "Address", "State", "Voter"]))
+    return 0
+
+
+def cmd_operator_raft_remove(args) -> int:
+    """`operator raft remove-peer` (command/operator_raft_remove.go)."""
+    out = _client(args).raft_remove_peer(args.peer_id)
+    print(f"Removed peer {out['removed']} from the Raft configuration")
+    return 0
+
+
+def cmd_operator_autopilot_get(args) -> int:
+    cfg = _client(args).autopilot_config()
+    print(f"CleanupDeadServers      = {cfg.cleanup_dead_servers}")
+    print(f"LastContactThreshold    = {cfg.last_contact_threshold_s}s")
+    print(f"MaxTrailingLogs         = {cfg.max_trailing_logs}")
+    print(f"ServerStabilizationTime = {cfg.server_stabilization_time_s}s")
+    return 0
+
+
+def cmd_operator_autopilot_set(args) -> int:
+    api = _client(args)
+    cfg = api.autopilot_config()
+    if args.cleanup_dead_servers is not None:
+        cfg.cleanup_dead_servers = args.cleanup_dead_servers == "true"
+    if args.max_trailing_logs is not None:
+        cfg.max_trailing_logs = args.max_trailing_logs
+    if args.last_contact_threshold is not None:
+        cfg.last_contact_threshold_s = args.last_contact_threshold
+    api.set_autopilot_config(cfg)
+    print("Autopilot configuration updated!")
+    return 0
+
+
+def cmd_operator_autopilot_health(args) -> int:
+    h = _client(args).autopilot_health()
+    print(f"Healthy            = {h['healthy']}")
+    print(f"FailureTolerance   = {h['failure_tolerance']}")
+    print(_columns(
+        [[s["id"], s["address"],
+          "leader" if s.get("leader") else "follower",
+          str(s["healthy"]).lower()] for s in h["servers"]],
+        ["Node", "Address", "State", "Healthy"]))
+    return 0
+
+
 def cmd_operator_scheduler_get(args) -> int:
     api = _client(args)
     cfg = api.scheduler_config()
@@ -637,6 +718,13 @@ def build_parser() -> argparse.ArgumentParser:
     ji.add_argument("job_id")
     ji.add_argument("-namespace", default="default")
     ji.set_defaults(fn=cmd_job_inspect)
+    jd = job.add_parser("dispatch")
+    jd.add_argument("job_id")
+    jd.add_argument("payload_file", nargs="?", default="")
+    jd.add_argument("-meta", action="append", default=[])
+    jd.add_argument("-namespace", default="default")
+    jd.add_argument("-detach", action="store_true")
+    jd.set_defaults(fn=cmd_job_dispatch)
     jpf = job.add_parser("periodic-force")
     jpf.add_argument("job_id")
     jpf.add_argument("-namespace", default="default")
@@ -718,6 +806,24 @@ def build_parser() -> argparse.ArgumentParser:
     osn.add_argument("action", choices=["save", "restore"])
     osn.add_argument("file")
     osn.set_defaults(fn=cmd_operator_snapshot)
+    orl = op.add_parser("raft-list-peers")
+    orl.set_defaults(fn=cmd_operator_raft_list)
+    orr = op.add_parser("raft-remove-peer")
+    orr.add_argument("-peer-id", dest="peer_id", required=True)
+    orr.set_defaults(fn=cmd_operator_raft_remove)
+    oag = op.add_parser("autopilot-get-config")
+    oag.set_defaults(fn=cmd_operator_autopilot_get)
+    oas = op.add_parser("autopilot-set-config")
+    oas.add_argument("-cleanup-dead-servers", dest="cleanup_dead_servers",
+                     choices=["true", "false"], default=None)
+    oas.add_argument("-max-trailing-logs", dest="max_trailing_logs",
+                     type=int, default=None)
+    oas.add_argument("-last-contact-threshold",
+                     dest="last_contact_threshold", type=float,
+                     default=None)
+    oas.set_defaults(fn=cmd_operator_autopilot_set)
+    oah = op.add_parser("autopilot-health")
+    oah.set_defaults(fn=cmd_operator_autopilot_health)
     osg = op.add_parser("scheduler-get-config")
     osg.set_defaults(fn=cmd_operator_scheduler_get)
     oss = op.add_parser("scheduler-set-config")

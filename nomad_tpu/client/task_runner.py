@@ -247,10 +247,25 @@ class TaskRunner:
         if not self.recover_state:
             for art in self.task.artifacts:
                 fetch_artifact(art, self.task_dir)
+        # dispatch_payload hook (taskrunner/dispatch_hook.go): a
+        # dispatched job's payload is written into local/<file> before
+        # the first start
+        import os
+
+        dp = self.task.dispatch_payload
+        if dp is not None and dp.file and self.alloc.job is not None \
+                and self.alloc.job.payload and not self.recover_state:
+            dest = os.path.normpath(os.path.join(
+                self.task_dir, "local", dp.file))
+            if not dest.startswith(self.task_dir + os.sep):
+                raise RuntimeError(
+                    f"dispatch_payload file escapes task dir: {dp.file!r}")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(self.alloc.job.payload)
         # volume_mounts hook (taskrunner volume_hook.go): materialize each
         # mount inside the task dir — the privilege-free bind-mount analog
         # is a symlink at the destination
-        import os
 
         for vm in self.task.volume_mounts:
             src = self.volume_paths.get(vm.volume)

@@ -217,6 +217,13 @@ def _parse_task(name: str, body: Dict[str, Any]) -> Task:
         lc = _one(body["lifecycle"])
         task.lifecycle = TaskLifecycle(
             hook=lc.get("hook", ""), sidecar=bool(lc.get("sidecar", False)))
+    if "dispatch_payload" in body:
+        # jobspec/parse_task.go parseDispatchPayload
+        from ..structs.job import DispatchPayloadConfig
+
+        dp = _one(body["dispatch_payload"])
+        task.dispatch_payload = DispatchPayloadConfig(
+            file=dp.get("file", ""))
     if "logs" in body:
         lg = _one(body["logs"])
         task.log_config = LogConfig(

@@ -310,6 +310,54 @@ class RaftNode:
         """Commit a no-op to flush the pipeline (hashicorp/raft Barrier)."""
         self.apply({"op": "__noop__"}, timeout=timeout)
 
+    # ---- membership changes (hashicorp/raft AddVoter/RemoveServer:
+    # configuration changes ride the log so every replica applies them at
+    # the same point in the entry stream) ----
+
+    def remove_peer(self, peer_id: str, timeout: float = 10.0) -> None:
+        """Leader-only: commit a config entry removing `peer_id` from the
+        voter set. The removed server stops being counted for quorum once
+        the entry applies. Note: the static peer map given at construction
+        is what a restarted process comes back with — operators removing a
+        server permanently must also drop it from the boot config."""
+        if peer_id == self.id:
+            raise ValueError("cannot remove the leader itself; "
+                             "transfer leadership first")
+        if peer_id not in self.peers:
+            raise ValueError(f"unknown peer {peer_id!r}")
+        self.apply({"op": "__raft_conf__",
+                    "action": "remove", "id": peer_id}, timeout=timeout)
+
+    def add_peer(self, peer_id: str, addr) -> None:
+        """Leader-only: commit a config entry adding a voter."""
+        self.apply({"op": "__raft_conf__", "action": "add",
+                    "id": peer_id, "addr": list(addr)})
+
+    #: optional callback fired after a committed config change applies
+    #: locally: on_conf_change(action, peer_id, addr_or_None)
+    on_conf_change = None
+
+    def _apply_conf(self, data: Dict[str, Any]) -> None:
+        action, peer_id = data.get("action"), data.get("id")
+        with self._lock:
+            if action == "remove":
+                self.peers.pop(peer_id, None)
+                self._match_index.pop(peer_id, None)
+                self._next_index.pop(peer_id, None)
+            elif action == "add":
+                self.peers[peer_id] = tuple(data.get("addr") or ())
+                self._next_index.setdefault(peer_id,
+                                            self.log.last_index() + 1)
+                self._match_index.setdefault(peer_id, 0)
+        cb = self.on_conf_change
+        if cb is not None:
+            try:
+                cb(action, peer_id, data.get("addr"))
+            except Exception:  # noqa: BLE001 — observer must not kill raft
+                import traceback
+
+                traceback.print_exc()
+
     # ---- ticker ----
 
     def _run_ticker(self) -> None:
@@ -497,6 +545,10 @@ class RaftNode:
                            if i in self._waiters]
             for _, data in batch:
                 if isinstance(data, dict) and data.get("op") == "__noop__":
+                    continue
+                if isinstance(data, dict) \
+                        and data.get("op") == "__raft_conf__":
+                    self._apply_conf(data)
                     continue
                 try:
                     self.apply_fn(data)

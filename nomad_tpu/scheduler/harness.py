@@ -260,6 +260,17 @@ class InMemState:
     def set_scheduler_config(self, config: SchedulerConfiguration) -> None:
         self._config = config
 
+    def autopilot_config(self):
+        cfg = getattr(self, "_autopilot_cfg", None)
+        if cfg is None:
+            from ..structs.operator import AutopilotConfig
+
+            cfg = self._autopilot_cfg = AutopilotConfig()
+        return cfg
+
+    def set_autopilot_config(self, config) -> None:
+        self._autopilot_cfg = config
+
     # ---- CSI volumes (reference state/schema.go :687/:719, csi state
     # methods in state_store.go) ----
 

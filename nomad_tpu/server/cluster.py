@@ -218,16 +218,22 @@ class ClusterServer:
         self._leader_lock = threading.Lock()
         # serf analog: anti-entropy membership + failure detection over
         # the RPC fabric (nomad/serf.go setupSerf; server.go:1363)
+        from .autopilot import Autopilot
         from .gossip import Membership
 
+        self.autopilot = Autopilot(self)
         # serf-style member name "<node>.<region>" (nomad/server.go:1374:
         # serf names are node.region) — bare node ids may collide across
         # federated regions, which would make the gossip table clobber or
         # drop the remote region's servers
         self.membership = Membership(
             f"{config.node_id}.{config.region}", self.addr, self.pool,
-            tags={"region": config.region})
+            tags={"region": config.region},
+            on_change=self.autopilot.member_change)
         self.rpc.register("Gossip.exchange", self.membership.exchange)
+        # committed raft config changes shrink/grow the endpoint peer map
+        # too (the reference's serf/raft reconciliation)
+        self.raft.on_conf_change = self._on_raft_conf_change
 
     # ---- lifecycle ----
 
@@ -250,6 +256,13 @@ class ClusterServer:
         self.raft.shutdown()
         self.rpc.shutdown()
         self.pool.close()
+
+    def _on_raft_conf_change(self, action: str, peer_id: str,
+                             addr) -> None:
+        if action == "remove":
+            self.peers.pop(peer_id, None)
+        elif action == "add" and addr:
+            self.peers[peer_id] = tuple(addr)
 
     # ---- leadership (leader.go monitorLeadership) ----
 

@@ -24,6 +24,7 @@ ALLOWED_OPS = frozenset({
     "upsert_alloc", "delete_alloc", "update_alloc_from_client",
     "upsert_deployment", "delete_deployment",
     "upsert_plan_results", "mark_job_stable", "set_scheduler_config",
+    "set_autopilot_config",
     "upsert_acl_policy", "delete_acl_policy",
     "upsert_acl_token", "delete_acl_token", "acl_bootstrap",
     "upsert_csi_volume", "delete_csi_volume",
@@ -96,6 +97,7 @@ def snapshot_state(state) -> Dict[str, Any]:
         "evals": [to_wire(e) for e in state.evals()],
         "deployments": [to_wire(d) for d in state.deployments()],
         "scheduler_config": to_wire(state.scheduler_config()),
+        "autopilot_config": to_wire(state.autopilot_config()),
         "csi_volumes": [to_wire(v) for v in state.csi_volumes()],
         "acl": {
             "bootstrapped": state.acl.bootstrapped,
@@ -133,6 +135,9 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
     cfg = snap.get("scheduler_config")
     if cfg is not None:
         state.set_scheduler_config(from_wire(cfg))
+    ap = snap.get("autopilot_config")
+    if ap is not None:
+        state.set_autopilot_config(from_wire(ap))
     for tree in snap.get("csi_volumes", []):
         _upsert_preserving_indexes(state.upsert_csi_volume, from_wire(tree))
     acl = snap.get("acl")

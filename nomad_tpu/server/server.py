@@ -552,6 +552,62 @@ class Server:
 
     # ---- scaling (nomad/job_endpoint.go:969 Scale + scaling policies) ----
 
+    #: Job.Dispatch payload ceiling (nomad/job_endpoint.go:1616
+    #: DispatchPayloadSizeLimit = 16 KiB)
+    DISPATCH_PAYLOAD_SIZE_LIMIT = 16 * 1024
+
+    def job_dispatch(self, namespace: str, job_id: str,
+                     payload: bytes = b"",
+                     meta: Optional[Dict[str, str]] = None
+                     ) -> Tuple[Job, Optional[Evaluation]]:
+        """Instantiate a parameterized job (Job.Dispatch,
+        nomad/job_endpoint.go:1634): validate payload presence/size and
+        meta keys against the parameterized stanza, then register a
+        dispatched child job carrying the payload."""
+        import copy
+
+        parent = self.state.job_by_id(namespace, job_id)
+        if parent is None:
+            raise ValueError(f"job {job_id!r} not found")
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id!r} is not parameterized")
+        if parent.stop:
+            raise ValueError(f"job {job_id!r} is stopped")
+        cfg = parent.parameterized
+        payload = bytes(payload or b"")
+        meta = dict(meta or {})
+        if cfg.payload == "required" and not payload:
+            raise ValueError("dispatch payload is required")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("dispatch payload is forbidden")
+        if len(payload) > self.DISPATCH_PAYLOAD_SIZE_LIMIT:
+            raise ValueError(
+                f"dispatch payload exceeds maximum size of "
+                f"{self.DISPATCH_PAYLOAD_SIZE_LIMIT} bytes")
+        missing = sorted(k for k in cfg.meta_required if k not in meta)
+        if missing:
+            raise ValueError(f"missing required dispatch meta: {missing}")
+        allowed = set(cfg.meta_required) | set(cfg.meta_optional)
+        extra = sorted(k for k in meta if k not in allowed)
+        if extra:
+            raise ValueError(f"dispatch meta not allowed: {extra}")
+        child = copy.deepcopy(parent)
+        # DispatchedID form (structs.go:3995)
+        child.id = (f"{parent.id}/dispatch-{int(time.time())}-"
+                    f"{str(uuid.uuid4())[:8]}")
+        child.parent_id = parent.id
+        child.dispatched = True
+        child.payload = payload
+        child.meta.update(meta)
+        child.version = 0
+        child.stable = False
+        child.periodic = None
+        for sp in child.scaling_policies:
+            sp.id = ""  # fresh policy rows keyed to the child job
+            sp.target = dict(sp.target, Job=child.id)
+        ev = self.job_register(child)
+        return child, ev
+
     def job_scale(self, namespace: str, job_id: str, group: str,
                   count: int, message: str = "") -> Optional[Evaluation]:
         import copy

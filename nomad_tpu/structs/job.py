@@ -207,6 +207,14 @@ class TaskLifecycle:
 
 
 @dataclass
+class DispatchPayloadConfig:
+    """Reference `structs.DispatchPayloadConfig` (structs.go:5054) — where
+    a dispatched job's payload lands inside the task dir."""
+
+    file: str = ""
+
+
+@dataclass
 class Task:
     """Reference `structs.Task` (structs.go:6140)."""
 
@@ -229,6 +237,7 @@ class Task:
     shutdown_delay_s: float = 0.0
     kill_signal: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
+    dispatch_payload: Optional[DispatchPayloadConfig] = None
 
 
 @dataclass

@@ -1,0 +1,40 @@
+"""Operator structs — autopilot configuration and raft server info.
+
+Behavioral reference: `nomad/structs/operator.go` (AutopilotConfig :45,
+RaftServer :9, RaftConfigurationResponse :29) and the Consul autopilot
+library the reference embeds (`vendor/github.com/hashicorp/consul/agent/
+consul/autopilot/`). Times are seconds (the reference uses
+time.Duration).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class AutopilotConfig:
+    """Reference `structs.AutopilotConfig` (operator.go:45)."""
+
+    #: remove failed/left servers from the Raft configuration as soon as
+    #: a healthy replacement keeps quorum (autopilot pruneDeadServers)
+    cleanup_dead_servers: bool = True
+    #: a server silent longer than this is unhealthy (reference 200ms on
+    #: serf probes; this build's gossip sweep works in seconds)
+    last_contact_threshold_s: float = 10.0
+    #: a server this many log entries behind is unhealthy
+    max_trailing_logs: int = 250
+    #: continuous-health window behind the health report's per-server
+    #: `stable` flag (the reference additionally gates non-voter
+    #: promotion on it; this build has no non-voters to promote)
+    server_stabilization_time_s: float = 10.0
+
+
+@dataclass
+class RaftServer:
+    """Reference `structs.RaftServer` (operator.go:9)."""
+
+    id: str = ""
+    address: str = ""
+    leader: bool = False
+    voter: bool = True
+    raft_protocol: str = "3"

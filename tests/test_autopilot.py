@@ -1,0 +1,145 @@
+"""Autopilot + operator raft surface (reference: nomad/autopilot.go,
+operator_endpoint.go, hashicorp/raft RemoveServer)."""
+import time
+
+import pytest
+
+from nomad_tpu.agent.http import HTTPApi, HttpError
+from tests.test_cluster import leader_of, make_cluster
+
+
+def _wait(cond, timeout=20.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+class _Facade:
+    def __init__(self, cluster):
+        self.server = cluster.server
+        self.client = None
+        self.cluster = cluster
+
+
+@pytest.fixture()
+def cluster():
+    agents = make_cluster(3)
+    yield agents
+    for a in agents:
+        try:
+            a.shutdown()
+        except Exception:
+            pass
+
+
+class TestOperatorRaft:
+    def test_raft_configuration_route(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        api = HTTPApi(_Facade(leader), "127.0.0.1", 0)
+        try:
+            out = api.route("GET", "/v1/operator/raft/configuration",
+                            {}, None)
+            assert len(out["servers"]) == 3
+            assert sum(1 for s in out["servers"] if s["leader"]) == 1
+        finally:
+            api.httpd.server_close()
+
+    def test_remove_peer_shrinks_config_everywhere(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        victim = next(a for a in cluster if a is not leader)
+        api = HTTPApi(_Facade(leader), "127.0.0.1", 0)
+        try:
+            out = api.route("DELETE", "/v1/operator/raft/peer",
+                            {"id": victim.config.node_id}, None)
+            assert out["removed"] == victim.config.node_id
+            # committed config change applies on every live server
+            for a in cluster:
+                if a is victim:
+                    continue
+                assert _wait(lambda a=a: victim.config.node_id
+                             not in a.raft.peers)
+                assert _wait(lambda a=a: victim.config.node_id
+                             not in a.peers)
+            # removing the leader itself is refused
+            with pytest.raises(HttpError):
+                api.route("DELETE", "/v1/operator/raft/peer",
+                          {"id": leader.config.node_id}, None)
+        finally:
+            api.httpd.server_close()
+
+
+class TestAutopilot:
+    def test_config_roundtrip(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        api = HTTPApi(_Facade(leader), "127.0.0.1", 0)
+        try:
+            cfg = api.route("GET", "/v1/operator/autopilot/configuration",
+                            {}, None)
+            assert cfg["cleanup_dead_servers"] is True
+            cfg["max_trailing_logs"] = 500
+            from nomad_tpu.structs.codec import from_wire
+
+            api.route("PUT", "/v1/operator/autopilot/configuration", {},
+                      from_wire(cfg))
+            got = api.route("GET",
+                            "/v1/operator/autopilot/configuration",
+                            {}, None)
+            assert got["max_trailing_logs"] == 500
+        finally:
+            api.httpd.server_close()
+
+    def test_health_report(self, cluster):
+        assert _wait(lambda: leader_of(cluster) is not None)
+        leader = leader_of(cluster)
+        assert _wait(lambda: all(
+            len(a.membership.members()) == 3 for a in cluster))
+        h = leader.autopilot.server_health()
+        assert h["healthy"] is True
+        assert len(h["servers"]) == 3
+        assert all(s["healthy"] for s in h["servers"])
+        assert h["failure_tolerance"] == 1
+
+    def test_dead_server_cleanup(self, cluster):
+        """A crashed server is removed from the raft voter set once
+        gossip marks it failed (pruneDeadServers)."""
+        assert _wait(lambda: leader_of(cluster) is not None)
+        assert _wait(lambda: all(
+            len(a.membership.members()) == 3 for a in cluster))
+        leader = leader_of(cluster)
+        victim = next(a for a in cluster if a is not leader)
+        # hard-crash: no graceful LEFT broadcast
+        victim.raft.shutdown()
+        victim.rpc.shutdown()
+        victim.membership.stop()
+        assert _wait(lambda: victim.config.node_id
+                     not in leader.raft.peers, timeout=30.0), \
+            "victim not pruned from raft config"
+        # the survivors still schedule writes (quorum of 2/2 remains)
+        from nomad_tpu import mock
+
+        node = mock.node()
+        leader.call("node_register", node)
+        assert leader.state.node_by_id(node.id) is not None
+
+    def test_cleanup_disabled_keeps_peer(self, cluster):
+        from nomad_tpu.structs.operator import AutopilotConfig
+
+        assert _wait(lambda: leader_of(cluster) is not None)
+        assert _wait(lambda: all(
+            len(a.membership.members()) == 3 for a in cluster))
+        leader = leader_of(cluster)
+        leader.state.set_autopilot_config(
+            AutopilotConfig(cleanup_dead_servers=False))
+        victim = next(a for a in cluster if a is not leader)
+        victim.raft.shutdown()
+        victim.rpc.shutdown()
+        victim.membership.stop()
+        # give gossip time to mark it failed; peer must remain
+        time.sleep(8.0)
+        assert victim.config.node_id in leader.raft.peers
