@@ -44,6 +44,10 @@ CAP_CSI_READ_VOLUME = "csi-read-volume"
 CAP_CSI_LIST_VOLUME = "csi-list-volume"
 CAP_CSI_MOUNT_VOLUME = "csi-mount-volume"
 CAP_SENTINEL_OVERRIDE = "sentinel-override"
+# built-in secrets engine (the Vault-analog KV; no reference caps — the
+# reference delegates secrets ACL to Vault's own policies)
+CAP_SECRETS_READ = "secrets-read"
+CAP_SECRETS_WRITE = "secrets-write"
 
 NAMESPACE_CAPABILITIES = {
     CAP_DENY, CAP_LIST_JOBS, CAP_READ_JOB, CAP_SUBMIT_JOB, CAP_DISPATCH_JOB,
@@ -52,6 +56,7 @@ NAMESPACE_CAPABILITIES = {
     CAP_READ_JOB_SCALING, CAP_SCALE_JOB, CAP_CSI_REGISTER_PLUGIN,
     CAP_CSI_WRITE_VOLUME, CAP_CSI_READ_VOLUME, CAP_CSI_LIST_VOLUME,
     CAP_CSI_MOUNT_VOLUME, CAP_SENTINEL_OVERRIDE,
+    CAP_SECRETS_READ, CAP_SECRETS_WRITE,
 }
 CAPABILITIES = NAMESPACE_CAPABILITIES
 
@@ -62,6 +67,7 @@ _WRITE_CAPS = _READ_CAPS + [
     CAP_SUBMIT_JOB, CAP_DISPATCH_JOB, CAP_READ_LOGS, CAP_READ_FS,
     CAP_ALLOC_EXEC, CAP_ALLOC_LIFECYCLE, CAP_CSI_WRITE_VOLUME,
     CAP_CSI_MOUNT_VOLUME, CAP_SCALE_JOB,
+    CAP_SECRETS_READ, CAP_SECRETS_WRITE,
 ]
 _SCALE_CAPS = [CAP_READ_JOB_SCALING, CAP_LIST_SCALING_POLICIES,
                CAP_READ_SCALING_POLICY, CAP_SCALE_JOB]

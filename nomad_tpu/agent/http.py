@@ -120,6 +120,25 @@ class HTTPApi:
             cluster.membership.set_tag(
                 "http_addr", f"{scheme}://{host}:{self.addr[1]}")
 
+    @staticmethod
+    def _service_index(state, ns: str, ns_visible) -> list:
+        """Grouped service listing: name + tag union + instance count per
+        namespace (api: GET /v1/services)."""
+        grouped: Dict[Tuple[str, str], dict] = {}
+        for r in state.service_registrations():
+            if not ns_visible(r.namespace, "read-job"):
+                continue
+            g = grouped.setdefault((r.namespace, r.service_name), {
+                "namespace": r.namespace, "service_name": r.service_name,
+                "tags": [], "count": 0, "passing": 0})
+            g["count"] += 1
+            if r.status == "passing":
+                g["passing"] += 1
+            for t in r.tags:
+                if t not in g["tags"]:
+                    g["tags"].append(t)
+        return [grouped[k] for k in sorted(grouped)]
+
     def _maybe_multiregion_register(self, server, job, local_region: str,
                                     token: Optional[str]) -> Optional[Any]:
         """Multiregion register decision, shared by both register routes
@@ -923,6 +942,66 @@ class HTTPApi:
             require_ns("list-scaling-policies")
             return [to_wire(p) for p in server.scaling_policies(
                 None if ns_for_acl == "*" else ns_for_acl)]
+        # /v1/secrets + /v1/secret/<path...> — built-in KV secrets engine
+        # (the Vault analog; structs/secrets.py). Values only flow to
+        # tokens holding the secrets capabilities.
+        if parts == ["secrets"] or (parts and parts[0] == "secret"):
+            # require_ns is a no-op for ?namespace=* (list routes filter
+            # per item instead) — secrets have no per-item filter, so a
+            # wildcard would bypass the ACL entirely; demand a concrete
+            # namespace
+            if ns == "*":
+                raise HttpError(400,
+                                "secrets require a concrete namespace")
+        if parts == ["secrets"]:
+            require_ns("secrets-read")
+            return blocking(lambda snap: (
+                snap.index_at,
+                [{"path": e.path, "version": e.version,
+                  "keys": sorted(e.data)}
+                 for e in state.secrets_list(ns)]))
+        if parts and parts[0] == "secret" and len(parts) >= 2:
+            spath = "/".join(parts[1:])
+            if method == "GET":
+                require_ns("secrets-read")
+                e = state.secret_get(ns, spath)
+                if e is None:
+                    raise HttpError(404, f"secret {spath!r} not found")
+                return to_wire(e)
+            if method in ("PUT", "POST"):
+                require_ns("secrets-write")
+                from ..structs.secrets import SecretEntry
+
+                data = (body or {}).get("Data", body) or {}
+                if not isinstance(data, dict) or not all(
+                        isinstance(k, str) for k in data):
+                    raise HttpError(400, "Data must be a string map")
+                try:
+                    server.secret_upsert(SecretEntry(
+                        namespace=ns, path=spath,
+                        data={k: str(v) for k, v in data.items()}))
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"updated": True}
+            if method == "DELETE":
+                require_ns("secrets-write")
+                server.secret_delete(ns, spath)
+                return {"deleted": True}
+        # /v1/services + /v1/service/<name> — native service discovery
+        # (the Consul catalog analog; Nomad's own later
+        # service_registration HTTP API has the same shape)
+        if parts == ["services"]:
+            require_ns("read-job")
+            return blocking(lambda snap: (
+                snap.index_at,
+                self._service_index(state, ns, ns_visible)))
+        if parts and parts[0] == "service" and len(parts) >= 2:
+            require_ns("read-job")
+            if method == "GET":
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(r) for r
+                     in state.services_by_name(ns, parts[1])]))
         if parts == ["search"] and method == "PUT":
             b = body or {}
             # per-context results are namespace-scoped reads

@@ -363,6 +363,39 @@ class NomadClient:
         """Federated region names (api/regions.go List)."""
         return self._request("GET", "/v1/regions")
 
+    # ---- services (native service discovery) ----
+
+    def services(self, namespace: str = "default") -> List[dict]:
+        res = self._request("GET", "/v1/services",
+                            params={"namespace": namespace})
+        return self._unblock(res)[1]
+
+    def service(self, name: str, namespace: str = "default") -> List[Any]:
+        res = self._request("GET", f"/v1/service/{name}",
+                            params={"namespace": namespace})
+        return [from_wire(r) for r in self._unblock(res)[1]]
+
+    # ---- secrets (built-in KV engine) ----
+
+    def secrets_list(self, namespace: str = "default") -> List[dict]:
+        res = self._request("GET", "/v1/secrets",
+                            params={"namespace": namespace})
+        return self._unblock(res)[1]
+
+    def secret_get(self, path: str, namespace: str = "default"):
+        return from_wire(self._request(
+            "GET", f"/v1/secret/{path}", params={"namespace": namespace}))
+
+    def secret_put(self, path: str, data: Dict[str, str],
+                   namespace: str = "default") -> None:
+        self._request("PUT", f"/v1/secret/{path}",
+                      params={"namespace": namespace},
+                      body={"Data": data})
+
+    def secret_delete(self, path: str, namespace: str = "default") -> None:
+        self._request("DELETE", f"/v1/secret/{path}",
+                      params={"namespace": namespace})
+
     # ---- operator (api/operator.go) ----
 
     def raft_configuration(self) -> dict:

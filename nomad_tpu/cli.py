@@ -499,6 +499,58 @@ def cmd_deployment_fail(args) -> int:
 
 # ---- operator / misc ----
 
+def cmd_secret(args) -> int:
+    """`nomad-tpu secret put|get|list|delete` — built-in KV engine."""
+    api = _client(args)
+    if args.sub == "list":
+        for e in api.secrets_list(namespace=args.namespace):
+            print(f"{e['path']}  v{e['version']}  "
+                  f"keys={','.join(e['keys'])}")
+        return 0
+    if args.sub == "get":
+        entry = api.secret_get(args.path, namespace=args.namespace)
+        for k in sorted(entry.data):
+            print(f"{k}={entry.data[k]}")
+        return 0
+    if args.sub == "delete":
+        api.secret_delete(args.path, namespace=args.namespace)
+        print(f"Deleted secret {args.path!r}")
+        return 0
+    data = {}
+    for kv in args.kv:
+        k, sep, v = kv.partition("=")
+        if not sep:
+            print(f"Error: expected key=value, got {kv!r}",
+                  file=sys.stderr)
+            return 1
+        data[k] = v
+    api.secret_put(args.path, data, namespace=args.namespace)
+    print(f"Wrote secret {args.path!r} ({len(data)} keys)")
+    return 0
+
+
+def cmd_service_list(args) -> int:
+    """`nomad-tpu service list` (native service discovery)."""
+    rows = _client(args).services(namespace=args.namespace)
+    print(_columns(
+        [[s["service_name"], ",".join(s["tags"]) or "<none>",
+          f'{s["passing"]}/{s["count"]}'] for s in rows],
+        ["Service", "Tags", "Healthy"]))
+    return 0
+
+
+def cmd_service_info(args) -> int:
+    regs = _client(args).service(args.name, namespace=args.namespace)
+    if not regs:
+        print(f"No instances of service {args.name!r}", file=sys.stderr)
+        return 1
+    print(_columns(
+        [[r.id[-20:], f"{r.address}:{r.port}", r.status, r.alloc_id[:8],
+          r.node_id[:8]] for r in regs],
+        ["ID", "Address", "Status", "Alloc", "Node"]))
+    return 0
+
+
 def cmd_regions_list(args) -> int:
     """`nomad-tpu regions list` (command/regions.go)."""
     for r in _client(args).regions():
@@ -678,6 +730,37 @@ def build_parser() -> argparse.ArgumentParser:
         dest="sub", required=True)
     rgl = rg.add_parser("list")
     rgl.set_defaults(fn=cmd_regions_list)
+
+    sec = sub.add_parser("secret",
+                         help="built-in KV secrets").add_subparsers(
+        dest="sub", required=True)
+    spt = sec.add_parser("put")
+    spt.add_argument("path")
+    spt.add_argument("kv", nargs="+")
+    spt.add_argument("-namespace", default="default")
+    spt.set_defaults(fn=cmd_secret)
+    sgt = sec.add_parser("get")
+    sgt.add_argument("path")
+    sgt.add_argument("-namespace", default="default")
+    sgt.set_defaults(fn=cmd_secret)
+    sls = sec.add_parser("list")
+    sls.add_argument("-namespace", default="default")
+    sls.set_defaults(fn=cmd_secret)
+    sdl = sec.add_parser("delete")
+    sdl.add_argument("path")
+    sdl.add_argument("-namespace", default="default")
+    sdl.set_defaults(fn=cmd_secret)
+
+    svc = sub.add_parser("service",
+                         help="service discovery").add_subparsers(
+        dest="sub", required=True)
+    svl = svc.add_parser("list")
+    svl.add_argument("-namespace", default="default")
+    svl.set_defaults(fn=cmd_service_list)
+    svi = svc.add_parser("info")
+    svi.add_argument("name")
+    svi.add_argument("-namespace", default="default")
+    svi.set_defaults(fn=cmd_service_info)
 
     ag = sub.add_parser("agent", help="run an agent")
     ag.add_argument("-dev", action="store_true")

@@ -49,6 +49,11 @@ class AllocRunner:
         #: volume name → host path, filled by the volumes hook; task
         #: runners materialize task.volume_mounts from it
         self.volume_paths: Dict[str, str] = {}
+        # service registration + checks (service_hook.go / group_service_
+        # hook.go; pushes to the native catalog over conn)
+        from .services import ServiceHook
+
+        self.services = ServiceHook(alloc, node, conn)
         self._csi_mounted: List[Tuple[str, str]] = []  # (plugin, vol)
         self._base_dir = base_dir
         self.alloc_dir = AllocDir(base_dir, alloc.id)
@@ -284,6 +289,7 @@ class AllocRunner:
             recover_state=(rec or {}).get("state"),
             driver_manager=self.driver_manager,
             volume_paths=self.volume_paths,
+            conn=self.conn,
         )
         with self._lock:
             self.task_runners[task.name] = tr
@@ -304,6 +310,12 @@ class AllocRunner:
             for other in runners:
                 if other is not tr:
                     other.kill()
+        # service registration rides task lifecycle (service_hook.go
+        # Poststart registers, Exited deregisters)
+        if state.state == "running":
+            self.services.task_running(name)
+        elif state.state == TASK_STATE_DEAD:
+            self.services.task_dead(name)
         self._recompute_status()
 
     def _recompute_status(self) -> None:
@@ -325,6 +337,8 @@ class AllocRunner:
             else:
                 status = ALLOC_CLIENT_PENDING
             self.client_status = status
+            if status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED):
+                self.services.stop()
             if self.on_update is not None and not self._shutting_down:
                 # Fires on every task-state transition (not just status
                 # flips): the server needs restart counts and events too;
@@ -370,6 +384,7 @@ class AllocRunner:
 
     def destroy(self) -> None:
         self._destroyed = True
+        self.services.stop()
         self.kill()
         for tr in list(self.task_runners.values()):
             tr.join(timeout=5.0)

@@ -63,6 +63,15 @@ class InProcConn:
     def csi_volume_get(self, namespace, vol_id):
         return self.server.csi_volume_get(namespace, vol_id)
 
+    def update_service_registrations(self, regs):
+        return self.server.update_service_registrations(regs)
+
+    def remove_service_registrations(self, alloc_id):
+        return self.server.remove_service_registrations(alloc_id)
+
+    def secret_get(self, namespace, path):
+        return self.server.secret_get(namespace, path)
+
 
 class RpcConn:
     """Server connection over the msgpack-RPC fabric with failover across
@@ -113,6 +122,15 @@ class RpcConn:
 
     def csi_volume_get(self, namespace, vol_id):
         return self._call("csi_volume_get", namespace, vol_id)
+
+    def update_service_registrations(self, regs):
+        return self._call("update_service_registrations", regs)
+
+    def remove_service_registrations(self, alloc_id):
+        return self._call("remove_service_registrations", alloc_id)
+
+    def secret_get(self, namespace, path):
+        return self._call("secret_get", namespace, path)
 
 
 class ClientConfig:

@@ -1,0 +1,237 @@
+"""Client-side service registration + health checking.
+
+Behavioral reference: `command/agent/consul/service_client.go` (the
+reference registers jobspec `service{}` stanzas and their checks against
+the local Consul agent; `client/allocrunner/taskrunner/service_hook.go`
+drives it from task lifecycle events). This build pushes registrations
+to the servers' native catalog instead (structs/service.py) and runs the
+HTTP/TCP checks itself, flipping a registration between "passing" and
+"critical" the way Consul's check runner would.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..structs.service import ServiceRegistration
+
+
+def _resolve_port(alloc, label: str) -> int:
+    """Port by label from the alloc's assigned networks (group shared
+    networks first, then task networks; rank.go AllocatedPortsToPortMap)."""
+    if not label:
+        return 0
+    if label.isdigit():
+        return int(label)
+    nets = []
+    ar = alloc.allocated_resources
+    if ar is not None:
+        if ar.shared is not None:
+            nets.extend(ar.shared.networks)
+        for tr in (ar.tasks or {}).values():
+            nets.extend(tr.networks)
+    for net in nets:
+        for p in list(net.dynamic_ports) + list(net.reserved_ports):
+            if p.label == label:
+                return p.value
+    return 0
+
+
+class ServiceHook:
+    """Per-alloc service registration lifecycle + check runner."""
+
+    def __init__(self, alloc, node, conn, check_interval: float = 1.0
+                 ) -> None:
+        self.alloc = alloc
+        self.node = node
+        self.conn = conn
+        self.check_interval = check_interval
+        self._lock = threading.Lock()
+        #: reg id → (registration, checks)
+        self._regs: Dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: a failed push happened; the runner loop re-asserts the set
+        self._dirty = False
+        #: periodic anti-entropy re-assert cadence (the reference's
+        #: Consul sync loop re-syncs on an interval too)
+        self.reassert_interval = 10.0
+
+    # ---- lifecycle (service_hook.go Poststart/Exited/Stop) ----
+
+    def task_running(self, task_name: str) -> None:
+        """Register the task's services (and the group's, once)."""
+        job = self.alloc.job
+        if job is None or self.conn is None:
+            return
+        tg = job.lookup_task_group(self.alloc.task_group)
+        if tg is None:
+            return
+        new = []
+        with self._lock:
+            for svc in tg.services:
+                reg = self._build(svc, task_name="")
+                if reg.id not in self._regs:
+                    self._regs[reg.id] = (reg, svc.checks)
+                    new.append(reg)
+            task = tg.lookup_task(task_name)
+            for svc in (task.services if task else []):
+                reg = self._build(svc, task_name=task_name)
+                if reg.id not in self._regs:
+                    self._regs[reg.id] = (reg, svc.checks)
+                    new.append(reg)
+        if new:
+            self._push(new)
+            self._ensure_checker()
+
+    def task_dead(self, task_name: str) -> None:
+        """Deregister the dead task's services. Group-level services stay
+        until the alloc stops."""
+        with self._lock:
+            gone = [rid for rid, (r, _) in self._regs.items()
+                    if r.task_name == task_name]
+            for rid in gone:
+                del self._regs[rid]
+        if gone and self.conn is not None:
+            # no per-id delete op on the wire: re-assert the remaining set
+            # after clearing the alloc's rows (both ride the same log)
+            try:
+                self.conn.remove_service_registrations(self.alloc.id)
+                with self._lock:
+                    rest = [r for r, _ in self._regs.values()]
+                if rest:
+                    self.conn.update_service_registrations(rest)
+            except Exception:  # noqa: BLE001 — transient (leader move):
+                # flag for the runner loop's periodic re-assert
+                self._dirty = True
+            self._ensure_checker()
+
+    def stop(self) -> None:
+        """Alloc terminal/destroyed: drop everything. The dereg RPC runs
+        off-thread — callers sit on the alloc status path and must not
+        block on the network."""
+        self._stop.set()
+        with self._lock:
+            had = bool(self._regs)
+            self._regs.clear()
+        if had and self.conn is not None:
+            def dereg():
+                try:
+                    self.conn.remove_service_registrations(self.alloc.id)
+                except Exception:  # noqa: BLE001 — alloc GC reconciles
+                    pass
+
+            threading.Thread(target=dereg, name="svc-dereg",
+                             daemon=True).start()
+
+    # ---- registration build ----
+
+    def _build(self, svc, task_name: str) -> ServiceRegistration:
+        node = self.node
+        address = ""
+        if node is not None:
+            address = node.attributes.get("unique.network.ip-address", "")
+        return ServiceRegistration(
+            id=f"_nomad-task-{self.alloc.id}-{task_name or 'group'}-"
+               f"{svc.name}",
+            service_name=svc.name,
+            namespace=self.alloc.namespace,
+            node_id=node.id if node else "",
+            job_id=self.alloc.job_id,
+            alloc_id=self.alloc.id,
+            task_name=task_name,
+            datacenter=node.datacenter if node else "",
+            tags=list(svc.tags),
+            address=address or "127.0.0.1",
+            port=_resolve_port(self.alloc, svc.port_label),
+            # Consul semantics: a checked service is critical until its
+            # first probe passes; checkless services are passing
+            status="critical" if svc.checks else "passing",
+        )
+
+    def _push(self, regs: List[ServiceRegistration]) -> None:
+        try:
+            self.conn.update_service_registrations(regs)
+        except Exception:  # noqa: BLE001 — transient; checks re-push
+            pass
+
+    # ---- check runner (Consul agent check semantics) ----
+
+    def _ensure_checker(self) -> None:
+        """Run the per-alloc sync loop whenever registrations exist: it
+        drives the checks AND the anti-entropy re-assert (a push that
+        failed mid-flight would otherwise leave the catalog stale for the
+        alloc's whole life)."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            if not self._regs:
+                return
+            self._thread = threading.Thread(
+                target=self._run_loop,
+                name=f"services-{self.alloc.id[:8]}", daemon=True)
+            self._thread.start()
+
+    def _run_loop(self) -> None:
+        #: per-check next-due stamps keyed (reg_id, idx)
+        due: Dict[tuple, float] = {}
+        next_reassert = time.time() + self.reassert_interval
+        while not self._stop.wait(self.check_interval):
+            with self._lock:
+                entries = [(r, list(checks))
+                           for r, checks in self._regs.values()]
+            now = time.time()
+            changed = []
+            for reg, checks in entries:
+                statuses = []
+                ran_any = False
+                for i, chk in enumerate(checks):
+                    key = (reg.id, i)
+                    if now < due.get(key, 0.0):
+                        continue
+                    due[key] = now + float(chk.get("interval_s", 10))
+                    ran_any = True
+                    statuses.append(self._run_check(reg, chk))
+                if not ran_any:
+                    continue
+                status = "passing" if all(statuses) else "critical"
+                if status != reg.status:
+                    reg.status = status
+                    changed.append(reg)
+            if changed:
+                self._push(changed)
+            if self._dirty or now >= next_reassert:
+                # anti-entropy: assert the full desired set (idempotent
+                # upserts; recovers from any dropped push)
+                next_reassert = now + self.reassert_interval
+                with self._lock:
+                    all_regs = [r for r, _ in self._regs.values()]
+                if all_regs:
+                    try:
+                        self.conn.update_service_registrations(all_regs)
+                        self._dirty = False
+                    except Exception:  # noqa: BLE001 — retry next round
+                        pass
+
+    def _run_check(self, reg: ServiceRegistration, chk: dict) -> bool:
+        port = _resolve_port(self.alloc, chk.get("port", "")) or reg.port
+        timeout = float(chk.get("timeout_s", 2))
+        if chk.get("type") == "http":
+            import urllib.request
+
+            url = (f"http://{reg.address}:{port}"
+                   f"{chk.get('path') or '/'}")
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    return 200 <= resp.status < 300
+            except Exception:  # noqa: BLE001 — any failure is critical
+                return False
+        # default: tcp connect (Consul's TCP check)
+        try:
+            with socket.create_connection((reg.address, port),
+                                          timeout=timeout):
+                return True
+        except OSError:
+            return False

@@ -69,9 +69,11 @@ class TaskRunner:
                  recover_state: Optional[dict] = None,
                  driver_manager=None,
                  update_period: float = 0.0,
-                 volume_paths: Optional[Dict[str, str]] = None) -> None:
+                 volume_paths: Optional[Dict[str, str]] = None,
+                 conn=None) -> None:
         self.alloc = alloc
         self.task = task
+        self.conn = conn  # server RPC for the secrets hook
         self.task_dir = task_dir
         self.logs_dir = logs_dir
         self.node = node
@@ -89,6 +91,9 @@ class TaskRunner:
             driver_manager.dispense(task.driver) if driver_manager
             else new_driver(task.driver))
         self.restart_tracker = RestartTracker(self._restart_policy())
+        #: NOMAD_SECRET_* env derived by the secrets hook; merged into the
+        #: task env and template interpolation scope
+        self._secret_env: Dict[str, str] = {}
         self.logmon: Optional[LogMon] = None
         self.handle = None
         self._kill = threading.Event()
@@ -247,6 +252,18 @@ class TaskRunner:
         if not self.recover_state:
             for art in self.task.artifacts:
                 fetch_artifact(art, self.task_dir)
+        # secrets hook (the vault_hook.go analog): a missing path fails
+        # task setup — launching without credentials the spec demands is
+        # worse than failing visibly. A RECOVERED task is already running
+        # with its env; a fetch failure here must not kill it (the
+        # reference marks the hook done in persisted state) — the next
+        # driver (re)start re-runs the fetch via _task_config and fails
+        # visibly then.
+        try:
+            self._ensure_secrets()
+        except Exception:
+            if not self.recover_state:
+                raise
         # dispatch_payload hook (taskrunner/dispatch_hook.go): a
         # dispatched job's payload is written into local/<file> before
         # the first start
@@ -301,6 +318,7 @@ class TaskRunner:
             tenv = build_env(self.alloc, self.task, self.node,
                              task_dir=self.task_dir,
                              shared_dir=f"{self.task_dir}/alloc")
+            tenv.update(self._secret_env)
             for tmpl in self.task.templates:
                 content = tmpl.embedded_tmpl
                 if not content and tmpl.source_path:
@@ -322,12 +340,49 @@ class TaskRunner:
                 with open(dest, "w") as f:
                     f.write(interpolate(content, tenv, self.node))
 
+    def _ensure_secrets(self) -> None:
+        """Fetch each declared KV path from the built-in engine and
+        materialize it under secrets/<path>.json (0600) + NOMAD_SECRET_*
+        env. Idempotent; re-fetches only while the env is unpopulated."""
+        if not self.task.secrets or self._secret_env:
+            return
+        import json as _json
+        import os
+
+        if self.conn is None:
+            raise RuntimeError(
+                f"task {self.task.name}: secrets declared but the "
+                "client has no server connection")
+        sdir = os.path.join(self.task_dir, "secrets")
+        env: Dict[str, str] = {}
+        for path in self.task.secrets:
+            entry = self.conn.secret_get(self.alloc.namespace, path)
+            if entry is None:
+                raise RuntimeError(
+                    f"task {self.task.name}: secret {path!r} not "
+                    f"found in namespace {self.alloc.namespace!r}")
+            dest = os.path.normpath(
+                os.path.join(sdir, path.replace("/", "_") + ".json"))
+            fd = os.open(dest, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "w") as f:
+                _json.dump(entry.data, f)
+            slug = path.upper().replace("/", "_").replace("-", "_")
+            for k, v in entry.data.items():
+                env[f"NOMAD_SECRET_{slug}_"
+                    f"{k.upper().replace('-', '_')}"] = str(v)
+        self._secret_env = env
+
     def _task_config(self) -> TaskConfig:
+        # a recovered task that restarts needs its secrets back (the
+        # prestart fetch may have been skipped or failed mid-recovery)
+        self._ensure_secrets()
         env = build_env(
             self.alloc, self.task, self.node,
             task_dir=self.task_dir,
             shared_dir=f"{self.task_dir}/alloc",
         )
+        env.update(self._secret_env)
         raw = interpolate_config(dict(self.task.config), env, self.node)
         return TaskConfig(
             id=f"{self.alloc.id}/{self.task.name}",

@@ -224,6 +224,11 @@ def _parse_task(name: str, body: Dict[str, Any]) -> Task:
         dp = _one(body["dispatch_payload"])
         task.dispatch_payload = DispatchPayloadConfig(
             file=dp.get("file", ""))
+    if "secrets" in body:
+        # built-in secrets engine (the vault{} stanza analog,
+        # jobspec/parse_task.go parseVault)
+        sc = _one(body["secrets"])
+        task.secrets = [str(p) for p in sc.get("paths", [])]
     if "logs" in body:
         lg = _one(body["logs"])
         task.log_config = LogConfig(
@@ -302,11 +307,25 @@ def _parse_network(body: Dict[str, Any]) -> NetworkResource:
 
 
 def _parse_service(body: Dict[str, Any]) -> Service:
+    # jobspec/parse_service.go parseChecks: check{} blocks become the
+    # client-side health probes behind registration status
+    checks = []
+    for c in _many(body.get("check")):
+        cb = _one(c)
+        checks.append({
+            "name": cb.get("name", ""),
+            "type": cb.get("type", "tcp"),
+            "path": cb.get("path", ""),
+            "port": str(cb.get("port", "")),
+            "interval_s": _seconds(cb.get("interval", 10)),
+            "timeout_s": _seconds(cb.get("timeout", 2)),
+        })
     return Service(
         name=body.get("name", ""),
         port_label=str(body.get("port", "")),
         tags=list(body.get("tags", [])),
         address_mode=body.get("address_mode", "auto"),
+        checks=checks,
     )
 
 

@@ -386,7 +386,9 @@ class RaftNode:
             last_term = self.log.term_at(last_idx)
         votes = {self.id}
         vote_lock = threading.Lock()
-        majority = len(self.peers) // 2 + 1
+        with self._lock:
+            peers = list(self.peers.items())
+        majority = len(peers) // 2 + 1
         done = threading.Event()
 
         def ask(peer_id: str, addr) -> None:
@@ -409,7 +411,7 @@ class RaftNode:
                     done.set()
 
         threads = []
-        for pid, addr in self.peers.items():
+        for pid, addr in peers:
             if pid == self.id:
                 continue
             t = threading.Thread(target=ask, args=(pid, addr), daemon=True)
@@ -444,7 +446,11 @@ class RaftNode:
     # ---- replication ----
 
     def _replicate_all(self) -> None:
-        for pid, addr in self.peers.items():
+        with self._lock:
+            # snapshot: committed config changes mutate self.peers from
+            # the applier thread
+            peers = list(self.peers.items())
+        for pid, addr in peers:
             if pid != self.id:
                 threading.Thread(target=self._replicate_one,
                                  args=(pid, addr), daemon=True).start()

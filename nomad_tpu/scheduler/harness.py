@@ -260,6 +260,88 @@ class InMemState:
     def set_scheduler_config(self, config: SchedulerConfiguration) -> None:
         self._config = config
 
+    # ---- service registrations (built-in service discovery; the
+    # reference's Consul catalog analog — structs/service.py) ----
+
+    @property
+    def _services(self):
+        tbl = getattr(self, "_service_regs", None)
+        if tbl is None:
+            tbl = self._service_regs = {}
+        return tbl
+
+    def upsert_service_registrations(self, regs) -> None:
+        import dataclasses as _dc
+
+        for reg in regs:
+            # store a copy: in-proc callers keep mutating their object
+            # (the check runner flips status in place) — shared storage
+            # would change state without an index bump
+            reg = _dc.replace(reg, tags=list(reg.tags))
+            prev = self._services.get(reg.id)
+            if prev is not None and (
+                    prev.service_name, prev.namespace, prev.node_id,
+                    prev.job_id, prev.alloc_id, prev.task_name, prev.tags,
+                    prev.address, prev.port, prev.status) == (
+                    reg.service_name, reg.namespace, reg.node_id,
+                    reg.job_id, reg.alloc_id, reg.task_name, reg.tags,
+                    reg.address, reg.port, reg.status):
+                continue  # anti-entropy re-assert: unchanged, no index
+            reg.modify_index = next(self.index)
+            reg.create_index = (prev.create_index if prev
+                                else reg.modify_index)
+            self._services[reg.id] = reg
+
+    def delete_service_registrations_by_alloc(self, alloc_id: str) -> None:
+        gone = [rid for rid, r in self._services.items()
+                if r.alloc_id == alloc_id]
+        for rid in gone:
+            del self._services[rid]
+        if gone:
+            next(self.index)
+
+    def service_registrations(self, namespace=None) -> List[object]:
+        return [r for r in self._services.values()
+                if namespace is None or r.namespace == namespace]
+
+    def services_by_name(self, namespace: str, name: str) -> List[object]:
+        return [r for r in self._services.values()
+                if r.namespace == namespace and r.service_name == name]
+
+    # ---- secrets KV (built-in Vault analog; structs/secrets.py) ----
+
+    @property
+    def _secrets(self):
+        tbl = getattr(self, "_secret_entries", None)
+        if tbl is None:
+            tbl = self._secret_entries = {}
+        return tbl
+
+    def upsert_secret(self, entry) -> None:
+        key = (entry.namespace, entry.path)
+        prev = self._secrets.get(key)
+        entry.modify_index = next(self.index)
+        entry.create_index = (prev.create_index if prev
+                              else entry.modify_index)
+        entry.version = (prev.version + 1) if prev else 1
+        self._secrets[key] = entry
+
+    def delete_secret(self, namespace: str, path: str) -> None:
+        if self._secrets.pop((namespace, path), None) is not None:
+            next(self.index)
+
+    def secret_get(self, namespace: str, path: str):
+        return self._secrets.get((namespace, path))
+
+    def secrets_list(self, namespace: str) -> List[object]:
+        return sorted((e for e in self._secrets.values()
+                       if e.namespace == namespace),
+                      key=lambda e: e.path)
+
+    def secret_entries(self) -> List[object]:
+        """All entries, every namespace (snapshot encode)."""
+        return list(self._secrets.values())
+
     def autopilot_config(self):
         cfg = getattr(self, "_autopilot_cfg", None)
         if cfg is None:

@@ -15,6 +15,7 @@ quorum; autopilot.go pruneDeadServers' canRemoveServers check).
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List
 
@@ -22,16 +23,53 @@ from .gossip import STATUS_ALIVE, STATUS_FAILED, STATUS_LEFT, Member
 
 
 class Autopilot:
+    #: leader reconcile cadence (autopilot.go runs its loop each
+    #: ServerHealthInterval)
+    RECONCILE_INTERVAL = 2.0
+
     def __init__(self, cluster) -> None:
         self.cluster = cluster
         #: per-server first-seen-healthy stamps (stabilization window)
         self._healthy_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ---- leader reconcile loop (autopilot.go promote/prune loop) ----
+    # Event-driven cleanup alone misses the crashed EX-LEADER: the
+    # survivors see the gossip failure while no one is leader yet, drop
+    # the event, and gossip never re-fires for an already-failed member.
+    # The new leader's periodic sweep is what prunes it.
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="autopilot", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.RECONCILE_INTERVAL):
+            if not self.cluster.is_leader():
+                continue
+            self.reconcile()
+
+    def reconcile(self) -> None:
+        for m in self.cluster.membership.members():
+            if m.status in (STATUS_FAILED, STATUS_LEFT):
+                self._maybe_prune(m)
 
     # ---- gossip event hook ----
 
     def member_change(self, member: Member) -> None:
         if member.status not in (STATUS_FAILED, STATUS_LEFT):
             return
+        self._maybe_prune(member)
+
+    def _maybe_prune(self, member: Member) -> None:
         cl = self.cluster
         if not cl.is_leader():
             return

@@ -174,6 +174,8 @@ FORWARDED = (
     "node_update_drain", "node_update_eligibility", "node_heartbeat",
     "node_update_allocs", "node_get_client_allocs", "alloc_get", "run_gc",
     "csi_volume_claim", "csi_volume_get",
+    "update_service_registrations", "remove_service_registrations",
+    "secret_upsert", "secret_delete", "secret_get",
 )
 
 
@@ -249,6 +251,7 @@ class ClusterServer:
 
     def shutdown(self) -> None:
         self.membership.leave()
+        self.autopilot.stop()
         with self._leader_lock:
             if self._leader_enabled:
                 self._leader_enabled = False
@@ -277,8 +280,10 @@ class ClusterServer:
                 self._leader_enabled = True
                 self._server_used = True
                 self.server.start()
+                self.autopilot.start()
             elif not is_leader and self._leader_enabled:
                 self._leader_enabled = False
+                self.autopilot.stop()
                 self.server.shutdown()
 
     def is_leader(self) -> bool:

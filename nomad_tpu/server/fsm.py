@@ -29,6 +29,9 @@ ALLOWED_OPS = frozenset({
     "upsert_acl_token", "delete_acl_token", "acl_bootstrap",
     "upsert_csi_volume", "delete_csi_volume",
     "csi_volume_claim", "csi_volume_release",
+    "upsert_service_registrations",
+    "delete_service_registrations_by_alloc",
+    "upsert_secret", "delete_secret",
 })
 
 
@@ -99,6 +102,9 @@ def snapshot_state(state) -> Dict[str, Any]:
         "scheduler_config": to_wire(state.scheduler_config()),
         "autopilot_config": to_wire(state.autopilot_config()),
         "csi_volumes": [to_wire(v) for v in state.csi_volumes()],
+        "service_regs": [to_wire(r)
+                         for r in state.service_registrations()],
+        "secrets": [to_wire(e) for e in state.secret_entries()],
         "acl": {
             "bootstrapped": state.acl.bootstrapped,
             "policies": [to_wire(p) for p in state.acl.policies()],
@@ -140,6 +146,16 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
         state.set_autopilot_config(from_wire(ap))
     for tree in snap.get("csi_volumes", []):
         _upsert_preserving_indexes(state.upsert_csi_volume, from_wire(tree))
+    for tree in snap.get("service_regs", []):
+        reg = from_wire(tree)
+        ci, mi = reg.create_index, reg.modify_index
+        state.upsert_service_registrations([reg])
+        reg.create_index, reg.modify_index = ci, mi
+    for tree in snap.get("secrets", []):
+        e = from_wire(tree)
+        ci, mi, ver = e.create_index, e.modify_index, e.version
+        state.upsert_secret(e)
+        e.create_index, e.modify_index, e.version = ci, mi, ver
     acl = snap.get("acl")
     if acl is not None:
         for tree in acl.get("policies", []):

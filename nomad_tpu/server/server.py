@@ -462,6 +462,37 @@ class Server:
         """Alloc fetch for the client pull loop (alloc_endpoint.go GetAlloc)."""
         return self.state.alloc_by_id(alloc_id)
 
+    # ---- service registrations (built-in service discovery; the
+    # reference's Consul service sync — nomad/consul.go — replaced by
+    # state-store-native registrations pushed over the RPC fabric) ----
+
+    def update_service_registrations(self, regs) -> None:
+        self.state.upsert_service_registrations(regs)
+        for r in regs:
+            self._publish("Service", "ServiceRegistered", r.id,
+                          r.namespace)
+
+    def remove_service_registrations(self, alloc_id: str) -> None:
+        self.state.delete_service_registrations_by_alloc(alloc_id)
+
+    # ---- secrets KV (the Vault-analog engine; nomad/vault.go's role
+    # collapsed into replicated state — see structs/secrets.py) ----
+
+    def secret_upsert(self, entry) -> None:
+        if not entry.path or entry.path.startswith("/") \
+                or ".." in entry.path.split("/"):
+            raise ValueError(f"invalid secret path {entry.path!r}")
+        self.state.upsert_secret(entry)
+
+    def secret_delete(self, namespace: str, path: str) -> None:
+        self.state.delete_secret(namespace, path)
+
+    def secret_get(self, namespace: str, path: str):
+        return self.state.secret_get(namespace, path)
+
+    def secrets_list(self, namespace: str):
+        return self.state.secrets_list(namespace)
+
     def node_update_allocs(self, updates: List[Allocation]) -> None:
         """Client pushes alloc status (node_endpoint.go:1013 UpdateAlloc):
         merge; terminal allocs free capacity (unblock) and failed allocs

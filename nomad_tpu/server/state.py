@@ -143,6 +143,16 @@ class StateStore(InMemState):
     deployments = _locked("deployments")
     latest_stable_job = _locked("latest_stable_job")
     mark_job_stable = _locked("mark_job_stable")
+    upsert_service_registrations = _locked("upsert_service_registrations")
+    delete_service_registrations_by_alloc = _locked(
+        "delete_service_registrations_by_alloc")
+    service_registrations = _locked("service_registrations")
+    services_by_name = _locked("services_by_name")
+    upsert_secret = _locked("upsert_secret")
+    delete_secret = _locked("delete_secret")
+    secret_get = _locked("secret_get")
+    secrets_list = _locked("secrets_list")
+    secret_entries = _locked("secret_entries")
     del _locked
 
     def delete_alloc(self, alloc_id: str) -> None:
@@ -151,6 +161,11 @@ class StateStore(InMemState):
         with self._cv:
             a = self._allocs.pop(alloc_id, None)
             if a is None:
+                # still sweep the catalog: registrations must never
+                # outlive their alloc, even across delete races
+                InMemState.delete_service_registrations_by_alloc(
+                    self, alloc_id)
+                self._cv.notify_all()
                 return
             jk = (a.namespace, a.job_id)
             by_job = dict(self._allocs_by_job.get(jk, {}))
@@ -160,6 +175,10 @@ class StateStore(InMemState):
             by_node.pop(alloc_id, None)
             self._allocs_by_node[a.node_id] = by_node
             self.cluster.remove_alloc(alloc_id, a.job_id)
+            # a GC'd alloc takes its service registrations with it (the
+            # safety net behind the client's own deregistration)
+            InMemState.delete_service_registrations_by_alloc(
+                self, alloc_id)
             self._cv.notify_all()
 
     def update_alloc_from_client(self, update: Allocation) -> Optional[Allocation]:

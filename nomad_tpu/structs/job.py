@@ -238,6 +238,10 @@ class Task:
     kill_signal: str = ""
     meta: Dict[str, str] = field(default_factory=dict)
     dispatch_payload: Optional[DispatchPayloadConfig] = None
+    #: KV paths the task needs from the built-in secrets engine (the
+    #: reference's vault{policies=[...]} stanza, structs.go:6972, bound
+    #: to the Vault analog in structs/secrets.py)
+    secrets: List[str] = field(default_factory=list)
 
 
 @dataclass

@@ -1,0 +1,27 @@
+"""Secrets — the built-in KV secrets engine (Vault analog).
+
+Behavioral reference: the reference integrates HashiCorp Vault
+(`nomad/vault.go` derives per-task tokens; `client/allocrunner/
+taskrunner/vault_hook.go` renews them and feeds templates). This build
+replaces the external dependency with a namespaced KV store replicated
+through the same WAL/Raft machinery as the rest of the state — the task
+surface stays: a task declares the paths it needs, the client materials
+them into the task's secrets dir and env before start
+(client/task_runner.py secrets hook).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class SecretEntry:
+    """One KV node at `path` (Vault KV-v1 shape: flat string map)."""
+
+    namespace: str = "default"
+    path: str = ""
+    data: Dict[str, str] = field(default_factory=dict)
+    version: int = 0
+    create_index: int = 0
+    modify_index: int = 0

@@ -1,0 +1,40 @@
+"""Service registrations — built-in service discovery.
+
+Behavioral reference: the reference delegates service registration to
+Consul (`nomad/consul.go`, `command/agent/consul/service_client.go`:
+services + checks from the jobspec `service{}` stanzas are registered
+against the local Consul agent and discovered through Consul's catalog).
+This build keeps the same jobspec surface (structs.Service,
+structs.go:5244) but stores registrations natively in the state store —
+the design Nomad itself later shipped as "native service discovery"
+(`nomad/structs/service_registration.go`): no external catalog binding,
+clients push registrations over the RPC fabric, consumers read
+`/v1/services`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class ServiceRegistration:
+    """One service instance bound to an alloc (reference
+    `structs.ServiceRegistration`)."""
+
+    id: str = ""  # "_nomad-task-<alloc>-<task>-<service>"
+    service_name: str = ""
+    namespace: str = "default"
+    node_id: str = ""
+    job_id: str = ""
+    alloc_id: str = ""
+    task_name: str = ""  # "" for group-level services
+    datacenter: str = ""
+    tags: List[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    #: health from the client-side check runner: "passing" | "critical"
+    #: (Consul check semantics; no checks → stays "passing")
+    status: str = "passing"
+    create_index: int = 0
+    modify_index: int = 0

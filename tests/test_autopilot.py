@@ -127,6 +127,25 @@ class TestAutopilot:
         leader.call("node_register", node)
         assert leader.state.node_by_id(node.id) is not None
 
+    def test_dead_leader_pruned_by_new_leader(self, cluster):
+        """When the LEADER crashes, the failure event fires while no one
+        is leader — the new leader's periodic reconcile must prune the
+        ex-leader (event-driven cleanup alone would drop it forever)."""
+        assert _wait(lambda: leader_of(cluster) is not None)
+        assert _wait(lambda: all(
+            len(a.membership.members()) == 3 for a in cluster))
+        old = leader_of(cluster)
+        survivors = [a for a in cluster if a is not old]
+        old.raft.shutdown()
+        old.rpc.shutdown()
+        old.membership.stop()
+        assert _wait(lambda: leader_of(survivors) is not None,
+                     timeout=30.0), "no new leader"
+        new_leader = leader_of(survivors)
+        assert _wait(lambda: old.config.node_id
+                     not in new_leader.raft.peers, timeout=30.0), \
+            "ex-leader not pruned"
+
     def test_cleanup_disabled_keeps_peer(self, cluster):
         from nomad_tpu.structs.operator import AutopilotConfig
 
