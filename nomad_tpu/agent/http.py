@@ -53,9 +53,28 @@ class HTTPApi:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _respond_html(self, code: int, html: str) -> None:
+                body = html.encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _handle(self, method: str) -> None:
                 try:
                     parsed = urlparse(self.path)
+                    # web console (ui/ in the reference; served from the
+                    # agent at /ui like command/agent/http.go UIServer)
+                    if method == "GET" and (
+                            parsed.path == "/"
+                            or parsed.path == "/ui"
+                            or parsed.path.startswith("/ui/")):
+                        from .ui import INDEX_HTML
+
+                        self._respond_html(200, INDEX_HTML)
+                        return
                     query = {k: v[0] for k, v in
                              parse_qs(parsed.query).items()}
                     length = int(self.headers.get("Content-Length") or 0)
@@ -779,7 +798,8 @@ class HTTPApi:
                 # live gossip view: status + incarnation per member
                 return {"members": [
                     {"name": m.name, "addr": list(m.addr),
-                     "status": m.status, "incarnation": m.incarnation}
+                     "status": m.status, "incarnation": m.incarnation,
+                     "tags": dict(m.tags)}
                     for m in cluster.membership.members()]}
             peers = cluster.peers if cluster is not None else {}
             return {"members": [{"name": pid, "addr": list(addr),
@@ -942,6 +962,57 @@ class HTTPApi:
             require_ns("list-scaling-policies")
             return [to_wire(p) for p in server.scaling_policies(
                 None if ns_for_acl == "*" else ns_for_acl)]
+        # /v1/namespaces + /v1/namespace[/<name>] (namespace_endpoint.go;
+        # writes are management-token-only like the reference)
+        if parts == ["namespaces"]:
+            return blocking(lambda snap: (
+                snap.index_at,
+                [to_wire(n) for n in snap.namespaces()
+                 if acl.management
+                 or acl.allow_namespace_operation(n.name, "read-job")]))
+        if parts and parts[0] == "namespace":
+            if parts[1:] == [] and method in ("PUT", "POST"):
+                require(acl.management)
+                from ..structs.operator import Namespace
+
+                if isinstance(body, dict) and "__t" in body:
+                    try:
+                        nsobj = from_wire(body)
+                    except Exception as e:  # unknown tag / bad shape
+                        raise HttpError(400, f"bad namespace body: {e}")
+                    if not isinstance(nsobj, Namespace):
+                        raise HttpError(
+                            400, f"expected Namespace, got "
+                            f"{type(nsobj).__name__}")
+                else:
+                    nsobj = Namespace(
+                        name=str((body or {}).get("Name", "")),
+                        description=str((body or {}).get(
+                            "Description", "")),
+                        meta=dict((body or {}).get("Meta") or {}))
+                try:
+                    server.namespace_upsert(nsobj)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"updated": True}
+            if len(parts) == 2:
+                name = parts[1]
+                if method == "GET":
+                    require(acl.management
+                            or acl.allow_namespace_operation(
+                                name, "read-job"))
+                    nsobj = state.namespace_by_name(name)
+                    if nsobj is None:
+                        raise HttpError(404,
+                                        f"namespace {name!r} not found")
+                    return to_wire(nsobj)
+                if method == "DELETE":
+                    require(acl.management)
+                    try:
+                        server.namespace_delete(name)
+                    except ValueError as e:
+                        raise HttpError(400, str(e))
+                    return {"deleted": True}
         # /v1/secrets + /v1/secret/<path...> — built-in KV secrets engine
         # (the Vault analog; structs/secrets.py). Values only flow to
         # tokens holding the secrets capabilities.
@@ -959,7 +1030,7 @@ class HTTPApi:
                 snap.index_at,
                 [{"path": e.path, "version": e.version,
                   "keys": sorted(e.data)}
-                 for e in state.secrets_list(ns)]))
+                 for e in snap.secrets_list(ns)]))
         if parts and parts[0] == "secret" and len(parts) >= 2:
             spath = "/".join(parts[1:])
             if method == "GET":
@@ -994,14 +1065,14 @@ class HTTPApi:
             require_ns("read-job")
             return blocking(lambda snap: (
                 snap.index_at,
-                self._service_index(state, ns, ns_visible)))
+                self._service_index(snap, ns, ns_visible)))
         if parts and parts[0] == "service" and len(parts) >= 2:
             require_ns("read-job")
             if method == "GET":
                 return blocking(lambda snap: (
                     snap.index_at,
                     [to_wire(r) for r
-                     in state.services_by_name(ns, parts[1])]))
+                     in snap.services_by_name(ns, parts[1])]))
         if parts == ["search"] and method == "PUT":
             b = body or {}
             # per-context results are namespace-scoped reads

@@ -375,6 +375,24 @@ class NomadClient:
                             params={"namespace": namespace})
         return [from_wire(r) for r in self._unblock(res)[1]]
 
+    # ---- namespaces (api/namespace.go) ----
+
+    def namespaces(self) -> List[Any]:
+        res = self._request("GET", "/v1/namespaces")
+        return [from_wire(n) for n in self._unblock(res)[1]]
+
+    def namespace(self, name: str):
+        return from_wire(self._request("GET", f"/v1/namespace/{name}"))
+
+    def namespace_apply(self, name: str, description: str = "",
+                        meta: Optional[Dict[str, str]] = None) -> None:
+        self._request("PUT", "/v1/namespace",
+                      body={"Name": name, "Description": description,
+                            "Meta": dict(meta or {})})
+
+    def namespace_delete(self, name: str) -> None:
+        self._request("DELETE", f"/v1/namespace/{name}")
+
     # ---- secrets (built-in KV engine) ----
 
     def secrets_list(self, namespace: str = "default") -> List[dict]:

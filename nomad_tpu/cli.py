@@ -499,6 +499,31 @@ def cmd_deployment_fail(args) -> int:
 
 # ---- operator / misc ----
 
+def cmd_namespace(args) -> int:
+    """`nomad-tpu namespace list|apply|delete|status`
+    (command/namespace_*.go)."""
+    api = _client(args)
+    if args.sub == "list":
+        print(_columns(
+            [[n.name, n.description or "<none>"]
+             for n in api.namespaces()],
+            ["Name", "Description"]))
+        return 0
+    if args.sub == "apply":
+        api.namespace_apply(args.name,
+                            description=args.description or "")
+        print(f"Successfully applied namespace {args.name!r}")
+        return 0
+    if args.sub == "delete":
+        api.namespace_delete(args.name)
+        print(f"Successfully deleted namespace {args.name!r}")
+        return 0
+    n = api.namespace(args.name)
+    print(f"Name        = {n.name}")
+    print(f"Description = {n.description or '<none>'}")
+    return 0
+
+
 def cmd_secret(args) -> int:
     """`nomad-tpu secret put|get|list|delete` — built-in KV engine."""
     api = _client(args)
@@ -730,6 +755,22 @@ def build_parser() -> argparse.ArgumentParser:
         dest="sub", required=True)
     rgl = rg.add_parser("list")
     rgl.set_defaults(fn=cmd_regions_list)
+
+    nsp = sub.add_parser("namespace",
+                         help="namespace commands").add_subparsers(
+        dest="sub", required=True)
+    nsl = nsp.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace)
+    nsa = nsp.add_parser("apply")
+    nsa.add_argument("name")
+    nsa.add_argument("-description", default="")
+    nsa.set_defaults(fn=cmd_namespace)
+    nsd = nsp.add_parser("delete")
+    nsd.add_argument("name")
+    nsd.set_defaults(fn=cmd_namespace)
+    nst = nsp.add_parser("status")
+    nst.add_argument("name")
+    nst.set_defaults(fn=cmd_namespace)
 
     sec = sub.add_parser("secret",
                          help="built-in KV secrets").add_subparsers(

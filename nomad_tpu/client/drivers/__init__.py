@@ -19,6 +19,7 @@ from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
 from .docker import DockerDriver
 from .executor_driver import (ExecDriver, ExecutorBackedDriver,
                               RawExecDriver)
+from .java_qemu import JavaDriver, QemuDriver
 from .mock import MockDriver
 
 #: reference BuiltinDrivers catalog (java/qemu register when their
@@ -28,6 +29,8 @@ BUILTIN_DRIVERS: Dict[str, Type[DriverPlugin]] = {
     "raw_exec": RawExecDriver,
     "exec": ExecDriver,
     "docker": DockerDriver,
+    "java": JavaDriver,
+    "qemu": QemuDriver,
 }
 
 
@@ -39,5 +42,6 @@ def new_driver(name: str) -> DriverPlugin:
 
 
 __all__ = ["BUILTIN_DRIVERS", "DockerDriver", "DriverPlugin", "ExecDriver",
-           "ExecutorBackedDriver", "ExitResult", "MockDriver",
-           "RawExecDriver", "TaskConfig", "TaskHandle", "new_driver"]
+           "ExecutorBackedDriver", "ExitResult", "JavaDriver", "MockDriver",
+           "QemuDriver", "RawExecDriver", "TaskConfig", "TaskHandle",
+           "new_driver"]

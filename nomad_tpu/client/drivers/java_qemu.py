@@ -1,0 +1,117 @@
+"""Java and QEMU drivers — executor-backed runtime wrappers.
+
+Behavioral reference: `drivers/java/driver.go` (jar/class launch under
+the shared executor, JVM fingerprint from `java -version`) and
+`drivers/qemu/driver.go` (VM image boot via qemu-system-*, memory wired
+from task resources, graceful shutdown via the monitor socket — here
+SIGTERM through the executor, matching qemu's default signal handling).
+Both inherit the out-of-process executor lifecycle (launch/reattach/
+recover) from ExecutorBackedDriver.
+"""
+from __future__ import annotations
+
+import copy
+import shutil
+import subprocess
+from typing import Dict
+
+from .base import TaskConfig
+from .executor_driver import ExecutorBackedDriver
+
+
+class JavaDriver(ExecutorBackedDriver):
+    """drivers/java/driver.go — `java -jar`/`-cp` under the executor."""
+
+    name = "java"
+
+    def fingerprint(self) -> Dict[str, str]:
+        java = shutil.which("java")
+        if not java:
+            return {}
+        try:
+            r = subprocess.run([java, "-version"], capture_output=True,
+                               timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            return {}
+        if r.returncode != 0:
+            return {}
+        # `java -version` prints to stderr: first line like
+        # openjdk version "17.0.2" ...
+        first = (r.stderr or r.stdout).decode().splitlines()[:1]
+        version = ""
+        if first:
+            import re
+
+            m = re.search(r'"([^"]+)"', first[0])
+            version = m.group(1) if m else first[0].strip()
+        return {"driver.java": "1", "driver.java.version": version}
+
+    def _launch_spec(self, cfg: TaskConfig) -> Dict[str, object]:
+        rc = cfg.raw_config
+        jar, cls = rc.get("jar_path"), rc.get("class")
+        if not jar and not cls:
+            raise ValueError("java driver needs config.jar_path or "
+                             "config.class")
+        args = [str(o) for o in rc.get("jvm_options", [])]
+        # JVM heap from the task's memory resource unless the user set it
+        if cfg.memory_mb and not any(
+                str(o).startswith("-Xmx") for o in args):
+            args.append(f"-Xmx{int(cfg.memory_mb)}m")
+        if jar:
+            args += ["-jar", str(jar)]
+        else:
+            cp = rc.get("class_path")
+            if cp:
+                args += ["-cp", str(cp)]
+            args.append(str(cls))
+        args += [str(a) for a in rc.get("args", [])]
+        c2 = copy.copy(cfg)
+        c2.raw_config = {**rc, "command": shutil.which("java") or "java",
+                         "args": args}
+        return super()._launch_spec(c2)
+
+
+class QemuDriver(ExecutorBackedDriver):
+    """drivers/qemu/driver.go — boots a VM image; memory from the task's
+    resources; extra args pass through."""
+
+    name = "qemu"
+
+    BINARY = "qemu-system-x86_64"
+
+    def fingerprint(self) -> Dict[str, str]:
+        binary = shutil.which(self.BINARY)
+        if not binary:
+            return {}
+        try:
+            r = subprocess.run([binary, "--version"], capture_output=True,
+                               timeout=10.0)
+        except (OSError, subprocess.TimeoutExpired):
+            return {}
+        if r.returncode != 0:
+            return {}
+        out = r.stdout.decode().strip().splitlines()[:1]
+        version = out[0].rsplit("version", 1)[-1].strip() if out else ""
+        return {"driver.qemu": "1", "driver.qemu.version": version}
+
+    def _launch_spec(self, cfg: TaskConfig) -> Dict[str, object]:
+        rc = cfg.raw_config
+        image = rc.get("image_path")
+        if not image:
+            raise ValueError("qemu driver needs config.image_path")
+        accel = rc.get("accelerator", "tcg")
+        mem = int(cfg.memory_mb or 512)
+        args = [
+            "-machine", f"type=pc,accel={accel}",
+            "-m", f"{mem}M",
+            "-drive", f"file={image}",
+            "-nographic",
+        ]
+        args += [str(a) for a in rc.get("args", [])]
+        c2 = copy.copy(cfg)
+        c2.raw_config = {
+            **rc,
+            "command": shutil.which(self.BINARY) or self.BINARY,
+            "args": args,
+        }
+        return super()._launch_spec(c2)

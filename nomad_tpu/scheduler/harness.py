@@ -342,6 +342,40 @@ class InMemState:
         """All entries, every namespace (snapshot encode)."""
         return list(self._secrets.values())
 
+    # ---- namespaces (structs/operator.py Namespace) ----
+
+    @property
+    def _namespaces(self):
+        tbl = getattr(self, "_namespace_rows", None)
+        if tbl is None:
+            from ..structs.operator import Namespace
+
+            tbl = self._namespace_rows = {
+                "default": Namespace(name="default",
+                                     description="Default shared namespace")}
+        return tbl
+
+    def upsert_namespace(self, ns) -> None:
+        prev = self._namespaces.get(ns.name)
+        ns.modify_index = next(self.index)
+        ns.create_index = prev.create_index if prev else ns.modify_index
+        self._namespaces[ns.name] = ns
+
+    def delete_namespace(self, name: str) -> None:
+        if self._namespaces.pop(name, None) is not None:
+            # cascade the namespace's KV secrets in the SAME log entry:
+            # leftovers would silently re-attach to a future namespace of
+            # the same name (a cross-tenant leak)
+            for key in [k for k in self._secrets if k[0] == name]:
+                del self._secrets[key]
+            next(self.index)
+
+    def namespaces(self) -> List[object]:
+        return sorted(self._namespaces.values(), key=lambda n: n.name)
+
+    def namespace_by_name(self, name: str):
+        return self._namespaces.get(name)
+
     def autopilot_config(self):
         cfg = getattr(self, "_autopilot_cfg", None)
         if cfg is None:

@@ -32,6 +32,7 @@ ALLOWED_OPS = frozenset({
     "upsert_service_registrations",
     "delete_service_registrations_by_alloc",
     "upsert_secret", "delete_secret",
+    "upsert_namespace", "delete_namespace",
 })
 
 
@@ -105,6 +106,7 @@ def snapshot_state(state) -> Dict[str, Any]:
         "service_regs": [to_wire(r)
                          for r in state.service_registrations()],
         "secrets": [to_wire(e) for e in state.secret_entries()],
+        "namespaces": [to_wire(n) for n in state.namespaces()],
         "acl": {
             "bootstrapped": state.acl.bootstrapped,
             "policies": [to_wire(p) for p in state.acl.policies()],
@@ -156,6 +158,9 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
         ci, mi, ver = e.create_index, e.modify_index, e.version
         state.upsert_secret(e)
         e.create_index, e.modify_index, e.version = ci, mi, ver
+    for tree in snap.get("namespaces", []):
+        _upsert_preserving_indexes(state.upsert_namespace,
+                                   from_wire(tree))
     acl = snap.get("acl")
     if acl is not None:
         for tree in acl.get("policies", []):

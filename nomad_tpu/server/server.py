@@ -257,6 +257,11 @@ class Server:
         err = job.validate() if hasattr(job, "validate") else None
         if err:
             raise ValueError(err)
+        if self.state.namespace_by_name(job.namespace) is None:
+            # the reference rejects registration into a namespace that
+            # does not exist (job_endpoint.go Register → ns lookup)
+            raise ValueError(
+                f"namespace {job.namespace!r} does not exist")
         if job.is_periodic() and job.periodic.spec_type == "cron":
             # Reject a bad cron spec BEFORE the job reaches state
             # (job_endpoint.go Register → Job.Validate → PeriodicConfig).
@@ -474,6 +479,36 @@ class Server:
 
     def remove_service_registrations(self, alloc_id: str) -> None:
         self.state.delete_service_registrations_by_alloc(alloc_id)
+
+    # ---- namespaces (structs/operator.py Namespace; the reference's
+    # nomad/namespace_endpoint.go, OSS since 1.0) ----
+
+    def namespace_upsert(self, ns) -> None:
+        import re
+
+        if not re.fullmatch(r"[a-zA-Z0-9][a-zA-Z0-9_-]{0,127}", ns.name):
+            raise ValueError(f"invalid namespace name {ns.name!r}")
+        self.state.upsert_namespace(ns)
+
+    def namespace_delete(self, name: str) -> None:
+        if name == "default":
+            raise ValueError("default namespace cannot be deleted")
+        if self.state.namespace_by_name(name) is None:
+            raise ValueError(f"namespace {name!r} not found")
+        in_use = [j.id for j in self.state.jobs()
+                  if j.namespace == name and not j.stop]
+        if in_use:
+            raise ValueError(
+                f"namespace {name!r} has non-terminal jobs: "
+                f"{in_use[:5]}")
+        vols = [v.id for v in self.state.csi_volumes()
+                if v.namespace == name]
+        if vols:
+            raise ValueError(
+                f"namespace {name!r} has CSI volumes: {vols[:5]}")
+        # KV secrets cascade with the delete (state mutator) — they must
+        # not survive to re-attach to a future namespace of this name
+        self.state.delete_namespace(name)
 
     # ---- secrets KV (the Vault-analog engine; nomad/vault.go's role
     # collapsed into replicated state — see structs/secrets.py) ----

@@ -47,6 +47,9 @@ class StateSnapshot(InMemState):
         self._evals = dict(store._evals)
         self._config = store._config
         self._csi_volumes = dict(store._csi)
+        self._namespace_rows = dict(store._namespaces)
+        self._service_regs = dict(store._services)
+        self._secret_entries = dict(store._secrets)
         self._acl_store = store.acl  # shared: snapshots read live tokens
         self.index = store.index
         self.cluster = store.cluster
@@ -153,6 +156,10 @@ class StateStore(InMemState):
     secret_get = _locked("secret_get")
     secrets_list = _locked("secrets_list")
     secret_entries = _locked("secret_entries")
+    upsert_namespace = _locked("upsert_namespace")
+    delete_namespace = _locked("delete_namespace")
+    namespaces = _locked("namespaces")
+    namespace_by_name = _locked("namespace_by_name")
     del _locked
 
     def delete_alloc(self, alloc_id: str) -> None:

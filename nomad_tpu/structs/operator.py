@@ -30,6 +30,23 @@ class AutopilotConfig:
 
 
 @dataclass
+class Namespace:
+    """Job isolation boundary (the reference gained OSS namespaces in
+    1.0 — `nomad/structs/structs.go` Namespace; every job/alloc/eval row
+    here already carries one)."""
+
+    name: str = ""
+    description: str = ""
+    meta: dict = None  # type: ignore[assignment]
+    create_index: int = 0
+    modify_index: int = 0
+
+    def __post_init__(self) -> None:
+        if self.meta is None:
+            self.meta = {}
+
+
+@dataclass
 class RaftServer:
     """Reference `structs.RaftServer` (operator.go:9)."""
 

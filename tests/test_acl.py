@@ -304,6 +304,7 @@ class TestHttpEnforcement:
         mgmt = NomadClient(host, port, token=boot.secret_id)
         j1 = mock.job()
         j2 = mock.job(namespace="prod")
+        mgmt.namespace_apply("prod")
         mgmt.register_job(j1)
         mgmt.register_job(j2)
         # management with ?namespace=* sees both; per-ns sees one

@@ -1,0 +1,120 @@
+"""Java + QEMU drivers (reference: drivers/java/driver.go,
+drivers/qemu/driver.go). Runtimes aren't installed in CI, so fingerprint
+and launch run against fake binaries on PATH; the launch-spec shaping is
+tested directly."""
+import os
+import stat
+
+import pytest
+
+from nomad_tpu.client.drivers import (BUILTIN_DRIVERS, JavaDriver,
+                                      QemuDriver, new_driver)
+from nomad_tpu.client.drivers.base import TaskConfig
+
+
+def _fake_bin(tmp_path, name, script):
+    p = tmp_path / name
+    p.write_text(f"#!/bin/sh\n{script}\n")
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return p
+
+
+@pytest.fixture()
+def fake_path(tmp_path, monkeypatch):
+    _fake_bin(tmp_path, "java",
+              'if [ "$1" = "-version" ]; then\n'
+              '  echo \'openjdk version "17.0.2" 2022-01-18\' >&2\n'
+              '  exit 0\nfi\necho "java-ran $@"')
+    _fake_bin(tmp_path, "qemu-system-x86_64",
+              'if [ "$1" = "--version" ]; then\n'
+              '  echo "QEMU emulator version 6.2.0"\n  exit 0\nfi\n'
+              'echo "qemu-ran $@"')
+    monkeypatch.setenv("PATH",
+                       f"{tmp_path}{os.pathsep}{os.environ['PATH']}")
+    return tmp_path
+
+
+class TestRegistry:
+    def test_drivers_registered(self):
+        assert "java" in BUILTIN_DRIVERS
+        assert "qemu" in BUILTIN_DRIVERS
+        assert isinstance(new_driver("java"), JavaDriver)
+        assert isinstance(new_driver("qemu"), QemuDriver)
+
+
+class TestFingerprint:
+    def test_java_version_detected(self, fake_path):
+        fp = JavaDriver().fingerprint()
+        assert fp["driver.java"] == "1"
+        assert fp["driver.java.version"] == "17.0.2"
+
+    def test_qemu_version_detected(self, fake_path):
+        fp = QemuDriver().fingerprint()
+        assert fp["driver.qemu"] == "1"
+        assert "6.2.0" in fp["driver.qemu.version"]
+
+    def test_absent_runtime_is_silent(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+        assert JavaDriver().fingerprint() == {}
+        assert QemuDriver().fingerprint() == {}
+
+
+class TestLaunchSpec:
+    def test_java_jar_spec(self, fake_path):
+        cfg = TaskConfig(id="a/t", name="t",
+                         raw_config={"jar_path": "/app/app.jar",
+                                     "jvm_options": ["-Xms64m"],
+                                     "args": ["serve"]},
+                         memory_mb=256)
+        spec = JavaDriver()._launch_spec(cfg)
+        assert spec["command"].endswith("java")
+        assert spec["args"] == ["-Xms64m", "-Xmx256m", "-jar",
+                               "/app/app.jar", "serve"]
+
+    def test_java_class_spec_and_user_xmx_kept(self, fake_path):
+        cfg = TaskConfig(id="a/t", name="t",
+                         raw_config={"class": "com.Main",
+                                     "class_path": "/lib/*",
+                                     "jvm_options": ["-Xmx1g"]},
+                         memory_mb=256)
+        spec = JavaDriver()._launch_spec(cfg)
+        assert spec["args"] == ["-Xmx1g", "-cp", "/lib/*", "com.Main"]
+
+    def test_java_requires_jar_or_class(self):
+        with pytest.raises(ValueError, match="jar_path or"):
+            JavaDriver()._launch_spec(
+                TaskConfig(id="a/t", name="t", raw_config={}))
+
+    def test_qemu_spec(self, fake_path):
+        cfg = TaskConfig(id="a/t", name="t",
+                         raw_config={"image_path": "/img/vm.qcow2",
+                                     "accelerator": "kvm",
+                                     "args": ["-snapshot"]},
+                         memory_mb=1024)
+        spec = QemuDriver()._launch_spec(cfg)
+        assert spec["command"].endswith("qemu-system-x86_64")
+        assert spec["args"] == [
+            "-machine", "type=pc,accel=kvm", "-m", "1024M",
+            "-drive", "file=/img/vm.qcow2", "-nographic", "-snapshot"]
+
+    def test_qemu_requires_image(self):
+        with pytest.raises(ValueError, match="image_path"):
+            QemuDriver()._launch_spec(
+                TaskConfig(id="a/t", name="t", raw_config={}))
+
+
+class TestJavaE2E:
+    def test_java_task_runs_under_executor(self, fake_path, tmp_path):
+        """Full executor launch with the fake JVM: the driver's spec runs
+        out-of-process and the exit flows back."""
+        drv = JavaDriver()
+        cfg = TaskConfig(id="alloc1/t", name="t",
+                         task_dir=str(tmp_path / "task"),
+                         raw_config={"jar_path": "/app/app.jar"})
+        os.makedirs(cfg.task_dir, exist_ok=True)
+        handle = drv.start_task(cfg)
+        try:
+            res = drv.wait_task(handle, timeout=15.0)
+            assert res is not None and res.exit_code == 0
+        finally:
+            drv.destroy_task(handle, force=True)

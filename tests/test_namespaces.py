@@ -1,0 +1,123 @@
+"""Namespace CRUD (reference: nomad/namespace_endpoint.go, OSS in 1.0)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent.http import HTTPApi, HttpError
+from nomad_tpu.server import Server, ServerConfig
+
+
+@pytest.fixture()
+def server():
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0,
+                            gc_interval=3600.0))
+    s.start()
+    yield s
+    s.shutdown()
+
+
+def _api(server):
+    class _Facade:
+        client = None
+        cluster = None
+
+    f = _Facade()
+    f.server = server
+    return HTTPApi(f, "127.0.0.1", 0)
+
+
+class TestNamespaces:
+    def test_default_exists(self, server):
+        names = [n.name for n in server.state.namespaces()]
+        assert names == ["default"]
+
+    def test_crud_over_http(self, server):
+        api = _api(server)
+        try:
+            api.route("PUT", "/v1/namespace", {},
+                      {"Name": "prod", "Description": "production"})
+            lst = api.route("GET", "/v1/namespaces", {}, None)
+            assert [n["name"] for n in lst["data"]] == ["default", "prod"]
+            got = api.route("GET", "/v1/namespace/prod", {}, None)
+            assert got["description"] == "production"
+            api.route("DELETE", "/v1/namespace/prod", {}, None)
+            with pytest.raises(HttpError):
+                api.route("GET", "/v1/namespace/prod", {}, None)
+        finally:
+            api.httpd.server_close()
+
+    def test_validation(self, server):
+        from nomad_tpu.structs.operator import Namespace
+
+        with pytest.raises(ValueError):
+            server.namespace_upsert(Namespace(name="bad name!"))
+        with pytest.raises(ValueError):
+            server.namespace_delete("default")
+        with pytest.raises(ValueError):
+            server.namespace_delete("ghost")
+
+    def test_delete_blocked_while_jobs_live(self, server):
+        from nomad_tpu.structs.operator import Namespace
+
+        server.namespace_upsert(Namespace(name="apps"))
+        job = mock.job(namespace="apps")
+        server.job_register(job)
+        with pytest.raises(ValueError, match="non-terminal jobs"):
+            server.namespace_delete("apps")
+        server.job_deregister("apps", job.id)
+        server.namespace_delete("apps")
+        assert server.state.namespace_by_name("apps") is None
+
+    def test_register_into_unknown_namespace_rejected(self, server):
+        job = mock.job(namespace="nope")
+        with pytest.raises(ValueError, match="does not exist"):
+            server.job_register(job)
+
+    def test_delete_cascades_secrets(self, server):
+        """KV secrets must not survive namespace deletion and re-attach
+        to a future namespace of the same name."""
+        from nomad_tpu.structs.operator import Namespace
+        from nomad_tpu.structs.secrets import SecretEntry
+
+        server.namespace_upsert(Namespace(name="team-a"))
+        server.secret_upsert(SecretEntry(namespace="team-a", path="kv",
+                                         data={"s": "1"}))
+        server.namespace_delete("team-a")
+        server.namespace_upsert(Namespace(name="team-a"))
+        assert server.state.secret_get("team-a", "kv") is None
+
+    def test_delete_blocked_by_csi_volumes(self, server):
+        from nomad_tpu.structs.csi import CSIVolume
+        from nomad_tpu.structs.operator import Namespace
+
+        server.namespace_upsert(Namespace(name="vols"))
+        server.csi_volume_register(CSIVolume(
+            id="v1", name="v1", namespace="vols", plugin_id="hostpath"))
+        with pytest.raises(ValueError, match="CSI volumes"):
+            server.namespace_delete("vols")
+
+    def test_write_needs_management_token(self):
+        from nomad_tpu.agent import Agent, AgentConfig
+        from nomad_tpu.api import ApiError, NomadClient
+
+        a = Agent(AgentConfig(client=False, acl_enabled=True,
+                              heartbeat_ttl=60.0))
+        a.start()
+        try:
+            host, port = a.http_addr
+            boot = NomadClient(host, port).acl_bootstrap()
+            mgmt = NomadClient(host, port, token=boot.secret_id)
+            mgmt.namespace_apply("team-a")
+            mgmt.acl_upsert_policy(
+                "w", 'namespace "team-a" { policy = "write" }')
+            tok = mgmt.acl_create_token(name="w", policies=["w"])
+            writer = NomadClient(host, port, token=tok.secret_id)
+            # namespace-scoped tokens can read their namespace row…
+            assert writer.namespace("team-a").name == "team-a"
+            assert [n.name for n in writer.namespaces()] == ["team-a"]
+            # …but cannot create/delete namespaces
+            with pytest.raises(ApiError):
+                writer.namespace_apply("team-b")
+            with pytest.raises(ApiError):
+                writer.namespace_delete("team-a")
+        finally:
+            a.shutdown()
