@@ -560,7 +560,10 @@ class HTTPApi:
                     server, job, local_region, token)
                 if mr_out is not None:
                     return mr_out
-                ev = server.job_register(job)
+                try:
+                    ev = server.job_register(job)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
                 return {"eval_id": ev.id if ev else "",
                         "job_modify_index": job.job_modify_index}
         # /v1/job/<id>[/...] — job ids may CONTAIN slashes (dispatched
@@ -571,7 +574,8 @@ class HTTPApi:
         # command/agent/job_endpoint.go JobSpecificRequest)
         if parts and parts[0] == "job" and len(parts) >= 2:
             _job_subs = {"allocations", "evaluations", "deployments",
-                         "summary", "plan", "scale", "dispatch"}
+                         "summary", "plan", "scale", "dispatch",
+                         "versions", "revert"}
             rest = parts[1:]
             if len(rest) >= 3 and rest[-2:] == ["periodic", "force"]:
                 job_id, sub = "/".join(rest[:-2]), "periodic"
@@ -599,7 +603,10 @@ class HTTPApi:
                         server, job, local_region, token)
                     if mr_out is not None:
                         return mr_out
-                    ev = server.job_register(job)
+                    try:
+                        ev = server.job_register(job)
+                    except ValueError as e:
+                        raise HttpError(400, str(e))
                     return {"eval_id": ev.id if ev else ""}
             if sub == "allocations":
                 require(acl.allow_namespace_operation(ns, "read-job"))
@@ -626,6 +633,24 @@ class HTTPApi:
                 if ev is None:
                     raise HttpError(404, "not a periodic job or overlapped")
                 return {"eval_id": ev.id}
+            if sub == "versions":
+                # job history (job_endpoint.go GetJobVersions)
+                require(acl.allow_namespace_operation(ns, "read-job"))
+                return blocking(lambda snap: (
+                    snap.index_at,
+                    [to_wire(j) for j
+                     in snap.job_versions_by_id(ns, job_id)]))
+            if sub == "revert" and method in ("PUT", "POST"):
+                # job revert (job_endpoint.go:1069 Revert)
+                require(acl.allow_namespace_operation(ns, "submit-job"))
+                if (body or {}).get("JobVersion") is None:
+                    raise HttpError(400, "missing JobVersion")
+                try:
+                    ev = server.job_revert(ns, job_id,
+                                           int(body["JobVersion"]))
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"eval_id": ev.id if ev else ""}
             if sub == "dispatch" and method in ("PUT", "POST"):
                 # Job.Dispatch (job_endpoint.go:1634; HTTP route
                 # command/agent/job_endpoint.go jobDispatchRequest)
@@ -728,6 +753,18 @@ class HTTPApi:
                 # a denied id reads exactly like a missing one — no
                 # cross-namespace existence oracle
                 raise HttpError(404, "alloc not found")
+            if len(parts) > 2 and parts[2] == "stop" \
+                    and method in ("PUT", "POST"):
+                # alloc_endpoint.go:220 Stop (alloc-lifecycle cap)
+                require(acl.allow_namespace_operation(
+                    a.namespace, "alloc-lifecycle")
+                    or acl.allow_namespace_operation(
+                        a.namespace, "submit-job"))
+                try:
+                    ev = server.alloc_stop(a.id)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"eval_id": ev.id if ev else ""}
             return to_wire(a)
         # /v1/evaluations, /v1/evaluation/<id>
         if parts == ["evaluations"]:

@@ -145,6 +145,25 @@ class NomadClient:
                                   "Message": message})
         return out.get("eval_id", "")
 
+    def job_versions(self, job_id: str,
+                     namespace: str = "default") -> List[Any]:
+        res = self._request("GET", f"/v1/job/{job_id}/versions",
+                            params={"namespace": namespace})
+        return [from_wire(j) for j in self._unblock(res)[1]]
+
+    def job_revert(self, job_id: str, version: int,
+                   namespace: str = "default") -> str:
+        out = self._request("PUT", f"/v1/job/{job_id}/revert",
+                            params={"namespace": namespace},
+                            body={"JobVersion": version})
+        return out.get("eval_id", "")
+
+    def alloc_stop(self, alloc_id: str,
+                   namespace: str = "default") -> str:
+        out = self._request("PUT", f"/v1/allocation/{alloc_id}/stop",
+                            params={"namespace": namespace})
+        return out.get("eval_id", "")
+
     def job_dispatch(self, job_id: str, payload: bytes = b"",
                      meta: Optional[Dict[str, str]] = None,
                      namespace: str = "default") -> dict:

@@ -195,6 +195,110 @@ def cmd_job_inspect(args) -> int:
     return 0
 
 
+def cmd_job_history(args) -> int:
+    """`nomad-tpu job history <job>` (command/job_history.go)."""
+    api = _client(args)
+    versions = api.job_versions(args.job_id, namespace=args.namespace)
+    if not versions:
+        print(f"No versions for job {args.job_id!r}", file=sys.stderr)
+        return 1
+    for j in versions:
+        print(f"Version     = {j.version}")
+        print(f"Stable      = {str(j.stable).lower()}")
+        print(f"Status      = {j.status}")
+        print(f"Groups      = "
+              f"{', '.join(f'{g.name}x{g.count}' for g in j.task_groups)}")
+        print()
+    return 0
+
+
+def cmd_job_revert(args) -> int:
+    """`nomad-tpu job revert <job> <version>` (command/job_revert.go)."""
+    api = _client(args)
+    eval_id = api.job_revert(args.job_id, args.version,
+                             namespace=args.namespace)
+    print(f"Job {args.job_id!r} reverted to version {args.version}")
+    if eval_id and not args.detach:
+        return _monitor(api, eval_id)
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    """`nomad-tpu alloc stop <alloc>` (command/alloc_stop.go)."""
+    api = _client(args)
+    matches = [a for a in api.allocations()
+               if a.id.startswith(args.alloc_id)]
+    if len(matches) != 1:
+        print(f"Error: alloc prefix {args.alloc_id!r} matched "
+              f"{len(matches)} allocations", file=sys.stderr)
+        return 1
+    eval_id = api.alloc_stop(matches[0].id)
+    print(f"Alloc {matches[0].id[:8]} stop requested")
+    if eval_id and not args.detach:
+        return _monitor(api, eval_id)
+    return 0
+
+
+def cmd_eval_list(args) -> int:
+    """`nomad-tpu eval list` (command/eval_list.go)."""
+    evals = _client(args).evaluations()
+    print(_columns(
+        [[e.id[:8], e.job_id, e.type, e.triggered_by, str(e.priority),
+          e.status] for e in evals],
+        ["ID", "Job", "Type", "Triggered By", "Priority", "Status"]))
+    return 0
+
+
+def cmd_acl(args) -> int:
+    """`nomad-tpu acl bootstrap|policy ...|token ...`
+    (command/acl_*.go)."""
+    api = _client(args)
+    if args.sub == "bootstrap":
+        tok = api.acl_bootstrap()
+        print(f"Accessor ID  = {tok.accessor_id}")
+        print(f"Secret ID    = {tok.secret_id}")
+        print(f"Type         = {tok.type}")
+        return 0
+    if args.sub == "policy-apply":
+        with open(args.rules_file) as f:
+            rules = f.read()
+        api.acl_upsert_policy(args.name, rules,
+                              description=args.description or "")
+        print(f"Successfully wrote policy {args.name!r}")
+        return 0
+    if args.sub == "policy-list":
+        print(_columns(
+            [[p.name, p.description or "<none>"]
+             for p in api.acl_policies()],
+            ["Name", "Description"]))
+        return 0
+    if args.sub == "policy-delete":
+        api.acl_delete_policy(args.name)
+        print(f"Deleted policy {args.name!r}")
+        return 0
+    if args.sub == "token-create":
+        tok = api.acl_create_token(
+            name=args.name or "", type=args.type,
+            policies=args.policy or [])
+        print(f"Accessor ID  = {tok.accessor_id}")
+        print(f"Secret ID    = {tok.secret_id}")
+        print(f"Policies     = {', '.join(tok.policies) or '<none>'}")
+        return 0
+    if args.sub == "token-list":
+        print(_columns(
+            [[t.accessor_id[:8], t.name or "<none>", t.type,
+              ", ".join(t.policies) or "<all>"]
+             for t in api.acl_tokens()],
+            ["Accessor", "Name", "Type", "Policies"]))
+        return 0
+    if args.sub == "token-delete":
+        api.acl_delete_token(args.accessor_id)
+        print(f"Deleted token {args.accessor_id!r}")
+        return 0
+    print(f"unknown acl subcommand {args.sub!r}", file=sys.stderr)
+    return 1
+
+
 def cmd_job_dispatch(args) -> int:
     """`nomad-tpu job dispatch [-meta k=v]... <job> [payload-file]`
     (command/job_dispatch.go; '-' reads the payload from stdin)."""
@@ -842,6 +946,16 @@ def build_parser() -> argparse.ArgumentParser:
     ji.add_argument("job_id")
     ji.add_argument("-namespace", default="default")
     ji.set_defaults(fn=cmd_job_inspect)
+    jh = job.add_parser("history")
+    jh.add_argument("job_id")
+    jh.add_argument("-namespace", default="default")
+    jh.set_defaults(fn=cmd_job_history)
+    jrv = job.add_parser("revert")
+    jrv.add_argument("job_id")
+    jrv.add_argument("version", type=int)
+    jrv.add_argument("-namespace", default="default")
+    jrv.add_argument("-detach", action="store_true")
+    jrv.set_defaults(fn=cmd_job_revert)
     jd = job.add_parser("dispatch")
     jd.add_argument("job_id")
     jd.add_argument("payload_file", nargs="?", default="")
@@ -890,6 +1004,10 @@ def build_parser() -> argparse.ArgumentParser:
     alf.add_argument("alloc_id")
     alf.add_argument("path", nargs="?", default="/")
     alf.set_defaults(fn=cmd_alloc_fs)
+    alst = al.add_parser("stop")
+    alst.add_argument("alloc_id")
+    alst.add_argument("-detach", action="store_true")
+    alst.set_defaults(fn=cmd_alloc_stop)
     alx = al.add_parser("exec")
     alx.add_argument("-task", default="")
     alx.add_argument("alloc_id")
@@ -903,6 +1021,34 @@ def build_parser() -> argparse.ArgumentParser:
     evs = ev.add_parser("status")
     evs.add_argument("eval_id")
     evs.set_defaults(fn=cmd_eval_status)
+    evl = ev.add_parser("list")
+    evl.set_defaults(fn=cmd_eval_list)
+
+    aclp = sub.add_parser("acl", help="ACL commands").add_subparsers(
+        dest="sub", required=True)
+    ab = aclp.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl)
+    apa = aclp.add_parser("policy-apply")
+    apa.add_argument("name")
+    apa.add_argument("rules_file")
+    apa.add_argument("-description", default="")
+    apa.set_defaults(fn=cmd_acl)
+    apl = aclp.add_parser("policy-list")
+    apl.set_defaults(fn=cmd_acl)
+    apd = aclp.add_parser("policy-delete")
+    apd.add_argument("name")
+    apd.set_defaults(fn=cmd_acl)
+    atc = aclp.add_parser("token-create")
+    atc.add_argument("-name", default="")
+    atc.add_argument("-type", default="client",
+                     choices=["client", "management"])
+    atc.add_argument("-policy", action="append", default=[])
+    atc.set_defaults(fn=cmd_acl)
+    atl = aclp.add_parser("token-list")
+    atl.set_defaults(fn=cmd_acl)
+    atd = aclp.add_parser("token-delete")
+    atd.add_argument("accessor_id")
+    atd.set_defaults(fn=cmd_acl)
 
     dep = sub.add_parser("deployment",
                          help="deployment commands").add_subparsers(

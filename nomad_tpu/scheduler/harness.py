@@ -172,6 +172,13 @@ class InMemState:
                               ) -> Optional[Job]:
         return self._job_versions.get((namespace, job_id, version))
 
+    def job_versions_by_id(self, namespace: str, job_id: str) -> List[Job]:
+        """All stored versions, newest first (state JobVersionsByID)."""
+        return sorted((job for (ns, jid, _v), job
+                       in self._job_versions.items()
+                       if (ns, jid) == (namespace, job_id)),
+                      key=lambda j: j.version, reverse=True)
+
     def allocs_by_job(self, namespace: str, job_id: str,
                       any_create_index: bool = True) -> List[Allocation]:
         return list(self._allocs_by_job.get((namespace, job_id), {}).values())
@@ -362,13 +369,31 @@ class InMemState:
         self._namespaces[ns.name] = ns
 
     def delete_namespace(self, name: str) -> None:
-        if self._namespaces.pop(name, None) is not None:
-            # cascade the namespace's KV secrets in the SAME log entry:
-            # leftovers would silently re-attach to a future namespace of
-            # the same name (a cross-tenant leak)
-            for key in [k for k in self._secrets if k[0] == name]:
-                del self._secrets[key]
-            next(self.index)
+        if self._namespaces.pop(name, None) is None:
+            return
+        # cascade EVERY namespace-scoped row in the SAME log entry:
+        # leftovers (secrets, stopped jobs + their version history,
+        # terminal allocs/evals) would silently re-attach to a future
+        # namespace of the same name — a cross-tenant leak. The server
+        # endpoint refuses the delete while non-terminal jobs or CSI
+        # volumes exist, so everything swept here is already dead.
+        for key in [k for k in self._secrets if k[0] == name]:
+            del self._secrets[key]
+        for a in [a for a in list(self._allocs.values())
+                  if a.namespace == name]:
+            self.delete_alloc(a.id)
+        for e in [e for e in list(self._evals.values())
+                  if e.namespace == name]:
+            self.delete_eval(e.id)
+        for j in [j for j in list(self._jobs.values())
+                  if j.namespace == name]:
+            self.delete_job(name, j.id)
+        for key in [k for k in self._job_versions if k[0] == name]:
+            del self._job_versions[key]
+        for d in [d for d in list(self._deployments.values())
+                  if d.namespace == name]:
+            self.delete_deployment(d.id)
+        next(self.index)
 
     def namespaces(self) -> List[object]:
         return sorted(self._namespaces.values(), key=lambda n: n.name)

@@ -674,6 +674,57 @@ class Server:
         ev = self.job_register(child)
         return child, ev
 
+    def job_versions(self, namespace: str, job_id: str) -> List[Job]:
+        """All stored versions, newest first (powers `job history`)."""
+        return self.state.job_versions_by_id(namespace, job_id)
+
+    def job_revert(self, namespace: str, job_id: str,
+                   version: int) -> Optional[Evaluation]:
+        """Re-register a prior version's spec as a NEW version
+        (nomad/job_endpoint.go:1069 Revert — revert is roll-forward)."""
+        import copy
+
+        cur = self.state.job_by_id(namespace, job_id)
+        if cur is None:
+            raise ValueError(f"job {job_id!r} not found")
+        if version == cur.version:
+            raise ValueError(
+                f"already at version {version} — nothing to revert")
+        target = self.state.job_by_id_and_version(namespace, job_id,
+                                                  version)
+        if target is None:
+            raise ValueError(f"job {job_id!r} has no version {version}")
+        j = copy.deepcopy(target)
+        j.stop = False
+        j.stable = False
+        return self.job_register(j)
+
+    def alloc_stop(self, alloc_id: str) -> Optional[Evaluation]:
+        """Stop one allocation and let the scheduler replace it
+        (nomad/alloc_endpoint.go:220 Stop — desired stop + an eval with
+        trigger alloc-stop)."""
+        import copy
+
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise ValueError(f"alloc {alloc_id!r} not found")
+        upd = copy.copy(alloc)
+        upd.desired_status = "stop"
+        upd.desired_description = "alloc was manually stopped by user"
+        self.state.upsert_alloc(upd)
+        job = self.state.job_by_id(alloc.namespace, alloc.job_id)
+        if job is None or job.stop:
+            return None
+        return self._create_eval(
+            namespace=job.namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by=TRIGGER_ALLOC_STOP,
+            job_id=job.id,
+            job_modify_index=job.modify_index,
+            status=EVAL_STATUS_PENDING,
+        )
+
     def job_scale(self, namespace: str, job_id: str, group: str,
                   count: int, message: str = "") -> Optional[Evaluation]:
         import copy

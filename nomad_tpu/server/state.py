@@ -160,6 +160,7 @@ class StateStore(InMemState):
     delete_namespace = _locked("delete_namespace")
     namespaces = _locked("namespaces")
     namespace_by_name = _locked("namespace_by_name")
+    job_versions_by_id = _locked("job_versions_by_id")
     del _locked
 
     def delete_alloc(self, alloc_id: str) -> None:

@@ -85,6 +85,34 @@ class TestNamespaces:
         server.namespace_upsert(Namespace(name="team-a"))
         assert server.state.secret_get("team-a", "kv") is None
 
+    def test_delete_cascades_stopped_jobs_and_history(self, server):
+        """Stopped jobs + their version history + evals must not leak
+        into a recreated namespace of the same name."""
+        from nomad_tpu.structs.operator import Namespace
+
+        server.namespace_upsert(Namespace(name="team-a"))
+        job = mock.job(namespace="team-a")
+        server.job_register(job)
+        server.job_deregister("team-a", job.id)
+        server.namespace_delete("team-a")
+        server.namespace_upsert(Namespace(name="team-a"))
+        assert server.state.job_by_id("team-a", job.id) is None
+        assert server.state.job_versions_by_id("team-a", job.id) == []
+        assert [e for e in server.state.evals()
+                if e.namespace == "team-a"] == []
+
+    def test_register_into_unknown_namespace_is_400(self, server):
+        from nomad_tpu.structs.codec import to_wire
+
+        api = _api(server)
+        try:
+            job = mock.job(namespace="ghost")
+            with pytest.raises(HttpError) as ei:
+                api.route("PUT", "/v1/jobs", {}, {"job": to_wire(job)})
+            assert ei.value.code == 400
+        finally:
+            api.httpd.server_close()
+
     def test_delete_blocked_by_csi_volumes(self, server):
         from nomad_tpu.structs.csi import CSIVolume
         from nomad_tpu.structs.operator import Namespace
