@@ -470,6 +470,25 @@ class HTTPApi:
         if parts0[1:3] == ["client", "allocation"] and len(parts0) >= 5:
             return self._client_alloc_op(parts0[3], parts0[4], query, body,
                                          token)
+        # /v1/agent/pprof — runtime profiling surface (agent_endpoint.go
+        # AgentPprofRequest; the goroutine dump maps to Python thread
+        # stacks here). agent:read like monitor.
+        if parts0[1:] == ["agent", "pprof"]:
+            self._require_local(token, "agent_read")
+            import sys as _sys
+            import traceback as _tb
+
+            frames = _sys._current_frames()
+            threads = {t.ident: t.name
+                       for t in threading.enumerate()}
+            dump = []
+            for tid, frame in frames.items():
+                dump.append({
+                    "thread": threads.get(tid, str(tid)),
+                    "stack": [ln.rstrip() for ln
+                              in _tb.format_stack(frame)],
+                })
+            return {"threads": dump, "count": len(dump)}
         # /v1/agent/monitor — agent-local log ring (agent_endpoint.go
         # Monitor; agent:read)
         if parts0[1:] == ["agent", "monitor"]:
@@ -1249,10 +1268,71 @@ class HTTPApi:
                 failed[tg] = {"nodes_evaluated": m.nodes_evaluated,
                               "nodes_filtered": m.nodes_filtered,
                               "nodes_exhausted": m.nodes_exhausted}
+        old = server.state.job_by_id(job.namespace, job.id)
         return {
             "placements": 0 if plan is None else sum(
                 len(v) for v in plan.node_allocation.values()),
             "stops": 0 if plan is None else sum(
                 len(v) for v in plan.node_update.values()),
             "failed_tg_allocs": failed,
+            "diff": _job_diff(old, job),
         }
+
+
+def _scalar_diff(old, new, fields) -> list:
+    """Changed plain fields between two structs (None-tolerant)."""
+    out = []
+    for f in fields:
+        ov = getattr(old, f, None) if old is not None else None
+        nv = getattr(new, f, None) if new is not None else None
+        if ov != nv:
+            out.append({"name": f, "old": ov, "new": nv})
+    return out
+
+
+def _job_diff(old, new) -> dict:
+    """Structured spec diff for `job plan` output (the reference's
+    nomad/structs/diff.go Job.Diff, rendered by command/job_plan.go).
+    Three levels: job fields, task groups by name, tasks by name."""
+    if old is None:
+        return {"type": "Added", "fields": [],
+                "groups": [{"name": tg.name, "type": "Added",
+                            "fields": [], "tasks": []}
+                           for tg in new.task_groups]}
+    jf = _scalar_diff(old, new, ["type", "priority", "region",
+                                 "datacenters", "all_at_once", "meta"])
+    groups = []
+    old_tgs = {tg.name: tg for tg in old.task_groups}
+    new_tgs = {tg.name: tg for tg in new.task_groups}
+    for name in sorted(set(old_tgs) | set(new_tgs)):
+        og, ng = old_tgs.get(name), new_tgs.get(name)
+        if og is None or ng is None:
+            groups.append({"name": name,
+                           "type": "Added" if og is None else "Deleted",
+                           "fields": [], "tasks": []})
+            continue
+        gf = _scalar_diff(og, ng, ["count", "meta"])
+        tasks = []
+        old_ts = {t.name: t for t in og.tasks}
+        new_ts = {t.name: t for t in ng.tasks}
+        for tname in sorted(set(old_ts) | set(new_ts)):
+            ot, nt = old_ts.get(tname), new_ts.get(tname)
+            if ot is None or nt is None:
+                tasks.append({"name": tname,
+                              "type": "Added" if ot is None else "Deleted",
+                              "fields": []})
+                continue
+            tf = _scalar_diff(ot, nt, ["driver", "config", "env", "meta",
+                                       "user", "kill_timeout_s"])
+            tf += [{"name": f"resources.{d['name']}", "old": d["old"],
+                    "new": d["new"]}
+                   for d in _scalar_diff(ot.resources, nt.resources,
+                                         ["cpu", "memory_mb", "disk_mb"])]
+            if tf:
+                tasks.append({"name": tname, "type": "Edited",
+                              "fields": tf})
+        if gf or tasks:
+            groups.append({"name": name, "type": "Edited", "fields": gf,
+                           "tasks": tasks})
+    kind = "Edited" if (jf or groups) else "None"
+    return {"type": kind, "fields": jf, "groups": groups}

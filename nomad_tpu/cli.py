@@ -146,7 +146,23 @@ def cmd_job_plan(args) -> int:
     api = _client(args)
     job = parse_file(args.spec)
     out = api.plan_job(job)
-    print(f"+ Job: {job.id!r}")
+    diff = out.get("diff") or {}
+    sym = {"Added": "+", "Deleted": "-", "Edited": "+/-",
+           "None": ""}.get(diff.get("type", "None"), "")
+    print(f"{sym or '='} Job: {job.id!r}")
+    for f in diff.get("fields", []):
+        print(f"  ~ {f['name']}: {f['old']!r} => {f['new']!r}")
+    for g in diff.get("groups", []):
+        gs = {"Added": "+", "Deleted": "-"}.get(g["type"], "+/-")
+        print(f"  {gs} group {g['name']!r}")
+        for f in g.get("fields", []):
+            print(f"      ~ {f['name']}: {f['old']!r} => {f['new']!r}")
+        for t in g.get("tasks", []):
+            ts = {"Added": "+", "Deleted": "-"}.get(t["type"], "+/-")
+            print(f"    {ts} task {t['name']!r}")
+            for f in t.get("fields", []):
+                print(f"        ~ {f['name']}: "
+                      f"{f['old']!r} => {f['new']!r}")
     print(f"Placements: {out['placements']}  Stops: {out['stops']}")
     for tg, m in out.get("failed_tg_allocs", {}).items():
         print(f"WARNING: group {tg!r} would fail placement "
@@ -748,6 +764,63 @@ def cmd_operator_autopilot_health(args) -> int:
     return 0
 
 
+def cmd_operator_debug(args) -> int:
+    """`nomad-tpu operator debug` (command/operator_debug.go): capture a
+    support bundle — cluster state dumps + agent diagnostics — into a
+    tar.gz."""
+    import io
+    import tarfile
+    import time as _time
+
+    api = _client(args)
+    captures = {
+        "agent-self.json": lambda: api.agent_self(),
+        "members.json": lambda: api._request("GET", "/v1/agent/members"),
+        "leader.json": lambda: api.status_leader(),
+        "regions.json": lambda: api.regions(),
+        "jobs.json": lambda: api._request(
+            "GET", "/v1/jobs", params={"namespace": "*"}),
+        "nodes.json": lambda: api._request("GET", "/v1/nodes"),
+        "allocations.json": lambda: api._request(
+            "GET", "/v1/allocations", params={"namespace": "*"}),
+        "evaluations.json": lambda: api._request(
+            "GET", "/v1/evaluations", params={"namespace": "*"}),
+        "deployments.json": lambda: api._request(
+            "GET", "/v1/deployments", params={"namespace": "*"}),
+        "metrics.json": lambda: api.metrics(),
+        "pprof-threads.json": lambda: api._request(
+            "GET", "/v1/agent/pprof"),
+        "raft-configuration.json": lambda: api.raft_configuration(),
+        "autopilot-health.json": lambda: api.autopilot_health(),
+        "monitor.json": lambda: api._request(
+            "GET", "/v1/agent/monitor"),
+    }
+    out_path = args.output or \
+        f"nomad-debug-{_time.strftime('%Y%m%d-%H%M%S')}.tar.gz"
+    ok = 0
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, fetch in captures.items():
+            try:
+                data = json.dumps(fetch(), indent=2, default=str).encode()
+                ok += 1
+                print(f"  captured {name}")
+            except Exception as e:  # noqa: BLE001 — partial bundle is
+                data = json.dumps({"error": str(e)}).encode()  # still useful
+                print(f"  FAILED  {name}: {e}", file=sys.stderr)
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(data))
+    if ok == 0:
+        print(f"Error: every capture failed — is the agent reachable? "
+              f"(bundle of error stubs left at {out_path})",
+              file=sys.stderr)
+        return 1
+    print(f"Created debug bundle: {out_path} "
+          f"({ok}/{len(captures)} captures)")
+    return 0
+
+
 def cmd_operator_scheduler_get(args) -> int:
     api = _client(args)
     cfg = api.scheduler_config()
@@ -1076,6 +1149,9 @@ def build_parser() -> argparse.ArgumentParser:
     osn.add_argument("action", choices=["save", "restore"])
     osn.add_argument("file")
     osn.set_defaults(fn=cmd_operator_snapshot)
+    odb = op.add_parser("debug")
+    odb.add_argument("-output", default="")
+    odb.set_defaults(fn=cmd_operator_debug)
     orl = op.add_parser("raft-list-peers")
     orl.set_defaults(fn=cmd_operator_raft_list)
     orr = op.add_parser("raft-remove-peer")

@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import platform
 import shutil
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..structs import Node
 from ..structs.resources import NodeResources
@@ -208,6 +208,116 @@ def bridge_fingerprint(node: Node) -> None:
         pass
 
 
+def cni_fingerprint(node: Node) -> None:
+    """CNI plugin/config discovery (client/fingerprint/cni.go): scan the
+    conf dir for network lists; names become plugins.cni.config.* attrs.
+    Dir override via NOMAD_TPU_CNI_CONFIG_DIR (the agent config's
+    cni_config_dir)."""
+    import json as _json
+
+    conf_dir = os.environ.get("NOMAD_TPU_CNI_CONFIG_DIR",
+                              "/opt/cni/config")
+    if not os.path.isdir(conf_dir):
+        return
+    for fn in sorted(os.listdir(conf_dir)):
+        if not fn.endswith((".conflist", ".conf", ".json")):
+            continue
+        try:
+            with open(os.path.join(conf_dir, fn)) as f:
+                conf = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(conf, dict):
+            continue  # valid JSON but not a network config
+        name = conf.get("name") or fn.rsplit(".", 1)[0]
+        node.attributes[f"plugins.cni.config.{name}"] = \
+            os.path.join(conf_dir, fn)
+
+
+def _cloud_metadata(url: str, headers: dict) -> Optional[str]:
+    """One metadata read with the aggressive timeout the reference uses
+    (cloud fingerprints must not stall registration off-cloud)."""
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=0.5) as resp:
+            return resp.read().decode().strip()
+    except Exception:  # noqa: BLE001 — not on this cloud
+        return None
+
+
+def env_gce_fingerprint(node: Node) -> None:
+    """GCE metadata (client/fingerprint/env_gce.go): machine attrs from
+    the metadata service. Endpoint override via
+    NOMAD_TPU_GCE_METADATA_URL (the reference honors GCE_METADATA_HOST);
+    skipped entirely when neither the override nor a known-GCE marker is
+    present, so bare-metal nodes never pay the probe."""
+    base = os.environ.get("NOMAD_TPU_GCE_METADATA_URL", "")
+    if not base:
+        if not os.path.exists("/sys/class/dmi/id/product_name"):
+            return
+        try:
+            with open("/sys/class/dmi/id/product_name") as f:
+                if "Google" not in f.read():
+                    return
+        except OSError:
+            return
+        base = "http://169.254.169.254/computeMetadata/v1"
+    hdr = {"Metadata-Flavor": "Google"}
+    for attr, path in [("platform.gce.machine-type", "/machine-type"),
+                       ("platform.gce.zone", "/zone"),
+                       ("platform.gce.hostname", "/hostname"),
+                       ("unique.platform.gce.id", "/id")]:
+        v = _cloud_metadata(f"{base}/instance{path}", hdr)
+        if v is None:
+            return  # first miss → not on GCE; stop probing
+        node.attributes[attr] = v.rsplit("/", 1)[-1]
+
+
+def env_aws_fingerprint(node: Node) -> None:
+    """EC2 metadata (client/fingerprint/env_aws.go). Endpoint override via
+    NOMAD_TPU_AWS_METADATA_URL; gated on a DMI marker like GCE. Speaks
+    IMDSv2 (session token) first — HttpTokens=required is the launch
+    default on current EC2 — falling back to v1 plain GETs."""
+    base = os.environ.get("NOMAD_TPU_AWS_METADATA_URL", "")
+    root = ""
+    if not base:
+        marker = "/sys/class/dmi/id/board_vendor"
+        try:
+            with open(marker) as f:
+                if "Amazon" not in f.read():
+                    return
+        except OSError:
+            return
+        root = "http://169.254.169.254"
+        base = f"{root}/latest/meta-data"
+    else:
+        root = base.rsplit("/latest/", 1)[0] if "/latest/" in base else ""
+    headers = {}
+    if root:
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                f"{root}/latest/api/token", method="PUT",
+                headers={"X-aws-ec2-metadata-token-ttl-seconds": "60"})
+            with urllib.request.urlopen(req, timeout=0.5) as resp:
+                headers = {"X-aws-ec2-metadata-token":
+                           resp.read().decode().strip()}
+        except Exception:  # noqa: BLE001 — IMDSv1 host: no token route
+            pass
+    for attr, path in [("platform.aws.instance-type", "/instance-type"),
+                       ("platform.aws.placement.availability-zone",
+                        "/placement/availability-zone"),
+                       ("unique.platform.aws.instance-id", "/instance-id"),
+                       ("unique.platform.aws.local-ipv4", "/local-ipv4")]:
+        v = _cloud_metadata(f"{base}{path}", headers)
+        if v is None:
+            return
+        node.attributes[attr] = v
+
+
 def driver_fingerprints(node: Node) -> None:
     from .drivers import BUILTIN_DRIVERS
 
@@ -223,6 +333,7 @@ FINGERPRINTERS: List[Callable[[Node], None]] = [
     storage_fingerprint, network_fingerprint, host_fingerprint,
     nomad_fingerprint, signal_fingerprint, tpu_fingerprint,
     device_env_fingerprint, cgroup_fingerprint, bridge_fingerprint,
+    cni_fingerprint, env_gce_fingerprint, env_aws_fingerprint,
     driver_fingerprints,
 ]
 
