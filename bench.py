@@ -433,10 +433,11 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
 def main() -> None:
     n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
     n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_ALLOCS", 100_000))
-    # throughput scales with batch well past 128 (dispatch amortization):
-    # 1288 evals/s @128 → 3076 @512 → 4425 @1024 on the 10K-node workload
-    n_evals = int(os.environ.get("NOMAD_TPU_BENCH_EVALS", 8192))
-    batch = int(os.environ.get("NOMAD_TPU_BENCH_BATCH", 1024))
+    # throughput scales with batch until HBM pressure wins (dispatch
+    # amortization): 1288 evals/s @128 → 4304 @1024 → 5031 @2048 →
+    # 5183 @4096 → 4267 @8192 on the 10K-node workload (v5e)
+    n_evals = int(os.environ.get("NOMAD_TPU_BENCH_EVALS", 16384))
+    batch = int(os.environ.get("NOMAD_TPU_BENCH_BATCH", 4096))
     count = int(os.environ.get("NOMAD_TPU_BENCH_COUNT", 8))
     # the scalar Python oracle runs ~0.12 evals/s at full size; 32 evals
     # (256 placements) keeps the parity sample meaningful at ~4.5 min
