@@ -28,7 +28,8 @@ from .state import ClientStateDB, MemClientStateDB
 
 class ServerConn(Protocol):
     def node_register(self, node: Node) -> None: ...
-    def node_heartbeat(self, node_id: str) -> bool: ...
+    def node_heartbeat(self, node_id: str) -> dict: ...
+    #  → {"ok": bool, "servers": [[host, port], ...]} (NodeServerInfo)
     def node_get_client_allocs(self, node_id: str, min_index: int,
                                timeout: float) -> Tuple[int, Dict[str, int]]: ...
     def alloc_get(self, alloc_id: str) -> Optional[Allocation]: ...
@@ -83,6 +84,18 @@ class RpcConn:
         self.addrs = [tuple(a) for a in addrs]
         self.pool = pool or ConnPool()
         self.rpc_timeout = rpc_timeout
+
+    def set_servers(self, addrs) -> None:
+        """Refresh the failover list from a heartbeat's server set
+        (client/servers/manager.go SetServers). Keeps the currently
+        preferred (first) server in front when it is still present."""
+        new = [tuple(a) for a in addrs]
+        if not new:
+            return
+        if self.addrs and self.addrs[0] in new:
+            new.remove(self.addrs[0])
+            new.insert(0, self.addrs[0])
+        self.addrs = new
 
     def _call(self, method, *args, timeout=None):
         from ..structs.codec import from_wire, to_wire
@@ -248,9 +261,17 @@ class Client:
     def _run_heartbeat(self) -> None:
         while not self._stop.wait(self.config.heartbeat_interval):
             try:
-                ok = self.conn.node_heartbeat(self.node.id)
+                resp = self.conn.node_heartbeat(self.node.id)
+                ok = resp.get("ok", False) if isinstance(resp, dict) \
+                    else bool(resp)
                 if not ok:  # server lost us: re-register (client.go:1605)
                     self.conn.node_register(self.node)
+                # heartbeat responses advertise the live server set —
+                # refresh the failover list (client/servers/manager.go)
+                if isinstance(resp, dict) and resp.get("servers"):
+                    set_servers = getattr(self.conn, "set_servers", None)
+                    if set_servers is not None:
+                        set_servers(resp["servers"])
                 self._last_heartbeat_ok = time.time()
             except Exception:
                 pass  # retry next tick; server failover handled by conn

@@ -199,8 +199,8 @@ class ClusterServer:
             nack_timeout=config.nack_timeout,
             gc_interval=config.gc_interval, gc=config.gc,
         )
-        self.server = Server(srv_cfg, state=state)
         self.state = state
+        self.server = self._new_server(srv_cfg, state)
 
         fsm = FSM(state.direct())
         raft_dir = None
@@ -260,6 +260,15 @@ class ClusterServer:
         self.rpc.shutdown()
         self.pool.close()
 
+    def _new_server(self, cfg: ServerConfig, state) -> Server:
+        """Server wiring shared by startup and leadership regain."""
+        srv = Server(cfg, state=state)
+        # heartbeat responses advertise this region's alive servers so
+        # clients keep their failover list current (NodeServerInfo)
+        srv.server_addrs_fn = \
+            lambda: self.region_servers(self.config.region)
+        return srv
+
     def _on_raft_conf_change(self, action: str, peer_id: str,
                              addr) -> None:
         if action == "remove":
@@ -276,7 +285,8 @@ class ClusterServer:
                     # Subsystem threads/brokers are single-shot; regaining
                     # leadership rebuilds them over the same replicated
                     # state (reference re-runs establishLeadership).
-                    self.server = Server(self._srv_cfg, state=self.state)
+                    self.server = self._new_server(self._srv_cfg,
+                                                   self.state)
                 self._leader_enabled = True
                 self._server_used = True
                 self.server.start()

@@ -351,12 +351,22 @@ class Server:
             if was is None or not was.ready():
                 self._create_node_evals_for_system_jobs(node)
 
-    def node_heartbeat(self, node_id: str) -> bool:
+    def node_heartbeat(self, node_id: str) -> dict:
+        """Heartbeat ack + the live server set (node_endpoint.go
+        UpdateStatus responses carry NodeServerInfo so clients keep
+        their failover list current; client/servers/manager.go)."""
+        servers = []
+        fn = getattr(self, "server_addrs_fn", None)
+        if fn is not None:
+            try:
+                servers = [list(a) for a in fn()]
+            except Exception:  # noqa: BLE001 — advisory payload only
+                pass
         node = self.state.node_by_id(node_id)
         if node is None:
-            return False
+            return {"ok": False, "servers": servers}
         self.heartbeater.reset(node_id)
-        return True
+        return {"ok": True, "servers": servers}
 
     def _heartbeat_expired(self, node_id: str) -> None:
         """TTL missed → mark down + create evals (heartbeat.go:135)."""

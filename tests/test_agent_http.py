@@ -221,3 +221,21 @@ class TestHttpApi:
         t.join(timeout=15.0)
         assert not t.is_alive()
         assert _wait(lambda: len(api.job_allocations(job.id)) == 1)
+
+
+class TestWebConsole:
+    def test_ui_served(self, agent):
+        """/ and /ui serve the embedded console (ui/ in the reference,
+        thin single-file reimplementation)."""
+        import urllib.request
+
+        a, api = agent
+        host, port = a.http_addr
+        for path in ("/", "/ui", "/ui/jobs"):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=10) as resp:
+                assert resp.status == 200
+                assert "text/html" in resp.headers["Content-Type"]
+                body = resp.read().decode()
+            assert "<title>nomad-tpu</title>" in body
+            assert "/v1/jobs" in body  # fetches the real API

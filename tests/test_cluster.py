@@ -131,6 +131,29 @@ class TestCluster:
             assert _wait(lambda a=a: a.state.alloc_by_id(
                 a0.id).client_status == "running")
 
+    def test_client_learns_server_set_from_heartbeats(self, cluster,
+                                                      tmp_path):
+        """A client configured with ONE server address learns the full
+        region server set from heartbeat responses
+        (client/servers/manager.go SetServers)."""
+        from nomad_tpu.client import Client, ClientConfig, RpcConn
+
+        assert _wait(lambda: leader_of(cluster) is not None)
+        assert _wait(lambda: all(
+            len(a.membership.members()) == 3 for a in cluster))
+        leader = leader_of(cluster)
+        conn = RpcConn([leader.addr])
+        client = Client(conn, ClientConfig(
+            data_dir=str(tmp_path / "c"), heartbeat_interval=0.5,
+            watch_timeout=2.0))
+        client.start()
+        try:
+            assert _wait(lambda: len(conn.addrs) == 3), \
+                f"failover list never grew: {conn.addrs}"
+            assert set(conn.addrs) == {a.addr for a in cluster}
+        finally:
+            client.shutdown()
+
     def test_rpc_client_agent_against_cluster(self, cluster, tmp_path):
         """A real Client over the RPC fabric: watch loop, task execution,
         status sync, reschedule side effects — through any server."""

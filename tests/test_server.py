@@ -152,7 +152,7 @@ def test_heartbeat_expiry_marks_down():
         # Heartbeat after re-registration revives it
         node2 = mock.node()
         s.node_register(node2)
-        assert s.node_heartbeat(node2.id)
+        assert s.node_heartbeat(node2.id)["ok"]
     finally:
         s.shutdown()
 
