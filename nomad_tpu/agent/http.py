@@ -333,7 +333,8 @@ class HTTPApi:
             raise HttpError(404, f"alloc {alloc_id!r} not on this agent")
         self._require_namespace_cap(
             token, runner.alloc.namespace,
-            "alloc-exec" if op == "exec" else "read-job")
+            {"exec": "alloc-exec", "restart": "alloc-lifecycle",
+             "signal": "alloc-lifecycle"}.get(op, "read-job"))
         if op == "stats":
             # Allocations.Stats: per-task driver/executor usage fan-in
             tasks = {}
@@ -368,6 +369,28 @@ class HTTPApi:
                     timeout_s=float(query.get("timeout", 30)))
             except Exception as e:  # noqa: BLE001 — surface driver errors
                 raise HttpError(500, f"exec failed: {e}")
+        if op == "restart":
+            # alloc_endpoint.go Restart (alloc-lifecycle, gated above)
+            try:
+                n = runner.restart_tasks(
+                    (body or {}).get("TaskName", "")
+                    or query.get("task", ""))
+            except ValueError as e:
+                raise HttpError(400, str(e))
+            return {"restarted": n}
+        if op == "signal":
+            # alloc_endpoint.go Signal (alloc-lifecycle, gated above)
+            sig = (body or {}).get("Signal") or query.get("signal") \
+                or "SIGHUP"
+            try:
+                n = runner.signal_tasks(
+                    sig, (body or {}).get("TaskName", "")
+                    or query.get("task", ""))
+            except ValueError as e:
+                raise HttpError(400, str(e))
+            except Exception as e:  # noqa: BLE001 — driver/unknown signal
+                raise HttpError(500, f"signal failed: {e}")
+            return {"signaled": n}
         raise HttpError(404, f"unknown allocation op {op!r}")
 
     # ---- client filesystem endpoints (client/fs_endpoint.go) ----

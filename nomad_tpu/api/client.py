@@ -164,6 +164,17 @@ class NomadClient:
                             params={"namespace": namespace})
         return out.get("eval_id", "")
 
+    def alloc_restart(self, alloc_id: str, task: str = "") -> dict:
+        return self._request(
+            "PUT", f"/v1/client/allocation/{alloc_id}/restart",
+            body={"TaskName": task})
+
+    def alloc_signal(self, alloc_id: str, signal: str = "SIGHUP",
+                     task: str = "") -> dict:
+        return self._request(
+            "PUT", f"/v1/client/allocation/{alloc_id}/signal",
+            body={"Signal": signal, "TaskName": task})
+
     def job_dispatch(self, job_id: str, payload: bytes = b"",
                      meta: Optional[Dict[str, str]] = None,
                      namespace: str = "default") -> dict:

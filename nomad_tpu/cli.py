@@ -242,17 +242,41 @@ def cmd_job_revert(args) -> int:
 def cmd_alloc_stop(args) -> int:
     """`nomad-tpu alloc stop <alloc>` (command/alloc_stop.go)."""
     api = _client(args)
-    matches = [a for a in api.allocations()
-               if a.id.startswith(args.alloc_id)]
-    if len(matches) != 1:
-        print(f"Error: alloc prefix {args.alloc_id!r} matched "
-              f"{len(matches)} allocations", file=sys.stderr)
-        return 1
-    eval_id = api.alloc_stop(matches[0].id)
-    print(f"Alloc {matches[0].id[:8]} stop requested")
+    a = _resolve_alloc(api, args.alloc_id)
+    eval_id = api.alloc_stop(a.id)
+    print(f"Alloc {a.id[:8]} stop requested")
     if eval_id and not args.detach:
         return _monitor(api, eval_id)
     return 0
+
+
+def _resolve_alloc(api, prefix: str):
+    matches = [a for a in api.allocations() if a.id.startswith(prefix)]
+    if len(matches) != 1:
+        print(f"Error: alloc prefix {prefix!r} matched "
+              f"{len(matches)} allocations", file=sys.stderr)
+        raise SystemExit(1)
+    return matches[0]
+
+
+def cmd_alloc_restart(args) -> int:
+    """`nomad-tpu alloc restart <alloc> [task]`
+    (command/alloc_restart.go)."""
+    api = _client(args)
+    a = _resolve_alloc(api, args.alloc_id)
+    out = api.alloc_restart(a.id, task=args.task)
+    print(f"Restarted {out['restarted']} task(s) in alloc {a.id[:8]}")
+    return 0 if out["restarted"] else 1
+
+
+def cmd_alloc_signal(args) -> int:
+    """`nomad-tpu alloc signal -s SIGHUP <alloc> [task]`
+    (command/alloc_signal.go)."""
+    api = _client(args)
+    a = _resolve_alloc(api, args.alloc_id)
+    out = api.alloc_signal(a.id, signal=args.signal, task=args.task)
+    print(f"Signaled {out['signaled']} task(s) in alloc {a.id[:8]}")
+    return 0 if out["signaled"] else 1
 
 
 def cmd_eval_list(args) -> int:
@@ -1081,6 +1105,15 @@ def build_parser() -> argparse.ArgumentParser:
     alst.add_argument("alloc_id")
     alst.add_argument("-detach", action="store_true")
     alst.set_defaults(fn=cmd_alloc_stop)
+    alr = al.add_parser("restart")
+    alr.add_argument("alloc_id")
+    alr.add_argument("task", nargs="?", default="")
+    alr.set_defaults(fn=cmd_alloc_restart)
+    alsg = al.add_parser("signal")
+    alsg.add_argument("-s", dest="signal", default="SIGHUP")
+    alsg.add_argument("alloc_id")
+    alsg.add_argument("task", nargs="?", default="")
+    alsg.set_defaults(fn=cmd_alloc_signal)
     alx = al.add_parser("exec")
     alx.add_argument("-task", default="")
     alx.add_argument("alloc_id")

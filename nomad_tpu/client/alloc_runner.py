@@ -364,6 +364,39 @@ class AllocRunner:
         if alloc.server_terminal_status():
             self.kill()
 
+    def restart_tasks(self, task_name: str = "") -> int:
+        """User-requested restart of one task or every running task
+        (alloc_endpoint.go Restart). Returns how many were restarted."""
+        with self._lock:
+            runners = [(n, tr) for n, tr in self.task_runners.items()
+                       if not task_name or n == task_name]
+        if task_name and not runners:
+            raise ValueError(f"unknown task {task_name!r}")
+        n = 0
+        for _, tr in runners:
+            try:
+                tr.restart()
+                n += 1
+            except RuntimeError:
+                pass  # not running: nothing to restart
+        return n
+
+    def signal_tasks(self, sig: str, task_name: str = "") -> int:
+        """Deliver a signal (alloc_endpoint.go Signal)."""
+        with self._lock:
+            runners = [(n, tr) for n, tr in self.task_runners.items()
+                       if not task_name or n == task_name]
+        if task_name and not runners:
+            raise ValueError(f"unknown task {task_name!r}")
+        n = 0
+        for _, tr in runners:
+            try:
+                if tr.signal(sig):
+                    n += 1
+            except RuntimeError:
+                pass
+        return n
+
     def kill(self) -> None:
         with self._lock:
             runners = list(self.task_runners.values())

@@ -114,3 +114,8 @@ class DriverPlugin:
                   timeout_s: float = 30.0) -> dict:
         """Run a command in the task's context (ExecTask)."""
         raise NotImplementedError(f"{self.name} does not support exec")
+
+    def signal_task(self, handle: TaskHandle, sig: str = "SIGHUP") -> bool:
+        """Deliver a signal to the task (SignalTask)."""
+        raise NotImplementedError(
+            f"{self.name} does not support signaling")

@@ -269,6 +269,14 @@ class DockerDriver(DriverPlugin):
                     pass
         return base
 
+    def signal_task(self, handle: TaskHandle, sig: str = "SIGHUP") -> bool:
+        docker = _docker_bin()
+        cid = handle.driver_state.get("container_id")
+        if not docker or not cid:
+            raise RuntimeError("no container for task")
+        r = self._run(docker, "kill", "--signal", sig, cid, timeout=10.0)
+        return r.returncode == 0
+
     def exec_task(self, handle: TaskHandle, command: str,
                   args: Optional[List[str]] = None,
                   timeout_s: float = 30.0) -> dict:

@@ -171,6 +171,14 @@ class ExecutorBackedDriver(DriverPlugin):
         base["driver_state"] = handle.driver_state
         return base
 
+    def signal_task(self, handle: TaskHandle, sig: str = "SIGHUP") -> bool:
+        """driver SignalTask (plugins/drivers/driver.go) — powers
+        `alloc signal`."""
+        client = getattr(handle, "client", None)
+        if client is None or not handle.is_running():
+            raise RuntimeError("task is not running")
+        return bool(client.call("Executor.signal", sig, timeout=10.0))
+
     def exec_task(self, handle: TaskHandle, command: str,
                   args: Optional[List[str]] = None,
                   timeout_s: float = 30.0) -> dict:

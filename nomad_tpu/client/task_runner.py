@@ -31,6 +31,8 @@ EVENT_TASK_SETUP = "Task Setup"
 EVENT_STARTED = "Started"
 EVENT_TERMINATED = "Terminated"
 EVENT_RESTARTING = "Restarting"
+EVENT_RESTART_SIGNALED = "Restart Signaled"
+EVENT_SIGNALING = "Signaling"
 EVENT_NOT_RESTARTING = "Not Restarting"
 EVENT_KILLING = "Killing"
 EVENT_KILLED = "Killed"
@@ -98,6 +100,9 @@ class TaskRunner:
         self.handle = None
         self._kill = threading.Event()
         self._detach = False
+        #: user-requested restart in flight: the next task exit restarts
+        #: immediately without consuming restart-policy budget
+        self._manual_restart = False
         self._thread: Optional[threading.Thread] = None
 
     def _restart_policy(self) -> RestartPolicy:
@@ -175,6 +180,16 @@ class TaskRunner:
             self._event(EVENT_TERMINATED,
                         f"Exit Code: {result.exit_code}"
                         + (f", Err: {result.err}" if result.err else ""))
+            if self._manual_restart:
+                # alloc restart (alloc_endpoint.go Restart → taskrunner
+                # Restart): always relaunch, no policy budget consumed
+                self._manual_restart = False
+                self.state.restarts += 1
+                self.state.last_restart = time.time()
+                self._event(EVENT_RESTART_SIGNALED,
+                            "User requested restart")
+                self._set_state(TASK_STATE_PENDING)
+                continue
             if ok:
                 self._set_state(TASK_STATE_DEAD, failed=False)
                 return
@@ -401,6 +416,30 @@ class TaskRunner:
             max_files=self.task.log_config.max_files,
             max_file_size_mb=self.task.log_config.max_file_size_mb,
         )
+
+    def restart(self) -> None:
+        """User-requested graceful restart (taskrunner lifecycle.go
+        Restart): stop the current process; the run loop relaunches."""
+        if self.handle is None or not self.handle.is_running():
+            raise RuntimeError("task is not running")
+        self._manual_restart = True
+        try:
+            self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+            # confirm the process actually exited: driver stop paths
+            # swallow transport errors, and a stale armed flag would
+            # later convert a natural successful exit into a relaunch
+            if self.handle.wait(self.task.kill_timeout_s + 7.0) is None:
+                raise RuntimeError("task did not stop for restart")
+        except Exception:
+            self._manual_restart = False
+            raise
+
+    def signal(self, sig: str = "SIGHUP") -> bool:
+        """Deliver a signal to the running task (lifecycle.go Signal)."""
+        if self.handle is None or not self.handle.is_running():
+            raise RuntimeError("task is not running")
+        self._event(EVENT_SIGNALING, f"Signal {sig} sent to task")
+        return self.driver.signal_task(self.handle, sig)
 
     def kill(self) -> None:
         self._kill.set()

@@ -209,6 +209,22 @@ class ExecutorService:
             self._exit_ev.wait(2.0)
         return self._exit
 
+    def signal(self, sig: str = "SIGHUP") -> bool:
+        """executor.go Signal: deliver without initiating shutdown."""
+        if self._proc is None or self._exit is not None:
+            return False
+        signum = _signals.get(sig)
+        if signum is None:
+            raise ValueError(f"unknown signal {sig!r}")
+        try:
+            os.killpg(self._proc.pid, signum)
+        except (ProcessLookupError, PermissionError):
+            try:
+                self._proc.send_signal(signum)
+            except ProcessLookupError:
+                return False
+        return True
+
     def stats(self) -> Dict[str, object]:
         """pid_collector.go analog: cgroup stats + /proc fallback."""
         out: Dict[str, object] = {"pids": {}}
