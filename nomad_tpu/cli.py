@@ -243,6 +243,8 @@ def cmd_alloc_stop(args) -> int:
     """`nomad-tpu alloc stop <alloc>` (command/alloc_stop.go)."""
     api = _client(args)
     a = _resolve_alloc(api, args.alloc_id)
+    if a is None:
+        return 1
     eval_id = api.alloc_stop(a.id)
     print(f"Alloc {a.id[:8]} stop requested")
     if eval_id and not args.detach:
@@ -250,20 +252,13 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
-def _resolve_alloc(api, prefix: str):
-    matches = [a for a in api.allocations() if a.id.startswith(prefix)]
-    if len(matches) != 1:
-        print(f"Error: alloc prefix {prefix!r} matched "
-              f"{len(matches)} allocations", file=sys.stderr)
-        raise SystemExit(1)
-    return matches[0]
-
-
 def cmd_alloc_restart(args) -> int:
     """`nomad-tpu alloc restart <alloc> [task]`
     (command/alloc_restart.go)."""
     api = _client(args)
     a = _resolve_alloc(api, args.alloc_id)
+    if a is None:
+        return 1
     out = api.alloc_restart(a.id, task=args.task)
     print(f"Restarted {out['restarted']} task(s) in alloc {a.id[:8]}")
     return 0 if out["restarted"] else 1
@@ -274,6 +269,8 @@ def cmd_alloc_signal(args) -> int:
     (command/alloc_signal.go)."""
     api = _client(args)
     a = _resolve_alloc(api, args.alloc_id)
+    if a is None:
+        return 1
     out = api.alloc_signal(a.id, signal=args.signal, task=args.task)
     print(f"Signaled {out['signaled']} task(s) in alloc {a.id[:8]}")
     return 0 if out["signaled"] else 1

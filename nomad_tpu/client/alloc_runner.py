@@ -372,14 +372,25 @@ class AllocRunner:
                        if not task_name or n == task_name]
         if task_name and not runners:
             raise ValueError(f"unknown task {task_name!r}")
-        n = 0
-        for _, tr in runners:
+        # concurrent: each restart blocks up to kill_timeout waiting for
+        # its process to exit — serializing would push multi-task allocs
+        # past API client timeouts
+        results: List[bool] = []
+
+        def one(tr):
             try:
                 tr.restart()
-                n += 1
+                results.append(True)
             except RuntimeError:
                 pass  # not running: nothing to restart
-        return n
+
+        threads = [threading.Thread(target=one, args=(tr,), daemon=True)
+                   for _, tr in runners]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return len(results)
 
     def signal_tasks(self, sig: str, task_name: str = "") -> int:
         """Deliver a signal (alloc_endpoint.go Signal)."""

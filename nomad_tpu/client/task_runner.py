@@ -420,15 +420,16 @@ class TaskRunner:
     def restart(self) -> None:
         """User-requested graceful restart (taskrunner lifecycle.go
         Restart): stop the current process; the run loop relaunches."""
-        if self.handle is None or not self.handle.is_running():
-            raise RuntimeError("task is not running")
+        handle = self.handle  # the run loop reassigns self.handle on
+        if handle is None or not handle.is_running():  # relaunch — wait
+            raise RuntimeError("task is not running")  # on OUR handle
         self._manual_restart = True
         try:
-            self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+            self.driver.stop_task(handle, self.task.kill_timeout_s)
             # confirm the process actually exited: driver stop paths
             # swallow transport errors, and a stale armed flag would
             # later convert a natural successful exit into a relaunch
-            if self.handle.wait(self.task.kill_timeout_s + 7.0) is None:
+            if handle.wait(self.task.kill_timeout_s + 7.0) is None:
                 raise RuntimeError("task did not stop for restart")
         except Exception:
             self._manual_restart = False

@@ -659,6 +659,14 @@ class TPUStack:
 
         params, m = self.compile_tg(job, tg, n_place, plan, volumes=volumes,
                                     sampled_rows=sampled_rows)
+        # Bucket-pad this single program (parallel/mesh.py pad_params —
+        # the same inert padding the batched path uses): without it every
+        # distinct (LUT width, constraint rows, spread/dp count) combo is
+        # a fresh XLA compile, and a control plane processing many
+        # distinct jobs spends its time compiling instead of placing.
+        from ..parallel.mesh import pad_params
+
+        (params,), _ = pad_params([params])
         arrays = self.device_arrays()
         if self._jit:
             result = place_task_group_jit(arrays, _to_device(params), m)
