@@ -1068,6 +1068,7 @@ class HTTPApi:
                         name=str((body or {}).get("Name", "")),
                         description=str((body or {}).get(
                             "Description", "")),
+                        quota=str((body or {}).get("Quota", "")),
                         meta=dict((body or {}).get("Meta") or {}))
                 try:
                     server.namespace_upsert(nsobj)
@@ -1089,6 +1090,63 @@ class HTTPApi:
                     require(acl.management)
                     try:
                         server.namespace_delete(name)
+                    except ValueError as e:
+                        raise HttpError(400, str(e))
+                    return {"deleted": True}
+        # /v1/quotas + /v1/quota[/<name>] + /v1/quota/usage/<name>
+        # (the ent reference's quota API shape; management-gated writes)
+        if parts == ["quotas"]:
+            # quota specs span namespaces: operator-read gated (vs the
+            # per-namespace filtering of /v1/namespaces)
+            require(acl.management or acl.allow_operator_read())
+            return blocking(lambda snap: (
+                snap.index_at, [to_wire(q) for q in snap.quotas()]))
+        if parts and parts[0] == "quota":
+            if parts[1:] == [] and method in ("PUT", "POST"):
+                require(acl.management)
+                from ..structs.operator import QuotaSpec
+
+                try:
+                    if isinstance(body, dict) and "__t" in body:
+                        try:
+                            qobj = from_wire(body)
+                        except Exception as e:  # unknown tag/bad shape
+                            raise HttpError(400,
+                                            f"bad quota body: {e}")
+                        if not isinstance(qobj, QuotaSpec):
+                            raise HttpError(
+                                400, f"expected QuotaSpec, got "
+                                f"{type(qobj).__name__}")
+                    else:
+                        qobj = QuotaSpec(
+                            name=str((body or {}).get("Name", "")),
+                            description=str((body or {}).get(
+                                "Description", "")),
+                            cpu=int((body or {}).get("Cpu", 0) or 0),
+                            memory_mb=int((body or {}).get(
+                                "MemoryMB", 0) or 0))
+                    server.quota_upsert(qobj)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"updated": True}
+            if len(parts) == 3 and parts[1] == "usage":
+                require(acl.management or acl.allow_operator_read())
+                if state.quota_by_name(parts[2]) is None:
+                    raise HttpError(404, f"quota {parts[2]!r} not found")
+                return server.quota_usage(parts[2])
+            if len(parts) == 2:
+                name = parts[1]
+                if method == "GET":
+                    require(acl.management or acl.allow_operator_read())
+                    q = state.quota_by_name(name)
+                    if q is None:
+                        raise HttpError(404,
+                                        f"quota {name!r} not found")
+                    return to_wire(q)
+                if method == "DELETE":
+                    require(acl.management)
+                    try:
+                        server.quota_delete(name)
                     except ValueError as e:
                         raise HttpError(400, str(e))
                     return {"deleted": True}

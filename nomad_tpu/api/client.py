@@ -415,10 +415,30 @@ class NomadClient:
         return from_wire(self._request("GET", f"/v1/namespace/{name}"))
 
     def namespace_apply(self, name: str, description: str = "",
-                        meta: Optional[Dict[str, str]] = None) -> None:
+                        meta: Optional[Dict[str, str]] = None,
+                        quota: str = "") -> None:
         self._request("PUT", "/v1/namespace",
                       body={"Name": name, "Description": description,
-                            "Meta": dict(meta or {})})
+                            "Quota": quota, "Meta": dict(meta or {})})
+
+    # ---- quotas ----
+
+    def quotas(self) -> List[Any]:
+        res = self._request("GET", "/v1/quotas")
+        return [from_wire(q) for q in self._unblock(res)[1]]
+
+    def quota_apply(self, name: str, cpu: int = 0, memory_mb: int = 0,
+                    description: str = "") -> None:
+        self._request("PUT", "/v1/quota",
+                      body={"Name": name, "Cpu": cpu,
+                            "MemoryMB": memory_mb,
+                            "Description": description})
+
+    def quota_delete(self, name: str) -> None:
+        self._request("DELETE", f"/v1/quota/{name}")
+
+    def quota_usage(self, name: str) -> dict:
+        return self._request("GET", f"/v1/quota/usage/{name}")
 
     def namespace_delete(self, name: str) -> None:
         self._request("DELETE", f"/v1/namespace/{name}")

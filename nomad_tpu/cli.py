@@ -640,6 +640,37 @@ def cmd_deployment_fail(args) -> int:
 
 # ---- operator / misc ----
 
+def cmd_quota(args) -> int:
+    """`nomad-tpu quota apply|list|delete|status` (the reference's ent
+    quota commands)."""
+    api = _client(args)
+    if args.sub == "list":
+        print(_columns(
+            [[q.name, str(q.cpu) if q.cpu else "∞",
+              str(q.memory_mb) if q.memory_mb else "∞"]
+             for q in api.quotas()],
+            ["Name", "CPU(MHz)", "Memory(MB)"]))
+        return 0
+    if args.sub == "apply":
+        api.quota_apply(args.name, cpu=args.cpu,
+                        memory_mb=args.memory,
+                        description=args.description or "")
+        print(f"Successfully applied quota {args.name!r}")
+        return 0
+    if args.sub == "delete":
+        api.quota_delete(args.name)
+        print(f"Successfully deleted quota {args.name!r}")
+        return 0
+    u = api.quota_usage(args.name)
+    print(f"Name       = {u['quota']}")
+    print(f"CPU        = {u['cpu_used']:.0f} / "
+          f"{u['cpu_limit'] or '∞'} MHz")
+    print(f"Memory     = {u['memory_mb_used']:.0f} / "
+          f"{u['memory_mb_limit'] or '∞'} MB")
+    print(f"Namespaces = {', '.join(u['namespaces']) or '<none>'}")
+    return 0
+
+
 def cmd_namespace(args) -> int:
     """`nomad-tpu namespace list|apply|delete|status`
     (command/namespace_*.go)."""
@@ -652,7 +683,8 @@ def cmd_namespace(args) -> int:
         return 0
     if args.sub == "apply":
         api.namespace_apply(args.name,
-                            description=args.description or "")
+                            description=args.description or "",
+                            quota=getattr(args, "quota", "") or "")
         print(f"Successfully applied namespace {args.name!r}")
         return 0
     if args.sub == "delete":
@@ -962,7 +994,25 @@ def build_parser() -> argparse.ArgumentParser:
     nsa = nsp.add_parser("apply")
     nsa.add_argument("name")
     nsa.add_argument("-description", default="")
+    nsa.add_argument("-quota", default="")
     nsa.set_defaults(fn=cmd_namespace)
+
+    qa = sub.add_parser("quota", help="resource quotas").add_subparsers(
+        dest="sub", required=True)
+    qal = qa.add_parser("list")
+    qal.set_defaults(fn=cmd_quota)
+    qaa = qa.add_parser("apply")
+    qaa.add_argument("name")
+    qaa.add_argument("-cpu", type=int, default=0)
+    qaa.add_argument("-memory", type=int, default=0)
+    qaa.add_argument("-description", default="")
+    qaa.set_defaults(fn=cmd_quota)
+    qad = qa.add_parser("delete")
+    qad.add_argument("name")
+    qad.set_defaults(fn=cmd_quota)
+    qas = qa.add_parser("status")
+    qas.add_argument("name")
+    qas.set_defaults(fn=cmd_quota)
     nsd = nsp.add_parser("delete")
     nsd.add_argument("name")
     nsd.set_defaults(fn=cmd_namespace)

@@ -376,20 +376,27 @@ class AllocRunner:
         # its process to exit — serializing would push multi-task allocs
         # past API client timeouts
         results: List[bool] = []
+        errors: List[str] = []
 
-        def one(tr):
+        def one(name, tr):
             try:
                 tr.restart()
                 results.append(True)
             except RuntimeError:
                 pass  # not running: nothing to restart
+            except Exception as e:  # noqa: BLE001 — surface to caller
+                errors.append(f"{name}: {e}")
 
-        threads = [threading.Thread(target=one, args=(tr,), daemon=True)
-                   for _, tr in runners]
+        threads = [threading.Thread(target=one, args=(n, tr),
+                                    daemon=True) for n, tr in runners]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
+        if errors:
+            raise RuntimeError(
+                f"restarted {len(results)} task(s); failed: "
+                + "; ".join(errors))
         return len(results)
 
     def signal_tasks(self, sig: str, task_name: str = "") -> int:

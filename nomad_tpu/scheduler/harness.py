@@ -401,6 +401,31 @@ class InMemState:
     def namespace_by_name(self, name: str):
         return self._namespaces.get(name)
 
+    # ---- quotas (structs/operator.py QuotaSpec) ----
+
+    @property
+    def _quotas(self):
+        tbl = getattr(self, "_quota_rows", None)
+        if tbl is None:
+            tbl = self._quota_rows = {}
+        return tbl
+
+    def upsert_quota(self, q) -> None:
+        prev = self._quotas.get(q.name)
+        q.modify_index = next(self.index)
+        q.create_index = prev.create_index if prev else q.modify_index
+        self._quotas[q.name] = q
+
+    def delete_quota(self, name: str) -> None:
+        if self._quotas.pop(name, None) is not None:
+            next(self.index)
+
+    def quotas(self) -> List[object]:
+        return sorted(self._quotas.values(), key=lambda q: q.name)
+
+    def quota_by_name(self, name: str):
+        return self._quotas.get(name)
+
     def autopilot_config(self):
         cfg = getattr(self, "_autopilot_cfg", None)
         if cfg is None:

@@ -33,6 +33,7 @@ ALLOWED_OPS = frozenset({
     "delete_service_registrations_by_alloc",
     "upsert_secret", "delete_secret",
     "upsert_namespace", "delete_namespace",
+    "upsert_quota", "delete_quota",
 })
 
 
@@ -107,6 +108,7 @@ def snapshot_state(state) -> Dict[str, Any]:
                          for r in state.service_registrations()],
         "secrets": [to_wire(e) for e in state.secret_entries()],
         "namespaces": [to_wire(n) for n in state.namespaces()],
+        "quotas": [to_wire(q) for q in state.quotas()],
         "acl": {
             "bootstrapped": state.acl.bootstrapped,
             "policies": [to_wire(p) for p in state.acl.policies()],
@@ -161,6 +163,8 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
     for tree in snap.get("namespaces", []):
         _upsert_preserving_indexes(state.upsert_namespace,
                                    from_wire(tree))
+    for tree in snap.get("quotas", []):
+        _upsert_preserving_indexes(state.upsert_quota, from_wire(tree))
     acl = snap.get("acl")
     if acl is not None:
         for tree in acl.get("policies", []):

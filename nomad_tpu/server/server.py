@@ -262,6 +262,7 @@ class Server:
             # does not exist (job_endpoint.go Register → ns lookup)
             raise ValueError(
                 f"namespace {job.namespace!r} does not exist")
+        self._enforce_quota(job)
         if job.is_periodic() and job.periodic.spec_type == "cron":
             # Reject a bad cron spec BEFORE the job reaches state
             # (job_endpoint.go Register → Job.Validate → PeriodicConfig).
@@ -498,6 +499,9 @@ class Server:
 
         if not re.fullmatch(r"[a-zA-Z0-9][a-zA-Z0-9_-]{0,127}", ns.name):
             raise ValueError(f"invalid namespace name {ns.name!r}")
+        if getattr(ns, "quota", "") \
+                and self.state.quota_by_name(ns.quota) is None:
+            raise ValueError(f"quota {ns.quota!r} does not exist")
         self.state.upsert_namespace(ns)
 
     def namespace_delete(self, name: str) -> None:
@@ -519,6 +523,96 @@ class Server:
         # KV secrets cascade with the delete (state mutator) — they must
         # not survive to re-attach to a future namespace of this name
         self.state.delete_namespace(name)
+
+    # ---- quotas (the reference's enterprise QuotaSpec, enforced at job
+    # admission with spec-based accounting) ----
+
+    def quota_upsert(self, q) -> None:
+        import re
+
+        if not re.fullmatch(r"[a-zA-Z0-9][a-zA-Z0-9_-]{0,127}", q.name):
+            raise ValueError(f"invalid quota name {q.name!r}")
+        if q.cpu < 0 or q.memory_mb < 0:
+            raise ValueError("quota limits must be >= 0")
+        self.state.upsert_quota(q)
+
+    def quota_delete(self, name: str) -> None:
+        if self.state.quota_by_name(name) is None:
+            raise ValueError(f"quota {name!r} not found")
+        attached = [n.name for n in self.state.namespaces()
+                    if n.quota == name]
+        if attached:
+            raise ValueError(
+                f"quota {name!r} attached to namespaces: {attached}")
+        self.state.delete_quota(name)
+
+    @staticmethod
+    def _job_requested(job: Job) -> Tuple[float, float]:
+        """Spec-requested (cpu, memory_mb) for a whole job: Σ group count
+        × the group's combined task resources."""
+        cpu = mem = 0.0
+        for tg in job.task_groups:
+            res = job.combined_task_resources(tg)
+            cpu += tg.count * res.cpu
+            mem += tg.count * res.memory_mb
+        return cpu, mem
+
+    def _quota_totals(self, quota_name: str,
+                      exclude: Optional[Tuple[str, str]] = None
+                      ) -> Tuple[float, float, set]:
+        """(cpu, memory) requested across the quota's attached
+        namespaces: non-stopped, non-template jobs, optionally excluding
+        one (namespace, job_id) — the single accounting rule shared by
+        enforcement and the usage report so they can never diverge."""
+        ns_names = {n.name for n in self.state.namespaces()
+                    if n.quota == quota_name}
+        cpu = mem = 0.0
+        for job in self.state.jobs():
+            if job.namespace not in ns_names or job.stop \
+                    or job.is_parameterized() or job.is_periodic():
+                continue
+            if exclude is not None \
+                    and (job.namespace, job.id) == exclude:
+                continue
+            c, m = self._job_requested(job)
+            cpu += c
+            mem += m
+        return cpu, mem, ns_names
+
+    def quota_usage(self, name: str) -> dict:
+        """Spec-based usage across every namespace attached to the
+        quota."""
+        cpu, mem, ns_names = self._quota_totals(name)
+        q = self.state.quota_by_name(name)
+        return {"quota": name, "cpu_used": cpu, "memory_mb_used": mem,
+                "cpu_limit": q.cpu if q else 0,
+                "memory_mb_limit": q.memory_mb if q else 0,
+                "namespaces": sorted(ns_names)}
+
+    def _enforce_quota(self, job: Job) -> None:
+        """Admission check (the ent reference rejects Register when the
+        namespace's quota would be exceeded). Spec-based: deterministic
+        and plan-independent. Periodic/parameterized parents are
+        templates — their children are charged when dispatched."""
+        ns = self.state.namespace_by_name(job.namespace)
+        if ns is None or not getattr(ns, "quota", ""):
+            return
+        q = self.state.quota_by_name(ns.quota)
+        if q is None or (not q.cpu and not q.memory_mb):
+            return
+        if job.is_parameterized() or job.is_periodic() or job.stop:
+            return
+        req_cpu, req_mem = self._job_requested(job)
+        used_cpu, used_mem, _ = self._quota_totals(
+            ns.quota, exclude=(job.namespace, job.id))
+        if q.cpu and used_cpu + req_cpu > q.cpu:
+            raise ValueError(
+                f"quota {q.name!r} exceeded: cpu "
+                f"{used_cpu + req_cpu:.0f} > limit {q.cpu}")
+        if q.memory_mb and used_mem + req_mem > q.memory_mb:
+            raise ValueError(
+                f"quota {q.name!r} exceeded: memory "
+                f"{used_mem + req_mem:.0f} MB > limit {q.memory_mb} MB")
 
     # ---- secrets KV (the Vault-analog engine; nomad/vault.go's role
     # collapsed into replicated state — see structs/secrets.py) ----
@@ -754,6 +848,7 @@ class Server:
         previous = tg.count
         job = copy.deepcopy(job)
         job.lookup_task_group(group).count = count
+        self._enforce_quota(job)  # scale bypasses job_register
         job.version += 1
         self.state.upsert_job(job)
         ev = self._create_eval(

@@ -48,6 +48,7 @@ class StateSnapshot(InMemState):
         self._config = store._config
         self._csi_volumes = dict(store._csi)
         self._namespace_rows = dict(store._namespaces)
+        self._quota_rows = dict(store._quotas)
         self._service_regs = dict(store._services)
         self._secret_entries = dict(store._secrets)
         self._acl_store = store.acl  # shared: snapshots read live tokens
@@ -161,6 +162,10 @@ class StateStore(InMemState):
     namespaces = _locked("namespaces")
     namespace_by_name = _locked("namespace_by_name")
     job_versions_by_id = _locked("job_versions_by_id")
+    upsert_quota = _locked("upsert_quota")
+    delete_quota = _locked("delete_quota")
+    quotas = _locked("quotas")
+    quota_by_name = _locked("quota_by_name")
     del _locked
 
     def delete_alloc(self, alloc_id: str) -> None:

@@ -37,6 +37,9 @@ class Namespace:
 
     name: str = ""
     description: str = ""
+    #: attached QuotaSpec name ("" = unlimited; the reference's ent-only
+    #: namespace quota attachment)
+    quota: str = ""
     meta: dict = None  # type: ignore[assignment]
     create_index: int = 0
     modify_index: int = 0
@@ -44,6 +47,21 @@ class Namespace:
     def __post_init__(self) -> None:
         if self.meta is None:
             self.meta = {}
+
+
+@dataclass
+class QuotaSpec:
+    """Resource ceiling shared by every namespace attached to it (the
+    reference's enterprise QuotaSpec, enforced here at job admission:
+    spec-based accounting over the non-stopped jobs of the attached
+    namespaces). 0 means unlimited for that dimension."""
+
+    name: str = ""
+    description: str = ""
+    cpu: int = 0        # MHz
+    memory_mb: int = 0
+    create_index: int = 0
+    modify_index: int = 0
 
 
 @dataclass
