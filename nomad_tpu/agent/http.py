@@ -1093,6 +1093,28 @@ class HTTPApi:
                     except ValueError as e:
                         raise HttpError(400, str(e))
                     return {"deleted": True}
+        # /v1/validate/job (command/agent/job_endpoint.go ValidateJobRequest)
+        if parts == ["validate", "job"] and method in ("PUT", "POST"):
+            from ..structs.job import Job as _Job
+
+            try:
+                job = from_wire(body["job"] if "job" in (body or {})
+                                else body)
+            except Exception as e:  # unknown tag / bad shape
+                raise HttpError(400, f"bad job body: {e}")
+            if not isinstance(job, _Job):
+                raise HttpError(400, f"expected Job, got "
+                                f"{type(job).__name__}")
+            # same capability as the register path (Job.Validate RPC)
+            require(acl.allow_namespace_operation(job.namespace,
+                                                  "submit-job"))
+            err = job.validate()
+            warnings = []
+            if state.namespace_by_name(job.namespace) is None:
+                warnings.append(
+                    f"namespace {job.namespace!r} does not exist")
+            return {"valid": not err, "error": err or "",
+                    "warnings": warnings}
         # /v1/quotas + /v1/quota[/<name>] + /v1/quota/usage/<name>
         # (the ent reference's quota API shape; management-gated writes)
         if parts == ["quotas"]:

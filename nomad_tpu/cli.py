@@ -211,6 +211,38 @@ def cmd_job_inspect(args) -> int:
     return 0
 
 
+def cmd_job_validate(args) -> int:
+    """`nomad-tpu job validate <spec>` (command/job_validate.go):
+    HCL parse + server-side spec validation without registering."""
+    from .jobspec import HclError, parse_file
+
+    try:
+        job = parse_file(args.spec)
+    except (HclError, OSError) as e:
+        print(f"Error parsing jobspec: {e}", file=sys.stderr)
+        return 1
+    from .structs.codec import to_wire
+
+    out = _client(args)._request("PUT", "/v1/validate/job",
+                                 body={"job": to_wire(job)})
+    for w in out.get("warnings", []):
+        print(f"Warning: {w}")
+    if not out.get("valid", False):
+        print(f"Error: {out.get('error', 'invalid job')}",
+              file=sys.stderr)
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+def cmd_ui(args) -> int:
+    """`nomad-tpu ui` (command/ui.go): print the web console URL."""
+    addr = args.address or os.environ.get("NOMAD_ADDR",
+                                          "http://127.0.0.1:4646")
+    print(f"Web console: {addr.rstrip('/')}/ui")
+    return 0
+
+
 def cmd_job_history(args) -> int:
     """`nomad-tpu job history <job>` (command/job_history.go)."""
     api = _client(args)
@@ -1090,6 +1122,9 @@ def build_parser() -> argparse.ArgumentParser:
     ji.add_argument("job_id")
     ji.add_argument("-namespace", default="default")
     ji.set_defaults(fn=cmd_job_inspect)
+    jv = job.add_parser("validate")
+    jv.add_argument("spec")
+    jv.set_defaults(fn=cmd_job_validate)
     jh = job.add_parser("history")
     jh.add_argument("job_id")
     jh.add_argument("-namespace", default="default")
@@ -1263,6 +1298,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status", help="cluster status")
     st.set_defaults(fn=cmd_status)
+    uip = sub.add_parser("ui", help="print the web console URL")
+    uip.set_defaults(fn=cmd_ui)
     mon = sub.add_parser("monitor", help="stream agent logs")
     mon.add_argument("-log-level", default="", dest="log_level")
     mon.add_argument("-f", dest="follow", action="store_true")

@@ -375,6 +375,53 @@ class Job:
     def stopped(self) -> bool:
         return self.stop
 
+    def validate(self) -> str:
+        """Structural spec validation (reference `structs.Job.Validate`,
+        structs.go:3900). Returns "" when valid, else the first error —
+        the register endpoint rejects before anything is journaled."""
+        if not self.id:
+            return "missing job ID"
+        if "\x00" in self.id or self.id.strip() != self.id:
+            return f"invalid job ID {self.id!r}"
+        if self.type not in (JOB_TYPE_SERVICE, JOB_TYPE_BATCH,
+                             JOB_TYPE_SYSTEM, JOB_TYPE_CORE):
+            return f"invalid job type {self.type!r}"
+        if self.priority < 1 or self.priority > 100:
+            return f"job priority {self.priority} not in [1, 100]"
+        if not self.datacenters:
+            return "job needs at least one datacenter"
+        if not self.task_groups:
+            return "job needs at least one task group"
+        seen_tg = set()
+        for tg in self.task_groups:
+            if not tg.name:
+                return "task group missing name"
+            if tg.name in seen_tg:
+                return f"duplicate task group {tg.name!r}"
+            seen_tg.add(tg.name)
+            if tg.count < 0:
+                return f"group {tg.name!r} count {tg.count} is negative"
+            if not tg.tasks:
+                return f"group {tg.name!r} needs at least one task"
+            seen_t = set()
+            for t in tg.tasks:
+                if not t.name:
+                    return f"task in group {tg.name!r} missing name"
+                if t.name in seen_t:
+                    return (f"duplicate task {t.name!r} in group "
+                            f"{tg.name!r}")
+                seen_t.add(t.name)
+                if not t.driver:
+                    return f"task {t.name!r} missing driver"
+                r = t.resources
+                if r.cpu < 0 or r.memory_mb < 0:
+                    return f"task {t.name!r} has negative resources"
+        if self.type == JOB_TYPE_SYSTEM and self.is_periodic():
+            return "system jobs cannot be periodic"
+        if self.type == JOB_TYPE_SYSTEM and self.is_parameterized():
+            return "system jobs cannot be parameterized"
+        return ""
+
     def is_periodic(self) -> bool:
         return self.periodic is not None
 

@@ -123,6 +123,46 @@ class TestNamespaces:
         with pytest.raises(ValueError, match="CSI volumes"):
             server.namespace_delete("vols")
 
+    def test_job_spec_validation_rejects_bad_specs(self, server):
+        """structs.Job.Validate analog: bad specs never reach state."""
+        for mutate, msg in [
+                (lambda j: setattr(j.task_groups[0], "count", -1),
+                 "negative"),
+                (lambda j: setattr(j, "type", "wat"), "invalid job type"),
+                (lambda j: setattr(j, "priority", 0), "not in"),
+                (lambda j: setattr(j, "datacenters", []), "datacenter"),
+                (lambda j: setattr(j.task_groups[0], "tasks", []),
+                 "at least one task"),
+                (lambda j: setattr(j.task_groups[0].tasks[0], "driver",
+                                   ""), "missing driver")]:
+            job = mock.job()
+            mutate(job)
+            with pytest.raises(ValueError, match=msg):
+                server.job_register(job)
+            assert server.state.job_by_id("default", job.id) is None
+
+    def test_validate_route(self, server):
+        from nomad_tpu.structs.codec import to_wire
+
+        api = _api(server)
+        try:
+            good = mock.job()
+            out = api.route("PUT", "/v1/validate/job", {},
+                            {"job": to_wire(good)})
+            assert out["valid"] is True
+            bad = mock.job()
+            bad.task_groups[0].count = -2
+            out = api.route("PUT", "/v1/validate/job", {},
+                            {"job": to_wire(bad)})
+            assert out["valid"] is False and "negative" in out["error"]
+            ghost = mock.job(namespace="ghost-ns")
+            out = api.route("PUT", "/v1/validate/job", {},
+                            {"job": to_wire(ghost)})
+            assert out["valid"] is True  # warning, not error
+            assert any("ghost-ns" in w for w in out["warnings"])
+        finally:
+            api.httpd.server_close()
+
     def test_write_needs_management_token(self):
         from nomad_tpu.agent import Agent, AgentConfig
         from nomad_tpu.api import ApiError, NomadClient
