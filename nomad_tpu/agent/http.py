@@ -512,6 +512,22 @@ class HTTPApi:
                               in _tb.format_stack(frame)],
                 })
             return {"threads": dump, "count": len(dump)}
+        # /v1/agent/join — add a server to this agent's gossip pool
+        # (agent_endpoint.go AgentJoinRequest; agent:write)
+        if parts0[1:] == ["agent", "join"] and method in ("PUT", "POST"):
+            self._require_local(token, "agent_write")
+            cluster0 = getattr(self.agent, "cluster", None)
+            if cluster0 is None or not hasattr(cluster0, "membership"):
+                raise HttpError(501,
+                                "this agent is not a gossiping server")
+            address = query.get("address", "")
+            # rpartition + bracket strip: "[::1]:4648" and "host:4648"
+            host0, _, port0 = address.rpartition(":")
+            host0 = host0.strip("[]")
+            if not host0 or not port0.isdigit():
+                raise HttpError(400, "address must be host:port")
+            ok = cluster0.membership.join([(host0, int(port0))])
+            return {"num_joined": 1 if ok else 0}
         # /v1/agent/monitor — agent-local log ring (agent_endpoint.go
         # Monitor; agent:read)
         if parts0[1:] == ["agent", "monitor"]:

@@ -277,7 +277,10 @@ class NomadClient:
             params={"namespace": namespace}))
 
     def csi_volume_register(self, vol) -> None:
+        # the ACL gate authorizes against ?namespace — it must be the
+        # volume's own, not the default
         self._request("PUT", f"/v1/volume/csi/{vol.id}",
+                      params={"namespace": vol.namespace},
                       body=to_wire(vol))
 
     def csi_volume_deregister(self, vol_id: str,
@@ -366,6 +369,21 @@ class NomadClient:
     def fail_deployment(self, deployment_id: str) -> str:
         out = self._request("PUT", f"/v1/deployment/fail/{deployment_id}")
         return out.get("eval_id", "")
+
+    def pause_deployment(self, deployment_id: str,
+                         pause: bool = True) -> None:
+        self._request("PUT", f"/v1/deployment/pause/{deployment_id}",
+                      body={"pause": pause})
+
+    def plugins(self) -> List[Any]:
+        res = self._request("GET", "/v1/plugins")
+        return [from_wire(p) for p in self._unblock(res)[1]]
+
+    def agent_join(self, address: str) -> dict:
+        """Join this agent's gossip pool to another server
+        (api/agent.go Join)."""
+        return self._request("PUT", "/v1/agent/join",
+                             params={"address": address})
 
     # ---- operator / system / agent ----
 

@@ -781,6 +781,121 @@ def cmd_service_info(args) -> int:
     return 0
 
 
+def cmd_agent_info(args) -> int:
+    """`nomad-tpu agent-info` (command/agent_info.go)."""
+    info = _client(args).agent_self()
+    for k in sorted(info):
+        print(f"{k} = {info[k]}")
+    return 0
+
+
+def cmd_server_join(args) -> int:
+    """`nomad-tpu server join <host:port>` (command/server_join.go)."""
+    out = _client(args).agent_join(args.join_address)
+    n = out.get("num_joined", 0)
+    print(f"Joined {n} server(s)")
+    return 0 if n else 1
+
+
+def cmd_volume(args) -> int:
+    """`nomad-tpu volume register|deregister|status`
+    (command/volume_*.go)."""
+    api = _client(args)
+    if args.sub == "register":
+        from .jobspec.hcl import parse_hcl
+        from .structs.csi import CSIVolume
+
+        with open(args.spec) as f:
+            tree = parse_hcl(f.read())
+
+        def one(v):
+            return v[0] if isinstance(v, list) and v else (v or {})
+
+        body = one(tree.get("volume")) or tree
+        if isinstance(body, dict) and len(body) == 1 \
+                and isinstance(next(iter(body.values())), (list, dict)):
+            (vid, vbody), = body.items()
+            body = dict(one(vbody), id=vid)
+        vol = CSIVolume(
+            id=str(body.get("id", "")),
+            name=str(body.get("name", body.get("id", ""))),
+            namespace=str(body.get("namespace", "default")),
+            plugin_id=str(body.get("plugin_id", "")),
+            access_mode=str(body.get("access_mode",
+                                     "single-node-writer")),
+            attachment_mode=str(body.get("attachment_mode",
+                                         "file-system")))
+        if not vol.id or not vol.plugin_id:
+            print("Error: volume spec needs id and plugin_id",
+                  file=sys.stderr)
+            return 1
+        api.csi_volume_register(vol)
+        print(f"Registered volume {vol.id!r}")
+        return 0
+    if args.sub == "deregister":
+        api.csi_volume_deregister(args.volume_id,
+                                  namespace=args.namespace)
+        print(f"Deregistered volume {args.volume_id!r}")
+        return 0
+    vols = api.csi_volumes()
+    if getattr(args, "volume_id", ""):
+        vols = [v for v in vols if v.id.startswith(args.volume_id)]
+        if not vols:
+            print(f"No volume matches {args.volume_id!r}",
+                  file=sys.stderr)
+            return 1
+    print(_columns(
+        [[v.id, v.plugin_id, v.access_mode,
+          "yes" if v.schedulable else "no",
+          str(len(v.read_claims) + len(v.write_claims))]
+         for v in vols],
+        ["ID", "Plugin", "Access", "Schedulable", "Claims"]))
+    return 0
+
+
+def cmd_plugin_status(args) -> int:
+    """`nomad-tpu plugin status` (command/plugin_status.go)."""
+    rows = _client(args).plugins()
+    print(_columns(
+        [[p.id, p.provider or "csi",
+          f"{p.nodes_healthy}/{p.nodes_expected}",
+          f"{p.controllers_healthy}/{p.controllers_expected}"]
+         for p in rows],
+        ["ID", "Provider", "Nodes", "Controllers"]))
+    return 0
+
+
+def cmd_scaling(args) -> int:
+    """`nomad-tpu scaling policies|policy <id>`
+    (command/scaling_policy_*.go)."""
+    api = _client(args)
+    if args.sub == "policies":
+        print(_columns(
+            [[sp.id[:8], sp.target.get("Job", ""),
+              sp.target.get("Group", ""), str(sp.min), str(sp.max),
+              str(sp.enabled).lower()] for sp in api.scaling_policies()],
+            ["ID", "Job", "Group", "Min", "Max", "Enabled"]))
+        return 0
+    sp = api.scaling_policy(args.policy_id)
+    print(f"ID      = {sp.id}")
+    print(f"Target  = {sp.target}")
+    print(f"Min/Max = {sp.min}/{sp.max}")
+    print(f"Enabled = {sp.enabled}")
+    return 0
+
+
+def cmd_deployment_pause(args) -> int:
+    _client(args).pause_deployment(args.deployment_id, pause=True)
+    print(f"Deployment {args.deployment_id[:8]} paused")
+    return 0
+
+
+def cmd_deployment_resume(args) -> int:
+    _client(args).pause_deployment(args.deployment_id, pause=False)
+    print(f"Deployment {args.deployment_id[:8]} resumed")
+    return 0
+
+
 def cmd_regions_list(args) -> int:
     """`nomad-tpu regions list` (command/regions.go)."""
     for r in _client(args).regions():
@@ -1252,11 +1367,51 @@ def build_parser() -> argparse.ArgumentParser:
     df = dep.add_parser("fail")
     df.add_argument("deployment_id")
     df.set_defaults(fn=cmd_deployment_fail)
+    dpa = dep.add_parser("pause")
+    dpa.add_argument("deployment_id")
+    dpa.set_defaults(fn=cmd_deployment_pause)
+    dre = dep.add_parser("resume")
+    dre.add_argument("deployment_id")
+    dre.set_defaults(fn=cmd_deployment_resume)
 
     srv = sub.add_parser("server", help="server commands").add_subparsers(
         dest="sub", required=True)
     sm = srv.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+    sj = srv.add_parser("join")
+    # NOT named "address": that would clobber the global -address flag
+    sj.add_argument("join_address", help="host:port of a server to join")
+    sj.set_defaults(fn=cmd_server_join)
+
+    ai = sub.add_parser("agent-info", help="agent diagnostics")
+    ai.set_defaults(fn=cmd_agent_info)
+
+    vol = sub.add_parser("volume", help="CSI volumes").add_subparsers(
+        dest="sub", required=True)
+    vs = vol.add_parser("status")
+    vs.add_argument("volume_id", nargs="?", default="")
+    vs.set_defaults(fn=cmd_volume)
+    vr = vol.add_parser("register")
+    vr.add_argument("spec")
+    vr.set_defaults(fn=cmd_volume)
+    vd = vol.add_parser("deregister")
+    vd.add_argument("volume_id")
+    vd.add_argument("-namespace", default="default")
+    vd.set_defaults(fn=cmd_volume)
+
+    plg = sub.add_parser("plugin", help="CSI plugins").add_subparsers(
+        dest="sub", required=True)
+    ps = plg.add_parser("status")
+    ps.set_defaults(fn=cmd_plugin_status)
+
+    sca = sub.add_parser("scaling",
+                         help="scaling policies").add_subparsers(
+        dest="sub", required=True)
+    scp = sca.add_parser("policies")
+    scp.set_defaults(fn=cmd_scaling)
+    sci = sca.add_parser("policy")
+    sci.add_argument("policy_id")
+    sci.set_defaults(fn=cmd_scaling)
 
     op = sub.add_parser("operator", help="operator commands").add_subparsers(
         dest="sub", required=True)
@@ -1321,6 +1476,8 @@ def main(argv: Optional[List[str]] = None) -> int:
               "(is `nomad-tpu agent` running? set -address/$NOMAD_ADDR)",
               file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        return 0  # output piped into a closed reader (e.g. `| head`)
 
 
 if __name__ == "__main__":

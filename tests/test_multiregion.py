@@ -89,6 +89,28 @@ class TestFederation:
                           None)
         assert got["id"] == job.id
 
+    def test_http_agent_join_federates(self):
+        """`server join` over HTTP (agent_endpoint.go Join) wires the
+        WAN the same way join_wan does."""
+        east = make_region("east2", "e0")
+        west = make_region("west2", "w0")
+        api = HTTPApi(_Facade(east), "127.0.0.1", 0)
+        try:
+            assert _wait(lambda: east.is_leader())
+            assert _wait(lambda: west.is_leader())
+            out = api.route(
+                "PUT", "/v1/agent/join",
+                {"address": f"{west.addr[0]}:{west.addr[1]}"}, None)
+            assert out["num_joined"] == 1
+            assert _wait(lambda: east.regions() == ["east2", "west2"])
+            with pytest.raises(HttpError):
+                api.route("PUT", "/v1/agent/join",
+                          {"address": "not-an-addr"}, None)
+        finally:
+            api.httpd.server_close()
+            east.shutdown()
+            west.shutdown()
+
     def test_unknown_region_errors(self, federation):
         east, _, api_e, _ = federation
         with pytest.raises(HttpError):
