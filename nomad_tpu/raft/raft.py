@@ -15,6 +15,15 @@ consensus library is vendored here:
 Threading model: one ticker thread (election/heartbeat), one applier
 thread (feeds committed entries to the FSM apply_fn in order), replication
 performed per-peer on heartbeat ticks and on demand after an append.
+
+Known boundary vs the reference: no log compaction / InstallSnapshot.
+The log grows with cluster lifetime (in memory and, when data_dir is
+set, in the journal). The operational escape hatches are (a) the WAL
+layer's own FSM snapshots for single-server durability and (b)
+`operator snapshot save/restore` to re-seed a fresh cluster; a follower
+that must replay from index 1 always can, because nothing is ever
+truncated. Membership changes ride the log (remove_peer/add_peer), and
+a server added mid-life replays the full history on join.
 """
 from __future__ import annotations
 
