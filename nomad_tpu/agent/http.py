@@ -528,6 +528,26 @@ class HTTPApi:
                 raise HttpError(400, "address must be host:port")
             ok = cluster0.membership.join([(host0, int(port0))])
             return {"num_joined": 1 if ok else 0}
+        # /v1/agent/force-leave — mark a gossip member left without
+        # waiting for the failure detector (agent_endpoint.go
+        # AgentForceLeaveRequest; agent:write)
+        if parts0[1:] == ["agent", "force-leave"] \
+                and method in ("PUT", "POST"):
+            self._require_local(token, "agent_write")
+            cluster0 = getattr(self.agent, "cluster", None)
+            if cluster0 is None or not hasattr(cluster0, "membership"):
+                raise HttpError(501,
+                                "this agent is not a gossiping server")
+            name = query.get("node", "")
+            if not name:
+                raise HttpError(400, "missing ?node=")
+            try:
+                cluster0.membership.force_leave(name)
+            except KeyError:
+                raise HttpError(404, f"unknown member {name!r}")
+            except ValueError as e:
+                raise HttpError(400, str(e))
+            return {"left": name}
         # /v1/agent/monitor — agent-local log ring (agent_endpoint.go
         # Monitor; agent:read)
         if parts0[1:] == ["agent", "monitor"]:

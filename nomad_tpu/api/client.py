@@ -385,6 +385,12 @@ class NomadClient:
         return self._request("PUT", "/v1/agent/join",
                              params={"address": address})
 
+    def agent_force_leave(self, node: str) -> dict:
+        """Force a member out of the gossip pool (api/agent.go
+        ForceLeave)."""
+        return self._request("PUT", "/v1/agent/force-leave",
+                             params={"node": node})
+
     # ---- operator / system / agent ----
 
     def scheduler_config(self):

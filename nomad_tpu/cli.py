@@ -797,6 +797,14 @@ def cmd_server_join(args) -> int:
     return 0 if n else 1
 
 
+def cmd_server_force_leave(args) -> int:
+    """`nomad-tpu server force-leave <name>`
+    (command/server_force_leave.go)."""
+    out = _client(args).agent_force_leave(args.node)
+    print(f"Member {out['left']!r} marked left")
+    return 0
+
+
 def cmd_volume(args) -> int:
     """`nomad-tpu volume register|deregister|status`
     (command/volume_*.go)."""
@@ -1382,6 +1390,9 @@ def build_parser() -> argparse.ArgumentParser:
     # NOT named "address": that would clobber the global -address flag
     sj.add_argument("join_address", help="host:port of a server to join")
     sj.set_defaults(fn=cmd_server_join)
+    sfl = srv.add_parser("force-leave")
+    sfl.add_argument("node", help="gossip member name (node.region)")
+    sfl.set_defaults(fn=cmd_server_force_leave)
 
     ai = sub.add_parser("agent-info", help="agent diagnostics")
     ai.set_defaults(fn=cmd_agent_info)

@@ -262,6 +262,37 @@ class Membership:
                            m.last_seen, dict(m.tags))
                     for m in self._members.values()]
 
+    def force_leave(self, name: str) -> None:
+        """Operator override (serf RemoveFailedNode / `server
+        force-leave`): mark a FAILED/SUSPECT member LEFT so reaping
+        doesn't wait out the failure detector.
+
+        Raises KeyError for an unknown member, ValueError for self or a
+        member still ALIVE (serf's RemoveFailedNode likewise applies to
+        failed nodes only — an operator typo must not evict a healthy
+        voter). The incarnation jumps by a margin so a stale higher
+        ALIVE entry held by some peer can't silently revert the LEFT
+        mark mid-propagation; a genuinely live node still wins by
+        refuting above the jump."""
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                raise KeyError(name)
+            if m.name == self.name:
+                raise ValueError(
+                    "cannot force-leave self; shut this server down "
+                    "gracefully instead")
+            if m.status == STATUS_ALIVE:
+                raise ValueError(
+                    f"member {name!r} is alive — force-leave applies "
+                    "to failed members")
+            m.status = STATUS_LEFT
+            m.incarnation += 64
+            snap = Member(m.name, m.addr, m.status, m.incarnation,
+                          m.last_seen, dict(m.tags))
+        if self.on_change is not None:
+            self.on_change(snap)
+
     def set_tag(self, key: str, value: str) -> None:
         """Update a local tag and bump incarnation so it propagates
         (serf SetTags re-broadcasts the member with fresh tags)."""

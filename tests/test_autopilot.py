@@ -146,6 +146,50 @@ class TestAutopilot:
                      not in new_leader.raft.peers, timeout=30.0), \
             "ex-leader not pruned"
 
+    def test_force_leave_prunes_without_waiting(self, cluster):
+        """`server force-leave` marks a crashed member LEFT immediately;
+        autopilot prunes without waiting for the failure detector."""
+        assert _wait(lambda: leader_of(cluster) is not None)
+        assert _wait(lambda: all(
+            len(a.membership.members()) == 3 for a in cluster))
+        leader = leader_of(cluster)
+        victim = next(a for a in cluster if a is not leader)
+        api = HTTPApi(_Facade(leader), "127.0.0.1", 0)
+        try:
+            # refusals: healthy members and self are protected
+            with pytest.raises(HttpError) as ei:
+                api.route("PUT", "/v1/agent/force-leave",
+                          {"node": victim.membership.name}, None)
+            assert ei.value.code == 400 and "alive" in str(ei.value)
+            with pytest.raises(HttpError) as ei:
+                api.route("PUT", "/v1/agent/force-leave",
+                          {"node": leader.membership.name}, None)
+            assert ei.value.code == 400
+            victim.raft.shutdown()
+            victim.rpc.shutdown()
+            victim.membership.stop()
+            from nomad_tpu.server.gossip import (STATUS_ALIVE,
+                                                 STATUS_LEFT)
+
+            # wait for the detector to mark it suspect/failed first
+            assert _wait(lambda: next(
+                m.status for m in leader.membership.members()
+                if m.name == victim.membership.name) != STATUS_ALIVE,
+                timeout=20.0)
+            out = api.route("PUT", "/v1/agent/force-leave",
+                            {"node": victim.membership.name}, None)
+            assert out["left"] == victim.membership.name
+            assert next(m.status for m in leader.membership.members()
+                        if m.name == victim.membership.name) \
+                == STATUS_LEFT
+            assert _wait(lambda: victim.config.node_id
+                         not in leader.raft.peers, timeout=20.0)
+            with pytest.raises(HttpError):
+                api.route("PUT", "/v1/agent/force-leave",
+                          {"node": "ghost"}, None)
+        finally:
+            api.httpd.server_close()
+
     def test_cleanup_disabled_keeps_peer(self, cluster):
         from nomad_tpu.structs.operator import AutopilotConfig
 
