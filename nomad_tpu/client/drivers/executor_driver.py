@@ -107,22 +107,50 @@ class ExecutorBackedDriver(DriverPlugin):
         except Exception:
             client.kill()
             raise
+        logs_dir = os.path.dirname(cfg.stdout_path) \
+            if cfg.stdout_path else ""
         handle = ExecutorTaskHandle(
             cfg.id, self.name, client,
             driver_state={
                 "reattach": client.reattach_config(),
                 "task_pid": res.get("pid"),
                 "applied": res.get("applied"),
+                # durable exit record the executor writes at task exit —
+                # recovery falls back to it when the (self-reaped)
+                # executor is gone, instead of re-running the task
+                "exit_record": os.path.join(
+                    logs_dir, f".{cfg.id.replace('/', '_')}.exit.json")
+                if logs_dir else "",
             },
         )
         return handle
 
     def recover_task(self, task_id: str,
                      driver_state: dict) -> Optional[TaskHandle]:
-        """plugins/drivers RecoverTask: reattach to the live executor; None
-        when it (and therefore the task) is gone."""
+        """plugins/drivers RecoverTask: reattach to the live executor;
+        fall back to the durable exit record when the executor already
+        self-reaped (its task had FINISHED — returning None there would
+        make the restart loop re-run a completed task); None only when
+        the task's fate is genuinely unknown."""
         client = reattach_plugin(driver_state.get("reattach") or {})
         if client is None:
+            rec_path = driver_state.get("exit_record") or ""
+            if rec_path and os.path.exists(rec_path):
+                import json as _json
+
+                try:
+                    with open(rec_path) as f:
+                        rec = _json.load(f)
+                except (OSError, ValueError):
+                    return None
+                handle = TaskHandle(task_id, self.name,
+                                    driver_state=driver_state)
+                handle.set_exit(ExitResult(
+                    exit_code=int(rec.get("exit_code", 0)),
+                    signal=int(rec.get("signal", 0)),
+                    oom_killed=bool(rec.get("oom_killed")),
+                    err=str(rec.get("err", ""))))
+                return handle
             return None
         try:
             st = client.call("Executor.status", timeout=5.0)

@@ -18,11 +18,13 @@ Run as: python -m nomad_tpu.plugins.executor
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import signal as _signal
 import subprocess
 import sys
 import threading
+import time
 from typing import Dict, List, Optional
 
 from . import isolation
@@ -35,6 +37,13 @@ _signals = {name: getattr(_signal, name) for name in dir(_signal)
 class ExecutorService:
     """The per-task executor endpoint (executor.go Executor interface)."""
 
+    #: after the task has exited, an executor nobody talks to for this
+    #: long exits on its own — without it, every agent killed mid-task
+    #: leaks one plugin process per task forever (observed: 156 orphans
+    #: on a busy dev box). Generous enough that an agent restart's
+    #: recover window (seconds–minutes) never races it.
+    IDLE_GRACE_S = 900.0
+
     def __init__(self) -> None:
         self._proc: Optional[subprocess.Popen] = None
         self._exit: Optional[Dict[str, object]] = None
@@ -44,6 +53,49 @@ class ExecutorService:
         self._applied: Dict[str, object] = {}
         self._pumps: List[threading.Thread] = []
         self._stop_plugin: Optional[threading.Event] = None
+        self._last_rpc = time.time()
+        self._inflight = 0
+        self._act_lock = threading.Lock()
+        threading.Thread(target=self._idle_reaper, name="idle-reaper",
+                         daemon=True).start()
+
+    @contextlib.contextmanager
+    def _touch(self):
+        """RPC-activity scope: the reaper only counts idle time with no
+        call in flight (wait() long-polls for hours while attached)."""
+        with self._act_lock:
+            self._last_rpc = time.time()
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._act_lock:
+                self._last_rpc = time.time()
+                self._inflight -= 1
+
+    def _idle_reaper(self) -> None:
+        grace = float(os.environ.get("NOMAD_TPU_EXECUTOR_IDLE_GRACE",
+                                     str(self.IDLE_GRACE_S)))
+        while True:
+            time.sleep(min(grace / 4, 5.0))
+            with self._act_lock:
+                idle = (self._inflight == 0
+                        and time.time() - self._last_rpc > grace)
+            task_over = self._proc is None or self._exit is not None
+            if idle and task_over:
+                # never launched, or task done and nobody attached: go.
+                # Only when serving as a real plugin (stop event wired by
+                # main()) — in-process uses of this class must never be
+                # able to kill their host.
+                stop = self._stop_plugin
+                if stop is not None:
+                    if self._cgroup:  # same cleanup destroy() performs
+                        try:
+                            self._cgroup.destroy()
+                        except Exception:  # noqa: BLE001
+                            pass
+                    stop.set()
+                    return
 
     # -- contract ----------------------------------------------------------
 
@@ -168,7 +220,34 @@ class ExecutorService:
             self._exit = {"exit_code": code, "signal": 0,
                           "oom_killed": oom, "err": ""}
         # cgroup stays for post-mortem stats; removed on destroy
+        self._persist_exit()
         self._exit_ev.set()
+
+    def _exit_record_path(self) -> Optional[str]:
+        logs_dir = self._spec.get("logs_dir")
+        task_id = str(self._spec.get("task_id") or "")
+        if not logs_dir or not task_id:
+            return None
+        safe = task_id.replace("/", "_")
+        return os.path.join(str(logs_dir), f".{safe}.exit.json")
+
+    def _persist_exit(self) -> None:
+        """Durable exit record: if this executor self-reaps before the
+        agent ever comes back, recovery reads the result from disk
+        instead of re-running a completed (possibly non-idempotent)
+        task."""
+        path = self._exit_record_path()
+        if path is None or self._exit is None:
+            return
+        import json as _json
+
+        try:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                _json.dump(self._exit, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # logs dir gone: nothing to persist into
 
     def wait(self, timeout_s: Optional[float] = None
              ) -> Optional[Dict[str, object]]:
@@ -277,7 +356,17 @@ def main() -> None:
         stop = threading.Event()
         server._plugin_stop = stop
         service._stop_plugin = stop
-        server.register_endpoint("Executor", service)
+        # every RPC marks activity so the idle reaper never fires while
+        # a driver is attached (incl. long-poll wait())
+        def track(fn):
+            def wrapped(*a, **k):
+                with service._touch():
+                    return fn(*a, **k)
+
+            wrapped.__name__ = getattr(fn, "__name__", "handler")
+            return wrapped
+
+        server.register_endpoint("Executor", service, wrap=track)
 
     serve_plugin("executor", register)
 

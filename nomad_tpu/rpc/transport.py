@@ -82,16 +82,19 @@ class RpcServer:
     def register(self, method: str, handler: Callable) -> None:
         self._handlers[method] = handler
 
-    def register_endpoint(self, name: str, obj: Any) -> None:
+    def register_endpoint(self, name: str, obj: Any,
+                          wrap: Optional[Callable] = None) -> None:
         """Register every public method of `obj` as `Name.method`
         (the reference's per-noun endpoint structs, nomad/server.go
-        setupRpcServer)."""
+        setupRpcServer). `wrap(fn) -> fn` decorates each handler (e.g.
+        activity tracking) without duplicating this scan at call sites."""
         for attr in dir(obj):
             if attr.startswith("_"):
                 continue
             fn = getattr(obj, attr)
             if callable(fn):
-                self.register(f"{name}.{attr}", fn)
+                self.register(f"{name}.{attr}",
+                              wrap(fn) if wrap is not None else fn)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._accept_loop,

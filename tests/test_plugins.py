@@ -124,6 +124,14 @@ class TestExecutorLifecycle:
         state = dict(h.driver_state)
         d.destroy_task(h, force=True)
         assert _wait(lambda: not h.client.alive())
+        # the durable exit record still recovers the COMPLETED task's
+        # result (no re-run); with the record gone, fate is unknown: None
+        rec = RawExecDriver().recover_task("a1/t", state)
+        if state.get("exit_record"):
+            assert rec is not None and not rec.is_running()
+            import os
+
+            os.unlink(state["exit_record"])
         assert RawExecDriver().recover_task("a1/t", state) is None
 
     def test_exec_in_task_context(self, tmp_path):
@@ -383,3 +391,112 @@ class TestAgentRestartRecovery:
             c2.shutdown()
         finally:
             server.shutdown()
+
+
+class TestExecutorIdleReaper:
+    def test_orphaned_executor_exits_after_grace(self, tmp_path):
+        """An executor whose task has finished and whose agent never
+        comes back must exit on its own (156 leaked plugin processes
+        observed without this)."""
+        import os
+        import sys
+
+        from nomad_tpu.plugins.base import launch_plugin
+
+        client = launch_plugin(
+            [sys.executable, "-m", "nomad_tpu.plugins.executor"],
+            env={**os.environ, "NOMAD_TPU_EXECUTOR_IDLE_GRACE": "1.5"},
+            log_path=str(tmp_path / "exec.log"))
+        try:
+            client.call("Executor.launch", {
+                "task_id": "t", "command": "/bin/true", "args": [],
+                "env": {}, "cwd": str(tmp_path),
+                "logs_dir": str(tmp_path), "stdout_prefix": "t.stdout",
+                "stderr_prefix": "t.stderr"})
+            res = client.call("Executor.wait", 10.0, timeout=15.0)
+            assert res is not None and res["exit_code"] == 0
+        finally:
+            client.close()
+        # nobody attached anymore: the plugin reaps itself
+        assert _wait(lambda: not client.alive(), timeout=15.0), \
+            "orphaned executor never exited"
+
+    def test_running_task_defeats_the_reaper(self, tmp_path):
+        """The reaper must never fire while the task is still running,
+        no matter how long the RPC channel is quiet."""
+        import os
+        import sys
+        import time as _time
+
+        from nomad_tpu.plugins.base import launch_plugin
+
+        client = launch_plugin(
+            [sys.executable, "-m", "nomad_tpu.plugins.executor"],
+            env={**os.environ, "NOMAD_TPU_EXECUTOR_IDLE_GRACE": "1.5"},
+            log_path=str(tmp_path / "exec.log"))
+        try:
+            client.call("Executor.launch", {
+                "task_id": "t", "command": "/bin/sleep", "args": ["6"],
+                "env": {}, "cwd": str(tmp_path),
+                "logs_dir": str(tmp_path), "stdout_prefix": "t.stdout",
+                "stderr_prefix": "t.stderr"})
+            _time.sleep(4.0)  # well past grace; task still running
+            st = client.call("Executor.status", timeout=5.0)
+            assert st["running"] is True, \
+                "reaper killed an executor with a LIVE task"
+            client.call("Executor.destroy", timeout=10.0)
+        finally:
+            client.close()
+
+    def test_inflight_rpc_defeats_the_reaper(self, monkeypatch):
+        """The in-flight guard directly: with the task over, a pending
+        RPC scope must hold the reaper off; releasing it arms it."""
+        import threading
+        import time as _time
+
+        from nomad_tpu.plugins.executor import ExecutorService
+
+        monkeypatch.setenv("NOMAD_TPU_EXECUTOR_IDLE_GRACE", "0.5")
+        svc = ExecutorService()
+        stop = threading.Event()
+        svc._stop_plugin = stop  # task never launched → task_over True
+        scope = svc._touch()
+        scope.__enter__()  # simulates a long-poll wait() in flight
+        _time.sleep(1.5)
+        assert not stop.is_set(), \
+            "reaper fired while an RPC was in flight"
+        scope.__exit__(None, None, None)
+        assert _wait(lambda: stop.is_set(), timeout=10.0), \
+            "reaper never fired after the RPC completed"
+
+    def test_exit_record_recovers_completed_task(self, tmp_path):
+        """Executor gone (self-reaped) + durable exit record → recovery
+        returns the stored result instead of re-running the task."""
+        import os
+        import sys
+
+        from nomad_tpu.client.drivers import RawExecDriver
+        from nomad_tpu.client.drivers.base import TaskConfig
+        from nomad_tpu.plugins.base import launch_plugin
+
+        drv = RawExecDriver()
+        logs = tmp_path / "logs"
+        logs.mkdir()
+        cfg = TaskConfig(id="a1/t", name="t", task_dir=str(tmp_path),
+                         stdout_path=str(logs / "t.stdout.0"),
+                         stderr_path=str(logs / "t.stderr.0"),
+                         raw_config={"command": "/bin/sh",
+                                     "args": ["-c", "exit 7"]})
+        h = drv.start_task(cfg)
+        res = drv.wait_task(h, timeout=15.0)
+        assert res is not None and res.exit_code == 7
+        state = dict(h.driver_state)
+        # kill the executor outright — simulates the self-reap
+        drv.destroy_task(h, force=True)
+        assert _wait(lambda: not h.client.alive(), timeout=15.0)
+        assert (logs / ".a1_t.exit.json").exists()
+        h2 = drv.recover_task("a1/t", state)
+        assert h2 is not None, "exit record ignored"
+        assert not h2.is_running()
+        res2 = h2.wait(1.0)
+        assert res2 is not None and res2.exit_code == 7
