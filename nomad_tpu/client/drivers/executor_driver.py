@@ -98,7 +98,12 @@ class ExecutorBackedDriver(DriverPlugin):
             log_path = os.path.join(cfg.task_dir, "executor.log")
         client = launch_plugin(
             [sys.executable, "-m", "nomad_tpu.plugins.executor"],
-            env={"PYTHONPATH": os.pathsep.join(p for p in sys.path if p)},
+            # drop accelerator site hooks (.axon_site et al) from the
+            # child's path: executors are pure host runtime, and a
+            # sitecustomize that eagerly imports jax adds seconds to
+            # every task start
+            env={"PYTHONPATH": os.pathsep.join(
+                p for p in sys.path if p and ".axon_site" not in p)},
             log_path=log_path,
         )
         try:
@@ -107,8 +112,6 @@ class ExecutorBackedDriver(DriverPlugin):
         except Exception:
             client.kill()
             raise
-        logs_dir = os.path.dirname(cfg.stdout_path) \
-            if cfg.stdout_path else ""
         handle = ExecutorTaskHandle(
             cfg.id, self.name, client,
             driver_state={
@@ -117,10 +120,9 @@ class ExecutorBackedDriver(DriverPlugin):
                 "applied": res.get("applied"),
                 # durable exit record the executor writes at task exit —
                 # recovery falls back to it when the (self-reaped)
-                # executor is gone, instead of re-running the task
-                "exit_record": os.path.join(
-                    logs_dir, f".{cfg.id.replace('/', '_')}.exit.json")
-                if logs_dir else "",
+                # executor is gone, instead of re-running the task. The
+                # executor names the file; stored verbatim.
+                "exit_record": res.get("exit_record", ""),
             },
         )
         return handle
@@ -134,35 +136,40 @@ class ExecutorBackedDriver(DriverPlugin):
         the task's fate is genuinely unknown."""
         client = reattach_plugin(driver_state.get("reattach") or {})
         if client is None:
-            rec_path = driver_state.get("exit_record") or ""
-            if rec_path and os.path.exists(rec_path):
-                import json as _json
-
-                try:
-                    with open(rec_path) as f:
-                        rec = _json.load(f)
-                except (OSError, ValueError):
-                    return None
-                handle = TaskHandle(task_id, self.name,
-                                    driver_state=driver_state)
-                handle.set_exit(ExitResult(
-                    exit_code=int(rec.get("exit_code", 0)),
-                    signal=int(rec.get("signal", 0)),
-                    oom_killed=bool(rec.get("oom_killed")),
-                    err=str(rec.get("err", ""))))
-                return handle
-            return None
+            return self._recover_from_record(task_id, driver_state)
         try:
             st = client.call("Executor.status", timeout=5.0)
         except Exception:
+            # executor died between reattach and the status RPC (e.g.
+            # its idle grace expired right now): same fallback
             client.close()
-            return None
+            return self._recover_from_record(task_id, driver_state)
         handle = ExecutorTaskHandle(task_id, self.name, client,
                                     driver_state=driver_state)
         if not st.get("running") and st.get("exit") is not None:
             # already exited while we were away; waiter will fetch the
             # same result, nothing else to do
             pass
+        return handle
+
+    def _recover_from_record(self, task_id: str,
+                             driver_state: dict) -> Optional[TaskHandle]:
+        rec_path = driver_state.get("exit_record") or ""
+        if not rec_path or not os.path.exists(rec_path):
+            return None
+        import json as _json
+
+        try:
+            with open(rec_path) as f:
+                rec = _json.load(f)
+        except (OSError, ValueError):
+            return None
+        handle = TaskHandle(task_id, self.name, driver_state=driver_state)
+        handle.set_exit(ExitResult(
+            exit_code=int(rec.get("exit_code", 0)),
+            signal=int(rec.get("signal", 0)),
+            oom_killed=bool(rec.get("oom_killed")),
+            err=str(rec.get("err", ""))))
         return handle
 
     def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0,
@@ -187,6 +194,15 @@ class ExecutorBackedDriver(DriverPlugin):
             except Exception:
                 pass
             client.close()
+        else:
+            # record-backed handle (executor already gone): retire the
+            # record so the destroyed task can't be resurrected later
+            rec = handle.driver_state.get("exit_record") or ""
+            if rec:
+                try:
+                    os.unlink(rec)
+                except OSError:
+                    pass
 
     def inspect_task(self, handle: TaskHandle) -> dict:
         base = super().inspect_task(handle)

@@ -74,8 +74,11 @@ class ExecutorService:
                 self._inflight -= 1
 
     def _idle_reaper(self) -> None:
-        grace = float(os.environ.get("NOMAD_TPU_EXECUTOR_IDLE_GRACE",
-                                     str(self.IDLE_GRACE_S)))
+        try:
+            grace = float(os.environ.get("NOMAD_TPU_EXECUTOR_IDLE_GRACE",
+                                         str(self.IDLE_GRACE_S)))
+        except ValueError:  # malformed override must not disable reaping
+            grace = self.IDLE_GRACE_S
         while True:
             time.sleep(min(grace / 4, 5.0))
             with self._act_lock:
@@ -112,6 +115,15 @@ class ExecutorService:
         if self._proc is not None:
             raise RuntimeError("task already launched")
         self._spec = spec
+        # a fresh run invalidates any predecessor's exit record — a
+        # stale one would let recovery report the OLD run's result for a
+        # lost in-flight run
+        stale = self._exit_record_path()
+        if stale is not None:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
         iso = spec.get("isolation") or {}
         caps = isolation.capabilities()
         applied: Dict[str, object] = {"cgroup": None, "namespaces": False,
@@ -192,7 +204,10 @@ class ExecutorService:
             self._pumps.append(t)
 
         threading.Thread(target=self._reap, daemon=True).start()
-        return {"pid": self._proc.pid, "applied": applied}
+        return {"pid": self._proc.pid, "applied": applied,
+                # single source of truth for the record location: the
+                # driver stores this verbatim (no parallel derivation)
+                "exit_record": self._exit_record_path() or ""}
 
     def _rotator(self, spec, stream: str):
         from ..client.logmon import FileRotator
@@ -214,13 +229,17 @@ class ExecutorService:
             t.join(timeout=2.0)
         oom = self._cgroup.oom_killed() if self._cgroup else False
         if code < 0:
-            self._exit = {"exit_code": 0, "signal": -code,
-                          "oom_killed": oom, "err": ""}
+            rec = {"exit_code": 0, "signal": -code,
+                   "oom_killed": oom, "err": ""}
         else:
-            self._exit = {"exit_code": code, "signal": 0,
-                          "oom_killed": oom, "err": ""}
+            rec = {"exit_code": code, "signal": 0,
+                   "oom_killed": oom, "err": ""}
+        # persist BEFORE publishing: the idle reaper keys on self._exit,
+        # and must never kill the process between exit and the record
+        # landing on disk
+        self._persist_exit(rec)
         # cgroup stays for post-mortem stats; removed on destroy
-        self._persist_exit()
+        self._exit = rec
         self._exit_ev.set()
 
     def _exit_record_path(self) -> Optional[str]:
@@ -231,20 +250,20 @@ class ExecutorService:
         safe = task_id.replace("/", "_")
         return os.path.join(str(logs_dir), f".{safe}.exit.json")
 
-    def _persist_exit(self) -> None:
+    def _persist_exit(self, rec: Dict[str, object]) -> None:
         """Durable exit record: if this executor self-reaps before the
         agent ever comes back, recovery reads the result from disk
         instead of re-running a completed (possibly non-idempotent)
         task."""
         path = self._exit_record_path()
-        if path is None or self._exit is None:
+        if path is None:
             return
         import json as _json
 
         try:
             tmp = f"{path}.tmp"
             with open(tmp, "w") as f:
-                _json.dump(self._exit, f)
+                _json.dump(rec, f)
             os.replace(tmp, path)
         except OSError:
             pass  # logs dir gone: nothing to persist into
@@ -343,6 +362,14 @@ class ExecutorService:
             self.stop("SIGKILL", 0.0)
         if self._cgroup:
             self._cgroup.destroy()
+        # an explicitly destroyed task must not be resurrectable as
+        # "completed" from its record
+        rec = self._exit_record_path()
+        if rec is not None:
+            try:
+                os.unlink(rec)
+            except OSError:
+                pass
         if self._stop_plugin is not None:
             # give the RPC response a beat to flush before exiting
             threading.Timer(0.2, self._stop_plugin.set).start()

@@ -124,14 +124,8 @@ class TestExecutorLifecycle:
         state = dict(h.driver_state)
         d.destroy_task(h, force=True)
         assert _wait(lambda: not h.client.alive())
-        # the durable exit record still recovers the COMPLETED task's
-        # result (no re-run); with the record gone, fate is unknown: None
-        rec = RawExecDriver().recover_task("a1/t", state)
-        if state.get("exit_record"):
-            assert rec is not None and not rec.is_running()
-            import os
-
-            os.unlink(state["exit_record"])
+        # explicit destroy retires the exit record too: the destroyed
+        # task's fate is unknown afterwards, never "completed"
         assert RawExecDriver().recover_task("a1/t", state) is None
 
     def test_exec_in_task_context(self, tmp_path):
@@ -491,8 +485,9 @@ class TestExecutorIdleReaper:
         res = drv.wait_task(h, timeout=15.0)
         assert res is not None and res.exit_code == 7
         state = dict(h.driver_state)
-        # kill the executor outright — simulates the self-reap
-        drv.destroy_task(h, force=True)
+        # hard-kill the executor WITHOUT destroy — the self-reap analog
+        # (destroy would retire the record on purpose)
+        h.client.kill()
         assert _wait(lambda: not h.client.alive(), timeout=15.0)
         assert (logs / ".a1_t.exit.json").exists()
         h2 = drv.recover_task("a1/t", state)
@@ -500,3 +495,7 @@ class TestExecutorIdleReaper:
         assert not h2.is_running()
         res2 = h2.wait(1.0)
         assert res2 is not None and res2.exit_code == 7
+        # retiring the record through the record-backed handle
+        drv.destroy_task(h2, force=True)
+        assert not (logs / ".a1_t.exit.json").exists()
+        assert drv.recover_task("a1/t", state) is None
