@@ -430,7 +430,47 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
     }
 
 
+def _probe_device(timeout_s: float = 240.0) -> None:
+    """Fail FAST with a diagnosis when the accelerator tunnel is wedged.
+
+    The axon PJRT client blocks indefinitely waiting for a chip grant; a
+    crashed predecessor can leave the grant stuck held, and the bench
+    would then hang until the harness kills it with no explanation.
+    Probing device init in a subprocess bounds that wait and turns it
+    into a clear error line. Skip with NOMAD_TPU_BENCH_PROBE=0."""
+    import subprocess
+
+    if os.environ.get("NOMAD_TPU_BENCH_PROBE", "1") == "0":
+        return
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return  # CPU init can't wedge (main() pins it via jax.config)
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s, check=True)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "error",
+            "error": f"accelerator device init did not complete within "
+                     f"{timeout_s:.0f}s — the TPU tunnel/grant appears "
+                     "wedged (a crashed process may still hold the "
+                     "claim); restart the tunnel or rerun with "
+                     "JAX_PLATFORMS=cpu"}))
+        sys.exit(2)
+    except subprocess.CalledProcessError:
+        pass  # init errored (not hung): let the real run surface it
+
+
 def main() -> None:
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the accelerator sitecustomize overrides the env var via
+        # jax.config — pin it back the way tests/conftest.py does, so
+        # JAX_PLATFORMS=cpu is an honest fallback (incl. around a
+        # wedged tunnel)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    _probe_device()
     n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
     n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_ALLOCS", 100_000))
     # throughput scales with batch until HBM pressure wins (dispatch

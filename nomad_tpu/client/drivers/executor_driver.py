@@ -188,15 +188,18 @@ class ExecutorBackedDriver(DriverPlugin):
         client = getattr(handle, "client", None)
         if handle.is_running() and not force:
             raise RuntimeError("task still running; use force")
+        destroyed_via_rpc = False
         if client is not None:
             try:
                 client.call("Executor.destroy", timeout=10.0)
+                destroyed_via_rpc = True  # executor retired its record
             except Exception:
                 pass
             client.close()
-        else:
-            # record-backed handle (executor already gone): retire the
-            # record so the destroyed task can't be resurrected later
+        if not destroyed_via_rpc:
+            # executor gone (record-backed handle) or the destroy RPC
+            # failed: retire the record ourselves so the destroyed task
+            # can't be resurrected as "completed" later
             rec = handle.driver_state.get("exit_record") or ""
             if rec:
                 try:
