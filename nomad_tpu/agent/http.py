@@ -644,6 +644,23 @@ class HTTPApi:
                     raise HttpError(400, str(e))
                 return {"eval_id": ev.id if ev else "",
                         "job_modify_index": job.job_modify_index}
+        # /v1/jobs/parse — server-side HCL parse (command/agent/
+        # job_endpoint.go JobsParseRequest; capability-gated like the
+        # reference post-1.2.4 — parsing arbitrary bodies is server CPU)
+        if parts == ["jobs", "parse"] and method in ("PUT", "POST"):
+            from ..jobspec import parse as parse_hcl_job
+
+            require_ns("submit-job")
+            src = (body or {}).get("JobHCL", "")
+            if not isinstance(src, str) or not src.strip():
+                raise HttpError(400, "missing JobHCL")
+            try:
+                return to_wire(parse_hcl_job(src))
+            except Exception as e:  # noqa: BLE001 — parser raises
+                # HclError for syntax but plain ValueError/TypeError/
+                # AttributeError for structural mistakes; all are the
+                # CLIENT's jobspec, never a server fault
+                raise HttpError(400, f"jobspec parse failed: {e}")
         # /v1/job/<id>[/...] — job ids may CONTAIN slashes (dispatched
         # children "<parent>/dispatch-...", periodic children
         # "<parent>/periodic-<ts>"; structs.go:3995): the sub-route is
@@ -811,6 +828,14 @@ class HTTPApi:
                 server.node_update_eligibility(node_id,
                                                body.get("eligibility"))
                 return {}
+            if sub == "purge" and method in ("PUT", "POST"):
+                # Node.Deregister (node_endpoint.go:388)
+                require(acl.allow_node_write())
+                try:
+                    evals = server.node_purge(node_id)
+                except ValueError as e:
+                    raise HttpError(404, str(e))
+                return {"eval_ids": [e.id for e in evals]}
             if sub == "allocations":
                 require(acl.allow_node_read())
                 return blocking(lambda snap: (

@@ -145,6 +145,16 @@ class NomadClient:
                                   "Message": message})
         return out.get("eval_id", "")
 
+    def jobs_parse(self, hcl: str):
+        """Server-side HCL parse (api/jobs.go ParseHCL)."""
+        return from_wire(self._request("PUT", "/v1/jobs/parse",
+                                       body={"JobHCL": hcl}))
+
+    def node_purge(self, node_id: str) -> List[str]:
+        """Deregister a node entirely (api/nodes.go Purge)."""
+        out = self._request("PUT", f"/v1/node/{node_id}/purge")
+        return out.get("eval_ids", [])
+
     def job_versions(self, job_id: str,
                      namespace: str = "default") -> List[Any]:
         res = self._request("GET", f"/v1/job/{job_id}/versions",

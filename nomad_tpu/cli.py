@@ -436,6 +436,26 @@ def cmd_node_status(args) -> int:
     return 0
 
 
+def _resolve_node(api, prefix: str):
+    matches = [n for n in api.nodes() if n.id.startswith(prefix)]
+    if len(matches) != 1:
+        print(f"{len(matches)} nodes match {prefix!r}", file=sys.stderr)
+        return None
+    return matches[0]
+
+
+def cmd_node_purge(args) -> int:
+    """`nomad-tpu node purge <id>` — deregister a node entirely; its
+    allocs get replacement evals (API PUT /v1/node/:id/purge)."""
+    api = _client(args)
+    n = _resolve_node(api, args.node_id)
+    if n is None:
+        return 1
+    evals = api.node_purge(n.id)
+    print(f"Node {n.id[:8]} purged ({len(evals)} reschedule eval(s))")
+    return 0
+
+
 def cmd_node_drain(args) -> int:
     from .structs.node import DrainStrategy
 
@@ -1284,6 +1304,9 @@ def build_parser() -> argparse.ArgumentParser:
     nd.add_argument("-deadline", type=float, default=3600.0)
     nd.add_argument("-ignore-system", action="store_true")
     nd.set_defaults(fn=cmd_node_drain)
+    np_ = node.add_parser("purge")
+    np_.add_argument("node_id")
+    np_.set_defaults(fn=cmd_node_purge)
     ne = node.add_parser("eligibility")
     ne.add_argument("node_id")
     g = ne.add_mutually_exclusive_group(required=True)

@@ -396,6 +396,20 @@ class Server:
             self.blocked.unblock_node(node_id, self.state.index.value)
         return evals
 
+    def node_purge(self, node_id: str) -> List[Evaluation]:
+        """Remove a node from state entirely (Node.Deregister,
+        nomad/node_endpoint.go:388 — the API's PUT /v1/node/:id/purge):
+        its allocs get node-update evals so the scheduler replaces them,
+        then the row is gone."""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise ValueError(f"node {node_id!r} not found")
+        self.heartbeater.remove(node_id)
+        evals = self._create_node_evals(node_id)
+        self.state.delete_node(node_id)
+        self._publish("Node", "NodeDeregistered", node_id)
+        return evals
+
     def node_update_drain(self, node_id: str, drain) -> List[Evaluation]:
         import copy
 

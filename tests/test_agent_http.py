@@ -239,3 +239,49 @@ class TestWebConsole:
                 body = resp.read().decode()
             assert "<title>nomad-tpu</title>" in body
             assert "/v1/jobs" in body  # fetches the real API
+
+
+class TestJobsParseAndNodePurge:
+    def test_jobs_parse_roundtrip(self, agent):
+        """Server-side HCL parse (jobs/parse) returns the wire Job."""
+        a, api = agent
+        job = api.jobs_parse("""
+        job "parsed" {
+          datacenters = ["dc9"]
+          group "g" {
+            count = 3
+            task "t" { driver = "raw_exec"
+                       config { command = "/bin/true" } }
+          }
+        }
+        """)
+        assert job.id == "parsed"
+        assert job.datacenters == ["dc9"]
+        assert job.task_groups[0].count == 3
+        from nomad_tpu.api import ApiError
+
+        import pytest as _pytest
+
+        with _pytest.raises(ApiError):
+            api.jobs_parse("not { hcl")
+        with _pytest.raises(ApiError):
+            api.jobs_parse("")
+
+    def test_node_purge_reschedules(self, agent):
+        a, api = agent
+        from nomad_tpu import mock
+
+        # a second, synthetic node carrying allocs
+        node = mock.node()
+        a.server.node_register(node)
+        job = _mock_driver_job(run_for=60.0)
+        job.task_groups[0].count = 1
+        job.constraints = []
+        ev = a.server.job_register(job)
+        a.server.wait_for_eval(ev.id, timeout=15.0)
+        allocs = a.server.state.allocs_by_job("default", job.id)
+        assert allocs
+        target = allocs[0].node_id
+        eval_ids = api.node_purge(target)
+        assert a.server.state.node_by_id(target) is None
+        assert eval_ids  # replacements queued
