@@ -414,12 +414,10 @@ def cmd_node_status(args) -> int:
               n.scheduling_eligibility, n.status] for n in api.nodes()],
             ["ID", "Name", "DC", "Class", "Eligibility", "Status"]))
         return 0
-    matches = [n for n in api.nodes() if n.id.startswith(args.node_id)]
-    if len(matches) != 1:
-        print(f"{len(matches)} nodes match prefix {args.node_id!r}",
-              file=sys.stderr)
+    n = _resolve_node(api, args.node_id)
+    if n is None:
         return 1
-    node = api.node(matches[0].id)
+    node = api.node(n.id)
     print(f"ID          = {node.id}")
     print(f"Name        = {node.name}")
     print(f"DC          = {node.datacenter}")

@@ -10,6 +10,8 @@ evaluations (vmap) — full-width over the node axis.
 from __future__ import annotations
 
 import math
+import threading
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -61,6 +63,12 @@ class SelectResult:
     raw: PlacementResult = None
 
 
+#: cluster object → last device upload, keyed per-tensor by sub-version
+#: (see TPUStack.device_arrays); weak so dead snapshots free their HBM
+_DEV_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_DEV_CACHE_LOCK = threading.Lock()
+
+
 class TPUStack:
     """Compiles placement programs and drives the placement kernel."""
 
@@ -69,8 +77,6 @@ class TPUStack:
         self.cluster = cluster
         self.algorithm = algorithm
         self._jit = jit
-        self._snapshot_version = -1
-        self._dev_arrays: Optional[ClusterArrays] = None
         # (namespace, job.id, version, modify_index, tg, volumes) →
         # compiled static program; re-evaluating the same job spec
         # (retries, node-down churn, deployments) skips the LUT compile
@@ -81,20 +87,53 @@ class TPUStack:
     # ---- device snapshot management ----
 
     def device_arrays(self) -> ClusterArrays:
+        """Device copy of the cluster tensors, cached GLOBALLY per
+        cluster object and keyed per-tensor by sub-versions.
+
+        The control plane builds a fresh TPUStack per evaluation; an
+        instance-level cache re-uploaded everything every eval — and
+        ports_used alone is u32[N, 2048] (≈128 MB at 16K rows), which
+        over a tunnel dwarfed the kernel itself. Static tensors re-upload
+        only when nodes/attrs change (node_version + shape), the port
+        bitmap only when a port flips (ports_version), and only the small
+        hot tensors (used/node_ok/dyn_free) go up per state version."""
         import jax.numpy as jnp
 
-        if self._dev_arrays is None or self._snapshot_version != self.cluster.version:
-            snap = self.cluster.snapshot()
-            self._dev_arrays = ClusterArrays(
-                capacity=jnp.asarray(snap.capacity),
-                used=jnp.asarray(snap.used),
-                node_ok=jnp.asarray(snap.node_ok),
-                attrs=jnp.asarray(snap.attrs),
-                ports_used=jnp.asarray(snap.ports_used),
-                dyn_free=jnp.asarray(snap.dyn_free),
+        cl = self.cluster
+        with _DEV_CACHE_LOCK:
+            # capture ALL keys BEFORE uploading: a concurrent mutation
+            # mid-upload must make the stored entry look stale (next
+            # caller re-uploads), never current with old data
+            version = cl.version
+            static_key = (cl.node_version, cl.n_cap, cl.k_cap)
+            ports_key = (cl.ports_version, cl.n_cap)
+            ent = _DEV_CACHE.get(cl)
+            if ent is not None and ent["version"] == version:
+                return ent["arrays"]
+            if ent is not None and ent["static_key"] == static_key:
+                capacity, attrs = ent["capacity"], ent["attrs"]
+            else:
+                capacity = jnp.asarray(cl.capacity)
+                attrs = jnp.asarray(cl.attrs)
+            if ent is not None and ent["ports_key"] == ports_key:
+                ports_used = ent["ports_used"]
+            else:
+                ports_used = jnp.asarray(cl.ports_used)
+            arrays = ClusterArrays(
+                capacity=capacity,
+                used=jnp.asarray(cl.used),
+                node_ok=jnp.asarray(cl.node_ok),
+                attrs=attrs,
+                ports_used=ports_used,
+                dyn_free=jnp.asarray(cl.dyn_free),
             )
-            self._snapshot_version = self.cluster.version
-        return self._dev_arrays
+            _DEV_CACHE[cl] = {
+                "version": version, "arrays": arrays,
+                "static_key": static_key, "capacity": capacity,
+                "attrs": attrs, "ports_key": ports_key,
+                "ports_used": ports_used,
+            }
+            return arrays
 
     # ---- program compilation ----
 

@@ -405,8 +405,11 @@ class Server:
         if node is None:
             raise ValueError(f"node {node_id!r} not found")
         self.heartbeater.remove(node_id)
-        evals = self._create_node_evals(node_id)
+        # delete FIRST: a worker that dequeues the eval must already see
+        # the node gone (missing ⇒ tainted/lost), or it no-ops while the
+        # node still looks ready and the allocs are stranded forever
         self.state.delete_node(node_id)
+        evals = self._create_node_evals(node_id)
         self._publish("Node", "NodeDeregistered", node_id)
         return evals
 

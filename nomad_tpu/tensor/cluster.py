@@ -93,6 +93,11 @@ class ClusterTensors:
         # job -> {alloc_id: (row, task_group)} for per-eval count vectors
         self.job_allocs: Dict[str, Dict[str, Tuple[int, str]]] = {}
         self.version = 0
+        #: bumps ONLY on port-bitmap mutations — ports_used is by far
+        #: the largest tensor (u32[N, 2048] ≈ 128 MB at 16K rows), so
+        #: the device cache keys its upload separately (stack.py
+        #: device_arrays)
+        self.ports_version = 0
         # bumped only on node-set/attribute changes (not alloc churn) —
         # freshness oracle for cached host-evaluated constraint masks
         self.node_version = 0
@@ -112,6 +117,7 @@ class ClusterTensors:
         pw = np.zeros((new_cap, PORT_WORDS), dtype=np.uint32)
         pw[: self.n_cap] = self.ports_used
         self.ports_used = pw
+        self.ports_version += 1
         df = np.zeros(new_cap, dtype=np.float32)
         df[: self.n_cap] = self.dyn_free
         self.dyn_free = df
@@ -141,12 +147,14 @@ class ClusterTensors:
 
     def _set_port(self, row: int, port: int) -> None:
         self.ports_used[row, port >> 5] |= np.uint32(1 << (port & 31))
+        self.ports_version += 1
         if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
             self.dyn_free[row] -= 1.0
 
     def _clear_port(self, row: int, port: int) -> None:
         self.ports_used[row, port >> 5] &= np.uint32(
             ~(1 << (port & 31)) & 0xFFFFFFFF)
+        self.ports_version += 1
         if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
             self.dyn_free[row] += 1.0
 
@@ -241,6 +249,7 @@ class ClusterTensors:
             rsv.reserved_ports) if 0 <= p < PORT_WORDS * 32)
         self.base_ports[row] = base
         self.ports_used[row, :] = 0
+        self.ports_version += 1
         self.dyn_free[row] = DYN_PORT_SPAN
         for port in base:
             self._set_port(row, port)
@@ -291,6 +300,7 @@ class ClusterTensors:
         self.nodes.pop(node_id, None)
         self.node_of_row[row] = None
         self.capacity[row] = 0
+        self.ports_version += 1
         self.used[row] = 0
         self.node_ok[row] = False
         self.attrs[row, :] = MISSING
