@@ -462,14 +462,9 @@ def _probe_device(timeout_s: float = 240.0) -> None:
 
 
 def main() -> None:
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        # the accelerator sitecustomize overrides the env var via
-        # jax.config — pin it back the way tests/conftest.py does, so
-        # JAX_PLATFORMS=cpu is an honest fallback (incl. around a
-        # wedged tunnel)
-        import jax
+    from nomad_tpu.utils import pin_jax_cpu_if_requested
 
-        jax.config.update("jax_platforms", "cpu")
+    pin_jax_cpu_if_requested()  # honest JAX_PLATFORMS=cpu fallback
     _probe_device()
     n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_NODES", 10_000))
     n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_ALLOCS", 100_000))

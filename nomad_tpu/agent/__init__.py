@@ -168,6 +168,13 @@ class Agent:
     """Composes Server + Client + HTTP API in one process."""
 
     def __init__(self, config: Optional[AgentConfig] = None) -> None:
+        # honor the operator's platform choice: accelerator
+        # sitecustomize hooks override the env var via jax.config, and a
+        # wedged tunnel would otherwise hang every scheduler worker at
+        # its first kernel dispatch
+        from ..utils import pin_jax_cpu_if_requested
+
+        pin_jax_cpu_if_requested()
         self.config = config or AgentConfig()
         self.server = None
         self.client = None

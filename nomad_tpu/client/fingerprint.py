@@ -118,18 +118,61 @@ def signal_fingerprint(node: Node) -> None:
     node.attributes["os.signals"] = ",".join(names)
 
 
+class _ProbedDevice(Tuple):
+    """Device row from the subprocess probe (duck-types jax.Device for
+    the annotation code below)."""
+
+    def __new__(cls, dev_id: str, platform: str, kind: str):
+        self = super().__new__(cls, (dev_id, platform, kind))
+        self.id = dev_id
+        self.platform = platform
+        self.device_kind = kind
+        return self
+
+
 def tpu_fingerprint(node: Node) -> None:
     """TPU detection via the JAX runtime (the reference's NVML analog,
     devices/gpu/nvidia/nvml/client.go:52-78). Gated: import failures or a
     CPU-only platform leave the node un-annotated."""
     if os.environ.get("NOMAD_TPU_SKIP_TPU_FINGERPRINT"):
         return
-    try:
-        import jax
+    from ..utils import jax_cpu_requested
 
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
-    except Exception:
-        return
+    if jax_cpu_requested():
+        return  # operator pinned CPU: no accelerator to annotate
+    # Bounded SUBPROCESS probe: accelerator device init can hang
+    # outright when the runtime/tunnel is wedged (observed: PJRT
+    # blocking forever on a stuck chip grant). An in-process probe
+    # thread would poison jax's global backend-init lock on timeout —
+    # every later jax call in the agent would then block too. A killed
+    # child leaves this process's jax state untouched; on timeout the
+    # node simply goes unannotated, like any other fingerprint failure.
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    try:
+        budget = float(os.environ.get("NOMAD_TPU_FINGERPRINT_TIMEOUT",
+                                      "30"))
+    except ValueError:
+        budget = 30.0
+    if budget <= 0:
+        budget = 30.0
+    script = (
+        "import jax, json; print(json.dumps("
+        "[{'id': str(d.id), 'platform': d.platform, "
+        "'kind': str(getattr(d, 'device_kind', d.platform))} "
+        "for d in jax.devices()]))"
+    )
+    try:
+        r = subprocess.run([_sys.executable, "-c", script],
+                           capture_output=True, timeout=budget)
+        rows = _json.loads(r.stdout.decode().strip().splitlines()[-1]) \
+            if r.returncode == 0 and r.stdout.strip() else []
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        return  # wedged or broken runtime: agent moves on unannotated
+    devs = [_ProbedDevice(d["id"], d["platform"], d["kind"])
+            for d in rows if d.get("platform") != "cpu"]
     if not devs:
         return
     node.attributes["tpu.count"] = str(len(devs))

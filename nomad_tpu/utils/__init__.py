@@ -16,6 +16,33 @@ def bucket(n: int, lo: int = 1) -> int:
     return b
 
 
+def jax_cpu_requested() -> bool:
+    """True when the caller's environment asks for the CPU platform or
+    virtual CPU devices (JAX_PLATFORMS=cpu / XLA_FLAGS host-platform
+    count). Accelerator sitecustomize hooks override the env var via
+    jax.config, so honoring it needs an explicit re-pin."""
+    import os
+
+    return (os.environ.get("JAX_PLATFORMS", "") == "cpu"
+            or "host_platform_device_count"
+            in os.environ.get("XLA_FLAGS", ""))
+
+
+def pin_jax_cpu_if_requested() -> bool:
+    """Re-pin jax to CPU when the environment requested it (see
+    jax_cpu_requested). Returns True when pinned. Shared by the agent,
+    bench, and driver entry so the fallback logic can't drift."""
+    if not jax_cpu_requested():
+        return False
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — no jax: nothing to pin
+        return False
+    return True
+
+
 def widen_lut(a: np.ndarray, v: int, fill) -> np.ndarray:
     """Widen a [*, V] LUT-style array to V=v columns, keeping the
     missing-token slot in the LAST column (kernels map token −1 → V−1)."""
