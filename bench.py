@@ -533,4 +533,23 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    # Hard exit on EVERY path, skipping interpreter teardown: the e2e
+    # section can leave scheduler workers parked inside an accelerator
+    # RPC, and unwinding live native threads at process exit has crashed
+    # the tunnel client ("FATAL: exception not rethrown") badly enough
+    # to leave the chip grant stuck server-side. Failure paths are the
+    # MOST likely to have such threads — they must hard-exit too.
+    code = 0
+    try:
+        main()
+    except SystemExit as e:
+        code = int(e.code or 0) if not isinstance(e.code, str) else 1
+    except BaseException:  # noqa: BLE001 — report, then hard-exit
+        import traceback
+
+        traceback.print_exc()
+        code = 1
+    finally:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
