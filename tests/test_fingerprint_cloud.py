@@ -163,3 +163,58 @@ class TestCniFingerprint:
             == str(tmp_path / "mynet.conflist")
         assert len([k for k in node.attributes
                     if k.startswith("plugins.cni.config.")]) == 1
+
+
+class TestTpuFingerprintBounded:
+    def test_wedged_probe_leaves_node_unannotated(self, monkeypatch):
+        """A hanging accelerator runtime must not block fingerprinting:
+        the subprocess probe times out and the agent moves on."""
+        import subprocess
+
+        from nomad_tpu.client import fingerprint as fp
+
+        def fake_run(*a, **k):
+            raise subprocess.TimeoutExpired(cmd=a[0], timeout=k["timeout"])
+
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        node = Node()
+        fp.tpu_fingerprint(node)  # must return promptly, not raise
+        assert "tpu.count" not in node.attributes
+
+    def test_probe_result_annotates_devices(self, monkeypatch):
+        import json
+        import subprocess
+
+        from nomad_tpu.client import fingerprint as fp
+
+        rows = [{"id": "0", "platform": "tpu", "kind": "TPU v5 lite"}]
+
+        class R:
+            returncode = 0
+            stdout = json.dumps(rows).encode()
+
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        monkeypatch.setattr(subprocess, "run", lambda *a, **k: R())
+        node = Node()
+        fp.tpu_fingerprint(node)
+        assert node.attributes["tpu.count"] == "1"
+        assert node.attributes["tpu.type"] == "TPU v5 lite"
+        assert node.node_resources.devices[0].vendor == "google"
+        assert node.node_resources.devices[0].instances[0].id == "0"
+
+    def test_cpu_pin_skips_probe(self, monkeypatch):
+        import subprocess
+
+        from nomad_tpu.client import fingerprint as fp
+
+        def boom(*a, **k):
+            raise AssertionError("probe must not run under a CPU pin")
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setattr(subprocess, "run", boom)
+        node = Node()
+        fp.tpu_fingerprint(node)
+        assert "tpu.count" not in node.attributes
