@@ -928,7 +928,7 @@ class HTTPApi:
             cluster = getattr(self.agent, "cluster", None)
             if cluster is not None:
                 return {pid: list(addr) for pid, addr
-                        in cluster.peers.items()}
+                        in cluster.peers_snapshot().items()}
             return {}
         # /v1/agent/*
         if parts == ["agent", "members"]:
@@ -941,7 +941,8 @@ class HTTPApi:
                      "status": m.status, "incarnation": m.incarnation,
                      "tags": dict(m.tags)}
                     for m in cluster.membership.members()]}
-            peers = cluster.peers if cluster is not None else {}
+            peers = (cluster.peers_snapshot()
+                     if cluster is not None else {})
             return {"members": [{"name": pid, "addr": list(addr),
                                  "status": "alive"}
                                 for pid, addr in peers.items()]}
@@ -1023,7 +1024,8 @@ class HTTPApi:
             return {"servers": [
                 {"id": pid, "address": f"{a[0]}:{a[1]}",
                  "leader": pid == leader, "voter": True}
-                for pid, a in sorted(cluster.raft.peers.items())],
+                for pid, a in sorted(
+                    cluster.raft.peers_snapshot().items())],
                 "index": state.index.value}
         if parts == ["operator", "raft", "peer"] and method == "DELETE":
             require(acl.allow_operator_write())

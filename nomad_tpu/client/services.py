@@ -95,18 +95,29 @@ class ServiceHook:
             for rid in gone:
                 del self._regs[rid]
         if gone and self.conn is not None:
-            # no per-id delete op on the wire: re-assert the remaining set
-            # after clearing the alloc's rows (both ride the same log)
-            try:
-                self.conn.remove_service_registrations(self.alloc.id)
-                with self._lock:
-                    rest = [r for r, _ in self._regs.values()]
-                if rest:
-                    self.conn.update_service_registrations(rest)
-            except Exception:  # noqa: BLE001 — transient (leader move):
-                # flag for the runner loop's periodic re-assert
-                self._dirty = True
+            self._reassert_catalog()
             self._ensure_checker()
+
+    def _reassert_catalog(self) -> None:
+        """Fence the server catalog to the desired set: clear the alloc's
+        rows, then re-push what remains (both ride the same log). A plain
+        upsert cannot recover from a failed task_dead dereg — the dead
+        task's rows would stay discoverable until the alloc stops. On
+        failure self._dirty stays set so the runner loop retries."""
+        try:
+            self.conn.remove_service_registrations(self.alloc.id)
+            # snapshot AFTER the remove returns: task transitions that
+            # landed during the (slow) RPC must be reflected in the
+            # re-push, and a concurrent stop() must win (its dereg ran;
+            # re-pushing rows for a terminal alloc would leave them
+            # orphaned until GC)
+            with self._lock:
+                rest = [r for r, _ in self._regs.values()]
+            if rest and not self._stop.is_set():
+                self.conn.update_service_registrations(rest)
+            self._dirty = False
+        except Exception:  # noqa: BLE001 — transient (leader move)
+            self._dirty = True
 
     def stop(self) -> None:
         """Alloc terminal/destroyed: drop everything. The dereg RPC runs
@@ -202,16 +213,23 @@ class ServiceHook:
                     changed.append(reg)
             if changed:
                 self._push(changed)
-            if self._dirty or now >= next_reassert:
-                # anti-entropy: assert the full desired set (idempotent
-                # upserts; recovers from any dropped push)
+            if self._dirty:
+                # a dereg/push failed earlier: full fence (remove then
+                # re-push) so stale rows cannot outlive their task;
+                # retried every loop tick until it lands
+                next_reassert = now + self.reassert_interval
+                self._reassert_catalog()
+            elif now >= next_reassert:
+                # clean periodic anti-entropy: plain idempotent upsert —
+                # no delete first, so no discovery blackout between the
+                # two RPCs and no index churn (the server short-circuits
+                # unchanged rows without an index bump)
                 next_reassert = now + self.reassert_interval
                 with self._lock:
                     all_regs = [r for r, _ in self._regs.values()]
                 if all_regs:
                     try:
                         self.conn.update_service_registrations(all_regs)
-                        self._dirty = False
                     except Exception:  # noqa: BLE001 — retry next round
                         pass
 

@@ -291,6 +291,18 @@ class RaftNode:
         with self._lock:
             return self.leader_id
 
+    def peers_snapshot(self, with_match: bool = False):
+        """Consistent copy of the peer map (and optionally the leader's
+        match indexes): the applier thread mutates both when a committed
+        __raft_conf__ entry applies, so observers (autopilot health, the
+        operator raft-configuration endpoint) must not iterate the live
+        dicts."""
+        with self._lock:
+            peers = dict(self.peers)
+            if with_match:
+                return peers, dict(self._match_index)
+            return peers
+
     def apply(self, data: Any, timeout: float = 10.0) -> int:
         """Leader-only: append, replicate, wait for commit. Returns the
         entry's log index (hashicorp/raft Apply future)."""

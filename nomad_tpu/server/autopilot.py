@@ -81,11 +81,12 @@ class Autopilot:
         except Exception:  # noqa: BLE001 — config read must not throw here
             return
         node_id = member.name.rsplit(".", 1)[0]  # serf name node.region
-        if node_id == cl.config.node_id or node_id not in cl.raft.peers:
+        peer_map = cl.raft.peers_snapshot()
+        if node_id == cl.config.node_id or node_id not in peer_map:
             return
         # quorum guard: voters remaining after removal must have an alive
         # majority among themselves
-        remaining = [p for p in cl.raft.peers if p != node_id]
+        remaining = [p for p in peer_map if p != node_id]
         alive = {m.name.rsplit(".", 1)[0]
                  for m in cl.membership.members()
                  if m.status == STATUS_ALIVE
@@ -111,7 +112,8 @@ class Autopilot:
         last_index = cl.raft.log.last_index()
         servers: List[dict] = []
         healthy_votes = 0
-        for pid, addr in sorted(cl.raft.peers.items()):
+        peer_map, match_index = cl.raft.peers_snapshot(with_match=True)
+        for pid, addr in sorted(peer_map.items()):
             m = members.get(pid)
             if pid == cl.config.node_id:
                 alive, last_contact = True, 0.0
@@ -120,7 +122,7 @@ class Autopilot:
             else:
                 alive = m.status == STATUS_ALIVE
                 last_contact = now - m.last_seen
-            trailing = (last_index - cl.raft._match_index.get(pid, 0)
+            trailing = (last_index - match_index.get(pid, 0)
                         if cl.is_leader() and pid != cl.config.node_id
                         else 0)
             healthy = (alive
@@ -148,7 +150,7 @@ class Autopilot:
                 "last_contact_s": (None if last_contact == float("inf")
                                    else round(last_contact, 3)),
             })
-        quorum = len(cl.raft.peers) // 2 + 1
+        quorum = len(peer_map) // 2 + 1
         return {
             "healthy": healthy_votes >= quorum,
             "failure_tolerance": max(0, healthy_votes - quorum),

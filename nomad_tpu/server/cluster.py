@@ -191,6 +191,9 @@ class ClusterServer:
         self.pool = ConnPool(tls=getattr(config, "tls", None))
         self.addr = self.rpc.addr
         self.peers = dict(peers) if peers else {config.node_id: self.addr}
+        # guards self.peers: the raft applier thread mutates it on
+        # committed conf changes while HTTP workers iterate it
+        self._peers_lock = threading.Lock()
 
         state = RaftStateStore()
         srv_cfg = ServerConfig(
@@ -271,10 +274,16 @@ class ClusterServer:
 
     def _on_raft_conf_change(self, action: str, peer_id: str,
                              addr) -> None:
-        if action == "remove":
-            self.peers.pop(peer_id, None)
-        elif action == "add" and addr:
-            self.peers[peer_id] = tuple(addr)
+        with self._peers_lock:
+            if action == "remove":
+                self.peers.pop(peer_id, None)
+            elif action == "add" and addr:
+                self.peers[peer_id] = tuple(addr)
+
+    def peers_snapshot(self) -> dict:
+        """Copy of the peer address map, safe to iterate off-thread."""
+        with self._peers_lock:
+            return dict(self.peers)
 
     # ---- leadership (leader.go monitorLeadership) ----
 
@@ -336,10 +345,13 @@ class ClusterServer:
                 if self.is_leader():
                     return self._invoke_local(method, wire_args)
                 leader = self.raft.leader()
-                if leader is not None and leader in self.peers \
-                        and leader != self.config.node_id:
+                leader_addr = (self.peers_snapshot().get(leader)
+                               if leader is not None
+                               and leader != self.config.node_id
+                               else None)
+                if leader_addr is not None:
                     res = self.pool.call(
-                        self.peers[leader], f"Server.{method}", *wire_args,
+                        leader_addr, f"Server.{method}", *wire_args,
                         timeout=max(0.1, deadline - time.time()))
                     return from_wire(res)
             except NotLeaderError:

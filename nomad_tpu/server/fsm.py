@@ -154,7 +154,12 @@ def restore_state(state, snap: Dict[str, Any]) -> None:
         reg = from_wire(tree)
         ci, mi = reg.create_index, reg.modify_index
         state.upsert_service_registrations([reg])
-        reg.create_index, reg.modify_index = ci, mi
+        # the upsert stores a defensive copy — re-stamp the STORED row
+        # (blocking queries keyed on X-Nomad-Index depend on these),
+        # mirroring _upsert_preserving_indexes semantics
+        stored = state._services.get(reg.id)
+        if stored is not None:
+            stored.create_index, stored.modify_index = ci, mi
     for tree in snap.get("secrets", []):
         e = from_wire(tree)
         ci, mi, ver = e.create_index, e.modify_index, e.version

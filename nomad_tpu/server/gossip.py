@@ -120,11 +120,17 @@ class Membership:
                          > _PRECEDENCE[cur.status])
                 if inc > cur.incarnation or (inc == cur.incarnation
                                              and worse):
+                    newer = inc > cur.incarnation
                     was = cur.status
                     cur.incarnation = inc
                     cur.status = status
                     cur.addr = tuple(w["addr"])
-                    if w.get("tags"):
+                    if newer and "tags" in w:
+                        # a member that legitimately CLEARS a tag must
+                        # propagate: "tags present but empty" is real
+                        # news at a newer incarnation, only absence isn't
+                        cur.tags = dict(w["tags"] or {})
+                    elif w.get("tags"):
                         cur.tags = dict(w["tags"])
                     if status == STATUS_ALIVE and inc > 0:
                         cur.last_seen = now  # rebuttal: direct evidence

@@ -62,6 +62,12 @@ class Server:
     def __init__(self, config: Optional[ServerConfig] = None,
                  state: Optional[StateStore] = None) -> None:
         self.config = config or ServerConfig()
+        # Serializes quota admission (check-then-act) against the job
+        # upsert: the HTTP layer is a ThreadingHTTPServer, so two
+        # concurrent registers could otherwise both pass _enforce_quota
+        # under the limit and both commit (ent reference serializes via
+        # the raft apply path).
+        self._admission_lock = threading.RLock()
         if state is not None:
             # Injected store (the cluster agent passes a RaftStateStore)
             self.state = state
@@ -254,6 +260,13 @@ class Server:
     # ---- Job endpoint (job_endpoint.go:79) ----
 
     def job_register(self, job: Job) -> Optional[Evaluation]:
+        # Held across _enforce_quota → upsert_job so concurrent registers
+        # cannot both pass the quota check under the limit (the reference
+        # serializes admission through the leader's raft apply).
+        with self._admission_lock:
+            return self._job_register(job)
+
+    def _job_register(self, job: Job) -> Optional[Evaluation]:
         err = job.validate() if hasattr(job, "validate") else None
         if err:
             raise ValueError(err)
@@ -848,6 +861,12 @@ class Server:
 
     def job_scale(self, namespace: str, job_id: str, group: str,
                   count: int, message: str = "") -> Optional[Evaluation]:
+        with self._admission_lock:  # see job_register
+            return self._job_scale(namespace, job_id, group, count,
+                                   message)
+
+    def _job_scale(self, namespace: str, job_id: str, group: str,
+                   count: int, message: str = "") -> Optional[Evaluation]:
         import copy
 
         job = self.state.job_by_id(namespace, job_id)
