@@ -54,6 +54,9 @@ class AllocRunner:
         from .services import ServiceHook
 
         self.services = ServiceHook(alloc, node, conn)
+        #: deployment health watcher (allochealth.py; reference
+        #: health_hook.go starts it only for deployment-tracked allocs)
+        self.health_tracker = None
         self._csi_mounted: List[Tuple[str, str]] = []  # (plugin, vol)
         self._base_dir = base_dir
         self.alloc_dir = AllocDir(base_dir, alloc.id)
@@ -110,19 +113,16 @@ class AllocRunner:
             self._recompute_status()
             return
 
-        def hook(t):
-            return t.lifecycle.hook if t.lifecycle is not None else ""
+        self._start_health_tracker()
 
-        prestart = [t for t in tasks if hook(t) == "prestart"
-                    and not t.lifecycle.sidecar]
-        sidecars = [t for t in tasks if t.lifecycle is not None
-                    and t.lifecycle.sidecar and hook(t) != "poststop"]
-        poststart = [t for t in tasks if hook(t) == "poststart"
-                     and not t.lifecycle.sidecar]
-        poststop = [t for t in tasks if hook(t) == "poststop"]
-        main = [t for t in tasks
-                if t not in prestart and t not in sidecars
-                and t not in poststart and t not in poststop]
+        from ..structs.job import lifecycle_buckets
+
+        buckets = lifecycle_buckets(tasks)
+        prestart = buckets["prestart"]
+        sidecars = buckets["sidecar"]
+        poststart = buckets["poststart"]
+        poststop = buckets["poststop"]
+        main = buckets["main"]
 
         # prestart tasks run to successful completion first (lifecycle
         # gating, taskrunner lifecycle.go)
@@ -157,6 +157,39 @@ class AllocRunner:
                 if not self._wait_dead([tr]):
                     return
         self._recompute_status()
+
+    def _start_health_tracker(self) -> None:
+        """Deployment-tracked allocs watch their own health and report
+        the verdict to the servers (health_hook.go; tracker.go:95).
+        Without this no rolling update could ever progress — the
+        DeploymentWatcher only acts on client-reported health."""
+        if not self.alloc.deployment_id or self.conn is None \
+                or not hasattr(self.conn, "update_alloc_health") \
+                or self._halted():
+            return
+        ds = self.alloc.deployment_status
+        if ds is not None and ds.healthy is not None:
+            # verdict already delivered (client restart mid-deployment):
+            # re-tracking could flip an accepted healthy alloc to
+            # unhealthy and spuriously fail the deployment
+            # (health_hook.go skips tracking on existing health)
+            return
+        from .allochealth import HealthTracker
+
+        def task_states_fn():
+            with self._lock:
+                return dict(self.task_states)
+
+        self.health_tracker = HealthTracker(
+            self.alloc,
+            task_states_fn=task_states_fn,
+            checks_fn=self.services.checks_status,
+            report_fn=lambda healthy: self.conn.update_alloc_health(
+                self.alloc.id, healthy),
+        )
+        self.health_tracker.start()
+        if self._halted():  # destroy/shutdown raced the creation
+            self.health_tracker.stop()
 
     def _migrate_prev_alloc_data(self) -> None:
         import os
@@ -416,6 +449,11 @@ class AllocRunner:
         return n
 
     def kill(self) -> None:
+        # a server-initiated stop of an undecided alloc (drain,
+        # scale-down, canary cleanup) must NOT read as "unhealthy" —
+        # cancel tracking before the tasks die
+        if self.health_tracker is not None:
+            self.health_tracker.stop()
         with self._lock:
             runners = list(self.task_runners.values())
         for tr in runners:
@@ -428,6 +466,8 @@ class AllocRunner:
         distinction; executor tasks survive because the executor plugin
         lives in its own session)."""
         self._shutting_down = True
+        if self.health_tracker is not None:
+            self.health_tracker.stop()
         with self._lock:
             runners = list(self.task_runners.values())
         for tr in runners:
@@ -435,6 +475,8 @@ class AllocRunner:
 
     def destroy(self) -> None:
         self._destroyed = True
+        if self.health_tracker is not None:
+            self.health_tracker.stop()
         self.services.stop()
         self.kill()
         for tr in list(self.task_runners.values()):

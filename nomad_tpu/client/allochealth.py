@@ -1,0 +1,158 @@
+"""Client-side deployment health tracking.
+
+Behavioral reference: `client/allochealth/tracker.go:95` (Tracker),
+wired into the alloc runner by `client/allocrunner/health_hook.go:1`.
+The tracker produces ONE terminal verdict per alloc: **healthy** when
+every counted task has been running continuously and every service
+check passing for `min_healthy_time`, within `healthy_deadline` of the
+alloc starting; **unhealthy** when a task fails, a counted task goes
+terminal, or the deadline passes first. The verdict is pushed to the
+servers (`Server.update_alloc_health`), which feed the
+DeploymentWatcher state machine (`server/deployments.py`) — rolling
+updates, canaries, promotion and auto-revert all hang off this signal.
+
+Task accounting mirrors the reference's lifecycle rules:
+- prestart non-sidecar tasks count as satisfied once they exit
+  successfully (they are not expected to keep running);
+- poststop tasks are ignored (they only run at teardown);
+- every other task (main + sidecars) must be RUNNING;
+- a task restart resets the healthy clock (the deadline still bounds
+  total time); a task failure or a counted task going terminal is an
+  immediate unhealthy verdict.
+
+Checks ride the ServiceHook's registrations: the check runner flips
+each registration between "passing" and "critical" (services.py), and
+the tracker requires every check-bearing registration to be passing
+for the whole min_healthy window.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..structs import TASK_STATE_DEAD, TaskState
+
+
+class HealthTracker:
+    """Watches task states + check results for one alloc and reports a
+    single healthy/unhealthy verdict."""
+
+    def __init__(self, alloc,
+                 task_states_fn: Callable[[], Dict[str, TaskState]],
+                 checks_fn: Callable[[], tuple],
+                 report_fn: Callable[[bool], None],
+                 poll_interval: float = 0.2) -> None:
+        self.alloc = alloc
+        self.task_states_fn = task_states_fn
+        #: () -> (n_checks, all_passing)
+        self.checks_fn = checks_fn
+        self.report_fn = report_fn
+        self.poll_interval = poll_interval
+        tg = alloc.job.lookup_task_group(alloc.task_group) \
+            if alloc.job else None
+        update = (tg.update if tg is not None and tg.update is not None
+                  else (alloc.job.update if alloc.job else None))
+        self.min_healthy_s = (update.min_healthy_time_s
+                              if update is not None else 10.0)
+        self.deadline_s = (update.healthy_deadline_s
+                           if update is not None else 300.0)
+        # lifecycle classification — shared with the alloc runner's
+        # launch ordering so the two can never diverge
+        from ..structs.job import lifecycle_buckets
+
+        buckets = lifecycle_buckets(tg.tasks if tg else [])
+        #: prestart non-sidecar: ok once successfully exited
+        self._may_exit = {t.name for t in buckets["prestart"]}
+        #: poststop: only runs at teardown
+        self._ignored = {t.name for t in buckets["poststop"]}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: None until the verdict is reported; then True/False
+        self.verdict: Optional[bool] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"health-{self.alloc.id[:8]}",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---- the watch loop (tracker.go watchTaskEvents + watchConsul
+    # collapsed into one poller over in-process state) ----
+
+    def _run(self) -> None:
+        start = time.time()
+        healthy_since: Optional[float] = None
+        restart_baseline: Dict[str, int] = {}
+        while not self._stop.is_set():
+            now = time.time()
+            states = self.task_states_fn()
+            verdict = self._evaluate(states, restart_baseline,
+                                     healthy_since, now)
+            if verdict == "unhealthy":
+                self._report(False)
+                return
+            if verdict == "reset":
+                healthy_since = None
+            elif verdict == "ok":
+                if healthy_since is None:
+                    healthy_since = now
+                if now - healthy_since >= self.min_healthy_s:
+                    self._report(True)
+                    return
+            if now - start >= self.deadline_s:
+                # deadline passed without a sustained healthy window
+                self._report(False)
+                return
+            self._stop.wait(self.poll_interval)
+
+    def _evaluate(self, states: Dict[str, TaskState],
+                  restart_baseline: Dict[str, int],
+                  healthy_since: Optional[float], now: float) -> str:
+        """One poll: 'unhealthy' | 'reset' | 'ok' | 'wait'."""
+        if not states:
+            return "wait"
+        all_ok = True
+        for name, ts in states.items():
+            if name in self._ignored:
+                continue
+            if ts.failed:
+                return "unhealthy"
+            prev = restart_baseline.setdefault(name, ts.restarts)
+            if ts.restarts > prev:
+                restart_baseline[name] = ts.restarts
+                return "reset"
+            if name in self._may_exit:
+                if ts.state == TASK_STATE_DEAD and not ts.successful():
+                    return "unhealthy"
+                continue  # pending/running/successfully-done all fine
+            if ts.state == TASK_STATE_DEAD:
+                # a counted task went terminal without the runner
+                # restarting it: it will never be running again
+                return "unhealthy"
+            if ts.state != "running":
+                all_ok = False
+        if not all_ok:
+            return "wait"
+        n_checks, passing = self.checks_fn()
+        if n_checks and not passing:
+            # a failing check resets the window (the reference requires
+            # checks passing for the full min_healthy_time)
+            return "reset"
+        return "ok"
+
+    def _report(self, healthy: bool) -> None:
+        self.verdict = healthy
+        try:
+            self.report_fn(healthy)
+        except Exception:  # noqa: BLE001 — server flake: one retry off
+            # the deadline path matters more than a perfect report; the
+            # server's progress deadline is the backstop
+            try:
+                time.sleep(1.0)
+                self.report_fn(healthy)
+            except Exception:  # noqa: BLE001
+                pass

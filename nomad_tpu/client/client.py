@@ -34,6 +34,7 @@ class ServerConn(Protocol):
                                timeout: float) -> Tuple[int, Dict[str, int]]: ...
     def alloc_get(self, alloc_id: str) -> Optional[Allocation]: ...
     def node_update_allocs(self, updates: List[Allocation]) -> None: ...
+    def update_alloc_health(self, alloc_id: str, healthy: bool) -> None: ...
 
 
 class InProcConn:
@@ -56,6 +57,9 @@ class InProcConn:
 
     def node_update_allocs(self, updates):
         return self.server.node_update_allocs(updates)
+
+    def update_alloc_health(self, alloc_id, healthy):
+        return self.server.update_alloc_health(alloc_id, healthy)
 
     def csi_volume_claim(self, namespace, vol_id, alloc_id, mode):
         return self.server.csi_volume_claim(namespace, vol_id, alloc_id,
@@ -128,6 +132,9 @@ class RpcConn:
 
     def node_update_allocs(self, updates):
         return self._call("node_update_allocs", updates)
+
+    def update_alloc_health(self, alloc_id, healthy):
+        return self._call("update_alloc_health", alloc_id, healthy)
 
     def csi_volume_claim(self, namespace, vol_id, alloc_id, mode):
         return self._call("csi_volume_claim", namespace, vol_id,

@@ -233,6 +233,20 @@ class ServiceHook:
                     except Exception:  # noqa: BLE001 — retry next round
                         pass
 
+    def checks_status(self) -> tuple:
+        """(n_checks, all_passing) across current registrations — the
+        alloc health tracker's check signal (allochealth.py)."""
+        with self._lock:
+            regs = list(self._regs.values())
+        n = 0
+        passing = True
+        for reg, checks in regs:
+            if checks:
+                n += len(checks)
+                if reg.status != "passing":
+                    passing = False
+        return n, passing
+
     def _run_check(self, reg: ServiceRegistration, chk: dict) -> bool:
         port = _resolve_port(self.alloc, chk.get("port", "")) or reg.port
         timeout = float(chk.get("timeout_s", 2))

@@ -206,6 +206,34 @@ class TaskLifecycle:
     sidecar: bool = False
 
 
+def lifecycle_buckets(tasks) -> Dict[str, list]:
+    """Partition tasks by lifecycle role — the ONE place that encodes the
+    hook/sidecar bucketing (taskrunner lifecycle gating semantics). Both
+    the alloc runner's launch ordering and the health tracker's task
+    accounting consume this, so they can never diverge.
+
+    Buckets: 'prestart' (run-to-completion before mains), 'sidecar'
+    (long-running companions), 'poststart' (launch after mains running),
+    'poststop' (teardown phase), 'main' (everything else)."""
+    out: Dict[str, list] = {"prestart": [], "sidecar": [],
+                            "poststart": [], "poststop": [], "main": []}
+    for t in tasks:
+        hook = t.lifecycle.hook if t.lifecycle is not None else ""
+        sidecar = bool(t.lifecycle.sidecar) \
+            if t.lifecycle is not None else False
+        if hook == "poststop":
+            out["poststop"].append(t)
+        elif sidecar:
+            out["sidecar"].append(t)
+        elif hook == "prestart":
+            out["prestart"].append(t)
+        elif hook == "poststart":
+            out["poststart"].append(t)
+        else:
+            out["main"].append(t)
+    return out
+
+
 @dataclass
 class DispatchPayloadConfig:
     """Reference `structs.DispatchPayloadConfig` (structs.go:5054) — where

@@ -1,0 +1,219 @@
+"""Client alloc-health watcher (reference client/allochealth/tracker.go:95
++ health_hook.go): verdict logic unit tests, plus the e2e bar — a rolling
+deployment that progresses and auto-reverts from task events ALONE (no
+test ever calls update_alloc_health; the client tracker does)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig, InProcConn
+from nomad_tpu.client.allochealth import HealthTracker
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import TaskState
+from nomad_tpu.structs.deployment import (
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+)
+from nomad_tpu.structs.job import UpdateStrategy
+
+
+def _wait(cond, timeout=30.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        v = cond()
+        if v:
+            return v
+        time.sleep(every)
+    return cond()
+
+
+def _alloc(min_healthy=0.2, deadline=5.0):
+    job = mock.job()
+    job.task_groups[0].update = UpdateStrategy(
+        max_parallel=1, min_healthy_time_s=min_healthy,
+        healthy_deadline_s=deadline)
+    a = mock.alloc(job=job)
+    a.deployment_id = "d1"
+    return a
+
+
+class TestTrackerVerdicts:
+    """Unit tests over the poll loop with synthetic state functions."""
+
+    def _run(self, alloc, states_seq, checks=(0, True), timeout=4.0):
+        """Feed successive state snapshots; return the verdict."""
+        reports = []
+        seq = list(states_seq)
+
+        def states_fn():
+            return seq.pop(0) if len(seq) > 1 else seq[0]
+
+        t = HealthTracker(alloc, states_fn, lambda: checks,
+                          reports.append, poll_interval=0.02)
+        t.start()
+        assert _wait(lambda: t.verdict is not None, timeout=timeout)
+        t.stop()
+        assert reports == [t.verdict]
+        return t.verdict
+
+    def test_healthy_after_min_healthy_time(self):
+        running = {"web": TaskState(state="running")}
+        assert self._run(_alloc(), [running]) is True
+
+    def test_task_failure_is_immediately_unhealthy(self):
+        failed = {"web": TaskState(state="dead", failed=True)}
+        assert self._run(_alloc(), [failed]) is False
+
+    def test_counted_task_terminal_is_unhealthy(self):
+        # a main task exiting cleanly is still not a healthy service
+        done = {"web": TaskState(state="dead", failed=False)}
+        assert self._run(_alloc(), [done]) is False
+
+    def test_deadline_without_health_is_unhealthy(self):
+        pending = {"web": TaskState(state="pending")}
+        a = _alloc(min_healthy=0.2, deadline=0.5)
+        start = time.time()
+        assert self._run(a, [pending]) is False
+        assert time.time() - start >= 0.5
+
+    def test_failing_check_blocks_health_until_deadline(self):
+        running = {"web": TaskState(state="running")}
+        a = _alloc(min_healthy=0.1, deadline=0.6)
+        assert self._run(a, [running], checks=(1, False)) is False
+
+    def test_passing_checks_allow_health(self):
+        running = {"web": TaskState(state="running")}
+        assert self._run(_alloc(), [running], checks=(2, True)) is True
+
+    def test_restart_resets_the_clock(self):
+        a = _alloc(min_healthy=0.3, deadline=10.0)
+        r0 = {"web": TaskState(state="running", restarts=0)}
+        r1 = {"web": TaskState(state="running", restarts=1)}
+        reports = []
+        phase = {"n": 0}
+
+        def states_fn():
+            phase["n"] += 1
+            return r0 if phase["n"] < 5 else r1
+
+        t = HealthTracker(a, states_fn, lambda: (0, True),
+                          reports.append, poll_interval=0.02)
+        start = time.time()
+        t.start()
+        assert _wait(lambda: t.verdict is not None, timeout=5.0)
+        # the restart at ~0.1s reset the window; health needed a fresh
+        # 0.3s of continuous running AFTER it
+        assert t.verdict is True
+        assert time.time() - start >= 0.3 + 0.08
+
+    def test_prestart_task_may_exit_successfully(self):
+        job = mock.job()
+        job.task_groups[0].update = UpdateStrategy(
+            max_parallel=1, min_healthy_time_s=0.15,
+            healthy_deadline_s=5.0)
+        from nomad_tpu.structs.job import Task, TaskLifecycle
+
+        init = Task(name="init", driver="raw_exec",
+                    lifecycle=TaskLifecycle(hook="prestart",
+                                            sidecar=False))
+        job.task_groups[0].tasks.append(init)
+        a = mock.alloc(job=job)
+        a.deployment_id = "d1"
+        states = {"web": TaskState(state="running"),
+                  "init": TaskState(state="dead", failed=False)}
+        assert self._run(a, [states]) is True
+        # ...but a FAILED prestart is terminal
+        states_bad = {"web": TaskState(state="running"),
+                      "init": TaskState(state="dead", failed=True)}
+        assert self._run(a, [states_bad]) is False
+
+    def test_non_deployment_alloc_gets_no_tracker(self):
+        """AllocRunner only starts the tracker for deployment allocs."""
+        from nomad_tpu.client.alloc_runner import AllocRunner
+
+        a = mock.alloc()
+        a.deployment_id = ""
+        r = AllocRunner(a, "/tmp/nonexistent-base", conn=object())
+        r._start_health_tracker()
+        assert r.health_tracker is None
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    server = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=60.0,
+                                 gc_interval=3600.0))
+    server.start()
+    client = Client(InProcConn(server),
+                    ClientConfig(data_dir=str(tmp_path / "c"),
+                                 heartbeat_interval=1.0))
+    client.start()
+    assert _wait(lambda: server.state.node_by_id(client.node.id)
+                 is not None)
+    yield server, client
+    client.shutdown()
+    server.shutdown()
+
+
+def _service_job(script, version_tag, count=1):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.update = UpdateStrategy(max_parallel=1, min_healthy_time_s=0.3,
+                               healthy_deadline_s=10.0, auto_revert=True)
+    job.update = tg.update
+    t = tg.tasks[0]
+    t.driver = "raw_exec"
+    t.config = {"command": "/bin/sh", "args": ["-c", script]}
+    t.env = {"v": version_tag}
+    tg.restart_policy.attempts = 0  # fail fast in the bad version
+    return job
+
+
+class TestDeploymentE2E:
+    """The VERDICT bar: rolling update + auto-revert driven entirely by
+    the client health watcher — this test NEVER calls
+    update_alloc_health."""
+
+    def test_rolling_update_and_auto_revert_from_task_events(self, agent):
+        server, client = agent
+
+        # --- v0: healthy service; its deployment must complete purely
+        # from the client tracker's report
+        v0 = _service_job("sleep 120", "0")
+        server.job_register(v0)
+        d0 = _wait(lambda: server.state.latest_deployment_by_job(
+            "default", v0.id))
+        assert d0 is not None
+        assert _wait(lambda: server.state.deployment_by_id(d0.id).status
+                     == DEPLOYMENT_STATUS_SUCCESSFUL), \
+            server.state.deployment_by_id(d0.id).status_description
+        stable = server.state.latest_stable_job("default", v0.id)
+        assert stable is not None and stable.version == 0
+        a0 = server.state.allocs_by_job("default", v0.id)[0]
+        assert a0.deployment_status is not None \
+            and a0.deployment_status.is_healthy()
+
+        # --- v1: broken task; the tracker reports unhealthy, the
+        # deployment fails, auto-revert brings v0's spec back
+        v1 = _service_job("exit 1", "1")
+        v1.id = v0.id
+        server.job_register(v1)
+        d1 = _wait(lambda: (
+            lambda d: d if d is not None and d.id != d0.id else None
+        )(server.state.latest_deployment_by_job("default", v0.id)))
+        assert d1 is not None
+        assert _wait(lambda: server.state.deployment_by_id(d1.id).status
+                     == DEPLOYMENT_STATUS_FAILED), \
+            server.state.deployment_by_id(d1.id).status
+        # auto-revert: a NEWER job version whose spec matches v0's
+        reverted = _wait(lambda: (
+            lambda j: j if j is not None and j.version > 1 else None
+        )(server.state.job_by_id("default", v0.id)))
+        assert reverted is not None
+        assert not reverted.spec_changed(v0)
+        # and the reverted version converges to a running, healthy alloc
+        assert _wait(lambda: any(
+            a.client_status == "running"
+            and a.job_version == reverted.version
+            for a in server.state.allocs_by_job("default", v0.id)))

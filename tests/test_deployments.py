@@ -1,8 +1,13 @@
-"""Deployment lifecycle tests.
+"""Deployment watcher (server-side) unit tests.
 
-Mirrors reference `nomad/deploymentwatcher/deployments_watcher_test.go` core
-transitions (healthy rollout → successful; unhealthy → failed + auto-revert;
-canary promotion; progress deadline) through the in-process Server.
+Mirrors reference `nomad/deploymentwatcher/deployments_watcher_test.go`:
+the health signal is INJECTED here (as the reference's tests inject it
+via raft shims) to exercise the watcher state machine in isolation —
+healthy rollout → successful; unhealthy → failed + auto-revert; canary
+promotion; auto-promote. The production loop that generates the signal
+(the client alloc-health tracker) is covered end-to-end in
+`tests/test_allochealth.py::TestDeploymentE2E`, where a rolling update
+and an auto-revert complete from task events alone.
 """
 import time
 
