@@ -62,8 +62,11 @@ class HealthTracker:
         from ..structs.job import lifecycle_buckets
 
         buckets = lifecycle_buckets(tg.tasks if tg else [])
-        #: prestart non-sidecar: ok once successfully exited
-        self._may_exit = {t.name for t in buckets["prestart"]}
+        #: non-sidecar prestart AND poststart: ok once successfully
+        #: exited — they are not expected to keep running (tracker.go
+        #: counts only tasks without a terminal lifecycle)
+        self._may_exit = {t.name for t in buckets["prestart"]} \
+            | {t.name for t in buckets["poststart"]}
         #: poststop: only runs at teardown
         self._ignored = {t.name for t in buckets["poststop"]}
         self._stop = threading.Event()
@@ -90,8 +93,7 @@ class HealthTracker:
         while not self._stop.is_set():
             now = time.time()
             states = self.task_states_fn()
-            verdict = self._evaluate(states, restart_baseline,
-                                     healthy_since, now)
+            verdict = self._evaluate(states, restart_baseline)
             if verdict == "unhealthy":
                 self._report(False)
                 return
@@ -110,8 +112,7 @@ class HealthTracker:
             self._stop.wait(self.poll_interval)
 
     def _evaluate(self, states: Dict[str, TaskState],
-                  restart_baseline: Dict[str, int],
-                  healthy_since: Optional[float], now: float) -> str:
+                  restart_baseline: Dict[str, int]) -> str:
         """One poll: 'unhealthy' | 'reset' | 'ok' | 'wait'."""
         if not states:
             return "wait"

@@ -55,6 +55,9 @@ class ServiceHook:
         self._thread: Optional[threading.Thread] = None
         #: a failed push happened; the runner loop re-asserts the set
         self._dirty = False
+        #: reg ids whose checks have ALL run at least once (the health
+        #: tracker refuses to call never-evaluated checks passing)
+        self._checks_evaluated: set = set()
         #: periodic anti-entropy re-assert cadence (the reference's
         #: Consul sync loop re-syncs on an interval too)
         self.reassert_interval = 10.0
@@ -205,6 +208,10 @@ class ServiceHook:
                     due[key] = now + float(chk.get("interval_s", 10))
                     ran_any = True
                     statuses.append(self._run_check(reg, chk))
+                if checks and all((reg.id, i) in due
+                                  for i in range(len(checks))):
+                    with self._lock:
+                        self._checks_evaluated.add(reg.id)
                 if not ran_any:
                     continue
                 status = "passing" if all(statuses) else "critical"
@@ -235,15 +242,20 @@ class ServiceHook:
 
     def checks_status(self) -> tuple:
         """(n_checks, all_passing) across current registrations — the
-        alloc health tracker's check signal (allochealth.py)."""
+        alloc health tracker's check signal (allochealth.py). A check
+        that has never RUN is not passing: ServiceRegistration.status
+        defaults to "passing" for checkless services, so with a short
+        min_healthy_time the tracker could otherwise bless an alloc
+        before its first (failing) check tick."""
         with self._lock:
             regs = list(self._regs.values())
+            evaluated = set(self._checks_evaluated)
         n = 0
         passing = True
         for reg, checks in regs:
             if checks:
                 n += len(checks)
-                if reg.status != "passing":
+                if reg.status != "passing" or reg.id not in evaluated:
                     passing = False
         return n, passing
 

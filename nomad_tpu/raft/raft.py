@@ -11,19 +11,22 @@ consensus library is vendored here:
 - randomized election timeouts, leader heartbeats
 - optional on-disk persistence of (term, votedFor, log) — the raft-boltdb
   analog — via msgpack frames
+- log compaction + InstallSnapshot (§7; fsm.go Snapshot :1242 / Restore
+  :1256): when `snapshot_fn`/`restore_fn` are configured, the applier
+  folds every `snapshot_threshold` applied entries into an FSM snapshot,
+  truncates the log prefix (memory AND the on-disk journal), and serves
+  the snapshot to followers whose next_index fell below the log base —
+  a lagging or freshly-joined server catches up in one transfer instead
+  of replaying history; restart restores the FSM from the latest
+  snapshot and replays only the suffix.
 
 Threading model: one ticker thread (election/heartbeat), one applier
-thread (feeds committed entries to the FSM apply_fn in order), replication
-performed per-peer on heartbeat ticks and on demand after an append.
-
-Known boundary vs the reference: no log compaction / InstallSnapshot.
-The log grows with cluster lifetime (in memory and, when data_dir is
-set, in the journal). The operational escape hatches are (a) the WAL
-layer's own FSM snapshots for single-server durability and (b)
-`operator snapshot save/restore` to re-seed a fresh cluster; a follower
-that must replay from index 1 always can, because nothing is ever
-truncated. Membership changes ride the log (remove_peer/add_peer), and
-a server added mid-life replays the full history on join.
+thread (feeds committed entries to the FSM apply_fn in order; takes the
+compaction snapshots, so they are consistent at exactly last_applied),
+replication performed per-peer on heartbeat ticks and on demand after
+an append. Membership changes ride the log (remove_peer/add_peer), and
+the voter map at the snapshot point is stored inside the snapshot so
+compacted conf entries survive installs.
 """
 from __future__ import annotations
 
@@ -54,11 +57,21 @@ class NotLeaderError(Exception):
 
 
 class _Log:
-    """1-indexed in-memory log with optional append-only file journal."""
+    """1-indexed in-memory log with optional append-only file journal.
+
+    Compaction support: the in-memory list holds only the SUFFIX
+    `[base_index+1 .. last_index]`; everything at or below `base_index`
+    has been folded into an FSM snapshot (base_term is the term of the
+    entry at base_index, needed for AppendEntries prev-log matching at
+    the boundary). A `{"op": "base"}` journal record marks a compaction
+    point; the journal is rewritten (tmp + rename) on compact so it
+    stays bounded on disk too."""
 
     def __init__(self, path: Optional[str] = None,
                  fsync: bool = False) -> None:
         self.entries: List[Dict[str, Any]] = []  # {"term": t, "data": ...}
+        self.base_index = 0
+        self.base_term = 0
         self._path = path
         self._fsync = fsync
         self._fh = None
@@ -69,10 +82,16 @@ class _Log:
             recs = load_journal(
                 path,
                 validate=lambda r: ("term" in r and "data" in r)
-                or (r.get("op") == "trunc" and "from" in r))
+                or (r.get("op") == "trunc" and "from" in r)
+                or (r.get("op") == "base" and "index" in r))
             for rec in recs:
-                if rec.get("op") == "trunc":
-                    del self.entries[rec["from"] - 1:]
+                op = rec.get("op")
+                if op == "trunc":
+                    del self.entries[rec["from"] - self.base_index - 1:]
+                elif op == "base":
+                    self.entries = []
+                    self.base_index = rec["index"]
+                    self.base_term = rec.get("term", 0)
                 else:
                     self.entries.append(rec)
 
@@ -86,30 +105,74 @@ class _Log:
         if self._fsync:
             os.fsync(self._fh.fileno())
 
+    def _rewrite_journal(self) -> None:
+        """Replace the on-disk journal with base marker + current suffix
+        (atomic rename) — this is what keeps the disk log bounded."""
+        if self._path is None:
+            return
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb(
+                {"op": "base", "index": self.base_index,
+                 "term": self.base_term}, use_bin_type=True))
+            for e in self.entries:
+                fh.write(msgpack.packb(e, use_bin_type=True))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._path)
+
     def last_index(self) -> int:
-        return len(self.entries)
+        return self.base_index + len(self.entries)
 
     def term_at(self, index: int) -> int:
         if index == 0:
             return 0
-        return self.entries[index - 1]["term"]
+        if index == self.base_index:
+            return self.base_term
+        if index < self.base_index:
+            # negative list indexing would silently return a WRONG
+            # entry's term — compacted history is unknowable, say so
+            raise KeyError(f"index {index} compacted (base "
+                           f"{self.base_index})")
+        return self.entries[index - self.base_index - 1]["term"]
 
     def append(self, term: int, data: Any) -> int:
         entry = {"term": term, "data": data}
         self.entries.append(entry)
         self._journal(entry)
-        return len(self.entries)
+        return self.last_index()
 
     def truncate_from(self, index: int) -> None:
         """Drop entries[index:] (1-indexed, inclusive)."""
-        if index <= len(self.entries):
-            del self.entries[index - 1:]
+        if index <= self.last_index():
+            del self.entries[index - self.base_index - 1:]
             self._journal({"op": "trunc", "from": index})
+
+    def compact_to(self, index: int, term: int) -> None:
+        """Fold entries ≤ index into the (already-persisted) snapshot."""
+        if index <= self.base_index:
+            return
+        del self.entries[: index - self.base_index]
+        self.base_index = index
+        self.base_term = term
+        self._rewrite_journal()
+
+    def reset_to(self, index: int, term: int) -> None:
+        """InstallSnapshot on a follower: discard the whole log and start
+        the suffix after the snapshot point."""
+        self.entries = []
+        self.base_index = index
+        self.base_term = term
+        self._rewrite_journal()
 
     def slice(self, start: int, limit: int = MAX_APPEND_BATCH
               ) -> List[Dict[str, Any]]:
-        """Entries from 1-indexed `start`."""
-        return self.entries[start - 1: start - 1 + limit]
+        """Entries from 1-indexed `start` (start must be > base_index)."""
+        off = start - self.base_index - 1
+        return self.entries[off: off + limit]
 
     def close(self) -> None:
         if self._fh is not None:
@@ -133,6 +196,9 @@ class RaftNode:
                  election_timeout: Tuple[float, float] = ELECTION_TIMEOUT,
                  on_leadership_change: Optional[Callable[[bool], None]] = None,
                  fsync: bool = False,
+                 snapshot_fn: Optional[Callable[[], Any]] = None,
+                 restore_fn: Optional[Callable[[Any], None]] = None,
+                 snapshot_threshold: int = 8192,
                  ) -> None:
         self.id = node_id
         self.peers = dict(peers)
@@ -141,19 +207,31 @@ class RaftNode:
         self.heartbeat_interval = heartbeat_interval
         self.election_timeout = election_timeout
         self.on_leadership_change = on_leadership_change
+        #: FSM snapshot/restore hooks (fsm.go Snapshot :1242 / Restore
+        #: :1256): snapshot_fn() returns a msgpack-able blob of the whole
+        #: FSM state as of the entries applied so far; restore_fn(blob)
+        #: rebuilds the FSM from one. Compaction is disabled without them.
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.snapshot_threshold = snapshot_threshold
 
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
         self._leadership_q: "deque[bool]" = deque()
         self._notify_lock = threading.Lock()
         self._notifier_running = False
+        #: applier is outside the lock running apply_fn on a batch —
+        #: InstallSnapshot must wait for it before swapping FSM state
+        self._applying = False
 
         self._meta_path = None
+        self._snap_path = None
         log_path = None
         if data_dir is not None:
             os.makedirs(data_dir, exist_ok=True)
             self._meta_path = os.path.join(data_dir, "raft_meta.mp")
             log_path = os.path.join(data_dir, "raft_log.mp")
+            self._snap_path = os.path.join(data_dir, "raft_snap.mp")
         self.log = _Log(log_path, fsync=fsync)
 
         self.term = 0
@@ -164,6 +242,10 @@ class RaftNode:
         self.leader_id: Optional[str] = None
         self.commit_index = 0
         self.last_applied = 0
+        #: latest FSM snapshot {"index","term","peers","state"} — served
+        #: to lagging followers whose next_index fell below the log base
+        self._snapshot: Optional[Dict[str, Any]] = None
+        self._load_snapshot()
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
         self._last_heard = time.monotonic()
@@ -174,6 +256,8 @@ class RaftNode:
 
         rpc_server.register("Raft.RequestVote", self._handle_request_vote)
         rpc_server.register("Raft.AppendEntries", self._handle_append_entries)
+        rpc_server.register("Raft.InstallSnapshot",
+                            self._handle_install_snapshot)
 
         self._ticker = threading.Thread(target=self._run_ticker,
                                         name=f"raft-tick-{node_id}",
@@ -214,6 +298,117 @@ class RaftNode:
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, self._meta_path)
+
+    # ---- FSM snapshots (fsm.go Snapshot/Restore; raft log compaction) --
+
+    def _load_snapshot(self) -> None:
+        """Boot: restore the FSM from the latest persisted snapshot and
+        start applying after it (replaces full-log replay)."""
+        if self._snap_path is None or not os.path.exists(self._snap_path):
+            return
+        with open(self._snap_path, "rb") as fh:
+            snap = msgpack.unpackb(fh.read(), raw=False,
+                                   strict_map_key=False)
+        self._install_snapshot_locked(snap, persist=False)
+
+    def _persist_snapshot(self, snap: Dict[str, Any]) -> None:
+        if self._snap_path is None:
+            return
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb(snap, use_bin_type=True))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._snap_path)
+
+    def _install_snapshot_locked(self, snap: Dict[str, Any],
+                                 persist: bool) -> None:
+        """Swap FSM state + log bookkeeping to a snapshot. Caller holds
+        the lock (or is the constructor, pre-threads)."""
+        idx, term = snap["index"], snap["term"]
+        if self.restore_fn is not None:
+            self.restore_fn(snap["state"])
+        if idx > self.log.last_index() or self.log.base_index > idx \
+                or self.log.term_at(idx) != term:
+            # our log diverges from / predates the snapshot: discard it
+            self.log.reset_to(idx, term)
+        else:
+            # snapshot covers a prefix we also have: just compact
+            self.log.compact_to(idx, term)
+        self.commit_index = max(self.commit_index, idx)
+        self.last_applied = max(self.last_applied, idx)
+        if snap.get("peers"):
+            self.peers = {p: tuple(a) for p, a in snap["peers"].items()}
+        self._snapshot = snap
+        if persist:
+            self._persist_snapshot(snap)
+
+    def _maybe_take_snapshot(self) -> None:
+        """Applier-thread only: the FSM is exactly at last_applied here
+        (all mutations ride the log), so the snapshot is consistent by
+        construction — no store quiescing needed."""
+        if self.snapshot_fn is None:
+            return
+        with self._lock:
+            if self.last_applied - self.log.base_index \
+                    < self.snapshot_threshold:
+                return
+            idx = self.last_applied
+            term = self.log.term_at(idx)
+            peers = {p: list(a) for p, a in self.peers.items()}
+            # flag the FSM as busy so a concurrent InstallSnapshot can't
+            # swap state underneath the serializer
+            self._applying = True
+        try:
+            state = self.snapshot_fn()
+        finally:
+            with self._commit_cv:
+                self._applying = False
+                self._commit_cv.notify_all()
+        snap = {"index": idx, "term": term, "peers": peers,
+                "state": state}
+        with self._lock:
+            if self.log.base_index >= idx or (
+                    self._snapshot is not None
+                    and self._snapshot["index"] >= idx):
+                # a concurrent InstallSnapshot published a newer one —
+                # persisting ours would roll the on-disk snapshot (and
+                # what we serve to lagging peers) backwards
+                return
+            # persist BEFORE compacting: a crash between the two leaves
+            # an over-long log (harmless), never a hole. Held under the
+            # lock so no newer install can interleave with the write.
+            self._persist_snapshot(snap)
+            self._snapshot = snap
+            self.log.compact_to(idx, term)
+
+    def force_snapshot(self) -> int:
+        """Take a snapshot now regardless of threshold (operator path /
+        tests). Returns the snapshot index (0 = nothing applied yet)."""
+        if self.snapshot_fn is None:
+            raise RuntimeError("no snapshot_fn configured")
+        with self._lock:
+            while self._applying:  # FSM mid-batch: wait for a stable point
+                self._commit_cv.wait(0.1)
+            idx = self.last_applied
+            if idx == 0:
+                return 0
+            term = self.log.term_at(idx)
+            peers = {p: list(a) for p, a in self.peers.items()}
+            # snapshot under the lock: the applier can't start a new
+            # batch (needs the lock) so the FSM stays at exactly idx
+            state = self.snapshot_fn()
+        snap = {"index": idx, "term": term, "peers": peers,
+                "state": state}
+        with self._lock:
+            if self.log.base_index >= idx or (
+                    self._snapshot is not None
+                    and self._snapshot["index"] >= idx):
+                return idx  # a newer snapshot landed meanwhile
+            self._persist_snapshot(snap)
+            self._snapshot = snap
+            self.log.compact_to(idx, term)
+        return idx
 
     def _rand_timeout(self) -> float:
         return random.uniform(*self.election_timeout)
@@ -321,9 +516,19 @@ class RaftNode:
                 self._waiters.pop(idx, None)
             raise TimeoutError("raft apply timed out (no quorum?)")
         with self._lock:
-            if (self.commit_index >= idx
-                    and self.log.last_index() >= idx
-                    and self.log.term_at(idx) == append_term):
+            ok = (self.commit_index >= idx
+                  and self.log.last_index() >= idx)
+            if ok:
+                if idx > self.log.base_index:
+                    ok = self.log.term_at(idx) == append_term
+                else:
+                    # our entry was applied AND compacted before we woke:
+                    # its term is gone, but entries can't be overwritten
+                    # while leadership is continuously held — still being
+                    # leader in the append term proves it was ours
+                    ok = (self.state == LEADER
+                          and self.term == append_term)
+            if ok:
                 return idx
         raise NotLeaderError(self.leader_id)  # lost leadership mid-apply
 
@@ -477,15 +682,26 @@ class RaftNode:
                                  args=(pid, addr), daemon=True).start()
 
     def _replicate_one(self, peer_id: str, addr) -> None:
+        snap_to_send = None
         with self._lock:
             if self.state != LEADER:
                 return
             term = self.term
             next_idx = self._next_index.get(peer_id, 1)
-            prev_idx = next_idx - 1
-            prev_term = self.log.term_at(prev_idx)
-            entries = self.log.slice(next_idx)
-            commit = self.commit_index
+            if next_idx <= self.log.base_index:
+                # the entries this peer needs were compacted away: ship
+                # the snapshot instead (InstallSnapshot, Raft §7)
+                snap_to_send = self._snapshot
+                if snap_to_send is None:
+                    return
+            else:
+                prev_idx = next_idx - 1
+                prev_term = self.log.term_at(prev_idx)
+                entries = self.log.slice(next_idx)
+                commit = self.commit_index
+        if snap_to_send is not None:
+            self._send_snapshot(peer_id, addr, term, snap_to_send)
+            return
         try:
             res = self.pool.call(addr, "Raft.AppendEntries", term, self.id,
                                  prev_idx, prev_term, entries, commit,
@@ -510,6 +726,55 @@ class RaftNode:
                 self._next_index[peer_id] = max(
                     1, hint if hint else next_idx - 1)
 
+    def _send_snapshot(self, peer_id: str, addr, term: int,
+                       snap: Dict[str, Any]) -> None:
+        """Leader → lagging follower: replace its FSM + log wholesale."""
+        try:
+            res = self.pool.call(addr, "Raft.InstallSnapshot", term,
+                                 self.id, snap, timeout=10.0)
+        except Exception:
+            return
+        with self._lock:
+            if res["term"] > self.term:
+                self._become_follower(res["term"], None)
+                return
+            if self.state != LEADER or self.term != term:
+                return
+            if res.get("success"):
+                idx = snap["index"]
+                if idx > self._match_index.get(peer_id, 0):
+                    self._match_index[peer_id] = idx
+                self._next_index[peer_id] = idx + 1
+                self._advance_commit()
+
+    def _handle_install_snapshot(self, term: int, leader: str,
+                                 snap: Dict[str, Any]) -> dict:
+        with self._lock:
+            if term < self.term:
+                return {"term": self.term, "success": False}
+            self._become_follower(term, leader)
+            if snap["index"] <= self.commit_index:
+                # we already have (and may have applied) past this point
+                return {"term": self.term, "success": True}
+            # park the applier: it mutates the FSM outside the lock and
+            # must not race the wholesale state swap
+            while self._applying:
+                self._commit_cv.wait(0.1)
+            if snap["index"] <= self.commit_index:
+                # went stale while we waited (concurrent AppendEntries
+                # advanced commit): installing now would rewind the FSM
+                # below last_applied and silently drop applied entries
+                return {"term": self.term, "success": True}
+            try:
+                self._install_snapshot_locked(snap, persist=True)
+            except Exception:  # noqa: BLE001 — a failed restore must not
+                # kill the RPC thread; the leader will retry
+                import traceback
+
+                traceback.print_exc()
+                return {"term": self.term, "success": False}
+            return {"term": self.term, "success": True}
+
     def _advance_commit(self) -> None:
         """Majority-match rule, current-term restriction (§5.4.2)."""
         for n in range(self.log.last_index(), self.commit_index, -1):
@@ -531,11 +796,20 @@ class RaftNode:
             if prev_idx > self.log.last_index():
                 return {"term": self.term, "success": False,
                         "conflict_index": self.log.last_index() + 1}
+            if prev_idx < self.log.base_index:
+                # we compacted past prev (snapshot installed): everything
+                # ≤ base is committed here; ask the leader to resend from
+                # the first index we still hold
+                return {"term": self.term, "success": False,
+                        "conflict_index": self.log.base_index + 1}
             if prev_idx > 0 and self.log.term_at(prev_idx) != prev_term:
-                # walk back past the conflicting term (§5.3 fast backup)
+                # walk back past the conflicting term (§5.3 fast backup);
+                # never below the compaction boundary — those terms are
+                # gone (and everything ≤ base is committed anyway)
                 t = self.log.term_at(prev_idx)
                 i = prev_idx
-                while i > 1 and self.log.term_at(i - 1) == t:
+                floor = max(1, self.log.base_index + 1)
+                while i > floor and self.log.term_at(i - 1) == t:
                     i -= 1
                 return {"term": self.term, "success": False,
                         "conflict_index": i}
@@ -565,23 +839,33 @@ class RaftNode:
                     return
                 start = self.last_applied + 1
                 end = self.commit_index
-                batch = [(i, self.log.entries[i - 1]["data"])
+                base = self.log.base_index
+                batch = [(i, self.log.entries[i - base - 1]["data"])
                          for i in range(start, end + 1)]
                 self.last_applied = end
                 waiters = [self._waiters.pop(i) for i in range(start, end + 1)
                            if i in self._waiters]
-            for _, data in batch:
-                if isinstance(data, dict) and data.get("op") == "__noop__":
-                    continue
-                if isinstance(data, dict) \
-                        and data.get("op") == "__raft_conf__":
-                    self._apply_conf(data)
-                    continue
-                try:
-                    self.apply_fn(data)
-                except Exception:
-                    import traceback
+                self._applying = True  # FSM mutation outside the lock —
+                # InstallSnapshot/force_snapshot park on this flag
+            try:
+                for _, data in batch:
+                    if isinstance(data, dict) \
+                            and data.get("op") == "__noop__":
+                        continue
+                    if isinstance(data, dict) \
+                            and data.get("op") == "__raft_conf__":
+                        self._apply_conf(data)
+                        continue
+                    try:
+                        self.apply_fn(data)
+                    except Exception:
+                        import traceback
 
-                    traceback.print_exc()
+                        traceback.print_exc()
+            finally:
+                with self._commit_cv:
+                    self._applying = False
+                    self._commit_cv.notify_all()
             for ev in waiters:
                 ev.set()
+            self._maybe_take_snapshot()

@@ -103,6 +103,15 @@ class RpcServer:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # shutdown() BEFORE close(): the accept thread is blocked inside
+        # accept(2), which holds the socket open at the kernel — close()
+        # alone neither wakes it nor frees the port, so a restarted agent
+        # could never rebind its own address. SHUT_RDWR forces accept to
+        # return, releasing the listener.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

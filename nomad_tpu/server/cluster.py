@@ -84,6 +84,27 @@ class RaftStateStore(StateStore):
     def direct(self) -> _DirectView:
         return _DirectView(self)
 
+    # ---- raft FSM snapshot hooks (fsm.go Snapshot :1242 / Restore
+    # :1256; consumed by RaftNode log compaction + InstallSnapshot) ----
+
+    def fsm_snapshot(self):
+        from .fsm import snapshot_state
+
+        return snapshot_state(self)
+
+    def fsm_restore(self, blob) -> None:
+        from .fsm import restore_state
+
+        self.reset_for_restore()
+        # restore runs through the normal mutators — they must write
+        # DIRECT, not re-enter raft.apply (self-deadlock on the applier)
+        prev = getattr(self._local, "direct", False)
+        self._local.direct = True
+        try:
+            restore_state(self, blob)
+        finally:
+            self._local.direct = prev
+
     def transact(self):
         """Serializes watcher read-modify-write sections against each other
         only. Raft-committed mutations land from the applier thread under
@@ -215,6 +236,12 @@ class ClusterServer:
             apply_fn=fsm.apply_resilient, data_dir=raft_dir,
             on_leadership_change=self._on_leadership_change,
             fsync=config.fsync,
+            # log compaction: fold applied entries into FSM snapshots so
+            # the log (memory + disk) stays bounded and lagging/fresh
+            # followers catch up via InstallSnapshot, not full replay
+            snapshot_fn=state.fsm_snapshot,
+            restore_fn=state.fsm_restore,
+            snapshot_threshold=config.snapshot_threshold,
         )
         state.raft = self.raft
         self._srv_cfg = srv_cfg

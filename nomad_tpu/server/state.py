@@ -218,6 +218,20 @@ class StateStore(InMemState):
         nested mutators from inside the scope safe)."""
         return self._cv
 
+    def reset_for_restore(self) -> None:
+        """Drop every data table (keep locks, watch plumbing, and the
+        index counter OBJECT — its value is pinned by restore_state) so a
+        raft InstallSnapshot can rebuild the FSM from the leader's
+        snapshot (fsm.go Restore :1256 wipes memdb the same way)."""
+        keep = {"index", "_lock", "_cv", "raft", "_intent_lock", "_local"}
+        kept = {k: v for k, v in self.__dict__.items() if k in keep}
+        with self._cv:
+            self.__dict__.clear()
+            InMemState.__init__(self)
+            self.__dict__.update(kept)  # restore the real counter + locks
+            self.index.value = 0
+            self._cv.notify_all()
+
     # -- snapshots & blocking --
 
     def snapshot(self) -> StateSnapshot:

@@ -217,3 +217,23 @@ class TestDeploymentE2E:
             a.client_status == "running"
             and a.job_version == reverted.version
             for a in server.state.allocs_by_job("default", v0.id)))
+
+
+def test_checks_status_requires_first_run():
+    """A check that has never executed must not count as passing —
+    ServiceRegistration.status defaults to 'passing', and a short
+    min_healthy_time could otherwise bless an alloc before its first
+    (failing) check tick."""
+    from nomad_tpu.client.services import ServiceHook
+    from nomad_tpu.structs.service import ServiceRegistration
+
+    hook = ServiceHook(mock.alloc(), None, None)
+    reg = ServiceRegistration(id="r1", service_name="s", alloc_id="a",
+                              port=1)
+    with hook._lock:
+        hook._regs["r1"] = (reg, [{"type": "tcp"}])
+    n, ok = hook.checks_status()
+    assert n == 1 and ok is False
+    hook._checks_evaluated.add("r1")
+    n, ok = hook.checks_status()
+    assert n == 1 and ok is True
