@@ -817,7 +817,23 @@ class HTTPApi:
                 node = state.node_by_id(node_id)
                 if node is None:
                     raise HttpError(404, f"node {node_id!r} not found")
-                return to_wire(node)
+                tree = to_wire(node)
+                # live heartbeat-carried device stats (devicemanager
+                # stats stream; off-raft telemetry). Heartbeats are
+                # leader-forwarded, so in cluster mode a follower asks
+                # the leader; a leadership change loses at most one
+                # heartbeat interval of telemetry.
+                ds = server.node_device_stats(node_id) \
+                    if hasattr(server, "node_device_stats") else None
+                if ds is None and cluster is not None:
+                    try:
+                        ds = cluster._call_wire("node_device_stats",
+                                                (to_wire(node_id),))
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        ds = None
+                if ds is not None:
+                    tree["device_stats"] = ds
+                return tree
             if sub == "drain" and method == "PUT":
                 require(acl.allow_node_write())
                 drain = from_wire(body.get("drain_spec")) if body else None

@@ -28,7 +28,8 @@ from .state import ClientStateDB, MemClientStateDB
 
 class ServerConn(Protocol):
     def node_register(self, node: Node) -> None: ...
-    def node_heartbeat(self, node_id: str) -> dict: ...
+    def node_heartbeat(self, node_id: str,
+                       device_stats: Optional[dict] = None) -> dict: ...
     #  → {"ok": bool, "servers": [[host, port], ...]} (NodeServerInfo)
     def node_get_client_allocs(self, node_id: str, min_index: int,
                                timeout: float) -> Tuple[int, Dict[str, int]]: ...
@@ -46,8 +47,8 @@ class InProcConn:
     def node_register(self, node):
         return self.server.node_register(node)
 
-    def node_heartbeat(self, node_id):
-        return self.server.node_heartbeat(node_id)
+    def node_heartbeat(self, node_id, device_stats=None):
+        return self.server.node_heartbeat(node_id, device_stats)
 
     def node_get_client_allocs(self, node_id, min_index, timeout):
         return self.server.node_get_client_allocs(node_id, min_index, timeout)
@@ -118,8 +119,8 @@ class RpcConn:
     def node_register(self, node):
         return self._call("node_register", node)
 
-    def node_heartbeat(self, node_id):
-        return self._call("node_heartbeat", node_id)
+    def node_heartbeat(self, node_id, device_stats=None):
+        return self._call("node_heartbeat", node_id, device_stats)
 
     def node_get_client_allocs(self, node_id, min_index, timeout):
         idx, allocs = self._call("node_get_client_allocs", node_id,
@@ -181,10 +182,13 @@ class Client:
         self.node = self.config.node or Node(id=str(uuid.uuid4()))
         if not self.node.id:
             self.node.id = str(uuid.uuid4())
+        from .devicemanager import DeviceManager
         from .pluginmanager import DriverManager
 
         self.driver_manager = DriverManager(
             on_attrs=self._driver_attrs_changed)
+        self.device_manager = DeviceManager(
+            on_devices=self._devices_changed)
         # CSI node plugins (client/pluginmanager/csimanager/): the builtin
         # hostpath plugin stands in for container-hosted CSI services and
         # is advertised on the node so CSIVolumeChecker feasibility passes
@@ -213,6 +217,10 @@ class Client:
         self._restore()
         self.conn.node_register(self.node)
         self.driver_manager.start()
+        # seed with the registration-time device set so the manager's
+        # first fingerprint doesn't trigger a redundant re-register
+        self.device_manager.seed(self.node.node_resources.devices)
+        self.device_manager.start()
         for fn, name in ((self._run_heartbeat, "hb"),
                          (self._run_watch, "watch"),
                          (self._run_sync, "sync")):
@@ -238,9 +246,20 @@ class Client:
             except Exception:
                 pass  # next heartbeat/registration retries
 
+    def _devices_changed(self, groups) -> None:
+        """Device fingerprint transition (devicemanager loop): rewrite
+        the node's device groups and re-register so the scheduler sees
+        vanished/unhealthy instances (manager.go UpdateNodeFromDevices)."""
+        self.node.node_resources.devices = list(groups)
+        try:
+            self.conn.node_register(self.node)
+        except Exception:  # noqa: BLE001 — next transition retries
+            pass
+
     def shutdown(self) -> None:
         self._stop.set()
         self.driver_manager.shutdown()
+        self.device_manager.shutdown()
         with self._dirty_cv:
             self._dirty.clear()  # nothing more leaves this client
             self._dirty_cv.notify_all()
@@ -268,7 +287,8 @@ class Client:
     def _run_heartbeat(self) -> None:
         while not self._stop.wait(self.config.heartbeat_interval):
             try:
-                resp = self.conn.node_heartbeat(self.node.id)
+                resp = self.conn.node_heartbeat(
+                    self.node.id, self.device_manager.latest_stats())
                 ok = resp.get("ok", False) if isinstance(resp, dict) \
                     else bool(resp)
                 if not ok:  # server lost us: re-register (client.go:1605)

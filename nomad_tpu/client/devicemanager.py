@@ -1,0 +1,277 @@
+"""Client device manager — device fingerprint + stats streams.
+
+Behavioral reference: `client/devicemanager/manager.go:1` (plugin
+instance ownership, fingerprint stream feeding node updates, stats
+collection) and `plugins/device/device.go:1` (DevicePlugin contract:
+Fingerprint / Reserve / Stats). The reference runs each device plugin
+as a separate process streaming over gRPC; here plugins are in-process
+objects with the same three-method contract, and the "streams" are the
+manager's poll loops:
+
+- **fingerprint loop** (slow cadence): re-detects device groups and
+  instance health; on any change the client rewrites the node's device
+  groups and re-registers, so the scheduler stops placing device asks
+  onto vanished/unhealthy instances (manager.go fingerprint →
+  UpdateNodeFromDevices).
+- **stats loop** (fast cadence): collects per-instance stats, cached in
+  the manager; the client attaches the latest map to every heartbeat
+  and the servers surface it on `/v1/node/<id>` (live, not raft-logged
+  — stats are ephemeral telemetry, like the reference's client stats
+  endpoint).
+
+The TPU plugin reuses the bounded subprocess probe from
+`fingerprint.py` (a wedged accelerator tunnel must never hang the
+agent); a probe failure AFTER devices were seen flips the instances
+unhealthy instead of silently dropping the group.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
+
+
+def parse_fake_devices(spec: str) -> List[NodeDeviceResource]:
+    """The ONE parser for NOMAD_TPU_FAKE_DEVICES ("vendor/type/name:count
+    [,...]") — shared by the registration-time fingerprinter
+    (fingerprint.py device_env_fingerprint) and EnvDevicePlugin, so the
+    two can never disagree on group shape or instance ids."""
+    groups: List[NodeDeviceResource] = []
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if ":" not in part:
+            continue
+        ident, _, cnt = part.rpartition(":")
+        bits = ident.split("/")
+        try:
+            count = int(cnt)
+        except ValueError:
+            continue
+        if len(bits) != 3 or count <= 0:
+            continue
+        groups.append(NodeDeviceResource(
+            vendor=bits[0], type=bits[1], name=bits[2],
+            instances=[NodeDeviceInstance(id=f"{ident}-{i}", healthy=True)
+                       for i in range(count)]))
+    return groups
+
+
+def reservation_env(vendor: str, typ: str,
+                    instance_ids: List[str]) -> Dict[str, str]:
+    """Visibility env for an assigned device group — the single source
+    of truth consumed by taskenv (device.go Reserve →
+    ContainerReservation; the NVIDIA_VISIBLE_DEVICES analog per
+    family)."""
+    if vendor == "google" and typ == "tpu":
+        return TpuDevicePlugin().reserve(instance_ids)
+    return {}
+
+
+class DevicePlugin:
+    """The plugins/device/device.go contract, in-process."""
+
+    name = "device"
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        """Detect device groups (instances + attributes)."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Dict[str, dict]]:
+        """{group_id: {instance_id: {...}}} for this plugin's devices —
+        group-keyed so the manager never has to re-fingerprint just to
+        map instances back to groups."""
+        raise NotImplementedError
+
+    def reserve(self, instance_ids: List[str]) -> Dict[str, str]:
+        """Env needed by a task to see exactly these instances
+        (device.go Reserve → ContainerReservation)."""
+        return {}
+
+
+class TpuDevicePlugin(DevicePlugin):
+    """TPU chips via the JAX runtime (the nvidia/NVML plugin analog,
+    devices/gpu/nvidia/). Detection delegates to the bounded subprocess
+    probe in fingerprint.py; stats report health + probe latency (the
+    runtime exposes no per-chip utilization counters off-device)."""
+
+    name = "tpu"
+
+    def __init__(self) -> None:
+        self._last_probe_ms: float = 0.0
+        self._last_ok: float = 0.0
+        self._seen: List[NodeDeviceResource] = []
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        from ..structs.node import Node
+        from .fingerprint import tpu_fingerprint
+
+        scratch = Node(id="probe")
+        t0 = time.time()
+        tpu_fingerprint(scratch)
+        probed = [d for d in scratch.node_resources.devices
+                  if d.vendor == "google" and d.type == "tpu"]
+        self._last_probe_ms = (time.time() - t0) * 1e3
+        if probed:
+            self._last_ok = time.time()
+            self._seen = probed
+            return probed
+        if self._seen:
+            # devices were here and the probe now fails/hangs: report
+            # them unhealthy (wedged tunnel / lost grant), don't vanish
+            sick = []
+            for g in self._seen:
+                sick.append(NodeDeviceResource(
+                    vendor=g.vendor, type=g.type, name=g.name,
+                    instances=[NodeDeviceInstance(id=i.id, healthy=False)
+                               for i in g.instances],
+                    attributes={**g.attributes,
+                                "health_description": "probe failed"},
+                ))
+            return sick
+        return []
+
+    def stats(self) -> Dict[str, Dict[str, dict]]:
+        out: Dict[str, Dict[str, dict]] = {}
+        for g in self._seen:
+            out[g.id()] = {inst.id: {
+                "healthy": inst.healthy,
+                "probe_ms": round(self._last_probe_ms, 1),
+                "last_ok_unix": round(self._last_ok, 1),
+            } for inst in g.instances}
+        return out
+
+    def reserve(self, instance_ids: List[str]) -> Dict[str, str]:
+        ids = ",".join(instance_ids)
+        # the TPU runtime's visibility contract (the NVIDIA_VISIBLE_
+        # DEVICES analog for libtpu-backed processes)
+        return {"TPU_VISIBLE_CHIPS": ids, "TPU_VISIBLE_DEVICES": ids}
+
+
+class EnvDevicePlugin(DevicePlugin):
+    """Declarative device groups from NOMAD_TPU_FAKE_DEVICES — the
+    test/dev stand-in for out-of-process plugins. Format:
+    "vendor/type/name:count[,...]". Stats are synthetic but live (they
+    change every collection, proving the stream end-to-end)."""
+
+    name = "env"
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        return parse_fake_devices(
+            os.environ.get("NOMAD_TPU_FAKE_DEVICES", ""))
+
+    def stats(self) -> Dict[str, Dict[str, dict]]:
+        out: Dict[str, Dict[str, dict]] = {}
+        for g in self.fingerprint():
+            out[g.id()] = {inst.id: {
+                "healthy": True,
+                "collected_unix": round(time.time(), 1),
+            } for inst in g.instances}
+        return out
+
+
+class DeviceManager:
+    """devicemanager/manager.go analog: owns the plugins, runs the
+    fingerprint + stats loops, feeds the client."""
+
+    def __init__(self,
+                 on_devices: Optional[
+                     Callable[[List[NodeDeviceResource]], None]] = None,
+                 fingerprint_interval: float = 60.0,
+                 stats_interval: float = 5.0,
+                 plugins: Optional[List[DevicePlugin]] = None) -> None:
+        self.on_devices = on_devices
+        self.fingerprint_interval = fingerprint_interval
+        self.stats_interval = stats_interval
+        self.plugins = plugins if plugins is not None else self._builtin()
+        self._lock = threading.Lock()
+        #: {"vendor/type/name": {instance_id: {..stats..}}}
+        self._stats: Dict[str, Dict[str, dict]] = {}
+        self._last_groups: Dict[str, list] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _builtin() -> List[DevicePlugin]:
+        plugins: List[DevicePlugin] = [EnvDevicePlugin()]
+        if not os.environ.get("NOMAD_TPU_SKIP_TPU_FINGERPRINT"):
+            plugins.append(TpuDevicePlugin())
+        return plugins
+
+    def seed(self, groups: List[NodeDeviceResource]) -> None:
+        """Adopt an externally-fingerprinted device set as the baseline
+        (registration-time fingerprint.py results) so the first loop
+        pass only reports REAL changes."""
+        with self._lock:
+            self._last_groups = {
+                g.id(): sorted((i.id, i.healthy) for i in g.instances)
+                for g in groups}
+
+    # ---- fingerprint stream ----
+
+    def fingerprint_once(self) -> Optional[List[NodeDeviceResource]]:
+        """Collect groups from every plugin; returns the full set when
+        ANYTHING changed since last time, else None."""
+        groups: List[NodeDeviceResource] = []
+        for p in self.plugins:
+            try:
+                groups.extend(p.fingerprint())
+            except Exception:  # noqa: BLE001 — a broken plugin loses
+                # only its own devices
+                continue
+        shape = {
+            g.id(): sorted((i.id, i.healthy) for i in g.instances)
+            for g in groups}
+        with self._lock:
+            changed = shape != self._last_groups
+            self._last_groups = shape
+        return groups if changed else None
+
+    # ---- stats stream ----
+
+    def collect_stats(self) -> Dict[str, Dict[str, dict]]:
+        stats: Dict[str, Dict[str, dict]] = {}
+        for p in self.plugins:
+            try:
+                stats.update(p.stats())
+            except Exception:  # noqa: BLE001 — a broken plugin loses
+                # only its own stats
+                continue
+        with self._lock:
+            self._stats = stats
+        return stats
+
+    def latest_stats(self) -> Dict[str, Dict[str, dict]]:
+        """Most recent stats map — attached to every client heartbeat."""
+        with self._lock:
+            return dict(self._stats)
+
+    # ---- loops ----
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="device-manager", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        next_fp = time.time() + self.fingerprint_interval
+        while not self._stop.wait(self.stats_interval):
+            try:
+                self.collect_stats()
+            except Exception:  # noqa: BLE001
+                pass
+            if time.time() >= next_fp:
+                next_fp = time.time() + self.fingerprint_interval
+                try:
+                    groups = self.fingerprint_once()
+                except Exception:  # noqa: BLE001
+                    groups = None
+                if groups is not None and self.on_devices is not None:
+                    try:
+                        self.on_devices(groups)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def shutdown(self) -> None:
+        self._stop.set()

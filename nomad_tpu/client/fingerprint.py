@@ -205,28 +205,14 @@ def device_env_fingerprint(node: Node) -> None:
     spec = os.environ.get("NOMAD_TPU_FAKE_DEVICES", "")
     if not spec:
         return
-    from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
+    from .devicemanager import parse_fake_devices
 
-    for part in spec.split(","):
-        part = part.strip()
-        if not part or ":" not in part:
-            continue
-        ident, _, cnt = part.rpartition(":")
-        bits = ident.split("/")
-        try:
-            count = int(cnt)
-        except ValueError:
-            continue
-        if len(bits) != 3 or count <= 0:
-            continue
+    for group in parse_fake_devices(spec):
         # re-run-safe: replace a previously-registered identical group
         node.node_resources.devices = [
-            d for d in node.node_resources.devices if d.id() != ident
-        ] + [NodeDeviceResource(
-            vendor=bits[0], type=bits[1], name=bits[2],
-            instances=[NodeDeviceInstance(id=f"{ident}-{i}", healthy=True)
-                       for i in range(count)],
-        )]
+            d for d in node.node_resources.devices
+            if d.id() != group.id()
+        ] + [group]
 
 
 def cgroup_fingerprint(node: Node) -> None:

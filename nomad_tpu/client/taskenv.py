@@ -48,6 +48,18 @@ def build_env(alloc: Allocation, task: Task, node: Optional[Node],
     meta.update(task.meta)
     for k, v in meta.items():
         env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = str(v)
+    # assigned devices (scheduler/device.py instance ids): generic
+    # NOMAD_DEVICE_* plus the owning plugin family's visibility env
+    # (devicemanager.reservation_env — the device.go Reserve contract)
+    ar = alloc.allocated_resources
+    atr = (ar.tasks or {}).get(task.name) if ar is not None else None
+    for dev in (atr.devices if atr is not None else []):
+        ids = ",".join(dev.device_ids)
+        key = dev.type.upper().replace("-", "_")
+        env[f"NOMAD_DEVICE_{key}"] = ids
+        from .devicemanager import reservation_env
+
+        env.update(reservation_env(dev.vendor, dev.type, dev.device_ids))
     for k, v in task.env.items():
         env[k] = str(v)
     return env

@@ -194,7 +194,7 @@ FORWARDED = (
     "job_register", "job_deregister", "node_register", "node_update_status",
     "node_update_drain", "node_update_eligibility", "node_heartbeat",
     "node_update_allocs", "node_get_client_allocs", "alloc_get", "run_gc",
-    "update_alloc_health",
+    "update_alloc_health", "node_device_stats",
     "csi_volume_claim", "csi_volume_get",
     "update_service_registrations", "remove_service_registrations",
     "secret_upsert", "secret_delete", "secret_get",

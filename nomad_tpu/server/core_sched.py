@@ -158,6 +158,7 @@ class CoreScheduler:
             if state.allocs_by_node(node.id):
                 continue
             state.delete_node(node.id)
+            self.server._drop_node_device_stats(node.id)
             n += 1
         return n
 

