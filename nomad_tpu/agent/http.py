@@ -819,18 +819,17 @@ class HTTPApi:
                     raise HttpError(404, f"node {node_id!r} not found")
                 tree = to_wire(node)
                 # live heartbeat-carried device stats (devicemanager
-                # stats stream; off-raft telemetry). Heartbeats are
-                # leader-forwarded, so in cluster mode a follower asks
-                # the leader; a leadership change loses at most one
-                # heartbeat interval of telemetry.
-                ds = server.node_device_stats(node_id) \
-                    if hasattr(server, "node_device_stats") else None
-                if ds is None and cluster is not None:
+                # stats stream; off-raft telemetry). Heartbeats land on
+                # the LEADER, so any non-leader (follower OR ex-leader
+                # holding a frozen pre-election map) must ask it; a
+                # leadership change loses at most one heartbeat interval.
+                if cluster is not None and not cluster.is_leader():
                     try:
-                        ds = cluster._call_wire("node_device_stats",
-                                                (to_wire(node_id),))
+                        ds = cluster.call("node_device_stats", node_id)
                     except Exception:  # noqa: BLE001 — telemetry only
                         ds = None
+                else:
+                    ds = server.node_device_stats(node_id)
                 if ds is not None:
                     tree["device_stats"] = ds
                 return tree

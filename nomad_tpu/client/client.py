@@ -249,12 +249,11 @@ class Client:
     def _devices_changed(self, groups) -> None:
         """Device fingerprint transition (devicemanager loop): rewrite
         the node's device groups and re-register so the scheduler sees
-        vanished/unhealthy instances (manager.go UpdateNodeFromDevices)."""
+        vanished/unhealthy instances (manager.go UpdateNodeFromDevices).
+        A registration failure propagates — the manager then refrains
+        from committing the new baseline and re-reports next pass."""
         self.node.node_resources.devices = list(groups)
-        try:
-            self.conn.node_register(self.node)
-        except Exception:  # noqa: BLE001 — next transition retries
-            pass
+        self.conn.node_register(self.node)
 
     def shutdown(self) -> None:
         self._stop.set()

@@ -119,7 +119,9 @@ class TpuDevicePlugin(DevicePlugin):
             return probed
         if self._seen:
             # devices were here and the probe now fails/hangs: report
-            # them unhealthy (wedged tunnel / lost grant), don't vanish
+            # them unhealthy (wedged tunnel / lost grant), don't vanish.
+            # Stored back into _seen so the stats stream agrees with the
+            # fingerprinted health instead of advertising stale healthy.
             sick = []
             for g in self._seen:
                 sick.append(NodeDeviceResource(
@@ -129,6 +131,7 @@ class TpuDevicePlugin(DevicePlugin):
                     attributes={**g.attributes,
                                 "health_description": "probe failed"},
                 ))
+            self._seen = sick
             return sick
         return []
 
@@ -210,9 +213,10 @@ class DeviceManager:
 
     # ---- fingerprint stream ----
 
-    def fingerprint_once(self) -> Optional[List[NodeDeviceResource]]:
-        """Collect groups from every plugin; returns the full set when
-        ANYTHING changed since last time, else None."""
+    def _detect(self):
+        """(groups, shape, changed) WITHOUT committing the shape — the
+        loop commits only after the node update succeeds, so a transient
+        registration failure can't eat a device transition forever."""
         groups: List[NodeDeviceResource] = []
         for p in self.plugins:
             try:
@@ -225,7 +229,18 @@ class DeviceManager:
             for g in groups}
         with self._lock:
             changed = shape != self._last_groups
+        return groups, shape, changed
+
+    def _commit(self, shape: Dict[str, list]) -> None:
+        with self._lock:
             self._last_groups = shape
+
+    def fingerprint_once(self) -> Optional[List[NodeDeviceResource]]:
+        """Collect groups from every plugin; returns the full set when
+        ANYTHING changed since last time (committing the new baseline),
+        else None."""
+        groups, shape, changed = self._detect()
+        self._commit(shape)
         return groups if changed else None
 
     # ---- stats stream ----
@@ -264,14 +279,20 @@ class DeviceManager:
             if time.time() >= next_fp:
                 next_fp = time.time() + self.fingerprint_interval
                 try:
-                    groups = self.fingerprint_once()
+                    groups, shape, changed = self._detect()
                 except Exception:  # noqa: BLE001
-                    groups = None
-                if groups is not None and self.on_devices is not None:
-                    try:
-                        self.on_devices(groups)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    continue
+                if not changed:
+                    continue
+                if self.on_devices is None:
+                    self._commit(shape)
+                    continue
+                try:
+                    self.on_devices(groups)
+                except Exception:  # noqa: BLE001 — node update failed:
+                    # do NOT commit; the next pass re-reports the change
+                    continue
+                self._commit(shape)
 
     def shutdown(self) -> None:
         self._stop.set()

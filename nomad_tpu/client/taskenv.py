@@ -50,16 +50,25 @@ def build_env(alloc: Allocation, task: Task, node: Optional[Node],
         env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = str(v)
     # assigned devices (scheduler/device.py instance ids): generic
     # NOMAD_DEVICE_* plus the owning plugin family's visibility env
-    # (devicemanager.reservation_env — the device.go Reserve contract)
+    # (devicemanager.reservation_env — the device.go Reserve contract).
+    # Ids MERGE across groups sharing a type/family (e.g. two tpu
+    # groups) — overwriting would hide a subset of granted devices.
     ar = alloc.allocated_resources
     atr = (ar.tasks or {}).get(task.name) if ar is not None else None
+    by_type: Dict[str, list] = {}
+    by_family: Dict[tuple, list] = {}
     for dev in (atr.devices if atr is not None else []):
-        ids = ",".join(dev.device_ids)
-        key = dev.type.upper().replace("-", "_")
-        env[f"NOMAD_DEVICE_{key}"] = ids
+        by_type.setdefault(dev.type.upper().replace("-", "_"),
+                           []).extend(dev.device_ids)
+        by_family.setdefault((dev.vendor, dev.type),
+                             []).extend(dev.device_ids)
+    for key, ids in by_type.items():
+        env[f"NOMAD_DEVICE_{key}"] = ",".join(ids)
+    if by_family:
         from .devicemanager import reservation_env
 
-        env.update(reservation_env(dev.vendor, dev.type, dev.device_ids))
+        for (vendor, typ), ids in by_family.items():
+            env.update(reservation_env(vendor, typ, ids))
     for k, v in task.env.items():
         env[k] = str(v)
     return env

@@ -340,21 +340,73 @@ class ExecutorService:
 
     def exec_cmd(self, command: str, args: List[str],
                  timeout_s: float = 30.0) -> Dict[str, object]:
-        """executor.go Exec: run a command in the task's context (cwd +
-        env); powers `nomad alloc exec`."""
+        """executor_linux.go Exec (nsenter path): run a command INSIDE
+        the task's isolation context — its namespaces, chroot, and
+        cgroup — not just with its cwd/env. Powers `nomad alloc exec`;
+        a chrooted task's exec must see the chroot root, and the
+        command's resource usage must land in the task's cgroup. Falls
+        back to plain cwd/env when the task holds no isolation (raw_exec)
+        or is already dead."""
         spec = self._spec
+        applied = self._applied or {}
+        preexec = None
+        cwd = spec.get("cwd") or None
+        if (self._proc is not None and self._exit is None
+                and (applied.get("namespaces") or applied.get("cgroup"))):
+            pid = self._proc.pid
+            cg = self._cgroup
+            inner_cwd = (spec.get("isolation") or {}).get("chroot_cwd") \
+                if applied.get("chroot") else (spec.get("cwd") or "/")
+            if applied.get("chroot"):
+                # startup race: an exec issued before taskinit finishes
+                # pivoting would join a not-yet-chrooted context and
+                # escape the sandbox — wait (bounded) for the pivot and
+                # FAIL CLOSED if it never materializes
+                pivoted = False
+                deadline = time.time() + 5.0
+                while time.time() < deadline:
+                    try:
+                        if os.readlink(f"/proc/{pid}/root") != "/":
+                            pivoted = True
+                            break
+                    except OSError:
+                        break  # task died: fail below, never on host
+                    time.sleep(0.05)
+                if not pivoted:
+                    return {"exit_code": -1, "stdout": "",
+                            "stderr": "task context unavailable "
+                                      "(chroot not entered or task "
+                                      "dead) — refusing host exec"}
+            # fail-closed requirements: the contexts the task is KNOWN
+            # to hold must be entered or the exec must not run
+            need_ns = ["ipc", "uts", "mnt"] \
+                if applied.get("namespaces") else []
+
+            def preexec():  # noqa: F811 — child-side context entry
+                isolation.enter_task_context(
+                    pid, cg, chdir_to=inner_cwd or "/",
+                    required_ns=need_ns,
+                    require_root=bool(applied.get("chroot")))
+
+            cwd = None  # the preexec pivot owns the working directory
         try:
             r = subprocess.run(
                 [command] + [str(a) for a in args or []],
-                cwd=spec.get("cwd") or None,
+                cwd=cwd,
                 env={**os.environ, **(spec.get("env") or {})},
                 capture_output=True, timeout=timeout_s,
+                preexec_fn=preexec,
             )
             return {"exit_code": r.returncode,
                     "stdout": r.stdout.decode("utf-8", "replace"),
                     "stderr": r.stderr.decode("utf-8", "replace")}
         except subprocess.TimeoutExpired:
             return {"exit_code": -1, "stdout": "", "stderr": "timeout"}
+        except (subprocess.SubprocessError, OSError) as e:
+            # preexec_fn raised: the child aborted BEFORE exec — the
+            # command never ran anywhere (fail-closed containment)
+            return {"exit_code": -1, "stdout": "",
+                    "stderr": f"could not enter task context: {e}"}
 
     def destroy(self) -> bool:
         """Kill the task if needed, clean the cgroup, exit the plugin."""

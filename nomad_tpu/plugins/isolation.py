@@ -134,6 +134,21 @@ class Cgroup:
     def _v2_path(self) -> str:
         return os.path.join(CGROUP_ROOT, PARENT_GROUP, self.name)
 
+    @classmethod
+    def attach_existing(cls, name: str,
+                        version: Optional[str] = None) -> "Cgroup":
+        """Handle to an ALREADY-CREATED task cgroup (taskinit joining
+        the executor's group, tests/observers inspecting membership) —
+        the one place that knows how paths resolve per version."""
+        g = cls(name, version)
+        if g.version == "v2":
+            g.paths = [g._v2_path()]
+        else:
+            g.paths = [p for p in (g._v1_path(c)
+                                   for c in ("memory", "cpu", "pids"))
+                       if os.path.isdir(p)]
+        return g
+
     @staticmethod
     def _write(path: str, value: str) -> None:
         with open(path, "w") as fh:
@@ -285,6 +300,68 @@ class Cgroup:
 # ---------------------------------------------------------------------------
 # Task-bootstrap helpers (run inside taskinit, between fork and exec)
 # ---------------------------------------------------------------------------
+
+def enter_task_context(pid: int, cgroup: Optional["Cgroup"] = None,
+                       chdir_to: str = "",
+                       required_ns: Optional[List[str]] = None,
+                       require_root: bool = False) -> None:
+    """Join a RUNNING task's isolation context — its cgroup, its
+    namespaces, and its root — so an exec'd command sees exactly what
+    the task sees (the nsenter path of the reference's
+    `drivers/shared/executor/executor_linux.go:1` Exec; `alloc exec`
+    must not escape the sandbox).
+
+    Runs as a subprocess preexec_fn (post-fork, pre-exec). Order
+    matters: join the cgroup while the host cgroupfs is still visible,
+    grab the ns + root fds from the HOST /proc, setns into every
+    namespace the task holds, then pivot the root via the saved fd
+    (fchdir + chroot("."), the nsenter -r recipe — the task's chroot is
+    per-process, so joining its mount namespace alone is not enough).
+
+    FAIL-CLOSED: namespaces in `required_ns` (and the root pivot when
+    `require_root`) MUST be entered — a failure raises, which aborts the
+    forked child before exec, so a command that cannot be contained
+    never runs at all. Everything else is joined best-effort.
+
+    Caveat: setns(pid) only applies to future children, so the exec'd
+    command itself keeps a host pid view; mount/net/ipc/uts + chroot +
+    cgroup — the actual containment — apply fully.
+    """
+    need = set(required_ns or ())
+    if cgroup is not None:
+        cgroup.add_pid(os.getpid())
+    ns_fds = []
+    for ns in ("ipc", "uts", "net", "pid", "mnt"):
+        try:
+            ns_fds.append((ns, os.open(f"/proc/{pid}/ns/{ns}",
+                                       os.O_RDONLY)))
+        except OSError:
+            if ns in need:
+                raise OSError(
+                    f"cannot open task {ns} namespace (task dead?)")
+            continue  # namespace not held / not privileged: skip
+    root_fd = None
+    try:
+        root_fd = os.open(f"/proc/{pid}/root", os.O_RDONLY)
+    except OSError:
+        if require_root:
+            raise OSError("cannot open task root (task dead?)")
+    libc = _get_libc()
+    for ns, fd in ns_fds:
+        rc = libc.setns(fd, 0)
+        os.close(fd)
+        if rc != 0 and ns in need:
+            raise OSError(f"setns({ns}) failed "
+                          f"(errno {ctypes.get_errno()})")
+    if root_fd is not None:
+        os.fchdir(root_fd)
+        os.chroot(".")
+        os.close(root_fd)
+        try:
+            os.chdir(chdir_to or "/")
+        except OSError:
+            os.chdir("/")
+
 
 def apply_rlimits(memory_mb: int = 0, nofile: int = 0) -> None:
     if memory_mb:

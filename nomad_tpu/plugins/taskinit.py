@@ -65,13 +65,7 @@ def main() -> None:
 
     cg = spec.get("cgroup")
     if cg:
-        g = isolation.Cgroup(cg["name"], cg.get("version"))
-        if g.version == "v2":
-            g.paths = [g._v2_path()]
-        else:
-            g.paths = [p for p in (g._v1_path(c)
-                                   for c in ("memory", "cpu", "pids"))
-                       if os.path.isdir(p)]
+        g = isolation.Cgroup.attach_existing(cg["name"], cg.get("version"))
         g.add_pid(os.getpid())
 
     if spec.get("nice"):
