@@ -522,13 +522,17 @@ def main() -> None:
     if system_evals:
         out.update(bench_system(state, nodes, system_evals))
 
-    e2e_evals = int(os.environ.get("NOMAD_TPU_BENCH_E2E_EVALS", 128))
+    e2e_evals = int(os.environ.get("NOMAD_TPU_BENCH_E2E_EVALS", 256))
     if e2e_evals:
+        # workers default 1: the select path is kernel-dispatched, so
+        # extra Python workers only fight the GIL and inflate optimistic
+        # plan conflicts — measured 112/s @1 worker vs 18/s @4 on the
+        # 2000-node config (worker.py's batched-dispatch design note)
         out.update(bench_e2e(
             min(n_nodes, int(os.environ.get("NOMAD_TPU_BENCH_E2E_NODES",
                                             2000))),
             min(n_allocs, 10_000), e2e_evals, count,
-            workers=int(os.environ.get("NOMAD_TPU_BENCH_E2E_WORKERS", 4))))
+            workers=int(os.environ.get("NOMAD_TPU_BENCH_E2E_WORKERS", 1))))
     print(json.dumps(out))
 
 

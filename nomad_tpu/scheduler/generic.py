@@ -13,11 +13,11 @@ deltas (PlanContext).
 from __future__ import annotations
 
 import time
-import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import fast_uuid
 from ..structs import (
     ALLOC_CLIENT_PENDING,
     ALLOC_DESIRED_RUN,
@@ -58,7 +58,7 @@ from .util import (
     generic_alloc_update_fn,
     progress_made,
     proposed_allocs,
-    ready_nodes_in_dcs,
+    ready_counts_in_dcs,
     resolve_volume_asks,
     retry_max,
     tainted_nodes,
@@ -279,7 +279,8 @@ class GenericScheduler:
     ) -> Optional[Exception]:
         """Reference computePlacements (generic_sched.go:468), restructured:
         one kernel dispatch per task group covering all its missing allocs."""
-        _nodes, by_dc = ready_nodes_in_dcs(self.state, self.job.datacenters)
+        by_dc = ready_counts_in_dcs(self.state, self.job.datacenters)
+        n_ready = sum(by_dc.values())  # AllocMetric nodes_evaluated
         dep_id = ""
         if self.deployment is not None and self.deployment.active():
             dep_id = self.deployment.id
@@ -323,7 +324,7 @@ class GenericScheduler:
                 score = result.scores[i]
                 victims: List[Allocation] = []
                 metrics = AllocMetric()
-                metrics.nodes_evaluated = len(_nodes)
+                metrics.nodes_evaluated = n_ready
                 metrics.nodes_available = dict(by_dc)
                 if node_id is None and self.preemption_enabled:
                     # Second pass with eviction enabled (reference
@@ -346,7 +347,7 @@ class GenericScheduler:
                         existing.coalesced_failures += 1
                     else:
                         metrics.nodes_filtered = (
-                            len(_nodes) - result.nodes_feasible
+                            n_ready - result.nodes_feasible
                         )
                         metrics.nodes_exhausted = (
                             result.nodes_feasible - result.nodes_fit[i]
@@ -356,7 +357,7 @@ class GenericScheduler:
                     continue
 
                 node = self.state.node_by_id(node_id)
-                alloc_id = str(uuid.uuid4())
+                alloc_id = fast_uuid()
                 if victims:
                     # Victims must enter the plan BEFORE allocated_resources
                     # builds the NetworkIndex, so the new alloc can claim the

@@ -15,10 +15,10 @@ from __future__ import annotations
 
 import copy
 import time as _time
-import uuid
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import fast_uuid
 from ..structs import (
     ALLOC_CLIENT_COMPLETE,
     ALLOC_CLIENT_FAILED,
@@ -850,7 +850,7 @@ class AllocReconciler:
         next_time = later[0].reschedule_time
         mapping: Dict[str, str] = {}
         ev = Evaluation(
-            id=str(uuid.uuid4()),
+            id=fast_uuid(),
             namespace=self.job.namespace,
             priority=self.job.priority,
             type=self.job.type,
@@ -867,7 +867,7 @@ class AllocReconciler:
             else:
                 next_time = info.reschedule_time
                 ev = Evaluation(
-                    id=str(uuid.uuid4()),
+                    id=fast_uuid(),
                     namespace=self.job.namespace,
                     priority=self.job.priority,
                     type=self.job.type,

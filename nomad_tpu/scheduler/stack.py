@@ -121,7 +121,7 @@ class TPUStack:
                 ports_used = jnp.asarray(cl.ports_used)
             arrays = ClusterArrays(
                 capacity=capacity,
-                used=jnp.asarray(cl.used),
+                used=jnp.asarray(cl.used, dtype=jnp.float32),
                 node_ok=jnp.asarray(cl.node_ok),
                 attrs=attrs,
                 ports_used=ports_used,
@@ -755,6 +755,11 @@ def _pad_lut(lut: np.ndarray, v: int, fill, dtype) -> np.ndarray:
 
 
 def _to_device(params: TGParams) -> TGParams:
-    import jax.numpy as jnp
-
-    return TGParams(*[jnp.asarray(x) for x in params])
+    # Intentional no-op: the jitted call ingests the numpy pytree and
+    # transfers it in ONE dispatch. Explicit per-field jnp.asarray was
+    # ~40 tiny device_puts per select (a third of per-eval wall time on
+    # the e2e control-plane path); even a batched jax.device_put of the
+    # pytree ahead of the call measured slower than letting dispatch do
+    # it. (The batched kernel path has its own transfer pipeline — this
+    # only serves the per-program select/system/preemption dispatches.)
+    return params

@@ -11,11 +11,11 @@ set arithmetic.
 """
 from __future__ import annotations
 
-import uuid
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import fast_uuid
 from ..structs import (
     ALLOC_CLIENT_LOST,
     ALLOC_CLIENT_PENDING,
@@ -298,7 +298,7 @@ class SystemScheduler:
                         self.failed_tg_allocs[tg.name] = metrics
                     continue
                 node = self.state.node_by_id(node_id)
-                alloc_id = str(uuid.uuid4())
+                alloc_id = fast_uuid()
                 if victims:
                     # Same ordering contract as the generic scheduler: plan
                     # preemptions precede the NetworkIndex build.

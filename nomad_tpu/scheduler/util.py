@@ -120,6 +120,23 @@ def ready_nodes_in_dcs(state: State, datacenters: List[str]
     return out, by_dc
 
 
+def ready_counts_in_dcs(state: State, datacenters: List[str]
+                        ) -> Dict[str, int]:
+    """Per-DC ready counts ONLY (the AllocMetric nodes_available input).
+    Served from the cluster tensors' incremental counters when present —
+    the full per-eval node scan ready_nodes_in_dcs does is measurable at
+    control-plane rates (util.go:233's caller also only needs counts on
+    the generic path)."""
+    cl = getattr(state, "cluster", None)
+    counters = getattr(cl, "ready_by_dc", None) if cl is not None else None
+    if counters is not None:
+        dcs = set(datacenters)
+        return {dc: n for dc, n in counters.items()
+                if dc in dcs and n > 0}
+    _, by_dc = ready_nodes_in_dcs(state, datacenters)
+    return by_dc
+
+
 def tainted_nodes(state: State, allocs: List[Allocation]
                   ) -> Dict[str, Optional[Node]]:
     """Reference taintedNodes (util.go:312): nodes referenced by allocs that

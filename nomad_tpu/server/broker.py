@@ -20,9 +20,9 @@ import heapq
 import itertools
 import threading
 import time
-import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..utils import fast_uuid
 from ..lib import DelayHeap
 from ..structs import Evaluation
 
@@ -159,7 +159,7 @@ class EvalBroker:
                 pick = self._pick_locked(schedulers)
                 if pick is not None:
                     eval = pick
-                    token = str(uuid.uuid4())
+                    token = fast_uuid()
                     count = self._dequeues.get(eval.id, 0) + 1
                     self._dequeues[eval.id] = count
                     un = _Unack(eval, token, count)

@@ -97,6 +97,11 @@ class PlanQueue:
                         return None
                 self._cv.wait(min(remaining, 1.0))
 
+    def idle(self) -> bool:
+        """Enabled with nothing pending — the inline fast path's gate."""
+        with self._cv:
+            return self._enabled and not self._heap and not self._shutdown
+
     def shutdown(self) -> None:
         with self._cv:
             self._shutdown = True
@@ -104,6 +109,70 @@ class PlanQueue:
                 fut.set(None, RuntimeError("plan queue shutdown"))
             self._heap.clear()
             self._cv.notify_all()
+
+
+_DIM_NAMES = {0: "cpu", 1: "memory", 2: "disk", 3: "network"}
+
+
+def _tensor_node_verify(cl, row: int, plan: Plan, node_id: str):
+    """Vectorized per-node verification against the LIVE cluster tensors
+    (the reference parallelizes exactly this check, plan_apply_pool.go:18;
+    here the incrementally-maintained used/capacity rows make it O(plan
+    allocs) instead of rebuilding the node's whole proposed set).
+    Returns (fit, reason) or None to fall back to the object path."""
+    import numpy as np
+
+    from ..tensor.cluster import R_TOTAL
+
+    freed = np.zeros(R_TOTAL, dtype=np.float32)
+    freed_ports: Dict[int, int] = {}
+
+    def release(alloc_id: str) -> None:
+        u = cl.alloc_usage.get(alloc_id)
+        if u is not None and u[0] == row:
+            np.add(freed, u[1], out=freed)
+        ap = cl.alloc_ports.get(alloc_id)
+        if ap is not None and ap[0] == row:
+            for p in ap[1]:
+                freed_ports[p] = freed_ports.get(p, 0) + 1
+
+    for a in plan.node_update.get(node_id, ()):
+        release(a.id)
+    for a in plan.node_preemptions.get(node_id, ()):
+        release(a.id)
+
+    placed = None
+    placed_ports: List[int] = []
+    for a in plan.node_allocation.get(node_id, ()):
+        release(a.id)  # in-place update: the plan's copy replaces it
+        if a.terminal_status():
+            continue
+        try:
+            v = cl.usage_row(a)
+            ports = cl._alloc_port_list(a)
+        except Exception:  # noqa: BLE001 — odd shape: object path decides
+            return None
+        placed = v if placed is None else placed + v
+        placed_ports.extend(ports)
+
+    if placed is None:
+        return True, ""
+    total = cl.used[row] - freed + placed
+    # float32 incremental accounting: tolerate epsilon at the boundary
+    over = total > cl.capacity[row] + 1e-3
+    if over.any():
+        col = int(np.argmax(over))
+        return False, _DIM_NAMES.get(col, "devices")
+    seen: set = set()
+    for p in placed_ports:
+        if p in seen:
+            return False, f"port {p} collision in plan"
+        seen.add(p)
+        refs = cl.port_refs[row].get(p, 0) - freed_ports.get(p, 0)
+        if refs > 0 or (p in cl.base_ports[row]
+                        and p not in freed_ports):
+            return False, f"port {p} already in use"
+    return True, ""
 
 
 def evaluate_node_plan(state, plan: Plan, node_id: str) -> Tuple[bool, str]:
@@ -121,6 +190,13 @@ def evaluate_node_plan(state, plan: Plan, node_id: str) -> Tuple[bool, str]:
     if node.drain is not None or node.scheduling_eligibility != "eligible":
         return False, "node is not eligible"
 
+    cl = getattr(state, "cluster", None)
+    row = cl.row_of.get(node_id) if cl is not None else None
+    if row is not None:
+        verdict = _tensor_node_verify(cl, row, plan, node_id)
+        if verdict is not None:
+            return verdict
+
     proposed = proposed_allocs(state, plan, node_id)
     fit, dim, _util = allocs_fit(node, proposed)
     return fit, dim
@@ -136,8 +212,12 @@ class PlanApplier:
         self.broker = broker
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # THE commit-point mutex: verification+commit is serialized
+        # whether a plan arrives via the queue thread or a worker's
+        # inline fast path
+        self._apply_lock = threading.Lock()
         self.stats = {"applied": 0, "partial": 0, "rejected_nodes": 0,
-                      "stale_token": 0}
+                      "stale_token": 0, "inline": 0}
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -156,10 +236,30 @@ class PlanApplier:
                 continue
             plan, fut = item
             try:
-                result = self.apply(plan)
+                with self._apply_lock:
+                    result = self.apply(plan)
                 fut.set(result)
             except Exception as e:  # noqa: BLE001 — fail the waiting worker
                 fut.set(None, e)
+
+    def try_apply_inline(self, plan: Plan) -> Optional[PlanResult]:
+        """Submitting-worker fast path: when nothing is queued and the
+        applier mutex is free, verify+commit on THIS thread — identical
+        serialization through _apply_lock, none of the two thread hops
+        of the queue round trip (the reference gets the same effect by
+        pipelining Raft apply with next-plan evaluation,
+        plan_apply.go:71). Returns None when the queue must be used
+        (busy applier or pending higher-priority plans)."""
+        if not self.queue.idle():
+            return None
+        if not self._apply_lock.acquire(blocking=False):
+            return None
+        try:
+            result = self.apply(plan)
+        finally:
+            self._apply_lock.release()
+        self.stats["inline"] += 1
+        return result
 
     def apply(self, plan: Plan) -> PlanResult:
         """Verify against latest state, commit what fits (plan_apply.go:400)."""
@@ -183,20 +283,32 @@ class PlanApplier:
         )
         partial = False
         touched = set(plan.node_allocation) | set(plan.node_preemptions)
-        for node_id in touched:
-            fit, reason = evaluate_node_plan(snap, plan, node_id)
-            if fit:
-                if node_id in plan.node_allocation:
-                    result.node_allocation[node_id] = list(
-                        plan.node_allocation[node_id]
-                    )
-                if node_id in plan.node_preemptions:
-                    result.node_preemptions[node_id] = list(
-                        plan.node_preemptions[node_id]
-                    )
-            else:
-                partial = True
-                self.stats["rejected_nodes"] += 1
+        # verification holds the store's mutation lock: the tensor path
+        # reads live used/alloc_usage counters, and a concurrent client
+        # upsert flipping a plan-stopped alloc terminal mid-verify would
+        # otherwise double-free its resources (released from `used` AND
+        # counted again as plan-freed). Released BEFORE the commit below
+        # — upsert_plan_results may block on a raft apply.
+        import contextlib
+
+        lock = (self.state.mutation_lock()
+                if hasattr(self.state, "mutation_lock")
+                else contextlib.nullcontext())
+        with lock:
+            for node_id in touched:
+                fit, reason = evaluate_node_plan(snap, plan, node_id)
+                if fit:
+                    if node_id in plan.node_allocation:
+                        result.node_allocation[node_id] = list(
+                            plan.node_allocation[node_id]
+                        )
+                    if node_id in plan.node_preemptions:
+                        result.node_preemptions[node_id] = list(
+                            plan.node_preemptions[node_id]
+                        )
+                else:
+                    partial = True
+                    self.stats["rejected_nodes"] += 1
         if partial and plan.all_at_once:
             # all-at-once plans commit nothing on any failure — including the
             # stops, or destructive updates would halt services with no

@@ -18,9 +18,9 @@ from __future__ import annotations
 
 import threading
 import time
-import uuid
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import fast_uuid
 from ..structs import Allocation, Evaluation, Job, Node
 from ..structs.evaluation import (
     EVAL_STATUS_BLOCKED,
@@ -221,7 +221,7 @@ class Server:
             priority=100,  # JobMaxPriority (core_sched.go coreJobEval)
             type=JOB_TYPE_CORE,
             triggered_by="scheduled",
-            job_id=f"{kind}:{uuid.uuid4()}",
+            job_id=f"{kind}:{fast_uuid()}",
             status=EVAL_STATUS_PENDING,
         )
 
@@ -229,7 +229,7 @@ class Server:
         """Synchronous GC (the `System.GarbageCollect` RPC path)."""
         from .core_sched import CoreScheduler
 
-        ev = Evaluation(job_id=f"{kind}:{uuid.uuid4()}")
+        ev = Evaluation(job_id=f"{kind}:{fast_uuid()}")
         CoreScheduler(self).process(ev)
 
     # ---- eval application (FSM upsertEvals analog, fsm.go:692) ----
@@ -297,7 +297,7 @@ class Server:
             # spec-unchanged (idempotent register path below).
             if not sp.id:
                 sp.id = (prior_policies.get(sp.target.get("Group", ""))
-                         or str(uuid.uuid4()))
+                         or fast_uuid())
             sp.target.setdefault("Namespace", job.namespace)
             sp.target.setdefault("Job", job.id)
         if existing is not None and existing.job_modify_index:
@@ -814,7 +814,7 @@ class Server:
         child = copy.deepcopy(parent)
         # DispatchedID form (structs.go:3995)
         child.id = (f"{parent.id}/dispatch-{int(time.time())}-"
-                    f"{str(uuid.uuid4())[:8]}")
+                    f"{fast_uuid()[:8]}")
         child.parent_id = parent.id
         child.dispatched = True
         child.payload = payload

@@ -218,6 +218,16 @@ class StateStore(InMemState):
         nested mutators from inside the scope safe)."""
         return self._cv
 
+    def mutation_lock(self):
+        """THE lock every mutator holds (also on RaftStateStore, whose
+        transact() is a different, weaker lock). Holders get reads that
+        are internally consistent with concurrent writers — e.g. the
+        plan applier's tensor verification must not observe an alloc
+        both released from `used` and still claimable via alloc_usage.
+        NEVER hold it across a blocking raft apply (deadlock — see
+        RaftStateStore.transact)."""
+        return self._cv
+
     def reset_for_restore(self) -> None:
         """Drop every data table (keep locks, watch plumbing, and the
         index counter OBJECT — its value is pinned by restore_state) so a

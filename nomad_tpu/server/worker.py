@@ -116,8 +116,12 @@ class Worker:
     def submit_plan(self, plan: Plan) -> Tuple[PlanResult, Optional[object]]:
         plan.eval_token = self._token
         plan.snapshot_index = self._snapshot.index_at if self._snapshot else 0
-        fut = self.server.plan_queue.enqueue(plan)
-        result = fut.wait(timeout=10.0)
+        # inline fast path (same commit-point mutex, no thread hops);
+        # queue round trip only when the applier is busy
+        result = self.server.planner.try_apply_inline(plan)
+        if result is None:
+            fut = self.server.plan_queue.enqueue(plan)
+            result = fut.wait(timeout=10.0)
         if result is None:
             raise RuntimeError("plan apply failed")
         if result.refresh_index:

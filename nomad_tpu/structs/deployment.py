@@ -1,8 +1,9 @@
 """Deployment model (reference `structs.Deployment`, nomad/structs/structs.go:8166)."""
 from __future__ import annotations
 
-import uuid
 from dataclasses import dataclass, field
+
+from ..utils import fast_uuid
 from typing import Dict, Optional
 
 DEPLOYMENT_STATUS_RUNNING = "running"
@@ -39,7 +40,7 @@ class DeploymentState:
 class Deployment:
     """Reference structs.go:8166."""
 
-    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    id: str = field(default_factory=fast_uuid)
     namespace: str = "default"
     job_id: str = ""
     job_version: int = 0

@@ -2,7 +2,6 @@
 from __future__ import annotations
 
 import time
-import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -33,7 +32,9 @@ CORE_JOB_PRIORITY = 200  # reference structs.go JobMaxPriority * 2
 
 
 def new_id() -> str:
-    return str(uuid.uuid4())
+    from ..utils import fast_uuid
+
+    return fast_uuid()
 
 
 @dataclass

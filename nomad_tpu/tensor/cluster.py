@@ -72,7 +72,12 @@ class ClusterTensors:
         self.n_cap = n_cap
         self.k_cap = k_cap
         self.capacity = np.zeros((n_cap, R_TOTAL), dtype=np.float32)
-        self.used = np.zeros((n_cap, R_TOTAL), dtype=np.float32)
+        # float64: `used` is a long-lived INCREMENTAL accumulator (+=
+        # on place, -= on release); float32 rounding residue from alloc
+        # churn would random-walk past any fixed epsilon and poison the
+        # plan applier's exact-boundary fit checks. The device copy
+        # downcasts to f32 at upload — kernel behavior is unchanged.
+        self.used = np.zeros((n_cap, R_TOTAL), dtype=np.float64)
         self.node_ok = np.zeros(n_cap, dtype=bool)
         self.attrs = np.full((n_cap, k_cap), MISSING, dtype=np.int32)
         self.ports_used = np.zeros((n_cap, PORT_WORDS), dtype=np.uint32)
@@ -85,6 +90,12 @@ class ClusterTensors:
         self.row_of: Dict[str, int] = {}
         self.node_of_row: List[Optional[str]] = [None] * n_cap
         self.nodes: Dict[str, Node] = {}
+        # incremental ready-node counts per datacenter (readyNodesInDCs
+        # fast path — a per-eval full node scan was ~15% of e2e time);
+        # contributions tracked per node id so in-place object reuse by
+        # in-proc callers can't corrupt the counters
+        self.ready_by_dc: Dict[str, int] = {}
+        self._ready_contrib: Dict[str, Tuple[str, bool]] = {}
         self.free_rows: List[int] = list(range(n_cap - 1, -1, -1))
         # device-type column registry: "vendor/type/name" -> column offset
         self.device_cols: Dict[str, int] = {}
@@ -225,6 +236,14 @@ class ClusterTensors:
             self.row_of[node.id] = row
             self.node_of_row[row] = node.id
         self.nodes[node.id] = node
+        old = self._ready_contrib.get(node.id)
+        if old is not None and old[1]:
+            self.ready_by_dc[old[0]] -= 1
+        contrib = (node.datacenter, bool(node.ready()))
+        self._ready_contrib[node.id] = contrib
+        if contrib[1]:
+            self.ready_by_dc[contrib[0]] = \
+                self.ready_by_dc.get(contrib[0], 0) + 1
         res = node.node_resources
         rsv = node.reserved_resources
         cap = np.zeros(R_TOTAL, dtype=np.float32)
@@ -298,6 +317,9 @@ class ClusterTensors:
         if row is None:
             return
         self.nodes.pop(node_id, None)
+        old = self._ready_contrib.pop(node_id, None)
+        if old is not None and old[1]:
+            self.ready_by_dc[old[0]] -= 1
         self.node_of_row[row] = None
         self.capacity[row] = 0
         self.ports_version += 1
@@ -327,7 +349,7 @@ class ClusterTensors:
     def usage_row(self, alloc: Allocation) -> np.ndarray:
         """Alloc utilization as a resource row (comparable form, reference
         `Allocation.ComparableResources`, structs.go:8958 + device counts)."""
-        u = np.zeros(R_TOTAL, dtype=np.float32)
+        u = np.zeros(R_TOTAL, dtype=np.float64)
         cr = alloc.comparable_resources()
         u[R_CPU] = cr.cpu
         u[R_MEM] = cr.memory_mb

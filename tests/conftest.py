@@ -22,6 +22,13 @@ jax.config.update(
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Debug hook: `kill -USR2 <pytest pid>` dumps every thread's stack to
+# stderr without killing the run — for diagnosing in-process hangs.
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+faulthandler.register(signal.SIGUSR2, all_threads=True)
+
 
 def pytest_configure(config):
     config.addinivalue_line(

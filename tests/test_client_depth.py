@@ -107,6 +107,10 @@ class TestStickyDiskMigration:
                     "args": ["-c",
                              "echo v0-state > alloc/data/state.txt; "
                              "sleep 60"]}
+        # transient start failures (executor handshake under full-suite
+        # load) must retry fast — the default restart delay alone would
+        # eat the test budget
+        tg.restart_policy.delay_s = 1.0
         api.wait_for_eval(api.register_job(job))
         assert _wait(lambda: any(
             al.client_status == "running"
@@ -120,9 +124,18 @@ class TestStickyDiskMigration:
             "command": "/bin/sh",
             "args": ["-c", "cat alloc/data/state.txt"]}
         api.wait_for_eval(api.register_job(job2))
+        # generous: the destructive path serializes v0-stop → prev-alloc
+        # terminal wait (itself bounded at 30s) → data copy → v1 run;
+        # under full-suite load the default budget flaked
         assert _wait(lambda: any(
             al.client_status == "complete" and al.job_version == 1
-            for al in api.job_allocations(job.id)))
+            for al in api.job_allocations(job.id)), timeout=90.0), [
+            (al.id[:8], al.client_status, al.desired_status,
+             al.job_version,
+             {t: (ts.state, ts.failed,
+                  [(e.type, e.message) for e in ts.events[-4:]])
+              for t, ts in al.task_states.items()})
+            for al in api.job_allocations(job.id)]
         alloc = next(al for al in api.job_allocations(job.id)
                      if al.client_status == "complete"
                      and al.job_version == 1)
