@@ -337,13 +337,14 @@ class HTTPApi:
              "signal": "alloc-lifecycle"}.get(op, "read-job"))
         if op == "stats":
             # Allocations.Stats: per-task driver/executor usage fan-in
+            # via the dedicated stats contract (inspect_task is metadata
+            # and must stay cheap — docker stats blocks a sample cycle)
             tasks = {}
             for name, tr in runner.task_runners.items():
                 usage = {}
                 if tr.handle is not None:
                     try:
-                        usage = tr.driver.inspect_task(tr.handle).get(
-                            "stats", {}) or {}
+                        usage = tr.driver.stats_task(tr.handle) or {}
                     except Exception:  # noqa: BLE001 — driver may be dead
                         usage = {}
                 tasks[name] = {

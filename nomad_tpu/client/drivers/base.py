@@ -33,6 +33,12 @@ class TaskConfig:
     # for in-process ones)
     max_files: int = 10
     max_file_size_mb: int = 10
+    #: scheduler-assigned host ports by label (reference drivers.TaskConfig
+    #: Resources.Ports / AllocatedPortMapping) — drivers publish against
+    #: these, never against raw user strings
+    ports: Dict[str, int] = field(default_factory=dict)
+    #: the node address the ports are bound on
+    ip: str = ""
 
 
 @dataclass
@@ -102,6 +108,12 @@ class DriverPlugin:
     def inspect_task(self, handle: TaskHandle) -> dict:
         return {"id": handle.task_id, "running": handle.is_running(),
                 "exit": None if handle.exit is None else vars(handle.exit)}
+
+    def stats_task(self, handle: TaskHandle) -> dict:
+        """Live resource usage (plugins/drivers TaskStats). Separate from
+        inspect_task: stats collection may be SLOW (docker stats blocks a
+        sampling cycle) and metadata readers must not pay for it."""
+        return {}
 
     def recover_task(self, task_id: str,
                      driver_state: dict) -> Optional[TaskHandle]:

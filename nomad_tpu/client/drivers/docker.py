@@ -29,6 +29,94 @@ def _docker_bin() -> Optional[str]:
     return os.environ.get("NOMAD_TPU_DOCKER_BIN") or shutil.which("docker")
 
 
+def _validate_volume(vol, task_dir: str) -> str:
+    """Structured "src:dst[:mode]" validation (drivers/docker volumes;
+    the reference gates host-absolute binds behind docker.volumes.enabled
+    and resolves relative sources against the task dir). Raw pass-through
+    would let a typo'd spec mount the wrong host path into a container."""
+    parts = str(vol).split(":")
+    if len(parts) not in (2, 3):
+        raise ValueError(
+            f"invalid volume {vol!r}: want 'src:dst' or 'src:dst:mode'")
+    src, dst = parts[0], parts[1]
+    mode = parts[2] if len(parts) == 3 else ""
+    if mode and mode not in ("ro", "rw"):
+        raise ValueError(f"invalid volume mode {mode!r} in {vol!r}")
+    if not dst.startswith("/"):
+        raise ValueError(
+            f"invalid volume {vol!r}: container path must be absolute")
+    if not src.startswith("/"):
+        # relative sources resolve inside the task sandbox, never the
+        # host cwd (and never the host root)
+        if ".." in src.split("/"):
+            raise ValueError(
+                f"invalid volume {vol!r}: source escapes the task dir")
+        if not task_dir:
+            raise ValueError(
+                f"invalid volume {vol!r}: relative source requires a "
+                f"task dir to resolve inside")
+        src = os.path.join(task_dir, src)
+    out = f"{src}:{dst}"
+    return f"{out}:{mode}" if mode else out
+
+
+def _port_publishes(port_map, cfg: TaskConfig) -> List[str]:
+    """port_map → -p specs (drivers/docker/ports.go). The structured form
+    is a MAP {port_label: container_port}: the host side is always the
+    scheduler-ASSIGNED port for that label (cfg.ports) — user strings
+    cannot bind host ports the node didn't reserve. Legacy list entries
+    ("host:container") are validated as integers."""
+    if not port_map:
+        return []
+    out: List[str] = []
+    if isinstance(port_map, dict):
+        for label, container_port in port_map.items():
+            host = cfg.ports.get(str(label))
+            if host is None:
+                raise ValueError(
+                    f"port_map label {label!r} has no assigned port "
+                    f"(declare it in the task's network stanza)")
+            try:
+                cp = int(container_port)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"port_map[{label!r}] = {container_port!r} is not "
+                    f"a port number")
+            if not 0 < cp < 65536:
+                raise ValueError(f"port_map[{label!r}] out of range")
+            out.append(f"{host}:{cp}")
+        return out
+    for pm in port_map:
+        host, _, cp = str(pm).partition(":")
+        if not (host.isdigit() and cp.isdigit()
+                and 0 < int(host) < 65536 and 0 < int(cp) < 65536):
+            raise ValueError(
+                f"invalid port mapping {pm!r}: want 'host:container' "
+                f"integers or the map form {{label = container_port}}")
+        out.append(f"{int(host)}:{int(cp)}")
+    return out
+
+
+_SIZE_UNITS = {"b": 1, "kb": 1000, "kib": 1024, "mb": 1000**2,
+               "mib": 1024**2, "gb": 1000**3, "gib": 1024**3,
+               "tb": 1000**4, "tib": 1024**4}
+
+
+def _parse_size(s: str) -> Optional[int]:
+    """'61.9MiB' → bytes (docker stats human units)."""
+    s = s.strip().lower()
+    for unit in sorted(_SIZE_UNITS, key=len, reverse=True):
+        if s.endswith(unit):
+            try:
+                return int(float(s[: -len(unit)]) * _SIZE_UNITS[unit])
+            except ValueError:
+                return None
+    try:
+        return int(float(s))
+    except ValueError:
+        return None
+
+
 class ImageCoordinator:
     """Deduplicates concurrent pulls of one image (coordinator.go:1)."""
 
@@ -125,9 +213,9 @@ class DockerDriver(DriverPlugin):
             # reference mounts alloc/local/secrets dirs into the container
             argv += ["--volume", f"{cfg.task_dir}:/local"]
         for vol in rc.get("volumes", []) or []:
-            argv += ["--volume", str(vol)]
-        for pm in rc.get("port_map", []) or []:
-            argv += ["--publish", str(pm)]
+            argv += ["--volume", _validate_volume(vol, cfg.task_dir)]
+        for spec in _port_publishes(rc.get("port_map"), cfg):
+            argv += ["--publish", spec]
         if rc.get("network_mode"):
             argv += ["--network", str(rc["network_mode"])]
         if cfg.user:
@@ -268,6 +356,40 @@ class DockerDriver(DriverPlugin):
                 except (ValueError, IndexError):
                     pass
         return base
+
+    def stats_task(self, handle: TaskHandle) -> Dict[str, object]:
+        """Container cpu/memory usage via `docker stats --no-stream`
+        (drivers/docker/stats.go; surfaces in
+        /v1/client/allocation/<id>/stats like executor-backed tasks)."""
+        docker = _docker_bin()
+        cid = handle.driver_state.get("container_id")
+        if not docker or not cid:
+            return {}
+        r = self._run(docker, "stats", "--no-stream", "--format",
+                      "{{json .}}", cid, timeout=20.0)
+        if r.returncode != 0 or not r.stdout.strip():
+            return {}
+        try:
+            row = json.loads(r.stdout.decode().strip().splitlines()[-1])
+        except ValueError:
+            return {}
+        out: Dict[str, object] = {}
+        cpu = str(row.get("CPUPerc", "")).rstrip("%")
+        try:
+            out["cpu_percent"] = float(cpu)
+        except ValueError:
+            pass
+        mem = str(row.get("MemUsage", "")).split("/")[0].strip()
+        val = _parse_size(mem)
+        if val is not None:
+            out["memory_bytes"] = val
+        pids = row.get("PIDs")
+        if pids is not None:
+            try:
+                out["pids"] = int(pids)
+            except (TypeError, ValueError):
+                pass
+        return out
 
     def signal_task(self, handle: TaskHandle, sig: str = "SIGHUP") -> bool:
         docker = _docker_bin()

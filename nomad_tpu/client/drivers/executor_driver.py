@@ -209,14 +209,21 @@ class ExecutorBackedDriver(DriverPlugin):
 
     def inspect_task(self, handle: TaskHandle) -> dict:
         base = super().inspect_task(handle)
-        client = getattr(handle, "client", None)
-        if client is not None:
-            try:
-                base["stats"] = client.call("Executor.stats", timeout=5.0)
-            except Exception:
-                pass
+        stats = self.stats_task(handle)
+        if stats:
+            base["stats"] = stats
         base["driver_state"] = handle.driver_state
         return base
+
+    def stats_task(self, handle: TaskHandle) -> dict:
+        """pid_collector.go analog via the executor RPC."""
+        client = getattr(handle, "client", None)
+        if client is None:
+            return {}
+        try:
+            return client.call("Executor.stats", timeout=5.0) or {}
+        except Exception:  # noqa: BLE001 — executor may be gone
+            return {}
 
     def signal_task(self, handle: TaskHandle, sig: str = "SIGHUP") -> bool:
         """driver SignalTask (plugins/drivers/driver.go) — powers

@@ -19,24 +19,14 @@ from ..structs.service import ServiceRegistration
 
 
 def _resolve_port(alloc, label: str) -> int:
-    """Port by label from the alloc's assigned networks (group shared
-    networks first, then task networks; rank.go AllocatedPortsToPortMap)."""
+    """Port by label from the alloc's assigned networks (the shared
+    Allocation.port_map walk; rank.go AllocatedPortsToPortMap)."""
     if not label:
         return 0
     if label.isdigit():
         return int(label)
-    nets = []
-    ar = alloc.allocated_resources
-    if ar is not None:
-        if ar.shared is not None:
-            nets.extend(ar.shared.networks)
-        for tr in (ar.tasks or {}).values():
-            nets.extend(tr.networks)
-    for net in nets:
-        for p in list(net.dynamic_ports) + list(net.reserved_ports):
-            if p.label == label:
-                return p.value
-    return 0
+    _ip, ports = alloc.port_map()
+    return ports.get(label, 0)
 
 
 class ServiceHook:

@@ -399,6 +399,7 @@ class TaskRunner:
         )
         env.update(self._secret_env)
         raw = interpolate_config(dict(self.task.config), env, self.node)
+        ip, ports = self.alloc.port_map(self.task.name)
         return TaskConfig(
             id=f"{self.alloc.id}/{self.task.name}",
             name=self.task.name,
@@ -415,6 +416,8 @@ class TaskRunner:
             kill_timeout_s=self.task.kill_timeout_s,
             max_files=self.task.log_config.max_files,
             max_file_size_mb=self.task.log_config.max_file_size_mb,
+            ports=ports,
+            ip=ip,
         )
 
     def restart(self) -> None:

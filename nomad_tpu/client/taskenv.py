@@ -48,6 +48,17 @@ def build_env(alloc: Allocation, task: Task, node: Optional[Node],
     meta.update(task.meta)
     for k, v in meta.items():
         env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = str(v)
+    # assigned network ports (taskenv env.go NOMAD_PORT_/NOMAD_HOST_PORT_
+    # /NOMAD_ADDR_ and NOMAD_IP) via the shared Allocation walk
+    ip, port_labels = alloc.port_map(task.name)
+    for raw_label, value in port_labels.items():
+        label = raw_label.upper().replace("-", "_")
+        env[f"NOMAD_PORT_{label}"] = str(value)
+        env[f"NOMAD_HOST_PORT_{label}"] = str(value)
+        if ip:
+            env[f"NOMAD_ADDR_{label}"] = f"{ip}:{value}"
+    if ip:
+        env.setdefault("NOMAD_IP", ip)
     # assigned devices (scheduler/device.py instance ids): generic
     # NOMAD_DEVICE_* plus the owning plugin family's visibility env
     # (devicemanager.reservation_env — the device.go Reserve contract).

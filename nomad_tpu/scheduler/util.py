@@ -131,7 +131,10 @@ def ready_counts_in_dcs(state: State, datacenters: List[str]
     counters = getattr(cl, "ready_by_dc", None) if cl is not None else None
     if counters is not None:
         dcs = set(datacenters)
-        return {dc: n for dc, n in counters.items()
+        # dict() is GIL-atomic: the live counters mutate under concurrent
+        # node upserts, and iterating them directly could raise
+        # "dictionary changed size during iteration"
+        return {dc: n for dc, n in dict(counters).items()
                 if dc in dcs and n > 0}
     _, by_dc = ready_nodes_in_dcs(state, datacenters)
     return by_dc

@@ -220,6 +220,36 @@ class Allocation:
         stop/evict first, then terminal client statuses."""
         return self.server_terminal_status() or self.client_terminal_status()
 
+    def allocated_networks(self, task_name: str = "") -> list:
+        """Assigned networks — group shared first, then the task's
+        (reference AllocatedResources walk used by taskenv, service
+        registration, and drivers alike; ONE place so address/port
+        resolution can't drift between consumers)."""
+        ar = self.allocated_resources
+        if ar is None:
+            return []
+        nets = list(ar.shared.networks) if ar.shared is not None else []
+        if task_name:
+            tr = (ar.tasks or {}).get(task_name)
+            if tr is not None:
+                nets += list(tr.networks)
+        else:
+            for tr in (ar.tasks or {}).values():
+                nets += list(tr.networks)
+        return nets
+
+    def port_map(self, task_name: str = "") -> tuple:
+        """(ip, {label: host_port}) across the alloc's assigned networks
+        (rank.go AllocatedPortsToPortMap analog)."""
+        ip = ""
+        ports = {}
+        for net in self.allocated_networks(task_name):
+            ip = ip or net.ip
+            for p in list(net.dynamic_ports) + list(net.reserved_ports):
+                if p.label:
+                    ports[p.label] = p.value
+        return ip, ports
+
     def comparable_resources(self) -> ComparableResources:
         """Reference `Allocation.ComparableResources` (structs.go:8958)."""
         if self.allocated_resources is not None:

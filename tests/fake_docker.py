@@ -218,6 +218,16 @@ def main():
         print(cid)
         return 0
 
+    if cmd == "stats":
+        # docker stats --no-stream --format "{{json .}}" <cid>
+        cid = resolve(args[-1])
+        if cid is None:
+            print("no such container", file=sys.stderr)
+            return 1
+        print(json.dumps({"CPUPerc": "1.25%", "MemUsage":
+                          "61.9MiB / 1GiB", "PIDs": "3"}))
+        return 0
+
     if cmd == "exec":
         cid = resolve(args[1])
         meta = load(cid)

@@ -574,3 +574,118 @@ class TestExecInTaskContext:
             assert seen_extra, "exec'd pid never appeared in the cgroup"
         finally:
             d.destroy_task(h, force=True)
+
+
+class TestDockerRealism:
+    """Round-4 VERDICT #9: structured port_map/volumes validation,
+    container stats (drivers/docker/stats.go, ports.go), and a
+    real-daemon test gated on docker presence."""
+
+    def _cfg(self, tmp_path, **kw):
+        return TaskConfig(id="a9/web", name="web",
+                          task_dir=str(tmp_path),
+                          memory_mb=64, cpu_mhz=100, **kw)
+
+    def test_port_map_resolves_assigned_ports(self, fake_docker,
+                                              tmp_path):
+        d = DockerDriver()
+        cfg = self._cfg(tmp_path,
+                        raw_config={"image": "busybox:1",
+                                    "command": "true",
+                                    "port_map": {"http": 8080}},
+                        ports={"http": 21234})
+        h = d.start_task(cfg)
+        try:
+            insp = d.inspect_task(h)
+            # the fake records --publish args verbatim (under Config)
+            assert insp["container"]["Config"]["publish"] \
+                == ["21234:8080"]
+        finally:
+            d.destroy_task(h, force=True)
+
+    def test_port_map_unknown_label_rejected(self, fake_docker,
+                                             tmp_path):
+        d = DockerDriver()
+        cfg = self._cfg(tmp_path,
+                        raw_config={"image": "busybox:1",
+                                    "port_map": {"db": 5432}},
+                        ports={"http": 21234})
+        with pytest.raises(ValueError, match="no assigned port"):
+            d.start_task(cfg)
+
+    def test_legacy_port_strings_validated(self, fake_docker, tmp_path):
+        d = DockerDriver()
+        cfg = self._cfg(tmp_path,
+                        raw_config={"image": "busybox:1",
+                                    "port_map": ["80:bad"]})
+        with pytest.raises(ValueError, match="invalid port mapping"):
+            d.start_task(cfg)
+
+    def test_volume_validation(self, fake_docker, tmp_path):
+        from nomad_tpu.client.drivers.docker import _validate_volume
+
+        assert _validate_volume("/data:/srv", "") == "/data:/srv"
+        assert _validate_volume("local/x:/srv:ro", str(tmp_path)) \
+            == f"{tmp_path}/local/x:/srv:ro"
+        with pytest.raises(ValueError, match="escapes"):
+            _validate_volume("../../etc:/srv", str(tmp_path))
+        with pytest.raises(ValueError, match="must be absolute"):
+            _validate_volume("/data:relative", str(tmp_path))
+        with pytest.raises(ValueError, match="mode"):
+            _validate_volume("/data:/srv:rox", str(tmp_path))
+
+    def test_container_stats(self, fake_docker, tmp_path):
+        d = DockerDriver()
+        cfg = self._cfg(tmp_path,
+                        raw_config={"image": "busybox:1",
+                                    "command": "sleep",
+                                    "args": ["30"]})
+        h = d.start_task(cfg)
+        try:
+            stats = d.stats_task(h)
+            assert stats["cpu_percent"] == 1.25
+            assert stats["memory_bytes"] == int(61.9 * 1024 * 1024)
+            assert stats["pids"] == 3
+            # inspect stays CHEAP metadata — stats ride the dedicated
+            # contract that /v1/client/allocation/<id>/stats fans in
+            assert "stats" not in d.inspect_task(h)
+        finally:
+            d.destroy_task(h, force=True)
+
+
+def _real_docker_available() -> bool:
+    import shutil as _sh
+    import subprocess as _sp
+
+    bin_ = _sh.which("docker")
+    if not bin_:
+        return False
+    try:
+        return _sp.run([bin_, "info"], capture_output=True,
+                       timeout=10).returncode == 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+@pytest.mark.skipif(not _real_docker_available(),
+                    reason="no usable docker daemon on this host")
+class TestDockerRealDaemon:
+    """e2e against a REAL daemon (gated): lifecycle + stats + exec."""
+
+    def test_real_container_end_to_end(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("NOMAD_TPU_DOCKER_BIN", raising=False)
+        d = DockerDriver()
+        cfg = TaskConfig(id="real/web", name="web",
+                         task_dir=str(tmp_path), memory_mb=64,
+                         raw_config={"image": "busybox:latest",
+                                     "command": "sleep",
+                                     "args": ["30"]})
+        h = d.start_task(cfg)
+        try:
+            assert h.is_running()
+            stats = d.stats_task(h)
+            assert "memory_bytes" in stats
+            res = d.exec_task(h, "/bin/sh", ["-c", "echo hi"])
+            assert res["exit_code"] == 0 and "hi" in res["stdout"]
+        finally:
+            d.destroy_task(h, force=True)
