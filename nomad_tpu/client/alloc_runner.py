@@ -33,8 +33,8 @@ class AllocRunner:
                  on_update: Optional[Callable[[Allocation], None]] = None,
                  on_handle: Optional[Callable] = None,
                  recover_handles: Optional[Dict[str, dict]] = None,
-                 driver_manager=None, csi_manager=None, conn=None
-                 ) -> None:
+                 driver_manager=None, csi_manager=None, conn=None,
+                 network_manager=None) -> None:
         self.alloc = alloc
         self.node = node
         self.on_update = on_update
@@ -46,6 +46,10 @@ class AllocRunner:
         self.driver_manager = driver_manager
         self.csi_manager = csi_manager
         self.conn = conn
+        #: bridge-mode networking (client/network.py; the reference's
+        #: network hook, networking_bridge_linux.go)
+        self.network_manager = network_manager
+        self.network_handle = None
         #: volume name → host path, filled by the volumes hook; task
         #: runners materialize task.volume_mounts from it
         self.volume_paths: Dict[str, str] = {}
@@ -113,6 +117,7 @@ class AllocRunner:
             self._recompute_status()
             return
 
+        self._setup_network()
         self._start_health_tracker()
 
         from ..structs.job import lifecycle_buckets
@@ -157,6 +162,34 @@ class AllocRunner:
                 if not self._wait_dead([tr]):
                     return
         self._recompute_status()
+
+    def _setup_network(self) -> None:
+        """Per-alloc netns for `network { mode = "bridge" }` groups
+        (alloc_runner network hook → networking_bridge_linux.go;
+        client/network.py for the TPU-host redesign). Degrades to host
+        networking on any failure — never fails the alloc."""
+        if self.network_manager is None:
+            return
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
+            if self.alloc.job else None
+        if tg is None or not any(n.mode == "bridge"
+                                 for n in (tg.networks or [])):
+            return
+        # port forwarders serve the exec-family tasks that JOIN the
+        # netns; docker publishes its own ports (and its containers run
+        # in dockerd's namespaces) — forwarding those too would collide
+        # with dockerd's host-port binds
+        if all(t.driver == "docker" for t in (tg.tasks or [])):
+            self.network_handle = self.network_manager.create(
+                self.alloc.id, [])
+            return
+        port_maps = []
+        for net in self.alloc.allocated_networks():
+            for p in list(net.dynamic_ports) + list(net.reserved_ports):
+                if p.value:
+                    port_maps.append((p.value, p.to or p.value))
+        self.network_handle = self.network_manager.create(
+            self.alloc.id, port_maps)
 
     def _start_health_tracker(self) -> None:
         """Deployment-tracked allocs watch their own health and report
@@ -323,6 +356,8 @@ class AllocRunner:
             driver_manager=self.driver_manager,
             volume_paths=self.volume_paths,
             conn=self.conn,
+            netns=(self.network_handle.netns_path
+                   if self.network_handle is not None else ""),
         )
         with self._lock:
             self.task_runners[task.name] = tr
@@ -482,6 +517,10 @@ class AllocRunner:
         for tr in list(self.task_runners.values()):
             tr.join(timeout=5.0)
         self._unmount_volumes()
+        if self.network_manager is not None:
+            # shutdown() deliberately does NOT tear this down — detached
+            # tasks keep running inside the netns across agent restarts
+            self.network_manager.destroy(self.alloc.id)
         self.alloc_dir.destroy()
 
     def wait(self, timeout: float = 10.0) -> bool:

@@ -189,6 +189,11 @@ class Client:
             on_attrs=self._driver_attrs_changed)
         self.device_manager = DeviceManager(
             on_devices=self._devices_changed)
+        from .network import NetworkManager
+
+        # bridge-mode alloc networking (degrades to host networking
+        # when unprivileged / iproute2 absent — see client/network.py)
+        self.network_manager = NetworkManager()
         # CSI node plugins (client/pluginmanager/csimanager/): the builtin
         # hostpath plugin stands in for container-hosted CSI services and
         # is advertised on the node so CSIVolumeChecker feasibility passes
@@ -370,7 +375,8 @@ class Client:
                              on_handle=on_handle,
                              recover_handles=recover_handles,
                              driver_manager=self.driver_manager,
-                             csi_manager=self.csi, conn=self.conn)
+                             csi_manager=self.csi, conn=self.conn,
+                             network_manager=self.network_manager)
         with self._lock:
             self.allocs[alloc.id] = runner
             self._known_index[alloc.id] = alloc.modify_index

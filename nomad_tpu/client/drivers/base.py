@@ -39,6 +39,9 @@ class TaskConfig:
     ports: Dict[str, int] = field(default_factory=dict)
     #: the node address the ports are bound on
     ip: str = ""
+    #: path of a pre-created network namespace the task must join
+    #: (per-alloc bridge networking, client/network.py)
+    netns: str = ""
 
 
 @dataclass

@@ -59,7 +59,10 @@ class ExecutorBackedDriver(DriverPlugin):
 
     #: subclass knob — what isolation the executor should apply
     def _isolation(self, cfg: TaskConfig) -> Dict[str, object]:
-        return {}
+        # even the un-isolated raw_exec joins the alloc's netns when the
+        # group uses bridge networking (the netns is alloc-level
+        # plumbing, not task-level isolation)
+        return {"netns": cfg.netns} if cfg.netns else {}
 
     def _launch_spec(self, cfg: TaskConfig) -> Dict[str, object]:
         rc = cfg.raw_config
@@ -272,4 +275,8 @@ class ExecDriver(ExecutorBackedDriver):
             paths = rc.get("chroot_paths")
             if paths:
                 iso["chroot_paths"] = [str(p) for p in paths]
+        if cfg.netns:
+            # alloc network hook: join the pre-created per-alloc netns
+            # (networking_bridge_linux.go; client/network.py)
+            iso["netns"] = cfg.netns
         return iso

@@ -72,10 +72,12 @@ class TaskRunner:
                  driver_manager=None,
                  update_period: float = 0.0,
                  volume_paths: Optional[Dict[str, str]] = None,
-                 conn=None) -> None:
+                 conn=None, netns: str = "") -> None:
         self.alloc = alloc
         self.task = task
         self.conn = conn  # server RPC for the secrets hook
+        #: pre-created per-alloc netns path (bridge networking hook)
+        self.netns = netns
         self.task_dir = task_dir
         self.logs_dir = logs_dir
         self.node = node
@@ -418,6 +420,7 @@ class TaskRunner:
             max_file_size_mb=self.task.log_config.max_file_size_mb,
             ports=ports,
             ip=ip,
+            netns=self.netns,
         )
 
     def restart(self) -> None:

@@ -49,14 +49,17 @@ def build_env(alloc: Allocation, task: Task, node: Optional[Node],
     for k, v in meta.items():
         env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = str(v)
     # assigned network ports (taskenv env.go NOMAD_PORT_/NOMAD_HOST_PORT_
-    # /NOMAD_ADDR_ and NOMAD_IP) via the shared Allocation walk
-    ip, port_labels = alloc.port_map(task.name)
-    for raw_label, value in port_labels.items():
+    # /NOMAD_ADDR_ and NOMAD_IP) via the shared Allocation walk.
+    # NOMAD_PORT is the port the task must BIND — `to` when mapped into
+    # an alloc netns, else the host port; NOMAD_HOST_PORT/NOMAD_ADDR are
+    # always the host-facing side (env.go semantics).
+    ip, port_labels = alloc.port_objects(task.name)
+    for raw_label, port in port_labels.items():
         label = raw_label.upper().replace("-", "_")
-        env[f"NOMAD_PORT_{label}"] = str(value)
-        env[f"NOMAD_HOST_PORT_{label}"] = str(value)
+        env[f"NOMAD_PORT_{label}"] = str(port.to or port.value)
+        env[f"NOMAD_HOST_PORT_{label}"] = str(port.value)
         if ip:
-            env[f"NOMAD_ADDR_{label}"] = f"{ip}:{value}"
+            env[f"NOMAD_ADDR_{label}"] = f"{ip}:{port.value}"
     if ip:
         env.setdefault("NOMAD_IP", ip)
     # assigned devices (scheduler/device.py instance ids): generic

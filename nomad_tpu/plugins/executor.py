@@ -161,6 +161,9 @@ class ExecutorService:
             if iso.get("pid_namespace"):
                 init_spec["pid_namespace"] = True
                 applied["pid_namespace"] = True
+        if iso.get("netns"):
+            init_spec["netns"] = iso["netns"]
+            applied["netns"] = iso["netns"]
         if iso.get("chroot") and caps["chroot"] and applied["namespaces"]:
             init_spec["chroot"] = iso["chroot"]
             init_spec["chroot_paths"] = iso.get("chroot_paths")

@@ -78,6 +78,19 @@ def main() -> None:
     # nothing may spawn helper children once CLONE_NEWPID is unshared)
     isolation._get_libc()
 
+    netns_path = spec.get("netns")
+    if netns_path:
+        # join the alloc's PRE-CREATED network namespace (bridge
+        # networking, client/network.py) BEFORE unsharing the others —
+        # setns(CLONE_NEWNET) applies to this process immediately
+        fd = os.open(netns_path, os.O_RDONLY)
+        try:
+            rc = isolation._get_libc().setns(fd, 0)
+            if rc != 0:
+                raise OSError(f"setns({netns_path}) failed")
+        finally:
+            os.close(fd)
+
     flags = 0
     if spec.get("namespaces"):
         flags |= os.CLONE_NEWNS | os.CLONE_NEWIPC | os.CLONE_NEWUTS

@@ -250,6 +250,18 @@ class Allocation:
                     ports[p.label] = p.value
         return ip, ports
 
+    def port_objects(self, task_name: str = "") -> tuple:
+        """(ip, {label: Port}) — for consumers that need the `to`
+        (inside-the-netns) side as well as the assigned host value."""
+        ip = ""
+        ports = {}
+        for net in self.allocated_networks(task_name):
+            ip = ip or net.ip
+            for p in list(net.dynamic_ports) + list(net.reserved_ports):
+                if p.label:
+                    ports[p.label] = p
+        return ip, ports
+
     def comparable_resources(self) -> ComparableResources:
         """Reference `Allocation.ComparableResources` (structs.go:8958)."""
         if self.allocated_resources is not None:
