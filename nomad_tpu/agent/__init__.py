@@ -62,7 +62,8 @@ class AgentConfig:
                  node_name: str = "", datacenter: str = "dc1",
                  region: str = "global",
                  server_addrs=None, acl_enabled: bool = False,
-                 host_volumes=None, node_meta=None, tls=None) -> None:
+                 host_volumes=None, node_meta=None, tls=None,
+                 plugin_config=None) -> None:
         self.server = server
         self.client = client
         self.http_host = http_host
@@ -81,6 +82,9 @@ class AgentConfig:
         self.tls = tls  # lib.tlsutil.TLSConfig | None
         self.statsd_address = ""  # telemetry{statsd_address}
         self.telemetry_interval = 10.0
+        #: driver name → operator config dict (agent `plugin "<name>" {}`
+        #: stanza; reference command/agent/config.go Plugins)
+        self.plugin_config = plugin_config or {}
 
     @classmethod
     def from_hcl(cls, text: str) -> "AgentConfig":
@@ -133,6 +137,15 @@ class AgentConfig:
         acl = one(tree.get("acl"))
         if acl:
             cfg.acl_enabled = bool(acl.get("enabled", False))
+        # plugin "docker" { config { volumes { enabled = true } } }
+        # (reference command/agent/config.go Plugins / plugin stanza) —
+        # the inner config{} wrapper is optional here
+        for pl in (tree.get("plugin") or []):
+            (pname, body), = pl.items()
+            b = one(body)
+            pcfg = dict(one(b.get("config")) or b)
+            pcfg.pop("config", None)
+            cfg.plugin_config[pname] = pcfg
         tel = one(tree.get("telemetry"))
         if tel:
             cfg.statsd_address = tel.get("statsd_address", "")
@@ -227,7 +240,8 @@ class Agent:
                 client_dir = os.path.join(self.config.data_dir, "client")
             self.client = Client(conn, ClientConfig(
                 data_dir=client_dir, node=node,
-                heartbeat_interval=max(self.config.heartbeat_ttl / 3, 0.5)))
+                heartbeat_interval=max(self.config.heartbeat_ttl / 3, 0.5),
+                plugin_config=self.config.plugin_config))
         self.http = HTTPApi(self, self.config.http_host,
                             self.config.http_port, tls=self.config.tls)
         # telemetry push (command/agent/command.go:952 setupTelemetry):

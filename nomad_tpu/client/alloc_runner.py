@@ -177,16 +177,38 @@ class AllocRunner:
             return
         # port forwarders serve the exec-family tasks that JOIN the
         # netns; docker publishes its own ports (and its containers run
-        # in dockerd's namespaces) — forwarding those too would collide
-        # with dockerd's host-port binds
+        # in dockerd's namespaces) — forwarding a docker-published label
+        # too would bind the host port first and make dockerd's own -p
+        # bind of the same port fail. Skip exactly the labels a docker
+        # task publishes (its port_map), not all ports whenever any
+        # docker task exists: a mixed docker+exec group still needs
+        # forwarders for the exec tasks' ports.
         if all(t.driver == "docker" for t in (tg.tasks or [])):
+            # no exec-family task ever joins the netns — nothing for a
+            # forwarder to reach
             self.network_handle = self.network_manager.create(
                 self.alloc.id, [])
             return
+        docker_labels = set()
+        docker_host_ports = set()
+        for t in (tg.tasks or []):
+            if t.driver != "docker":
+                continue
+            pm = (t.config or {}).get("port_map")
+            if isinstance(pm, dict):
+                docker_labels.update(str(k) for k in pm)
+            elif pm:
+                # legacy list form names concrete host ports — skip
+                # exactly those values, not every group label
+                for entry in pm:
+                    host, _, _cp = str(entry).partition(":")
+                    if host.isdigit():
+                        docker_host_ports.add(int(host))
         port_maps = []
         for net in self.alloc.allocated_networks():
             for p in list(net.dynamic_ports) + list(net.reserved_ports):
-                if p.value:
+                if (p.value and p.label not in docker_labels
+                        and p.value not in docker_host_ports):
                     port_maps.append((p.value, p.to or p.value))
         self.network_handle = self.network_manager.create(
             self.alloc.id, port_maps)

@@ -160,13 +160,16 @@ class ClientConfig:
                  heartbeat_interval: float = 3.0,
                  sync_interval: float = 0.2,
                  watch_timeout: float = 5.0,
-                 persist: bool = True) -> None:
+                 persist: bool = True,
+                 plugin_config: Optional[Dict[str, dict]] = None) -> None:
         self.data_dir = data_dir
         self.node = node
         self.heartbeat_interval = heartbeat_interval
         self.sync_interval = sync_interval
         self.watch_timeout = watch_timeout
         self.persist = persist
+        #: per-driver operator config (agent `plugin "<name>" {}` stanzas)
+        self.plugin_config: Dict[str, dict] = plugin_config or {}
 
 
 class Client:
@@ -186,7 +189,8 @@ class Client:
         from .pluginmanager import DriverManager
 
         self.driver_manager = DriverManager(
-            on_attrs=self._driver_attrs_changed)
+            on_attrs=self._driver_attrs_changed,
+            plugin_config=self.config.plugin_config)
         self.device_manager = DeviceManager(
             on_devices=self._devices_changed)
         from .network import NetworkManager

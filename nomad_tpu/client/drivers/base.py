@@ -87,6 +87,12 @@ class DriverPlugin:
 
     name = "base"
 
+    def __init__(self, plugin_config: Optional[dict] = None) -> None:
+        #: operator-supplied driver config (agent `plugin "<name>" {}`
+        #: stanza — reference plugins/shared/hclspec SetConfig); security
+        #: gates like docker volumes.enabled live here, NOT in jobspecs
+        self.plugin_config: dict = plugin_config or {}
+
     def fingerprint(self) -> Dict[str, str]:
         """attributes to merge into the node (health implied by presence)."""
         return {f"driver.{self.name}": "1"}

@@ -29,11 +29,15 @@ def _docker_bin() -> Optional[str]:
     return os.environ.get("NOMAD_TPU_DOCKER_BIN") or shutil.which("docker")
 
 
-def _validate_volume(vol, task_dir: str) -> str:
-    """Structured "src:dst[:mode]" validation (drivers/docker volumes;
-    the reference gates host-absolute binds behind docker.volumes.enabled
-    and resolves relative sources against the task dir). Raw pass-through
-    would let a typo'd spec mount the wrong host path into a container."""
+def _validate_volume(vol, task_dir: str,
+                     volumes_enabled: bool = False) -> str:
+    """Structured "src:dst[:mode]" validation (drivers/docker volumes).
+    Host-absolute sources are gated behind the operator's
+    `plugin "docker" { volumes { enabled = true } }` config exactly like
+    the reference's docker.volumes.enabled (default FALSE): an ungated
+    absolute bind lets any job mount `/` or the docker socket and own the
+    client host. Relative sources resolve against the task dir and are
+    always allowed."""
     parts = str(vol).split(":")
     if len(parts) not in (2, 3):
         raise ValueError(
@@ -45,7 +49,13 @@ def _validate_volume(vol, task_dir: str) -> str:
     if not dst.startswith("/"):
         raise ValueError(
             f"invalid volume {vol!r}: container path must be absolute")
-    if not src.startswith("/"):
+    if src.startswith("/"):
+        if not volumes_enabled:
+            raise ValueError(
+                f"volume {vol!r}: host-absolute sources are disabled; "
+                f"set plugin \"docker\" {{ volumes {{ enabled = true }} }} "
+                f"in the agent config to allow them")
+    else:
         # relative sources resolve inside the task sandbox, never the
         # host cwd (and never the host root)
         if ".." in src.split("/"):
@@ -65,7 +75,8 @@ def _port_publishes(port_map, cfg: TaskConfig) -> List[str]:
     is a MAP {port_label: container_port}: the host side is always the
     scheduler-ASSIGNED port for that label (cfg.ports) — user strings
     cannot bind host ports the node didn't reserve. Legacy list entries
-    ("host:container") are validated as integers."""
+    ("host:container") must name a scheduler-assigned host port too, so
+    the list form can't publish ports the node never reserved."""
     if not port_map:
         return []
     out: List[str] = []
@@ -93,6 +104,11 @@ def _port_publishes(port_map, cfg: TaskConfig) -> List[str]:
             raise ValueError(
                 f"invalid port mapping {pm!r}: want 'host:container' "
                 f"integers or the map form {{label = container_port}}")
+        if int(host) not in cfg.ports.values():
+            raise ValueError(
+                f"port mapping {pm!r}: host port {host} was not assigned "
+                f"to this alloc by the scheduler (assigned: "
+                f"{sorted(cfg.ports.values())})")
         out.append(f"{int(host)}:{int(cp)}")
     return out
 
@@ -167,6 +183,17 @@ class DockerDriver(DriverPlugin):
 
     _coordinator = ImageCoordinator()
 
+    def _volumes_enabled(self) -> bool:
+        """Operator opt-in for host-absolute binds (docker.volumes.enabled,
+        default false). Accepts `volumes { enabled = true }` (HCL block,
+        possibly list-wrapped) or a flat `volumes_enabled = true`."""
+        v = self.plugin_config.get("volumes")
+        if isinstance(v, list):
+            v = v[0] if v else {}
+        if isinstance(v, dict):
+            return bool(v.get("enabled"))
+        return bool(self.plugin_config.get("volumes_enabled"))
+
     def fingerprint(self) -> Dict[str, str]:
         docker = _docker_bin()
         if not docker:
@@ -213,7 +240,9 @@ class DockerDriver(DriverPlugin):
             # reference mounts alloc/local/secrets dirs into the container
             argv += ["--volume", f"{cfg.task_dir}:/local"]
         for vol in rc.get("volumes", []) or []:
-            argv += ["--volume", _validate_volume(vol, cfg.task_dir)]
+            argv += ["--volume",
+                     _validate_volume(vol, cfg.task_dir,
+                                      self._volumes_enabled())]
         for spec in _port_publishes(rc.get("port_map"), cfg):
             argv += ["--publish", spec]
         if rc.get("network_mode"):

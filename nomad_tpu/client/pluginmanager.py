@@ -25,9 +25,12 @@ class DriverManager:
 
     def __init__(self,
                  on_attrs: Optional[Callable[[Dict[str, str]], None]] = None,
-                 fingerprint_interval: float = 30.0) -> None:
+                 fingerprint_interval: float = 30.0,
+                 plugin_config: Optional[Dict[str, dict]] = None) -> None:
         self.on_attrs = on_attrs
         self.fingerprint_interval = fingerprint_interval
+        #: per-driver operator config (agent `plugin "<name>" {}` stanzas)
+        self.plugin_config: Dict[str, dict] = plugin_config or {}
         self._drivers: Dict[str, DriverPlugin] = {}
         self._last_attrs: Dict[str, Dict[str, str]] = {}
         self._lock = threading.Lock()
@@ -42,7 +45,7 @@ class DriverManager:
                 cls = BUILTIN_DRIVERS.get(name)
                 if cls is None:
                     raise ValueError(f"unknown driver {name!r}")
-                d = cls()
+                d = cls(self.plugin_config.get(name))
                 self._drivers[name] = d
             return d
 

@@ -328,6 +328,15 @@ class RaftNode:
         idx, term = snap["index"], snap["term"]
         if self.restore_fn is not None:
             self.restore_fn(snap["state"])
+        if persist:
+            # Persist AFTER the restore succeeds (a rejected snapshot must
+            # not become the durable boot state) but BEFORE truncating the
+            # journal (same ordering as _maybe_take_snapshot): a crash
+            # between the two leaves an over-long log + durable snapshot
+            # (harmless), never a journal whose base_index points past the
+            # on-disk snapshot — that state would make the applier index
+            # before the log base.
+            self._persist_snapshot(snap)
         if idx > self.log.last_index() or self.log.base_index > idx \
                 or self.log.term_at(idx) != term:
             # our log diverges from / predates the snapshot: discard it
@@ -340,8 +349,6 @@ class RaftNode:
         if snap.get("peers"):
             self.peers = {p: tuple(a) for p, a in snap["peers"].items()}
         self._snapshot = snap
-        if persist:
-            self._persist_snapshot(snap)
 
     def _maybe_take_snapshot(self) -> None:
         """Applier-thread only: the FSM is exactly at last_applied here
@@ -840,6 +847,14 @@ class RaftNode:
                 start = self.last_applied + 1
                 end = self.commit_index
                 base = self.log.base_index
+                if start <= base:
+                    # The journal was compacted past our applied point with
+                    # no snapshot covering it (e.g. disk corruption): a
+                    # negative offset here would silently feed the FSM the
+                    # wrong entries. Fail loudly instead.
+                    raise RuntimeError(
+                        f"raft applier: last_applied={start - 1} < "
+                        f"log base_index={base} with no covering snapshot")
                 batch = [(i, self.log.entries[i - base - 1]["data"])
                          for i in range(start, end + 1)]
                 self.last_applied = end

@@ -56,6 +56,11 @@ class PlanQueue:
         self._seq = itertools.count()
         self._enabled = False
         self._shutdown = False
+        # plans popped by dequeue() but not yet committed (the applier
+        # thread pops BEFORE taking the apply mutex) — idle() must count
+        # them or the inline fast path could commit ahead of an
+        # already-dequeued higher-priority plan
+        self._in_flight = 0
 
     def set_enabled(self, enabled: bool) -> None:
         with self._cv:
@@ -89,6 +94,7 @@ class PlanQueue:
                     return None
                 if self._heap:
                     _, _, plan, fut = heapq.heappop(self._heap)
+                    self._in_flight += 1
                     return plan, fut
                 remaining = 1.0
                 if deadline is not None:
@@ -97,10 +103,17 @@ class PlanQueue:
                         return None
                 self._cv.wait(min(remaining, 1.0))
 
-    def idle(self) -> bool:
-        """Enabled with nothing pending — the inline fast path's gate."""
+    def task_done(self) -> None:
+        """Applier thread: the plan returned by dequeue() is committed."""
         with self._cv:
-            return self._enabled and not self._heap and not self._shutdown
+            self._in_flight -= 1
+
+    def idle(self) -> bool:
+        """Enabled with nothing pending or in flight — the inline fast
+        path's gate."""
+        with self._cv:
+            return (self._enabled and not self._heap
+                    and self._in_flight == 0 and not self._shutdown)
 
     def shutdown(self) -> None:
         with self._cv:
@@ -241,6 +254,8 @@ class PlanApplier:
                 fut.set(result)
             except Exception as e:  # noqa: BLE001 — fail the waiting worker
                 fut.set(None, e)
+            finally:
+                self.queue.task_done()
 
     def try_apply_inline(self, plan: Plan) -> Optional[PlanResult]:
         """Submitting-worker fast path: when nothing is queued and the
@@ -250,11 +265,15 @@ class PlanApplier:
         pipelining Raft apply with next-plan evaluation,
         plan_apply.go:71). Returns None when the queue must be used
         (busy applier or pending higher-priority plans)."""
-        if not self.queue.idle():
-            return None
         if not self._apply_lock.acquire(blocking=False):
             return None
         try:
+            # idle() is checked UNDER the lock: checking first and locking
+            # second would let a plan enqueued between the two commit after
+            # us despite higher priority; idle() also counts plans the
+            # applier thread has dequeued but not yet committed.
+            if not self.queue.idle():
+                return None
             result = self.apply(plan)
         finally:
             self._apply_lock.release()

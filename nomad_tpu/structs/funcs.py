@@ -4,7 +4,7 @@ Behavioral reference: `nomad/structs/funcs.go` — `AllocsFit` :103,
 `computeFreePercentage` :150, `ScoreFitBinPack` :175 (Google BestFit v3),
 `ScoreFitSpread` :202 (worst fit), `FilterTerminalAllocs` :62.
 
-These scalar forms are the oracle; `nomad_tpu/kernels/scoring.py` holds the
+These scalar forms are the oracle; `nomad_tpu/kernels/placement.py` holds the
 vectorized versions and is golden-tested against these.
 """
 from __future__ import annotations

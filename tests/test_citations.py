@@ -1,0 +1,27 @@
+"""Guard against phantom intra-repo citations.
+
+Rounds 2-4 each shipped one docstring that cited a `nomad_tpu/...` path
+that did not exist (scale-route comment, devicemanager, kernels/scoring).
+This test greps every backtick-quoted or bare `nomad_tpu/...py` citation
+in repo sources and asserts the file exists.
+"""
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CITE = re.compile(r"nomad_tpu/[A-Za-z0-9_/]+\.(?:py|cpp|c|h)")
+
+
+def test_all_repo_path_citations_resolve():
+    missing = []
+    roots = [REPO / "nomad_tpu", REPO / "tests",
+             REPO / "bench.py", REPO / "__graft_entry__.py"]
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            text = f.read_text(errors="replace")
+            for m in CITE.finditer(text):
+                if not (REPO / m.group(0)).exists():
+                    missing.append(f"{f.relative_to(REPO)}: {m.group(0)}")
+    assert not missing, (
+        "phantom repo citations (file does not exist):\n" + "\n".join(missing))

@@ -166,6 +166,12 @@ class TestAgentConfigFile:
         }
         ports { http = 14646 }
         acl { enabled = true }
+        plugin "docker" {
+          config {
+            volumes { enabled = true }
+          }
+        }
+        plugin "raw_exec" { enabled = true }
         ''')
         assert cfg.data_dir == "/var/lib/nomad-tpu"
         assert cfg.datacenter == "dc2" and cfg.node_name == "edge-1"
@@ -173,6 +179,12 @@ class TestAgentConfigFile:
         assert cfg.client and cfg.node_meta == {"rack": "r9"}
         assert cfg.host_volumes["certs"]["read_only"] is True
         assert cfg.http_port == 14646 and cfg.acl_enabled
+        # plugin stanzas reach the driver config (docker volumes gate)
+        from nomad_tpu.client.drivers.docker import DockerDriver
+
+        assert DockerDriver(
+            cfg.plugin_config["docker"])._volumes_enabled() is True
+        assert cfg.plugin_config["raw_exec"]["enabled"] is True
         # mode blocks are opt-in
         cfg2 = AgentConfig.from_hcl('client { enabled = true }')
         assert cfg2.client and not cfg2.server

@@ -153,6 +153,67 @@ class TestBridgeNetworking:
             mgr.destroy(alloc_id)
 
 
+def test_mixed_group_forwarders_skip_docker_published_ports():
+    """Round-4 advisor (low): in a mixed docker+exec bridge group, port
+    forwarders must cover the exec tasks' ports but SKIP the labels a
+    docker task publishes itself — a forwarder on those would bind the
+    host port first and break dockerd's own -p publish."""
+    from nomad_tpu import mock
+    from nomad_tpu.client.alloc_runner import AllocRunner
+    from nomad_tpu.structs import Task
+    from nomad_tpu.structs.resources import NetworkResource, Port
+
+    class FakeNetMgr:
+        def __init__(self):
+            self.calls = []
+
+        def create(self, alloc_id, port_maps=None):
+            self.calls.append((alloc_id, port_maps))
+            return None
+
+        def destroy(self, alloc_id):
+            pass
+
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.networks[0].mode = "bridge"
+    tg.tasks[0].driver = "docker"
+    tg.tasks[0].config = {"image": "busybox", "port_map": {"http": 8080}}
+    tg.tasks.append(Task(name="sidecar", driver="exec",
+                         config={"command": "/bin/date"}))
+    alloc = mock.alloc(job=j)
+    alloc.allocated_resources.tasks["web"].networks = []  # group ports only
+    alloc.allocated_resources.shared.networks = [NetworkResource(
+        ip="10.0.0.9",
+        dynamic_ports=[Port(label="http", value=21111),
+                       Port(label="api", value=22222, to=9090)])]
+    mgr = FakeNetMgr()
+    ar = AllocRunner(alloc, base_dir="/tmp/nomad-test-na",
+                     network_manager=mgr)
+    ar._setup_network()
+    assert mgr.calls, "bridge group must still create the netns"
+    _, port_maps = mgr.calls[0]
+    # docker's "http" label is skipped; exec's "api" is forwarded
+    assert port_maps == [(22222, 9090)]
+
+    # legacy list-form port_map skips only the listed HOST ports, not
+    # every group label — the exec task's port keeps its forwarder
+    tg.tasks[0].config = {"image": "busybox", "port_map": ["21111:80"]}
+    mgr_legacy = FakeNetMgr()
+    AllocRunner(alloc, base_dir="/tmp/nomad-test-na",
+                network_manager=mgr_legacy)._setup_network()
+    assert mgr_legacy.calls[0][1] == [(22222, 9090)]
+
+    # all-docker group: netns created, zero forwarders (unchanged)
+    tg.tasks[0].config = {"image": "busybox", "port_map": {"http": 8080}}
+    tg.tasks.pop()
+    mgr2 = FakeNetMgr()
+    ar2 = AllocRunner(alloc, base_dir="/tmp/nomad-test-na",
+                      network_manager=mgr2)
+    ar2._setup_network()
+    assert mgr2.calls[0][1] == []
+
+
 def test_taskenv_bridge_port_semantics():
     """NOMAD_PORT is the port the task must BIND (`to` when mapped),
     NOMAD_HOST_PORT the host-facing side (taskenv env.go)."""

@@ -624,7 +624,13 @@ class TestDockerRealism:
     def test_volume_validation(self, fake_docker, tmp_path):
         from nomad_tpu.client.drivers.docker import _validate_volume
 
-        assert _validate_volume("/data:/srv", "") == "/data:/srv"
+        # host-absolute sources are gated on the operator's
+        # volumes.enabled (default DENY — a job could otherwise mount /
+        # or the docker socket and own the host)
+        with pytest.raises(ValueError, match="disabled"):
+            _validate_volume("/data:/srv", "")
+        assert _validate_volume("/data:/srv", "", volumes_enabled=True) \
+            == "/data:/srv"
         assert _validate_volume("local/x:/srv:ro", str(tmp_path)) \
             == f"{tmp_path}/local/x:/srv:ro"
         with pytest.raises(ValueError, match="escapes"):
@@ -633,6 +639,46 @@ class TestDockerRealism:
             _validate_volume("/data:relative", str(tmp_path))
         with pytest.raises(ValueError, match="mode"):
             _validate_volume("/data:/srv:rox", str(tmp_path))
+
+    def test_volumes_enabled_plumbed_from_plugin_config(self, fake_docker,
+                                                        tmp_path):
+        # agent plugin "docker" { volumes { enabled = true } } reaches the
+        # driver through DriverManager plugin_config
+        assert DockerDriver()._volumes_enabled() is False
+        assert DockerDriver(
+            {"volumes": [{"enabled": True}]})._volumes_enabled() is True
+        assert DockerDriver(
+            {"volumes": {"enabled": True}})._volumes_enabled() is True
+        assert DockerDriver(
+            {"volumes_enabled": True})._volumes_enabled() is True
+        d = DockerDriver()
+        cfg = self._cfg(tmp_path,
+                        raw_config={"image": "busybox:1",
+                                    "volumes": ["/etc:/host-etc"]})
+        with pytest.raises(ValueError, match="disabled"):
+            d.start_task(cfg)
+
+    def test_legacy_port_strings_must_be_assigned(self, fake_docker,
+                                                  tmp_path):
+        # the list form can only publish scheduler-assigned host ports
+        d = DockerDriver()
+        cfg = self._cfg(tmp_path,
+                        raw_config={"image": "busybox:1",
+                                    "command": "true",
+                                    "port_map": ["21234:80"]},
+                        ports={"http": 21234})
+        h = d.start_task(cfg)
+        try:
+            insp = d.inspect_task(h)
+            assert insp["container"]["Config"]["publish"] == ["21234:80"]
+        finally:
+            d.destroy_task(h, force=True)
+        cfg2 = self._cfg(tmp_path,
+                         raw_config={"image": "busybox:1",
+                                     "port_map": ["9999:80"]},
+                         ports={"http": 21234})
+        with pytest.raises(ValueError, match="not assigned"):
+            d.start_task(cfg2)
 
     def test_container_stats(self, fake_docker, tmp_path):
         d = DockerDriver()

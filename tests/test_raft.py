@@ -1,6 +1,7 @@
 """RPC fabric + Raft consensus tests (reference models: nomad/rpc_test.go,
 hashicorp/raft's own suite exercised via nomad/leader_test.go — in-process
 multi-server on localhost, SURVEY §4.3)."""
+import os
 import threading
 import time
 
@@ -491,6 +492,65 @@ class TestSnapshotCompaction:
             assert _wait(lambda: c.fsm["n2"] == want, timeout=15.0)
             assert c.nodes["n2"].log.base_index >= 100
             assert c.apply_count["n2"] <= 201 - c.nodes["n2"].log.base_index
+        finally:
+            c.shutdown()
+
+    def test_install_persists_snapshot_before_truncating_log(self,
+                                                             tmp_path):
+        """Round-4 advisor (medium): InstallSnapshot must persist the
+        snapshot file BEFORE rewriting the journal with the new
+        base_index — the reverse order leaves, after a crash between the
+        two, a journal whose base points past any durable snapshot, and
+        the applier would then index before the log base. Simulate the
+        crash by making the snapshot write fail: the journal must be
+        untouched."""
+        c = SnapCluster(n=1, data_dirs=[str(tmp_path / "n0")],
+                        threshold=10_000)  # no auto-compaction
+        try:
+            leader = c.wait_leader()
+            for i in range(5):
+                leader.apply({"k": f"k{i}", "v": i})
+            assert leader.log.base_index == 0
+            snap = {"index": 4, "term": leader.log.term_at(4),
+                    "peers": {}, "state": {"k0": 0}}
+
+            def boom(_snap):
+                raise OSError("disk full")
+
+            leader._persist_snapshot = boom
+            with leader._lock:
+                with pytest.raises(OSError):
+                    leader._install_snapshot_locked(snap, persist=True)
+            # crash point: snapshot never became durable → the journal
+            # must still start at 0 with every entry present
+            assert leader.log.base_index == 0
+            assert leader.log.last_index() >= 5
+        finally:
+            c.shutdown()
+
+    def test_rejected_restore_never_persists_snapshot(self, tmp_path):
+        """The flip side of the ordering: a snapshot the FSM's restore
+        rejects must not become the durable boot state (it would brick
+        the node at the next start)."""
+        c = SnapCluster(n=1, data_dirs=[str(tmp_path / "n0")],
+                        threshold=10_000)
+        try:
+            leader = c.wait_leader()
+            for i in range(5):
+                leader.apply({"k": f"k{i}", "v": i})
+            snap = {"index": 4, "term": leader.log.term_at(4),
+                    "peers": {}, "state": {"bad": "blob"}}
+
+            def reject(_state):
+                raise ValueError("unrecognized snapshot format")
+
+            leader.restore_fn = reject
+            with leader._lock:
+                with pytest.raises(ValueError):
+                    leader._install_snapshot_locked(snap, persist=True)
+            assert not os.path.exists(
+                str(tmp_path / "n0" / "raft_snap.mp"))
+            assert leader.log.base_index == 0
         finally:
             c.shutdown()
 
