@@ -530,6 +530,39 @@ def place_packed_batch(cluster: ClusterArrays, i32buf, f32buf, u8buf,
 
 
 @functools.partial(jax.jit, static_argnames=("max_allocs",))
+def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
+                           max_allocs: int) -> PlacementResult:
+    """Chained batched placement: scan over the program axis carrying
+    (used, dyn_free) so program i sees programs 0..i-1's placements.
+
+    This is the conflict-FREE form of eval batching: where `_batch`
+    (vmap) mirrors the reference's N workers racing on one MVCC snapshot
+    (`nomad/server.go:1419`) and leaves collisions to plan-apply
+    (`nomad/plan_apply.go:437`), the chain threads the optimistic
+    resource view through the batch the way a single worker's in-plan
+    accounting does (`scheduler/context.go:120` ProposedAllocs) — two
+    evals in one batch can never over-commit cpu/mem/disk or the dynamic
+    port budget on a node. Reserved-port collisions across programs are
+    still resolved at apply (port VALUES are assigned host-side).
+    Serial over B programs on-device, but it's ONE dispatch; the inner
+    node-axis work stays full-width SPMD."""
+    n = cluster.used.shape[0]
+
+    def prog(carry, p):
+        used, dyn = carry
+        cl = cluster._replace(used=used, dyn_free=dyn)
+        r = place_task_group(cl, p, max_allocs)
+        placed = jnp.sum(
+            ((r.sel_idx[:, None] == jnp.arange(n)[None, :])
+             & (r.sel_idx >= 0)[:, None]).astype(jnp.float32), axis=0)
+        return (r.new_used, dyn - placed * p.n_dyn), r
+
+    (_, _), results = jax.lax.scan(
+        prog, (cluster.used, cluster.dyn_free), batch)
+    return results
+
+
+@functools.partial(jax.jit, static_argnames=("max_allocs",))
 def place_task_group_batch(cluster: ClusterArrays, batch: TGParams,
                            max_allocs: int) -> PlacementResult:
     """Batched placement: vmap over independent evaluations against one shared

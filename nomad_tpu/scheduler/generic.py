@@ -95,6 +95,9 @@ class GenericScheduler:
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.queued_allocs: Dict[str, int] = {}
         self.follow_up_evals: List[Evaluation] = []
+        #: set by the worker's batch path (server/select_batch.py) to
+        #: fuse this eval's placement dispatches with its batch-mates'
+        self.select_coordinator = None
 
     # ---- entry point ----
 
@@ -159,6 +162,8 @@ class GenericScheduler:
 
         config = self.state.scheduler_config()
         self.stack = TPUStack(self.cluster, algorithm=config.scheduler_algorithm)
+        self.stack.coordinator = self.select_coordinator
+        self.stack.coordinator_order = getattr(self, "select_order", 0)
         self.preemption_enabled = (
             config.preemption_batch_enabled if self.batch
             else config.preemption_service_enabled

@@ -77,6 +77,10 @@ class TPUStack:
         self.cluster = cluster
         self.algorithm = algorithm
         self._jit = jit
+        #: when set (server/select_batch.py SelectCoordinator), select()
+        #: parks its compiled program there and the coordinator fuses the
+        #: batch into one chained kernel dispatch
+        self.coordinator = None
         # (namespace, job.id, version, modify_index, tg, volumes) →
         # compiled static program; re-evaluating the same job spec
         # (retries, node-down churn, deployments) skips the LUT compile
@@ -698,21 +702,37 @@ class TPUStack:
 
         params, m = self.compile_tg(job, tg, n_place, plan, volumes=volumes,
                                     sampled_rows=sampled_rows)
-        # Bucket-pad this single program (parallel/mesh.py pad_params —
-        # the same inert padding the batched path uses): without it every
-        # distinct (LUT width, constraint rows, spread/dp count) combo is
-        # a fresh XLA compile, and a control plane processing many
-        # distinct jobs spends its time compiling instead of placing.
-        from ..parallel.mesh import pad_params
-
-        (params,), _ = pad_params([params])
-        arrays = self.device_arrays()
-        if self._jit:
-            result = place_task_group_jit(arrays, _to_device(params), m)
+        if self.coordinator is not None:
+            # batched path: park the raw program; the coordinator pads,
+            # stacks, and runs ONE chained kernel for the whole eval batch
+            # (chained in broker-drain order for determinism). The device
+            # view is fetched by the COORDINATOR at dispatch time, not
+            # here — under pipelining the previous batch's plans commit
+            # between this park and the dispatch, and placing against a
+            # park-time snapshot would ignore them.
+            sel, scores, n_feas, n_fit = self.coordinator.select(
+                self.device_arrays, params, n_place,
+                order=getattr(self, "coordinator_order", 0))
+            result = None
         else:
-            result = place_task_group(arrays, _to_device(params), m)
-        sel = np.asarray(result.sel_idx)
-        scores = np.asarray(result.sel_score)
+            arrays = self.device_arrays()
+            # Bucket-pad this single program (parallel/mesh.py pad_params —
+            # the same inert padding the batched path uses): without it
+            # every distinct (LUT width, constraint rows, spread/dp count)
+            # combo is a fresh XLA compile, and a control plane processing
+            # many distinct jobs spends its time compiling instead of
+            # placing.
+            from ..parallel.mesh import pad_params
+
+            (params,), _ = pad_params([params])
+            if self._jit:
+                result = place_task_group_jit(arrays, _to_device(params), m)
+            else:
+                result = place_task_group(arrays, _to_device(params), m)
+            sel = np.asarray(result.sel_idx)
+            scores = np.asarray(result.sel_score)
+            n_feas = int(result.nodes_feasible)
+            n_fit = np.asarray(result.nodes_fit)
         snap_rows = self.cluster.node_of_row
         node_ids: List[Optional[str]] = []
         out_scores: List[float] = []
@@ -723,8 +743,8 @@ class TPUStack:
         return SelectResult(
             node_ids=node_ids,
             scores=out_scores,
-            nodes_feasible=int(result.nodes_feasible),
-            nodes_fit=[int(x) for x in np.asarray(result.nodes_fit)[:n_place]],
+            nodes_feasible=n_feas,
+            nodes_fit=[int(x) for x in np.asarray(n_fit)[:n_place]],
             raw=result,
         )
 

@@ -292,7 +292,6 @@ class PlanApplier:
                 raise ValueError(
                     f"plan for eval {plan.eval_id} has a stale token"
                 )
-        snap = self.state.snapshot()
         result = PlanResult(
             node_update={k: list(v) for k, v in plan.node_update.items()},
             node_allocation={},
@@ -314,8 +313,12 @@ class PlanApplier:
                 if hasattr(self.state, "mutation_lock")
                 else contextlib.nullcontext())
         with lock:
+            # verify against the LIVE store (not a snapshot): the mutation
+            # lock already guarantees internal consistency, and a full
+            # StateSnapshot copy per plan (~0.6 ms at 10K allocs) was the
+            # single biggest apply cost
             for node_id in touched:
-                fit, reason = evaluate_node_plan(snap, plan, node_id)
+                fit, reason = evaluate_node_plan(self.state, plan, node_id)
                 if fit:
                     if node_id in plan.node_allocation:
                         result.node_allocation[node_id] = list(

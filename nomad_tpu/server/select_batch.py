@@ -1,0 +1,203 @@
+"""Batched select dispatch — the control plane's road onto the chained
+placement kernel.
+
+The reference scales eval throughput with NumCPU worker goroutines racing
+on MVCC snapshots (`nomad/server.go:1419-1451`, `nomad/worker.go:105`);
+collisions surface as plan rejections (`nomad/plan_apply.go:437`). The
+TPU build batches instead: one worker drains up to B evals from the
+broker, runs each eval's scheduler in a short-lived thread, and this
+coordinator fuses their `TPUStack.select` dispatches into ONE
+`place_task_group_chain` call (kernels/placement.py) — a scan over the
+program axis that carries (used, dyn_free), so programs in a batch see
+each other's placements and cannot over-commit a node (SURVEY §7
+hard-part (e): conflict-aware eval batching).
+
+Determinism: programs chain in the evals' broker-drain order (each
+request carries its batch position), so within a dispatch a batched
+server places exactly what a sequential one would, regardless of
+thread timing (tests/test_select_batch.py asserts this equivalence end
+to end for the single-round case, which is every eval's first select).
+Later rounds (multi-TG jobs, refresh retries, reselect) place against
+the LIVE device view at dispatch time: a program's own plan-relative
+deltas (compile_tg) already encode its earlier placements/stops, so
+re-applying them on top of a cross-round carry would double-count —
+instead, cross-round conflicts fall to plan-apply verification exactly
+like the reference's optimistic worker race (`nomad/plan_apply.go:437`).
+
+Rendezvous protocol: scheduler threads park in `select()`; the
+coordinator dispatches when every live thread is parked (the common
+case — each scheduler issues exactly one select) or when a short window
+expires (stragglers blocked elsewhere, e.g. in plan-apply). A thread may
+park again for later rounds (multi-TG jobs, plan-refresh retries); the
+loop runs until every thread has finished.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import bucket as _bucket
+
+
+class _SelectReq:
+    __slots__ = ("arrays_fn", "params", "n_place", "order", "event", "out",
+                 "err")
+
+    def __init__(self, arrays_fn, params, n_place: int, order: int) -> None:
+        #: zero-arg callable returning the CURRENT device cluster view
+        #: (TPUStack.device_arrays) — resolved at dispatch time, because
+        #: under pipelining the predecessor batch's plans commit between
+        #: park and dispatch
+        self.arrays_fn = arrays_fn
+        self.params = params
+        self.n_place = n_place
+        self.order = order
+        self.event = threading.Event()
+        self.out: Optional[Tuple] = None
+        self.err: Optional[BaseException] = None
+
+
+class SelectCoordinator:
+    """Fuses concurrent select dispatches from one eval batch."""
+
+    def __init__(self, window_s: float = 0.004) -> None:
+        self._cv = threading.Condition()
+        self._live = 0
+        self._parked: List[_SelectReq] = []
+        self.window_s = window_s
+        self.stats = {"dispatches": 0, "programs": 0, "batched": 0}
+
+    # ---- scheduler-thread side ----
+
+    def add_thread(self) -> None:
+        with self._cv:
+            self._live += 1
+
+    def thread_done(self) -> None:
+        with self._cv:
+            self._live -= 1
+            self._cv.notify_all()
+
+    def select(self, arrays_fn, params, n_place: int, order: int = 0):
+        """Park until the coordinator dispatches this program. Returns
+        (sel_rows i32[M], scores f32[M], nodes_feasible int,
+        nodes_fit i32[M])."""
+        req = _SelectReq(arrays_fn, params, n_place, order)
+        with self._cv:
+            self._parked.append(req)
+            self._cv.notify_all()
+        req.event.wait()
+        if req.err is not None:
+            raise req.err
+        return req.out
+
+    # ---- coordinator side (the worker's batch thread) ----
+
+    def run(self) -> None:
+        """Dispatch parked programs until all scheduler threads finish.
+
+        Round 1 is a STRICT rendezvous: before the first dispatch no
+        thread can be blocked anywhere but here (submit_plan only happens
+        after a select), so waiting for every live thread costs nothing
+        and yields one full-width chain instead of several partial ones.
+        Later rounds (plan-refresh retries, multi-TG jobs) use a short
+        window — batch-mates may legitimately be busy applying plans."""
+        first = True
+        while True:
+            with self._cv:
+                deadline = None
+                while True:
+                    if self._parked:
+                        if len(self._parked) >= self._live:
+                            break
+                        # round 1 gets a generous deadline (a stops-only
+                        # eval can briefly be in submit_plan before its
+                        # first select; unbounded waiting could stall on
+                        # a wedged apply), later rounds a tight one
+                        window = 0.1 if first else self.window_s
+                        if deadline is None:
+                            deadline = time.time() + window
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                    else:
+                        if self._live == 0:
+                            return
+                        deadline = None
+                        self._cv.wait(0.05)
+                batch, self._parked = self._parked, []
+                first = False
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # noqa: BLE001 — fail the waiters
+                for r in batch:
+                    if not r.event.is_set():
+                        r.err = e
+                        r.event.set()
+
+    def _dispatch(self, batch: List[_SelectReq]) -> None:
+        from ..kernels.placement import (place_task_group_chain,
+                                         place_task_group_jit)
+        from ..parallel.mesh import pad_params, stack_params
+
+        self.stats["dispatches"] += 1
+        self.stats["programs"] += len(batch)
+        # resolve each request's device view NOW (post-predecessor-commit)
+        # and group by cluster (capacity buffer is stable across
+        # used-version bumps; distinct clusters would be distinct states)
+        by_cluster: Dict[int, List[Tuple[_SelectReq, object]]] = {}
+        for r in batch:
+            a = r.arrays_fn()
+            by_cluster.setdefault(id(a.capacity), []).append((r, a))
+        for pairs in by_cluster.values():
+            pairs.sort(key=lambda p: p[0].order)
+            reqs = [p[0] for p in pairs]
+            arrays = pairs[0][1]
+            if len(reqs) == 1:
+                r = reqs[0]
+                (p,), m = pad_params([r.params])
+                res = place_task_group_jit(arrays, p, m)
+                r.out = (np.asarray(res.sel_idx), np.asarray(res.sel_score),
+                         int(res.nodes_feasible), np.asarray(res.nodes_fit))
+                r.event.set()
+                continue
+            self.stats["batched"] += len(reqs)
+            params_list = [r.params for r in reqs]
+            # pad the program axis to a power of two with inert programs
+            # (n_place=0, no deltas) so chain compiles are shared across
+            # batch sizes instead of one per B
+            b = _bucket(len(reqs), lo=2)
+            if b > len(reqs):
+                pad = _inert_program(params_list[0])
+                params_list = params_list + [pad] * (b - len(reqs))
+            stacked, m = stack_params(params_list)
+            res = place_task_group_chain(arrays, stacked, m)
+            sel_all = np.asarray(res.sel_idx)
+            scores = np.asarray(res.sel_score)
+            feas = np.asarray(res.nodes_feasible)
+            fit = np.asarray(res.nodes_fit)
+            for i, r in enumerate(reqs):
+                r.out = (sel_all[i], scores[i], int(feas[i]), fit[i])
+                r.event.set()
+
+
+def _inert_program(p):
+    """A zero-effect pad program: places nothing (n_place=0) and carries
+    no plan-relative deltas, so the chain's (used, dyn_free) carry passes
+    through it unchanged."""
+    z = np.zeros_like
+    return p._replace(
+        n_place=np.int32(0),
+        ask=z(np.asarray(p.ask)),
+        n_dyn=np.float32(0.0),
+        delta_idx=np.full_like(np.asarray(p.delta_idx), -1),
+        delta_res=z(np.asarray(p.delta_res)),
+        pclr_idx=np.full_like(np.asarray(p.pclr_idx), -1),
+        pclr_port=np.full_like(np.asarray(p.pclr_port), -1),
+        pset_idx=np.full_like(np.asarray(p.pset_idx), -1),
+        pset_port=np.full_like(np.asarray(p.pset_port), -1),
+    )

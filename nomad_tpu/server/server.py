@@ -46,7 +46,7 @@ class ServerConfig:
                  nack_timeout: float = 60.0, gc_interval: float = 60.0,
                  gc=None, data_dir: Optional[str] = None,
                  fsync: bool = False, snapshot_threshold: int = 8192,
-                 acl_enabled: bool = False):
+                 acl_enabled: bool = False, eval_batch: int = 16):
         self.num_schedulers = num_schedulers
         self.heartbeat_ttl = heartbeat_ttl
         self.nack_timeout = nack_timeout
@@ -56,6 +56,9 @@ class ServerConfig:
         self.fsync = fsync
         self.snapshot_threshold = snapshot_threshold
         self.acl_enabled = acl_enabled
+        #: max evals one worker drains into a fused-select batch
+        #: (worker.py process_batch); 1 disables batching
+        self.eval_batch = eval_batch
 
 
 class Server:

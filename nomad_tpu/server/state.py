@@ -75,6 +75,10 @@ class StateSnapshot(InMemState):
         counter.value = self.index_at
         self.index = counter
         self.cluster = copy.deepcopy(self.cluster)
+        # mutable from here on: read-side memos must not engage
+        # (scheduler/util.py _node_live_allocs)
+        self._detached = True
+        self.__dict__.pop("_live_allocs_memo", None)
         return self
 
 
