@@ -850,7 +850,10 @@ def cmd_volume(args) -> int:
             access_mode=str(body.get("access_mode",
                                      "single-node-writer")),
             attachment_mode=str(body.get("attachment_mode",
-                                         "file-system")))
+                                         "file-system")),
+            controller_required=bool(body.get("controller_required",
+                                              body.get("external_id",
+                                                       False))))
         if not vol.id or not vol.plugin_id:
             print("Error: volume spec needs id and plugin_id",
                   file=sys.stderr)

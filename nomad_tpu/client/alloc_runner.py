@@ -330,9 +330,39 @@ class AllocRunner:
                 if not claimed:
                     raise RuntimeError(
                         f"CSI claim rejected for {req.source!r} ({mode})")
+                # controller-required volumes: the server queued a
+                # ControllerPublish for this node at claim time; staging
+                # must wait for the controller's publish context
+                # (csi_hook.go — the claim RPC returns PublishContext;
+                # here the client polls the volume for it)
+                publish_context = None
+                if vol.controller_required:
+                    deadline = time.time() + 15.0
+                    while time.time() < deadline:
+                        if self._halted():
+                            raise _AllocHalted()
+                        fresh = self.conn.csi_volume_get(
+                            self.alloc.namespace, req.source)
+                        publish_context = (fresh.publish_contexts or {}) \
+                            .get(self.alloc.node_id) if fresh else None
+                        if publish_context is not None:
+                            break
+                        err = (fresh.controller_errors or {}).get(
+                            self.alloc.node_id) if fresh else None
+                        if err:
+                            raise RuntimeError(
+                                f"controller publish failed for "
+                                f"{req.source!r}: {err}")
+                        time.sleep(0.1)
+                    if publish_context is None:
+                        raise RuntimeError(
+                            f"controller publish for {req.source!r} did "
+                            f"not complete (no controller plugin "
+                            f"running for {vol.plugin_id!r}?)")
                 path = self.csi_manager.mount_volume(
                     vol.plugin_id, vol.id, self.alloc.id,
-                    readonly=req.read_only)
+                    readonly=req.read_only,
+                    publish_context=publish_context)
                 self.volume_paths[name] = path
                 self._csi_mounted.append((vol.plugin_id, vol.id))
 

@@ -69,6 +69,14 @@ class InProcConn:
     def csi_volume_get(self, namespace, vol_id):
         return self.server.csi_volume_get(namespace, vol_id)
 
+    def csi_controller_poll(self, node_id):
+        return self.server.csi_controller_poll(node_id)
+
+    def csi_controller_done(self, namespace, vol_id, node_id, op,
+                            context=None, error=""):
+        return self.server.csi_controller_done(namespace, vol_id, node_id,
+                                               op, context, error)
+
     def update_service_registrations(self, regs):
         return self.server.update_service_registrations(regs)
 
@@ -144,6 +152,14 @@ class RpcConn:
     def csi_volume_get(self, namespace, vol_id):
         return self._call("csi_volume_get", namespace, vol_id)
 
+    def csi_controller_poll(self, node_id):
+        return self._call("csi_controller_poll", node_id)
+
+    def csi_controller_done(self, namespace, vol_id, node_id, op,
+                            context=None, error=""):
+        return self._call("csi_controller_done", namespace, vol_id,
+                          node_id, op, context, error)
+
     def update_service_registrations(self, regs):
         return self._call("update_service_registrations", regs)
 
@@ -201,13 +217,21 @@ class Client:
         # CSI node plugins (client/pluginmanager/csimanager/): the builtin
         # hostpath plugin stands in for container-hosted CSI services and
         # is advertised on the node so CSIVolumeChecker feasibility passes
-        from .csi import CsiManager, HostPathCsiPlugin
+        from .csi import (CsiManager, HostPathCsiControllerPlugin,
+                          HostPathCsiPlugin)
 
         self.csi = CsiManager(os.path.join(self.data_dir, "csi"))
-        self.csi.register(HostPathCsiPlugin(
-            "hostpath", os.path.join(self.data_dir, "csi", "hostpath")))
+        hostpath_root = os.path.join(self.data_dir, "csi", "hostpath")
+        self.csi.register(HostPathCsiPlugin("hostpath", hostpath_root))
+        # every hostpath node can also serve the controller service (the
+        # reference runs controllers as jobs; the builtin stands in)
+        self.csi.register_controller(
+            HostPathCsiControllerPlugin("hostpath", hostpath_root))
         for pid in self.csi.plugins:
             self.node.csi_node_plugins.setdefault(pid, {"healthy": True})
+        for pid in self.csi.controllers:
+            self.node.csi_controller_plugins.setdefault(
+                pid, {"healthy": True})
         self.allocs: Dict[str, AllocRunner] = {}
         self._known_index: Dict[str, int] = {}
         self._last_heartbeat_ok = time.time()
@@ -230,9 +254,12 @@ class Client:
         # first fingerprint doesn't trigger a redundant re-register
         self.device_manager.seed(self.node.node_resources.devices)
         self.device_manager.start()
-        for fn, name in ((self._run_heartbeat, "hb"),
-                         (self._run_watch, "watch"),
-                         (self._run_sync, "sync")):
+        threads = [(self._run_heartbeat, "hb"),
+                   (self._run_watch, "watch"),
+                   (self._run_sync, "sync")]
+        if self.csi.controllers:
+            threads.append((self._run_csi_controller, "csi-ctrl"))
+        for fn, name in threads:
             t = threading.Thread(target=fn, name=f"client-{name}",
                                  daemon=True)
             t.start()
@@ -418,6 +445,46 @@ class Client:
                         self._dirty.setdefault(aid, a)
                 if self._stop.wait(0.5):
                     return
+
+    def _run_csi_controller(self) -> None:
+        """Drain controller publish/unpublish work queued for the
+        controller plugins this client hosts (the client-pull analog of
+        the reference's server→client ClientCSI.ControllerAttachVolume,
+        nomad/csi_endpoint.go:458 — see server.csi_controller_poll)."""
+        interval = 0.25
+        while not self._stop.wait(interval):
+            try:
+                ops = self.conn.csi_controller_poll(self.node.id) or []
+            except Exception:
+                continue
+            # adaptive cadence: controller work is bursty and rare —
+            # busy hosts poll fast, idle ones back off so a large fleet
+            # of controller-capable clients doesn't hammer the volume
+            # table (every poll scans it under the store lock)
+            interval = 0.25 if ops else min(interval * 2, 2.0)
+            for op in ops:
+                plugin = self.csi.controllers.get(op.get("plugin_id"))
+                if plugin is None:
+                    continue
+                ns, vol_id = op["namespace"], op["volume_id"]
+                node_id, kind = op["node_id"], op["op"]
+                try:
+                    if kind == "publish":
+                        ctx = plugin.controller_publish_volume(
+                            vol_id, node_id,
+                            readonly=bool(op.get("readonly"))) or {}
+                        self.conn.csi_controller_done(
+                            ns, vol_id, node_id, "publish", ctx, "")
+                    elif kind == "unpublish":
+                        plugin.controller_unpublish_volume(vol_id, node_id)
+                        self.conn.csi_controller_done(
+                            ns, vol_id, node_id, "unpublish", None, "")
+                except Exception as e:  # noqa: BLE001 — report, don't die
+                    try:
+                        self.conn.csi_controller_done(
+                            ns, vol_id, node_id, kind, None, str(e))
+                    except Exception:
+                        pass
 
     # ---- introspection ----
 

@@ -28,15 +28,19 @@ class CsiError(Exception):
 
 class CsiNodePlugin:
     """Node-service contract (plugins/csi/plugin.go NodeStageVolume /
-    NodePublishVolume / NodeUnpublishVolume / NodeUnstageVolume)."""
+    NodePublishVolume / NodeUnpublishVolume / NodeUnstageVolume).
+    `publish_context` is what the controller's ControllerPublishVolume
+    returned for THIS node (empty for controller-less plugins)."""
 
     plugin_id = ""
 
-    def node_stage_volume(self, volume_id: str, staging_path: str) -> None:
+    def node_stage_volume(self, volume_id: str, staging_path: str,
+                          publish_context: Optional[dict] = None) -> None:
         raise NotImplementedError
 
     def node_publish_volume(self, volume_id: str, staging_path: str,
-                            target_path: str, readonly: bool) -> None:
+                            target_path: str, readonly: bool,
+                            publish_context: Optional[dict] = None) -> None:
         raise NotImplementedError
 
     def node_unpublish_volume(self, volume_id: str,
@@ -46,6 +50,32 @@ class CsiNodePlugin:
     def node_unstage_volume(self, volume_id: str,
                             staging_path: str) -> None:
         raise NotImplementedError
+
+
+class CsiControllerPlugin:
+    """Controller-service contract (plugins/csi/plugin.go:34-46
+    GetControllerCapabilities / ControllerPublishVolume /
+    ControllerUnpublishVolume / ControllerValidateCapabilities). The
+    publish return value is the PublishContext handed to the node
+    service on the target node."""
+
+    plugin_id = ""
+
+    def controller_capabilities(self) -> dict:
+        return {"attach": True}
+
+    def controller_publish_volume(self, volume_id: str, node_id: str,
+                                  readonly: bool = False) -> dict:
+        raise NotImplementedError
+
+    def controller_unpublish_volume(self, volume_id: str,
+                                    node_id: str) -> None:
+        raise NotImplementedError
+
+    def controller_validate_volume(self, volume_id: str,
+                                   attachment_mode: str,
+                                   access_mode: str) -> None:
+        return None
 
 
 class HostPathCsiPlugin(CsiNodePlugin):
@@ -60,12 +90,21 @@ class HostPathCsiPlugin(CsiNodePlugin):
     def _backing(self, volume_id: str) -> str:
         return os.path.join(self.root, volume_id)
 
-    def node_stage_volume(self, volume_id: str, staging_path: str) -> None:
+    def node_stage_volume(self, volume_id: str, staging_path: str,
+                          publish_context: Optional[dict] = None) -> None:
+        # controller-attached volumes stage from the device the
+        # controller surfaced; detached staging of such a volume is the
+        # bug class the controller path exists to prevent
+        if publish_context is not None and "device_path" in publish_context:
+            os.makedirs(publish_context["device_path"], exist_ok=True)
+            return
         os.makedirs(self._backing(volume_id), exist_ok=True)
 
     def node_publish_volume(self, volume_id: str, staging_path: str,
-                            target_path: str, readonly: bool) -> None:
-        backing = self._backing(volume_id)
+                            target_path: str, readonly: bool,
+                            publish_context: Optional[dict] = None) -> None:
+        backing = (publish_context or {}).get("device_path") \
+            or self._backing(volume_id)
         os.makedirs(os.path.dirname(target_path), exist_ok=True)
         if os.path.islink(target_path):
             os.unlink(target_path)
@@ -79,6 +118,49 @@ class HostPathCsiPlugin(CsiNodePlugin):
     def node_unstage_volume(self, volume_id: str,
                             staging_path: str) -> None:
         pass  # backing dir persists (volume data outlives allocs)
+
+
+class HostPathCsiControllerPlugin(CsiControllerPlugin):
+    """Functional controller plugin over the same hostpath root: attach
+    is an explicit, durable attachment record (the `plugins/csi/fake`
+    controller analog) and the publish context points the node service
+    at the attached device directory. A node staging WITHOUT the record
+    means the controller leg was skipped — exactly what the e2e test
+    asserts cannot happen."""
+
+    def __init__(self, plugin_id: str, root: str) -> None:
+        self.plugin_id = plugin_id
+        self.root = root
+
+    def _attach_dir(self) -> str:
+        return os.path.join(self.root, "attachments")
+
+    def _record(self, volume_id: str, node_id: str) -> str:
+        return os.path.join(self._attach_dir(), f"{volume_id}@{node_id}")
+
+    def controller_publish_volume(self, volume_id: str, node_id: str,
+                                  readonly: bool = False) -> dict:
+        device = os.path.join(self.root, "devices", volume_id)
+        os.makedirs(device, exist_ok=True)
+        os.makedirs(self._attach_dir(), exist_ok=True)
+        with open(self._record(volume_id, node_id), "w") as fh:
+            fh.write("ro" if readonly else "rw")
+        return {"device_path": device, "attached_to": node_id}
+
+    def controller_unpublish_volume(self, volume_id: str,
+                                    node_id: str) -> None:
+        try:
+            os.unlink(self._record(volume_id, node_id))
+        except FileNotFoundError:
+            pass
+
+    def attached_nodes(self, volume_id: str) -> Set[str]:
+        try:
+            names = os.listdir(self._attach_dir())
+        except FileNotFoundError:
+            return set()
+        prefix = f"{volume_id}@"
+        return {n[len(prefix):] for n in names if n.startswith(prefix)}
 
 
 @dataclass
@@ -95,18 +177,25 @@ class CsiManager:
     def __init__(self, base_dir: str) -> None:
         self.base_dir = base_dir  # <data_dir>/csi
         self.plugins: Dict[str, CsiNodePlugin] = {}
+        #: controller services hosted by THIS client (csimanager plugin
+        #: registry; drained by the client's controller poll loop)
+        self.controllers: Dict[str, CsiControllerPlugin] = {}
         self._usage: Dict[str, _VolumeUsage] = {}  # "<plugin>/<vol>"
         self._lock = threading.Lock()
 
     def register(self, plugin: CsiNodePlugin) -> None:
         self.plugins[plugin.plugin_id] = plugin
 
+    def register_controller(self, plugin: CsiControllerPlugin) -> None:
+        self.controllers[plugin.plugin_id] = plugin
+
     def _target(self, alloc_id: str, volume_id: str) -> str:
         return os.path.join(self.base_dir, "per-alloc", alloc_id,
                             volume_id, "mount")
 
     def mount_volume(self, plugin_id: str, volume_id: str, alloc_id: str,
-                     readonly: bool = False) -> str:
+                     readonly: bool = False,
+                     publish_context: Optional[dict] = None) -> str:
         plugin = self.plugins.get(plugin_id)
         if plugin is None:
             raise CsiError(f"no CSI plugin {plugin_id!r} on this node")
@@ -117,11 +206,13 @@ class CsiManager:
                 staging = os.path.join(self.base_dir, "staging", plugin_id,
                                        volume_id)
                 os.makedirs(staging, exist_ok=True)
-                plugin.node_stage_volume(volume_id, staging)
+                plugin.node_stage_volume(volume_id, staging,
+                                         publish_context=publish_context)
                 usage = self._usage[key] = _VolumeUsage(staging)
             target = self._target(alloc_id, volume_id)
             plugin.node_publish_volume(volume_id, usage.staging_path,
-                                       target, readonly)
+                                       target, readonly,
+                                       publish_context=publish_context)
             usage.allocs.add(alloc_id)
         return target
 

@@ -476,6 +476,75 @@ class InMemState:
         if vol is not None and vol.release(alloc_id):
             vol.modify_index = next(self.index)
 
+    # -- controller orchestration (nomad/csi_endpoint.go:458
+    # controllerPublishVolume; volume_watcher.go unpublish path). The
+    # server queues ops on the volume; clients hosting the controller
+    # plugin drain them via csi_controller_pending + report through
+    # csi_controller_done. --
+
+    def csi_controller_request(self, namespace: str, vol_id: str,
+                               node_id: str, op: str,
+                               readonly: bool = False) -> None:
+        vol = self._csi.get((namespace, vol_id))
+        if vol is None:
+            return
+        pending = vol.controller_pending.get(node_id)
+        if op == "publish":
+            if pending is not None and pending.get("op") == "unpublish":
+                # node re-claimed before the detach ran: convert the
+                # pending op to a (re-)publish — deleting it would race
+                # an already-executing unpublish and strand the node
+                # detached with a stale context
+                vol.controller_pending[node_id] = {"op": "publish",
+                                                   "readonly": readonly}
+                vol.controller_errors.pop(node_id, None)
+                vol.modify_index = next(self.index)
+                return
+            if node_id in vol.publish_contexts:
+                return  # already attached, nothing queued against it
+        if pending is not None and pending.get("op") == op:
+            return  # already queued
+        vol.controller_pending[node_id] = {"op": op, "readonly": readonly}
+        vol.controller_errors.pop(node_id, None)
+        vol.modify_index = next(self.index)
+
+    def csi_controller_pending(self, plugin_ids) -> List[dict]:
+        """Queued controller ops for the given plugin ids (a controller
+        host's poll)."""
+        pids = set(plugin_ids)
+        out = []
+        for vol in self._csi.values():
+            if vol.plugin_id not in pids:
+                continue
+            for node_id, ent in vol.controller_pending.items():
+                out.append({"namespace": vol.namespace, "volume_id": vol.id,
+                            "plugin_id": vol.plugin_id,
+                            "node_id": node_id, "op": ent["op"],
+                            "readonly": bool(ent.get("readonly"))})
+        return out
+
+    def csi_controller_done(self, namespace: str, vol_id: str,
+                            node_id: str, op: str,
+                            context: Optional[dict] = None,
+                            error: str = "") -> None:
+        vol = self._csi.get((namespace, vol_id))
+        if vol is None:
+            return
+        pending = vol.controller_pending.get(node_id)
+        still_wanted = pending is not None and pending.get("op") == op
+        if still_wanted:
+            del vol.controller_pending[node_id]
+        if error:
+            if still_wanted:
+                vol.controller_errors[node_id] = error
+        elif op == "publish":
+            vol.publish_contexts[node_id] = dict(context or {})
+        elif op == "unpublish" and still_wanted:
+            # a CANCELLED unpublish (pending converted back to publish)
+            # must not clear the context the re-publish is about to renew
+            vol.publish_contexts.pop(node_id, None)
+        vol.modify_index = next(self.index)
+
     def csi_plugins(self) -> List[object]:
         """Aggregate plugin health from node fingerprints (csi.go
         CSIPlugin counts)."""

@@ -79,9 +79,32 @@ def resolve_volume_asks(state, namespace: str, tg) -> list:
                 vol = lookup(namespace, req.source)
             if vol is None or not vol.schedulable:
                 out.append(("missing", req.source, req.read_only))
+            elif getattr(vol, "controller_required", False) \
+                    and not _controller_available(state, vol.plugin_id):
+                # a controller-required volume with no live controller
+                # can never attach (CSIVolumeChecker + plugin health,
+                # feasible.go:194 / csi.go ControllerRequired) — poison
+                # feasibility instead of failing at claim time
+                out.append(("missing", req.source, req.read_only))
             else:
                 out.append(("csi", vol.plugin_id, req.read_only))
     return out
+
+
+def _controller_available(state, plugin_id: str) -> bool:
+    nodes_fn = getattr(state, "nodes", None)
+    if nodes_fn is None:
+        return True  # stateless harness: assume reachable
+    for n in nodes_fn():
+        if not n.ready():
+            # a down/draining node's fingerprint lingers in state but
+            # its controller poll loop is gone — it can't drain work
+            continue
+        info = (n.csi_controller_plugins or {}).get(plugin_id)
+        if info and (not isinstance(info, dict) or info.get("healthy",
+                                                           True)):
+            return True
+    return False
 
 
 def _node_live_allocs(state: State, node_id: str) -> List[Allocation]:

@@ -196,6 +196,7 @@ FORWARDED = (
     "node_update_allocs", "node_get_client_allocs", "alloc_get", "run_gc",
     "update_alloc_health", "node_device_stats",
     "csi_volume_claim", "csi_volume_get",
+    "csi_controller_poll", "csi_controller_done",
     "update_service_registrations", "remove_service_registrations",
     "secret_upsert", "secret_delete", "secret_get",
 )

@@ -29,6 +29,7 @@ ALLOWED_OPS = frozenset({
     "upsert_acl_token", "delete_acl_token", "acl_bootstrap",
     "upsert_csi_volume", "delete_csi_volume",
     "csi_volume_claim", "csi_volume_release",
+    "csi_controller_request", "csi_controller_done",
     "upsert_service_registrations",
     "delete_service_registrations_by_alloc",
     "upsert_secret", "delete_secret",

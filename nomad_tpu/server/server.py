@@ -765,13 +765,51 @@ class Server:
 
     def csi_volume_claim(self, namespace: str, vol_id: str, alloc_id: str,
                          mode: str) -> bool:
-        """Client claims a volume for an alloc (CSIVolume.Claim RPC)."""
-        return self.state.csi_volume_claim(namespace, vol_id, alloc_id,
-                                           mode)
+        """Client claims a volume for an alloc (CSIVolume.Claim RPC).
+
+        Controller-required volumes additionally get a ControllerPublish
+        queued for the alloc's node (csi_endpoint.go:458
+        controllerPublishVolume) — a controller host drains it via
+        csi_controller_poll and the claiming client waits for the node's
+        publish context before staging."""
+        ok = self.state.csi_volume_claim(namespace, vol_id, alloc_id, mode)
+        if not ok:
+            return False
+        vol = self.state.csi_volume(namespace, vol_id)
+        if vol is not None and vol.controller_required:
+            alloc = self.state.alloc_by_id(alloc_id)
+            node_id = alloc.node_id if alloc is not None else ""
+            if node_id:
+                # requested unconditionally: the state op is what knows
+                # whether the node is attached, queued, or has a pending
+                # DETACH that this claim must cancel
+                # positional: the durable/raft store wrappers journal
+                # positional args only
+                self.state.csi_controller_request(
+                    namespace, vol_id, node_id, "publish", mode == "read")
+        return True
 
     def csi_volume_get(self, namespace: str, vol_id: str):
         """Client fetches a volume for the mount path (CSIVolume.Get)."""
         return self.state.csi_volume(namespace, vol_id)
+
+    def csi_controller_poll(self, node_id: str):
+        """Queued controller ops for the controller plugins this node
+        hosts (the pull analog of ClientCSI.ControllerAttachVolume —
+        clients poll for work instead of the server dialing them)."""
+        node = self.state.node_by_id(node_id)
+        pids = list((node.csi_controller_plugins or {}).keys()) \
+            if node is not None else []
+        if not pids:
+            return []
+        return self.state.csi_controller_pending(pids)
+
+    def csi_controller_done(self, namespace: str, vol_id: str,
+                            node_id: str, op: str, context=None,
+                            error: str = "") -> None:
+        """A controller host reports a publish/unpublish result."""
+        self.state.csi_controller_done(namespace, vol_id, node_id, op,
+                                       context, error)
 
     # ---- scaling (nomad/job_endpoint.go:969 Scale + scaling policies) ----
 

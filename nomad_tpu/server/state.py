@@ -143,6 +143,9 @@ class StateStore(InMemState):
     csi_volume_release = _locked("csi_volume_release")
     csi_volumes = _locked("csi_volumes")
     csi_plugins = _locked("csi_plugins")
+    csi_controller_request = _locked("csi_controller_request")
+    csi_controller_pending = _locked("csi_controller_pending")
+    csi_controller_done = _locked("csi_controller_done")
     # Iterating reads must hold the lock too — the table dicts mutate in place.
     nodes = _locked("nodes")
     jobs = _locked("jobs")

@@ -45,10 +45,21 @@ class VolumeWatcher:
     def tick(self) -> None:
         state = self.server.state
         for vol in state.csi_volumes():
-            if not vol.in_use():
-                continue
             for alloc_id in list(vol.read_claims) + list(vol.write_claims):
                 alloc = state.alloc_by_id(alloc_id)
                 if alloc is None or alloc.terminal_status():
                     state.csi_volume_release(vol.namespace, vol.id,
                                              alloc_id)
+            if vol.controller_required and vol.publish_contexts:
+                # detach nodes that no live claim needs anymore
+                # (volume_watcher.go:249 → ControllerUnpublishVolume)
+                claimed_nodes = set()
+                for alloc_id in (list(vol.read_claims)
+                                 + list(vol.write_claims)):
+                    a = state.alloc_by_id(alloc_id)
+                    if a is not None and not a.terminal_status():
+                        claimed_nodes.add(a.node_id)
+                for node_id in list(vol.publish_contexts):
+                    if node_id not in claimed_nodes:
+                        state.csi_controller_request(
+                            vol.namespace, vol.id, node_id, "unpublish")

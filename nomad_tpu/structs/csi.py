@@ -40,6 +40,21 @@ class CSIVolume:
     read_claims: Dict[str, bool] = field(default_factory=dict)
     write_claims: Dict[str, bool] = field(default_factory=dict)
     schedulable: bool = True
+    #: volume needs a controller attach before node staging (csi.go
+    #: ControllerRequired — every real remote volume). The server
+    #: orchestrates ControllerPublish through the claim flow; node
+    #: staging waits for the node's publish context.
+    controller_required: bool = False
+    #: node_id → context returned by ControllerPublishVolume, consumed
+    #: by NodeStageVolume (csi.go PublishContext)
+    publish_contexts: Dict[str, dict] = field(default_factory=dict)
+    #: node_id → queued controller op ("publish" | "unpublish"); drained
+    #: by clients hosting the controller plugin (client-polled analog of
+    #: the reference's server→client ClientCSI.ControllerAttachVolume
+    #: RPC, nomad/csi_endpoint.go:458 — this build's clients pull work)
+    controller_pending: Dict[str, str] = field(default_factory=dict)
+    #: last controller error per node (operator visibility)
+    controller_errors: Dict[str, str] = field(default_factory=dict)
     create_index: int = 0
     modify_index: int = 0
 

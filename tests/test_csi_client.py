@@ -123,3 +123,153 @@ class TestCsiMountPath:
             for al in api.job_allocations(job.id)))
         alloc = api.job_allocations(job.id)[0]
         assert b"from-host" in api.alloc_logs(alloc.id, "web")
+
+
+class TestCsiControllerPath:
+    """Round-5 VERDICT #3: the controller attach/publish leg
+    (nomad/csi_endpoint.go:458 ControllerAttachVolume,
+    plugins/csi/plugin.go:38 ControllerPublishVolume; here a
+    client-polled controller work queue — server/server.py
+    csi_controller_poll)."""
+
+    def test_unit_controller_publish_context(self, tmp_path):
+        from nomad_tpu.client.csi import (CsiManager,
+                                          HostPathCsiControllerPlugin,
+                                          HostPathCsiPlugin)
+
+        root = str(tmp_path / "backing")
+        ctrl = HostPathCsiControllerPlugin("hp", root)
+        ctx = ctrl.controller_publish_volume("v1", "node-a")
+        assert os.path.isdir(ctx["device_path"])
+        assert ctrl.attached_nodes("v1") == {"node-a"}
+        mgr = CsiManager(str(tmp_path / "csi"))
+        mgr.register(HostPathCsiPlugin("hp", root))
+        p = mgr.mount_volume("hp", "v1", "alloc-a", publish_context=ctx)
+        # the node mount is backed by the controller-surfaced device
+        assert os.path.realpath(p) == os.path.realpath(ctx["device_path"])
+        ctrl.controller_unpublish_volume("v1", "node-a")
+        assert ctrl.attached_nodes("v1") == set()
+
+    def test_e2e_controller_volume_attach_detach(self, agent):
+        """register (controller_required) → schedule → controller
+        publishes for the alloc's node → node stages from the publish
+        context → task writes through the mount → claims released →
+        controller unpublishes the node."""
+        a, api = agent
+        vol = CSIVolume(id="cvol", name="cvol", plugin_id="hostpath",
+                        controller_required=True)
+        api.csi_volume_register(vol)
+
+        job = csi_job("echo via-controller > data/out.txt",
+                      vol_source="cvol")
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(job.id)))
+
+        node_id = a.client.node.id
+        got = a.server.state.csi_volume("default", "cvol")
+        # the controller attached THIS node and the context was recorded
+        assert node_id in got.publish_contexts, got.publish_contexts
+        ctrl = a.client.csi.controllers["hostpath"]
+        assert node_id in ctrl.attached_nodes("cvol")
+        # the write went through the controller-surfaced device
+        device = got.publish_contexts[node_id]["device_path"]
+        assert open(os.path.join(device, "out.txt")).read().strip() \
+            == "via-controller"
+
+        # volumewatcher: terminal alloc -> claims released -> unpublish
+        assert _wait(lambda: not a.server.state.csi_volume(
+            "default", "cvol").in_use())
+        assert _wait(lambda: node_id not in a.server.state.csi_volume(
+            "default", "cvol").publish_contexts)
+        assert _wait(lambda: ctrl.attached_nodes("cvol") == set())
+
+    def test_controller_error_fails_alloc(self, agent, monkeypatch):
+        """A failing controller publish surfaces as an alloc failure,
+        not a silent unattached mount."""
+        from nomad_tpu.client.csi import HostPathCsiControllerPlugin
+
+        a, api = agent
+
+        def boom(self, volume_id, node_id, readonly=False):
+            raise RuntimeError("backend rejected attach")
+
+        monkeypatch.setattr(HostPathCsiControllerPlugin,
+                            "controller_publish_volume", boom)
+        vol = CSIVolume(id="badvol", name="badvol", plugin_id="hostpath",
+                        controller_required=True)
+        api.csi_volume_register(vol)
+        job = csi_job("true", vol_source="badvol")
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "failed"
+            for al in api.job_allocations(job.id)))
+        got = a.server.state.csi_volume("default", "badvol")
+        assert "backend rejected attach" in str(
+            got.controller_errors.values())
+
+
+class TestControllerRaces:
+    """State-level controller-queue edge cases (round-5 advisor)."""
+
+    def _server_with_vol(self, tmp_path):
+        from nomad_tpu.server import Server, ServerConfig
+
+        s = Server(ServerConfig(num_schedulers=0, heartbeat_ttl=3600.0))
+        n = mock.node()
+        n.csi_controller_plugins = {"hostpath": {"healthy": True}}
+        s.state.upsert_node(n)
+        vol = CSIVolume(id="v", plugin_id="hostpath",
+                        controller_required=True,
+                        access_mode="multi-node-multi-writer")
+        s.state.upsert_csi_volume(vol)
+        return s, n, vol
+
+    def test_reclaim_cancels_pending_unpublish(self, tmp_path):
+        s, n, vol = self._server_with_vol(tmp_path)
+        alloc = mock.alloc()
+        alloc.node_id = n.id
+        s.state.upsert_alloc(alloc)
+        # attached, then the watcher queued a detach
+        vol.publish_contexts[n.id] = {"device_path": "/dev/x"}
+        s.state.csi_controller_request("default", "v", n.id, "unpublish")
+        # a replacement alloc claims before the detach runs: the pending
+        # op must flip to publish, not be left to wipe the context
+        assert s.csi_volume_claim("default", "v", alloc.id, "write")
+        got = s.state.csi_volume("default", "v")
+        assert got.controller_pending[n.id]["op"] == "publish"
+        # the in-flight unpublish result lands late: context survives
+        s.state.csi_controller_done("default", "v", n.id, "unpublish")
+        assert n.id in got.publish_contexts
+        # the re-publish renews it
+        s.state.csi_controller_done("default", "v", n.id, "publish",
+                                    {"device_path": "/dev/y"})
+        assert got.publish_contexts[n.id]["device_path"] == "/dev/y"
+        assert n.id not in got.controller_pending
+
+    def test_readonly_claim_rides_to_controller(self, tmp_path):
+        s, n, vol = self._server_with_vol(tmp_path)
+        alloc = mock.alloc()
+        alloc.node_id = n.id
+        s.state.upsert_alloc(alloc)
+        assert s.csi_volume_claim("default", "v", alloc.id, "read")
+        ops = s.csi_controller_poll(n.id)
+        assert ops and ops[0]["op"] == "publish"
+        assert ops[0]["readonly"] is True
+
+    def test_down_controller_host_poisons_feasibility(self, tmp_path):
+        from nomad_tpu.scheduler.util import resolve_volume_asks
+        from nomad_tpu.structs.job import VolumeRequest
+        from nomad_tpu.structs.node import NODE_STATUS_DOWN
+
+        s, n, vol = self._server_with_vol(tmp_path)
+        tg = mock.job().task_groups[0]
+        tg.volumes = {"data": VolumeRequest(name="data", type="csi",
+                                            source="v")}
+        asks = resolve_volume_asks(s.state, "default", tg)
+        assert asks == [("csi", "hostpath", False)]
+        n.status = NODE_STATUS_DOWN
+        s.state.upsert_node(n)
+        asks = resolve_volume_asks(s.state, "default", tg)
+        assert asks == [("missing", "v", False)]
