@@ -209,6 +209,7 @@ class Agent:
                 heartbeat_ttl=self.config.heartbeat_ttl,
                 data_dir=self.config.data_dir,
                 acl_enabled=self.config.acl_enabled,
+                mesh="env",
             ))
         if self.config.client:
             from ..client import Client, ClientConfig, InProcConn, RpcConn

@@ -852,8 +852,7 @@ def cmd_volume(args) -> int:
             attachment_mode=str(body.get("attachment_mode",
                                          "file-system")),
             controller_required=bool(body.get("controller_required",
-                                              body.get("external_id",
-                                                       False))))
+                                              False)))
         if not vol.id or not vol.plugin_id:
             print("Error: volume spec needs id and plugin_id",
                   file=sys.stderr)

@@ -337,7 +337,11 @@ class AllocRunner:
                 # here the client polls the volume for it)
                 publish_context = None
                 if vol.controller_required:
-                    deadline = time.time() + 15.0
+                    # deadline must exceed the controller-op lease
+                    # (harness.CONTROLLER_LEASE_S = 15s) + poll backoff +
+                    # execution, or crash failover to a second controller
+                    # host could never complete before the alloc fails
+                    deadline = time.time() + 45.0
                     while time.time() < deadline:
                         if self._halted():
                             raise _AllocHalted()
@@ -345,6 +349,15 @@ class AllocRunner:
                             self.alloc.namespace, req.source)
                         publish_context = (fresh.publish_contexts or {}) \
                             .get(self.alloc.node_id) if fresh else None
+                        if publish_context is not None and self.alloc \
+                                .node_id in (fresh.controller_pending
+                                             or {}):
+                            # a context exists but an op for this node is
+                            # still queued/executing (e.g. an unpublish
+                            # converted to re-publish): the context may
+                            # be about to be invalidated — wait for the
+                            # op to resolve rather than mount from it
+                            publish_context = None
                         if publish_context is not None:
                             break
                         err = (fresh.controller_errors or {}).get(

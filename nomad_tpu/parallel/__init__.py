@@ -3,10 +3,13 @@ the placement kernels (SURVEY.md §2.7/§2.8 — the node axis is this domain's
 sequence axis; evals are the batch axis)."""
 from .mesh import (
     cluster_sharding,
+    get_active_mesh,
     make_mesh,
+    mesh_from_env,
     params_sharding,
     place_batch_sharded,
     scheduler_step,
+    set_active_mesh,
     shard_cluster,
     stack_params,
 )
@@ -19,4 +22,7 @@ __all__ = [
     "stack_params",
     "place_batch_sharded",
     "scheduler_step",
+    "set_active_mesh",
+    "get_active_mesh",
+    "mesh_from_env",
 ]

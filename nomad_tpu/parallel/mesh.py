@@ -31,6 +31,52 @@ from ..utils import bucket as _bucket, widen_lut as _widen_v
 BATCH_AXIS = "batch"
 NODE_AXIS = "nodes"
 
+#: process-wide mesh the LIVE control plane shards cluster uploads over
+#: (None = single-device dispatch). Set by Server from config/env; read by
+#: TPUStack.device_arrays so the code the workers run is the code the
+#: multichip dryrun proves (SURVEY §2.7).
+#:
+#: Deliberately a process singleton rather than a per-Server field: the
+#: dispatch layer (TPUStack) is constructed per-eval from snapshots that
+#: carry no server reference, and the devices being meshed are a process
+#: resource anyway — two servers in one process sharding differently
+#: over the same chips has no sensible semantics. A mesh-owning Server
+#: uninstalls its mesh on shutdown (server.py); servers with mesh=None
+#: never touch the global.
+_ACTIVE_MESH: Optional[Mesh] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh]) -> None:
+    """Install (or clear, with None) the control plane's device mesh."""
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def get_active_mesh() -> Optional[Mesh]:
+    return _ACTIVE_MESH
+
+
+def mesh_from_env() -> Optional[Mesh]:
+    """Build a mesh from NOMAD_TPU_MESH: unset/"0"/"1" → None (single
+    device), "auto" → all visible devices, an integer → that many."""
+    import os
+
+    spec = os.environ.get("NOMAD_TPU_MESH", "").strip().lower()
+    if spec in ("", "0", "1", "off", "none"):
+        return None
+    if spec == "auto":
+        n = None
+    else:
+        try:
+            n = int(spec)
+        except ValueError:
+            raise ValueError(
+                f"NOMAD_TPU_MESH={spec!r}: must be an integer device "
+                f"count, 'auto', or unset/'off'") from None
+    if n is not None and n <= 1:
+        return None
+    return make_mesh(n)
+
 # TGParams no longer carries node-width per-eval vectors: job counts ship
 # sparse (jc_idx/jc_val) and the host-check mask is width-1 when trivial.
 # Params are therefore replicated across the node ring; only the cluster

@@ -494,9 +494,15 @@ class InMemState:
                 # node re-claimed before the detach ran: convert the
                 # pending op to a (re-)publish — deleting it would race
                 # an already-executing unpublish and strand the node
-                # detached with a stale context
-                vol.controller_pending[node_id] = {"op": "publish",
-                                                   "readonly": readonly}
+                # detached with a stale context. The lease (if any)
+                # carries over: the client executing the unpublish must
+                # report done before the publish is handed out, keeping
+                # controller ops serial per (volume, node).
+                new = {"op": "publish", "readonly": readonly}
+                for k in ("lease", "lease_ts"):
+                    if k in pending:
+                        new[k] = pending[k]
+                vol.controller_pending[node_id] = new
                 vol.controller_errors.pop(node_id, None)
                 vol.modify_index = next(self.index)
                 return
@@ -504,19 +510,49 @@ class InMemState:
                 return  # already attached, nothing queued against it
         if pending is not None and pending.get("op") == op:
             return  # already queued
-        vol.controller_pending[node_id] = {"op": op, "readonly": readonly}
+        new = {"op": op, "readonly": readonly}
+        if pending is not None:
+            # overwriting a queued op (publish→unpublish when the claim
+            # vanished): keep the lease so an executing host finishes
+            # and reports before the successor op is handed out
+            for k in ("lease", "lease_ts"):
+                if k in pending:
+                    new[k] = pending[k]
+        vol.controller_pending[node_id] = new
         vol.controller_errors.pop(node_id, None)
         vol.modify_index = next(self.index)
 
-    def csi_controller_pending(self, plugin_ids) -> List[dict]:
+    #: how long one controller host owns a handed-out op before another
+    #: poller may retry it (the host crashed or wedged mid-op)
+    CONTROLLER_LEASE_S = 15.0
+
+    def csi_controller_pending(self, plugin_ids,
+                               lessee: Optional[str] = None) -> List[dict]:
         """Queued controller ops for the given plugin ids (a controller
-        host's poll)."""
+        host's poll). Ops are LEASED to the polling node: with several
+        clients hosting the same controller plugin, exactly one executes
+        a given op at a time — a second host only inherits it after the
+        lease expires (crash recovery). Leases are ephemeral coordination
+        state (not replicated/persisted): after a server restart ops are
+        simply handed out afresh."""
+        import time as _time
+
         pids = set(plugin_ids)
+        now = _time.time()
         out = []
         for vol in self._csi.values():
             if vol.plugin_id not in pids:
                 continue
             for node_id, ent in vol.controller_pending.items():
+                lease = ent.get("lease")
+                if (lessee is not None and lease is not None
+                        and lease != lessee
+                        and ent.get("lease_ts", 0.0)
+                        + self.CONTROLLER_LEASE_S > now):
+                    continue  # another host is executing this op
+                if lessee is not None:
+                    ent["lease"] = lessee
+                    ent["lease_ts"] = now
                 out.append({"namespace": vol.namespace, "volume_id": vol.id,
                             "plugin_id": vol.plugin_id,
                             "node_id": node_id, "op": ent["op"],
@@ -534,14 +570,28 @@ class InMemState:
         still_wanted = pending is not None and pending.get("op") == op
         if still_wanted:
             del vol.controller_pending[node_id]
+        elif pending is not None:
+            # the op was converted (unpublish → publish) while this one
+            # executed: release the lease so the successor op can be
+            # handed out on the next poll
+            pending.pop("lease", None)
+            pending.pop("lease_ts", None)
         if error:
             if still_wanted:
                 vol.controller_errors[node_id] = error
-        elif op == "publish":
+        elif op == "publish" and pending is not None:
+            # pending None = this result is STALE (a lease-expired host
+            # finally finished after the op was superseded and resolved)
+            # — reinstalling a context for a possibly-detached node would
+            # let a waiter mount from a dead device. pending='unpublish'
+            # is fine: the attach ran, and the queued detach will pop it.
             vol.publish_contexts[node_id] = dict(context or {})
-        elif op == "unpublish" and still_wanted:
-            # a CANCELLED unpublish (pending converted back to publish)
-            # must not clear the context the re-publish is about to renew
+        elif op == "unpublish" and (still_wanted or pending is not None):
+            # the detach DID run: drop the context so a converted
+            # re-publish repopulates it before any waiter mounts from it.
+            # When pending is None the op was superseded by an already-
+            # COMPLETED publish (lease-expiry corner) — keep that fresh
+            # context.
             vol.publish_contexts.pop(node_id, None)
         vol.modify_index = next(self.index)
 

@@ -100,8 +100,25 @@ class TPUStack:
         over a tunnel dwarfed the kernel itself. Static tensors re-upload
         only when nodes/attrs change (node_version + shape), the port
         bitmap only when a port flips (ports_version), and only the small
-        hot tensors (used/node_ok/dyn_free) go up per state version."""
+        hot tensors (used/node_ok/dyn_free) go up per state version.
+
+        When a control-plane mesh is active (parallel/mesh.py
+        set_active_mesh), every upload is committed with the node axis
+        split over the mesh's node ring — the SAME sharded dispatch the
+        multichip dryrun compiles, now on the live worker path."""
+        import jax
         import jax.numpy as jnp
+
+        from ..parallel.mesh import cluster_sharding, get_active_mesh
+
+        mesh = get_active_mesh()
+        if mesh is not None:
+            sh = cluster_sharding(mesh)
+            up = lambda a, s, dtype=None: jax.device_put(  # noqa: E731
+                np.asarray(a, dtype=dtype) if dtype else np.asarray(a), s)
+        else:
+            sh = ClusterArrays(*([None] * len(ClusterArrays._fields)))
+            up = lambda a, s, dtype=None: jnp.asarray(a, dtype=dtype)  # noqa: E731
 
         cl = self.cluster
         with _DEV_CACHE_LOCK:
@@ -109,27 +126,28 @@ class TPUStack:
             # mid-upload must make the stored entry look stale (next
             # caller re-uploads), never current with old data
             version = cl.version
-            static_key = (cl.node_version, cl.n_cap, cl.k_cap)
-            ports_key = (cl.ports_version, cl.n_cap)
+            static_key = (cl.node_version, cl.n_cap, cl.k_cap, mesh)
+            ports_key = (cl.ports_version, cl.n_cap, mesh)
             ent = _DEV_CACHE.get(cl)
-            if ent is not None and ent["version"] == version:
+            if ent is not None and ent["version"] == version \
+                    and ent["static_key"] == static_key:
                 return ent["arrays"]
             if ent is not None and ent["static_key"] == static_key:
                 capacity, attrs = ent["capacity"], ent["attrs"]
             else:
-                capacity = jnp.asarray(cl.capacity)
-                attrs = jnp.asarray(cl.attrs)
+                capacity = up(cl.capacity, sh.capacity)
+                attrs = up(cl.attrs, sh.attrs)
             if ent is not None and ent["ports_key"] == ports_key:
                 ports_used = ent["ports_used"]
             else:
-                ports_used = jnp.asarray(cl.ports_used)
+                ports_used = up(cl.ports_used, sh.ports_used)
             arrays = ClusterArrays(
                 capacity=capacity,
-                used=jnp.asarray(cl.used, dtype=jnp.float32),
-                node_ok=jnp.asarray(cl.node_ok),
+                used=up(cl.used, sh.used, dtype=np.float32),
+                node_ok=up(cl.node_ok, sh.node_ok),
                 attrs=attrs,
                 ports_used=ports_used,
-                dyn_free=jnp.asarray(cl.dyn_free),
+                dyn_free=up(cl.dyn_free, sh.dyn_free),
             )
             _DEV_CACHE[cl] = {
                 "version": version, "arrays": arrays,

@@ -95,6 +95,22 @@ def _controller_available(state, plugin_id: str) -> bool:
     nodes_fn = getattr(state, "nodes", None)
     if nodes_fn is None:
         return True  # stateless harness: assume reachable
+    # memoized per immutable snapshot (same discipline as
+    # _node_live_allocs below) — this is the scheduler hot path and the
+    # scan is O(all nodes) under the state lock
+    memo = None
+    if hasattr(state, "index_at") and not getattr(state, "_detached", False):
+        memo = state.__dict__.setdefault("_ctrl_avail_memo", {})
+        got = memo.get(plugin_id)
+        if got is not None:
+            return got
+    out = _controller_available_scan(nodes_fn, plugin_id)
+    if memo is not None:
+        memo[plugin_id] = out
+    return out
+
+
+def _controller_available_scan(nodes_fn, plugin_id: str) -> bool:
     for n in nodes_fn():
         if not n.ready():
             # a down/draining node's fingerprint lingers in state but

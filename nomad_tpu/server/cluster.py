@@ -224,6 +224,7 @@ class ClusterServer:
             heartbeat_ttl=config.heartbeat_ttl,
             nack_timeout=config.nack_timeout,
             gc_interval=config.gc_interval, gc=config.gc,
+            mesh="env",
         )
         self.state = state
         self.server = self._new_server(srv_cfg, state)

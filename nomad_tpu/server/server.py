@@ -46,7 +46,8 @@ class ServerConfig:
                  nack_timeout: float = 60.0, gc_interval: float = 60.0,
                  gc=None, data_dir: Optional[str] = None,
                  fsync: bool = False, snapshot_threshold: int = 8192,
-                 acl_enabled: bool = False, eval_batch: int = 16):
+                 acl_enabled: bool = False, eval_batch: int = 16,
+                 mesh=None):
         self.num_schedulers = num_schedulers
         self.heartbeat_ttl = heartbeat_ttl
         self.nack_timeout = nack_timeout
@@ -59,12 +60,29 @@ class ServerConfig:
         #: max evals one worker drains into a fused-select batch
         #: (worker.py process_batch); 1 disables batching
         self.eval_batch = eval_batch
+        #: jax.sharding.Mesh the workers shard cluster uploads over
+        #: ("env" → build from NOMAD_TPU_MESH; None → single device)
+        self.mesh = mesh
 
 
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None,
                  state: Optional[StateStore] = None) -> None:
         self.config = config or ServerConfig()
+        # Control-plane device mesh: sharded cluster uploads on the live
+        # worker path (SURVEY §2.7; the dryrun proves this same path).
+        # Installed process-wide — the kernel dispatch layer (TPUStack)
+        # is below the Server and sees it via get_active_mesh().
+        mesh = self.config.mesh
+        if mesh == "env":
+            from ..parallel.mesh import mesh_from_env
+
+            mesh = mesh_from_env()
+        self._installed_mesh = mesh
+        if mesh is not None:
+            from ..parallel.mesh import set_active_mesh
+
+            set_active_mesh(mesh)
         # Serializes quota admission (check-then-act) against the job
         # upsert: the HTTP layer is a ThreadingHTTPServer, so two
         # concurrent registers could otherwise both pass _enforce_quota
@@ -197,6 +215,14 @@ class Server:
         wal = getattr(self.state, "wal", None)
         if wal is not None:
             wal.close()
+        if self._installed_mesh is not None:
+            # uninstall the process-global mesh this server set up —
+            # but only if a newer server hasn't replaced it meanwhile
+            from ..parallel.mesh import get_active_mesh, set_active_mesh
+
+            if get_active_mesh() is self._installed_mesh:
+                set_active_mesh(None)
+            self._installed_mesh = None
 
     # ---- core GC (leader.go schedulePeriodic + core_sched.go) ----
 
@@ -802,7 +828,7 @@ class Server:
             if node is not None else []
         if not pids:
             return []
-        return self.state.csi_controller_pending(pids)
+        return self.state.csi_controller_pending(pids, lessee=node_id)
 
     def csi_controller_done(self, namespace: str, vol_id: str,
                             node_id: str, op: str, context=None,

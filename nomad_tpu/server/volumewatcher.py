@@ -60,6 +60,15 @@ class VolumeWatcher:
                     if a is not None and not a.terminal_status():
                         claimed_nodes.add(a.node_id)
                 for node_id in list(vol.publish_contexts):
-                    if node_id not in claimed_nodes:
-                        state.csi_controller_request(
-                            vol.namespace, vol.id, node_id, "unpublish")
+                    if node_id in claimed_nodes:
+                        continue
+                    ent = vol.controller_pending.get(node_id)
+                    if ent is not None and ent.get("op") == "unpublish":
+                        # already queued: re-requesting would be a no-op
+                        # in the harness but the durable/raft stores
+                        # journal EVERY csi_controller_request — at a
+                        # 0.1s tick that's WAL churn forcing snapshot
+                        # rewrites while a controller host is down
+                        continue
+                    state.csi_controller_request(
+                        vol.namespace, vol.id, node_id, "unpublish")

@@ -48,11 +48,12 @@ class CSIVolume:
     #: node_id → context returned by ControllerPublishVolume, consumed
     #: by NodeStageVolume (csi.go PublishContext)
     publish_contexts: Dict[str, dict] = field(default_factory=dict)
-    #: node_id → queued controller op ("publish" | "unpublish"); drained
-    #: by clients hosting the controller plugin (client-polled analog of
+    #: node_id → queued controller op entry {"op": "publish"|"unpublish",
+    #: "readonly": bool, + ephemeral "lease"/"lease_ts"}; drained by
+    #: clients hosting the controller plugin (client-polled analog of
     #: the reference's server→client ClientCSI.ControllerAttachVolume
     #: RPC, nomad/csi_endpoint.go:458 — this build's clients pull work)
-    controller_pending: Dict[str, str] = field(default_factory=dict)
+    controller_pending: Dict[str, dict] = field(default_factory=dict)
     #: last controller error per node (operator visibility)
     controller_errors: Dict[str, str] = field(default_factory=dict)
     create_index: int = 0

@@ -239,13 +239,45 @@ class TestControllerRaces:
         assert s.csi_volume_claim("default", "v", alloc.id, "write")
         got = s.state.csi_volume("default", "v")
         assert got.controller_pending[n.id]["op"] == "publish"
-        # the in-flight unpublish result lands late: context survives
+        # the in-flight unpublish result lands late: the detach DID run,
+        # so the now-stale context is dropped — a waiter must block until
+        # the re-publish lands rather than mount from a detached device
         s.state.csi_controller_done("default", "v", n.id, "unpublish")
-        assert n.id in got.publish_contexts
-        # the re-publish renews it
+        assert n.id not in got.publish_contexts
+        # ...and the converted publish op is still queued to renew it
+        assert got.controller_pending[n.id]["op"] == "publish"
         s.state.csi_controller_done("default", "v", n.id, "publish",
                                     {"device_path": "/dev/y"})
         assert got.publish_contexts[n.id]["device_path"] == "/dev/y"
+        assert n.id not in got.controller_pending
+
+    def test_controller_op_leased_to_one_host(self, tmp_path):
+        """Two clients hosting the same controller plugin must not both
+        execute one op: the first poll leases it, the second host only
+        inherits after lease expiry (crash recovery)."""
+        s, n, vol = self._server_with_vol(tmp_path)
+        n2 = mock.node()
+        n2.csi_controller_plugins = {"hostpath": {"healthy": True}}
+        s.state.upsert_node(n2)
+        alloc = mock.alloc()
+        alloc.node_id = n.id
+        s.state.upsert_alloc(alloc)
+        assert s.csi_volume_claim("default", "v", alloc.id, "write")
+        ops1 = s.csi_controller_poll(n.id)
+        assert len(ops1) == 1 and ops1[0]["op"] == "publish"
+        # second host polls while the lease is live: nothing handed out
+        assert s.csi_controller_poll(n2.id) == []
+        # the lessee itself may re-poll (retry after transient failure)
+        assert len(s.csi_controller_poll(n.id)) == 1
+        # lease expiry hands the op to the second host
+        got = s.state.csi_volume("default", "v")
+        got.controller_pending[n.id]["lease_ts"] -= 60.0
+        ops2 = s.csi_controller_poll(n2.id)
+        assert len(ops2) == 1 and ops2[0]["op"] == "publish"
+        # ...after which the first host is locked out until THAT expires
+        assert s.csi_controller_poll(n.id) == []
+        s.csi_controller_done("default", "v", n.id, "publish",
+                              {"device_path": "/dev/x"})
         assert n.id not in got.controller_pending
 
     def test_readonly_claim_rides_to_controller(self, tmp_path):

@@ -161,3 +161,52 @@ def test_sharded_matches_single_device_at_bench_scale(bench_scale_problem):
     )
     placed = int((np.asarray(single.sel_idx) >= 0).sum())
     assert placed == len(params) * 4  # everything placed at this scale
+
+
+class TestServerPathMesh:
+    """VERDICT r4 #6: the code the control plane runs must be the code the
+    multichip dryrun proves — a Server with an active mesh shards its
+    cluster uploads (TPUStack.device_arrays) and its workers' fused chain
+    dispatches run partitioned over the node ring."""
+
+    def _run_server(self, mesh, eval_batch=8, n_jobs=10, seed=11):
+        from nomad_tpu.parallel import get_active_mesh, set_active_mesh
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.synth import synth_node
+
+        rng = random.Random(seed)
+        s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
+                                eval_batch=eval_batch, mesh=mesh))
+        try:
+            assert get_active_mesh() is mesh
+            for i in range(32):
+                s.state.upsert_node(synth_node(rng, i))
+            jobs = [synth_service_job(rng, count=2) for _ in range(n_jobs)]
+            # deep queue before workers start so the batch path engages
+            evs = [s.job_register(j) for j in jobs]
+            s.start()
+            for ev in evs:
+                got = s.wait_for_eval(
+                    ev.id, statuses=("complete", "failed", "blocked",
+                                     "cancelled"), timeout=120.0)
+                assert got is not None and got.status == "complete", got
+            node_names = {nid: nd.name for nid, nd in s.state._nodes.items()}
+            placements = {}
+            for ji, j in enumerate(jobs):
+                for a in s.state.allocs_by_job("default", j.id):
+                    placements[(ji, a.name.rsplit("[", 1)[1])] = \
+                        node_names.get(a.node_id, a.node_id)
+            wstats = dict(s.workers[0].batch_stats) if s.workers else {}
+        finally:
+            s.shutdown()
+            set_active_mesh(None)
+        return placements, wstats
+
+    def test_server_sharded_equals_single_device(self):
+        base, _ = self._run_server(mesh=None)
+        meshed, wstats = self._run_server(mesh=make_mesh(8))
+        assert base and set(base) == set(meshed)
+        diffs = {k for k in base if base[k] != meshed[k]}
+        assert not diffs, f"{len(diffs)} placements differ: {sorted(diffs)[:5]}"
+        # the fused-chain path actually ran under the mesh
+        assert wstats.get("batched", 0) > 0, wstats
