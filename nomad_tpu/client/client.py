@@ -73,9 +73,9 @@ class InProcConn:
         return self.server.csi_controller_poll(node_id)
 
     def csi_controller_done(self, namespace, vol_id, node_id, op,
-                            context=None, error=""):
+                            context=None, error="", reporter=""):
         return self.server.csi_controller_done(namespace, vol_id, node_id,
-                                               op, context, error)
+                                               op, context, error, reporter)
 
     def update_service_registrations(self, regs):
         return self.server.update_service_registrations(regs)
@@ -156,9 +156,9 @@ class RpcConn:
         return self._call("csi_controller_poll", node_id)
 
     def csi_controller_done(self, namespace, vol_id, node_id, op,
-                            context=None, error=""):
+                            context=None, error="", reporter=""):
         return self._call("csi_controller_done", namespace, vol_id,
-                          node_id, op, context, error)
+                          node_id, op, context, error, reporter)
 
     def update_service_registrations(self, regs):
         return self._call("update_service_registrations", regs)
@@ -206,9 +206,11 @@ class Client:
 
         self.driver_manager = DriverManager(
             on_attrs=self._driver_attrs_changed,
-            plugin_config=self.config.plugin_config)
+            plugin_config=self.config.plugin_config,
+            state_dir=os.path.join(self.data_dir, "plugins"))
         self.device_manager = DeviceManager(
-            on_devices=self._devices_changed)
+            on_devices=self._devices_changed,
+            state_dir=os.path.join(self.data_dir, "plugins"))
         from .network import NetworkManager
 
         # bridge-mode alloc networking (degrades to host networking
@@ -474,15 +476,18 @@ class Client:
                             vol_id, node_id,
                             readonly=bool(op.get("readonly"))) or {}
                         self.conn.csi_controller_done(
-                            ns, vol_id, node_id, "publish", ctx, "")
+                            ns, vol_id, node_id, "publish", ctx, "",
+                            self.node.id)
                     elif kind == "unpublish":
                         plugin.controller_unpublish_volume(vol_id, node_id)
                         self.conn.csi_controller_done(
-                            ns, vol_id, node_id, "unpublish", None, "")
+                            ns, vol_id, node_id, "unpublish", None, "",
+                            self.node.id)
                 except Exception as e:  # noqa: BLE001 — report, don't die
                     try:
                         self.conn.csi_controller_done(
-                            ns, vol_id, node_id, kind, None, str(e))
+                            ns, vol_id, node_id, kind, None, str(e),
+                            self.node.id)
                     except Exception:
                         pass
 

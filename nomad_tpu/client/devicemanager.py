@@ -174,6 +174,97 @@ class EnvDevicePlugin(DevicePlugin):
         return out
 
 
+class RemoteDevicePlugin(DevicePlugin):
+    """Proxy running a device plugin in its own process
+    (plugins/device_host.py over the plugins/base.py transport — the
+    `plugins/device/device.go` per-process model). Supervised: any RPC
+    failure relaunches the host; a crashing probe (e.g. a wedged
+    accelerator tunnel taking the process down) costs a plugin restart,
+    never the agent. While the host is down, fingerprint() degrades the
+    same way TpuDevicePlugin does on probe failure: last-seen devices
+    flip unhealthy instead of vanishing."""
+
+    def __init__(self, name: str, state_dir: str = "") -> None:
+        self.name = name
+        self.state_dir = state_dir
+        self._client = None
+        self._lock = threading.Lock()
+        self._closed = False
+        self._seen: List[NodeDeviceResource] = []
+
+    def _ensure(self):
+        import sys
+
+        from ..plugins.base import launch_plugin
+
+        with self._lock:
+            if self._closed:
+                # a stats/fingerprint call racing (or following) close()
+                # must not relaunch the host as an unkillable orphan
+                raise RuntimeError(f"device plugin {self.name} closed")
+            if self._client is not None and self._client.alive():
+                return self._client
+            if self._client is not None:
+                self._client.close()
+            log_path = ""
+            if self.state_dir:
+                os.makedirs(self.state_dir, exist_ok=True)
+                log_path = os.path.join(self.state_dir,
+                                        f"device_{self.name}.log")
+            self._client = launch_plugin(
+                [sys.executable, "-m", "nomad_tpu.plugins.device_host",
+                 self.name], log_path=log_path)
+            return self._client
+
+    def fingerprint(self) -> List[NodeDeviceResource]:
+        from ..plugins.device_host import groups_from_wire
+
+        try:
+            wire = self._ensure().call("Device.fingerprint", timeout=30.0)
+        except Exception:  # noqa: BLE001 — host down: degrade, relaunch
+            # next pass
+            if not self._seen:
+                return []
+            sick = [NodeDeviceResource(
+                vendor=g.vendor, type=g.type, name=g.name,
+                instances=[NodeDeviceInstance(id=i.id, healthy=False)
+                           for i in g.instances],
+                attributes={**g.attributes,
+                            "health_description": "device plugin down"},
+            ) for g in self._seen]
+            self._seen = sick
+            return sick
+        groups = groups_from_wire(wire)
+        if groups:
+            self._seen = groups
+        return groups
+
+    def stats(self) -> Dict[str, Dict[str, dict]]:
+        try:
+            return self._ensure().call("Device.stats", timeout=15.0) or {}
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            return {}
+
+    def reserve(self, instance_ids: List[str]) -> Dict[str, str]:
+        return self._ensure().call("Device.reserve", list(instance_ids),
+                                   timeout=15.0) or {}
+
+    def close(self, kill_plugin: bool = True) -> None:
+        with self._lock:
+            self._closed = True
+            client, self._client = self._client, None
+        if client is None:
+            return
+        if kill_plugin:
+            try:
+                client.call("Device.shutdown", timeout=5.0)
+            except Exception:  # noqa: BLE001 — force below
+                pass
+            client.kill()
+        else:
+            client.close()
+
+
 class DeviceManager:
     """devicemanager/manager.go analog: owns the plugins, runs the
     fingerprint + stats loops, feeds the client."""
@@ -183,10 +274,13 @@ class DeviceManager:
                      Callable[[List[NodeDeviceResource]], None]] = None,
                  fingerprint_interval: float = 60.0,
                  stats_interval: float = 5.0,
-                 plugins: Optional[List[DevicePlugin]] = None) -> None:
+                 plugins: Optional[List[DevicePlugin]] = None,
+                 state_dir: str = "") -> None:
         self.on_devices = on_devices
         self.fingerprint_interval = fingerprint_interval
         self.stats_interval = stats_interval
+        #: where out-of-process device-host logs live
+        self.state_dir = state_dir
         self.plugins = plugins if plugins is not None else self._builtin()
         self._lock = threading.Lock()
         #: {"vendor/type/name": {instance_id: {..stats..}}}
@@ -195,11 +289,20 @@ class DeviceManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    @staticmethod
-    def _builtin() -> List[DevicePlugin]:
-        plugins: List[DevicePlugin] = [EnvDevicePlugin()]
+    def _builtin(self) -> List[DevicePlugin]:
+        from ..plugins.base import oop_requested
+
+        def mk(name: str, cls) -> DevicePlugin:
+            # out-of-process opt-in (plugins/device_host.py): the
+            # reference runs every device plugin external; here it's an
+            # explicit knob like NOMAD_TPU_OOP_DRIVERS
+            if oop_requested("NOMAD_TPU_OOP_DEVICES", name):
+                return RemoteDevicePlugin(name, state_dir=self.state_dir)
+            return cls()
+
+        plugins: List[DevicePlugin] = [mk("env", EnvDevicePlugin)]
         if not os.environ.get("NOMAD_TPU_SKIP_TPU_FINGERPRINT"):
-            plugins.append(TpuDevicePlugin())
+            plugins.append(mk("tpu", TpuDevicePlugin))
         return plugins
 
     def seed(self, groups: List[NodeDeviceResource]) -> None:
@@ -296,3 +399,10 @@ class DeviceManager:
 
     def shutdown(self) -> None:
         self._stop.set()
+        for p in self.plugins:
+            close = getattr(p, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass

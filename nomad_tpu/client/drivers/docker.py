@@ -283,6 +283,20 @@ class DockerDriver(DriverPlugin):
         """Start the wait + log pumps for a (possibly recovered) container."""
         cid = handle.driver_state["container_id"]
 
+        if cfg is not None and cfg.stdout_sink is None and cfg.stdout_path:
+            # out-of-process host (plugins/driver_host.py): no in-process
+            # sinks cross the boundary — write the rotation target files
+            # directly (the logmon contract's documented path fallback)
+            def _file_sink(path):
+                def sink(chunk: bytes) -> None:
+                    with open(path, "ab") as fh:
+                        fh.write(chunk)
+                return sink
+
+            cfg.stdout_sink = _file_sink(cfg.stdout_path)
+            cfg.stderr_sink = _file_sink(cfg.stderr_path
+                                         or cfg.stdout_path)
+
         if cfg is not None and cfg.stdout_sink is not None:
             def pump_logs():
                 # docklog analog: stream stdout/stderr since container start
@@ -292,7 +306,11 @@ class DockerDriver(DriverPlugin):
                 handle._log_proc = proc
 
                 def read(stream, sink):
-                    for chunk in iter(lambda: stream.read(8192), b""):
+                    # read1: deliver whatever the pipe has NOW — a plain
+                    # read(8192) blocks until 8 KiB or EOF, so a quiet
+                    # long-running container's logs would only land at
+                    # exit instead of streaming
+                    for chunk in iter(lambda: stream.read1(8192), b""):
                         try:
                             sink(chunk)
                         except Exception:

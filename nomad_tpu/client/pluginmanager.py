@@ -26,28 +26,63 @@ class DriverManager:
     def __init__(self,
                  on_attrs: Optional[Callable[[Dict[str, str]], None]] = None,
                  fingerprint_interval: float = 30.0,
-                 plugin_config: Optional[Dict[str, dict]] = None) -> None:
+                 plugin_config: Optional[Dict[str, dict]] = None,
+                 state_dir: str = "") -> None:
         self.on_attrs = on_attrs
         self.fingerprint_interval = fingerprint_interval
         #: per-driver operator config (agent `plugin "<name>" {}` stanzas)
         self.plugin_config: Dict[str, dict] = plugin_config or {}
+        #: where out-of-process plugin reattach records + logs live
+        self.state_dir = state_dir
         self._drivers: Dict[str, DriverPlugin] = {}
         self._last_attrs: Dict[str, Dict[str, str]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _out_of_process(self, name: str) -> bool:
+        """Run this driver as its own plugin process? Operator opt-in via
+        `plugin "<name>" { out_of_process = true }` or the
+        NOMAD_TPU_OOP_DRIVERS env ("docker,raw_exec" or "all"). Default
+        in-process: one less process per driver on a dev agent, same
+        contract either way (plugins/base/plugin.go runs everything
+        external; this build makes isolation an explicit knob)."""
+        from ..plugins.base import oop_requested
+
+        return oop_requested("NOMAD_TPU_OOP_DRIVERS", name,
+                             self.plugin_config.get(name))
+
     def dispense(self, name: str) -> DriverPlugin:
-        """Shared driver instance (manager.go Dispense)."""
+        """Shared driver instance (manager.go Dispense). Construction
+        happens OUTSIDE the lock: an out-of-process driver's launch +
+        handshake can take seconds, and a task start must not queue
+        behind the fingerprint loop dispensing some other driver."""
         with self._lock:
             d = self._drivers.get(name)
-            if d is None:
-                cls = BUILTIN_DRIVERS.get(name)
-                if cls is None:
-                    raise ValueError(f"unknown driver {name!r}")
-                d = cls(self.plugin_config.get(name))
-                self._drivers[name] = d
+        if d is not None:
             return d
+        if name not in BUILTIN_DRIVERS:
+            raise ValueError(f"unknown driver {name!r}")
+        if self._out_of_process(name):
+            from .drivers.remote import OutOfProcessDriver
+
+            d = OutOfProcessDriver(name, self.plugin_config.get(name),
+                                   state_dir=self.state_dir)
+        else:
+            d = BUILTIN_DRIVERS[name](self.plugin_config.get(name))
+        with self._lock:
+            raced = self._drivers.get(name)
+            if raced is None:
+                self._drivers[name] = d
+                return d
+        # lost the construction race: keep the winner, retire ours
+        close = getattr(d, "close", None)
+        if close is not None:
+            try:
+                close(kill_plugin=True)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        return raced
 
     def fingerprint_once(self) -> Dict[str, str]:
         """Run every driver's fingerprint; returns the merged attribute
@@ -83,3 +118,14 @@ class DriverManager:
 
     def shutdown(self) -> None:
         self._stop.set()
+        with self._lock:
+            drivers = list(self._drivers.values())
+        for d in drivers:
+            close = getattr(d, "close", None)
+            if close is not None:
+                # detach only: the plugin host stays up so a restarted
+                # agent reattaches (go-plugin ReattachConfig semantics)
+                try:
+                    close(kill_plugin=False)
+                except Exception:  # noqa: BLE001 — shutdown is best-effort
+                    pass

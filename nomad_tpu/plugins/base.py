@@ -160,6 +160,18 @@ def reattach_plugin(reattach: Dict[str, object]) -> Optional[PluginClient]:
         return None
 
 
+def oop_requested(env_var: str, name: str,
+                  config: Optional[Dict] = None) -> bool:
+    """Shared out-of-process opt-in rule for driver/device plugins:
+    explicit `out_of_process` in the plugin's operator config wins,
+    else the env var ("name1,name2" or "all")."""
+    if config and "out_of_process" in config:
+        return bool(config["out_of_process"])
+    spec = os.environ.get(env_var, "")
+    names = {s.strip() for s in spec.split(",") if s.strip()}
+    return "all" in names or name in names
+
+
 def serve_plugin(plugin_type: str, register) -> None:
     """Plugin-side main: bind, handshake on stdout, serve forever.
 

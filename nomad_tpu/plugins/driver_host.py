@@ -1,0 +1,173 @@
+"""Out-of-process task-driver plugin host.
+
+Behavioral reference: `plugins/drivers/driver.go` (the driver plugin
+gRPC surface) + `plugins/base/plugin.go` (every plugin is its own
+process with handshake + recovery). The reference runs each task driver
+as a separate go-plugin process; this host is that process for this
+build: it instantiates ONE driver (builtin by name, or a third-party
+`module:Class` path) and serves the full DriverPlugin contract over the
+msgpack-RPC plugin transport (`plugins/base.py`).
+
+Crash isolation is the point: a driver bug kills THIS process, never the
+agent. Tasks survive the host too — executor-backed drivers run their
+task under a separate session-leader executor process, and docker tasks
+belong to the daemon — so the agent can relaunch a fresh host and
+`Driver.recover_task` its way back (the client-side proxy in
+`client/drivers/remote.py` does exactly that).
+
+Launch: ``python -m nomad_tpu.plugins.driver_host <name>`` with optional
+``NOMAD_TPU_DRIVER_PLUGIN_CONFIG`` (json) for the operator's
+``plugin "<name>" {}`` stanza.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+from typing import Dict, Optional
+
+#: TaskConfig fields that cross the process boundary (everything except
+#: the in-process log sinks — out-of-process drivers write the rotation
+#: target files directly, the logmon contract's documented fallback)
+TASK_CONFIG_FIELDS = (
+    "id", "name", "env", "user", "task_dir", "stdout_path", "stderr_path",
+    "raw_config", "cpu_mhz", "memory_mb", "kill_timeout_s", "max_files",
+    "max_file_size_mb", "ports", "ip", "netns",
+)
+
+
+def task_config_to_dict(cfg) -> dict:
+    return {f: getattr(cfg, f) for f in TASK_CONFIG_FIELDS}
+
+
+def exit_to_dict(res) -> Optional[dict]:
+    if res is None:
+        return None
+    return {"exit_code": res.exit_code, "signal": res.signal,
+            "oom_killed": res.oom_killed, "err": res.err}
+
+
+class DriverHost:
+    """RPC endpoint wrapping one live driver instance."""
+
+    def __init__(self, driver) -> None:
+        self.driver = driver
+        self._handles: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    # -- contract surface (each maps 1:1 onto DriverPlugin) --
+
+    def fingerprint(self) -> Dict[str, str]:
+        return self.driver.fingerprint()
+
+    def start_task(self, cfg_dict: dict) -> dict:
+        from ..client.drivers.base import TaskConfig
+
+        cfg = TaskConfig(**{k: v for k, v in cfg_dict.items()
+                            if k in TASK_CONFIG_FIELDS})
+        handle = self.driver.start_task(cfg)
+        with self._lock:
+            self._handles[handle.task_id] = handle
+        return {"task_id": handle.task_id,
+                "driver_state": handle.driver_state}
+
+    def recover_task(self, task_id: str, driver_state: dict) -> bool:
+        with self._lock:
+            if task_id in self._handles:
+                return True
+        handle = self.driver.recover_task(task_id, driver_state or {})
+        if handle is None:
+            return False
+        with self._lock:
+            self._handles[task_id] = handle
+        return True
+
+    def wait_task(self, task_id: str,
+                  timeout: Optional[float]) -> Optional[dict]:
+        return exit_to_dict(self.driver.wait_task(self._get(task_id),
+                                                  timeout=timeout))
+
+    def stop_task(self, task_id: str, timeout_s: float,
+                  signal: str) -> None:
+        self.driver.stop_task(self._get(task_id), timeout_s=timeout_s,
+                              signal=signal)
+
+    def destroy_task(self, task_id: str, force: bool) -> None:
+        with self._lock:
+            handle = self._handles.pop(task_id, None)
+        if handle is not None:
+            self.driver.destroy_task(handle, force=force)
+
+    def inspect_task(self, task_id: str) -> dict:
+        return self.driver.inspect_task(self._get(task_id))
+
+    def stats_task(self, task_id: str) -> dict:
+        return self.driver.stats_task(self._get(task_id))
+
+    def signal_task(self, task_id: str, sig: str) -> bool:
+        return bool(self.driver.signal_task(self._get(task_id), sig))
+
+    def exec_task(self, task_id: str, command: str, args,
+                  timeout_s: float) -> dict:
+        return self.driver.exec_task(self._get(task_id), command,
+                                     args=list(args or []),
+                                     timeout_s=timeout_s)
+
+    def known_tasks(self) -> list:
+        with self._lock:
+            return list(self._handles)
+
+    def _get(self, task_id: str):
+        with self._lock:
+            handle = self._handles.get(task_id)
+        if handle is None:
+            raise KeyError(f"unknown task {task_id!r} (not started or "
+                           f"recovered in this host)")
+        return handle
+
+
+def make_driver(name: str, plugin_config: Optional[dict] = None):
+    """Builtin by name, or third-party `pkg.mod:Class`."""
+    if ":" in name:
+        import importlib
+
+        mod, _, cls_name = name.partition(":")
+        cls = getattr(importlib.import_module(mod), cls_name)
+        return cls(plugin_config)
+    from ..client.drivers import BUILTIN_DRIVERS
+
+    cls = BUILTIN_DRIVERS.get(name)
+    if cls is None:
+        raise ValueError(f"unknown driver {name!r}")
+    return cls(plugin_config)
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m nomad_tpu.plugins.driver_host <driver>",
+              file=sys.stderr)
+        raise SystemExit(2)
+    cfg_raw = os.environ.get("NOMAD_TPU_DRIVER_PLUGIN_CONFIG", "")
+    plugin_config = json.loads(cfg_raw) if cfg_raw else None
+    driver = make_driver(argv[0], plugin_config)
+    host = DriverHost(driver)
+
+    from .base import serve_plugin
+
+    def register(server) -> None:
+        server._plugin_stop = threading.Event()
+        server.register_endpoint("Driver", host)
+
+        def shutdown() -> bool:
+            server._plugin_stop.set()
+            return True
+
+        server.register("Driver.shutdown", shutdown)
+
+    serve_plugin(f"driver:{argv[0]}", register)
+
+
+if __name__ == "__main__":
+    main()

@@ -447,6 +447,18 @@ class InMemState:
             tbl = self._csi_volumes = {}
         return tbl
 
+    @property
+    def _ctrl_leases(self):
+        """(namespace, vol_id, node_id) → (lessee_node, ts). Ephemeral
+        coordination state kept OUTSIDE the CSIVolume structs so it is
+        never serialized into snapshots/journals — a restored server
+        simply hands ops out afresh (leases are wall-clock; persisting
+        them would stall attach on any clock skew)."""
+        tbl = getattr(self, "_ctrl_lease_tbl", None)
+        if tbl is None:
+            tbl = self._ctrl_lease_tbl = {}
+        return tbl
+
     def upsert_csi_volume(self, vol) -> None:
         vol.modify_index = next(self.index)
         if not vol.create_index:
@@ -494,15 +506,12 @@ class InMemState:
                 # node re-claimed before the detach ran: convert the
                 # pending op to a (re-)publish — deleting it would race
                 # an already-executing unpublish and strand the node
-                # detached with a stale context. The lease (if any)
-                # carries over: the client executing the unpublish must
+                # detached with a stale context. Any lease survives in
+                # _ctrl_leases: the client executing the unpublish must
                 # report done before the publish is handed out, keeping
                 # controller ops serial per (volume, node).
-                new = {"op": "publish", "readonly": readonly}
-                for k in ("lease", "lease_ts"):
-                    if k in pending:
-                        new[k] = pending[k]
-                vol.controller_pending[node_id] = new
+                vol.controller_pending[node_id] = {"op": "publish",
+                                                   "readonly": readonly}
                 vol.controller_errors.pop(node_id, None)
                 vol.modify_index = next(self.index)
                 return
@@ -510,15 +519,10 @@ class InMemState:
                 return  # already attached, nothing queued against it
         if pending is not None and pending.get("op") == op:
             return  # already queued
-        new = {"op": op, "readonly": readonly}
-        if pending is not None:
-            # overwriting a queued op (publish→unpublish when the claim
-            # vanished): keep the lease so an executing host finishes
-            # and reports before the successor op is handed out
-            for k in ("lease", "lease_ts"):
-                if k in pending:
-                    new[k] = pending[k]
-        vol.controller_pending[node_id] = new
+        # on overwrite (publish→unpublish when the claim vanished) the
+        # _ctrl_leases entry is left intact: an executing host finishes
+        # and reports before the successor op is handed out
+        vol.controller_pending[node_id] = {"op": op, "readonly": readonly}
         vol.controller_errors.pop(node_id, None)
         vol.modify_index = next(self.index)
 
@@ -539,20 +543,20 @@ class InMemState:
 
         pids = set(plugin_ids)
         now = _time.time()
+        leases = self._ctrl_leases
         out = []
         for vol in self._csi.values():
             if vol.plugin_id not in pids:
                 continue
             for node_id, ent in vol.controller_pending.items():
-                lease = ent.get("lease")
+                key = (vol.namespace, vol.id, node_id)
+                lease = leases.get(key)
                 if (lessee is not None and lease is not None
-                        and lease != lessee
-                        and ent.get("lease_ts", 0.0)
-                        + self.CONTROLLER_LEASE_S > now):
+                        and lease[0] != lessee
+                        and lease[1] + self.CONTROLLER_LEASE_S > now):
                     continue  # another host is executing this op
                 if lessee is not None:
-                    ent["lease"] = lessee
-                    ent["lease_ts"] = now
+                    leases[key] = (lessee, now)
                 out.append({"namespace": vol.namespace, "volume_id": vol.id,
                             "plugin_id": vol.plugin_id,
                             "node_id": node_id, "op": ent["op"],
@@ -562,20 +566,25 @@ class InMemState:
     def csi_controller_done(self, namespace: str, vol_id: str,
                             node_id: str, op: str,
                             context: Optional[dict] = None,
-                            error: str = "") -> None:
+                            error: str = "", reporter: str = "") -> None:
         vol = self._csi.get((namespace, vol_id))
         if vol is None:
             return
+        key = (namespace, vol_id, node_id)
+        lease = self._ctrl_leases.get(key)
+        if lease is not None and reporter and lease[0] != reporter:
+            # a superseded host (its lease expired and another took the
+            # op) reporting late: ignore entirely — its error must not
+            # delete the live lessee's pending op, and its success must
+            # not install a context the live execution will contradict
+            return
+        # op resolved or converted-then-reported: either way the lease is
+        # released so the successor op can be handed out
+        self._ctrl_leases.pop(key, None)
         pending = vol.controller_pending.get(node_id)
         still_wanted = pending is not None and pending.get("op") == op
         if still_wanted:
             del vol.controller_pending[node_id]
-        elif pending is not None:
-            # the op was converted (unpublish → publish) while this one
-            # executed: release the lease so the successor op can be
-            # handed out on the next poll
-            pending.pop("lease", None)
-            pending.pop("lease_ts", None)
         if error:
             if still_wanted:
                 vol.controller_errors[node_id] = error

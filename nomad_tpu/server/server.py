@@ -832,10 +832,12 @@ class Server:
 
     def csi_controller_done(self, namespace: str, vol_id: str,
                             node_id: str, op: str, context=None,
-                            error: str = "") -> None:
-        """A controller host reports a publish/unpublish result."""
+                            error: str = "", reporter: str = "") -> None:
+        """A controller host reports a publish/unpublish result.
+        `reporter` is the reporting node — results from a host whose
+        lease was superseded are discarded (harness csi_controller_done)."""
         self.state.csi_controller_done(namespace, vol_id, node_id, op,
-                                       context, error)
+                                       context, error, reporter)
 
     # ---- scaling (nomad/job_endpoint.go:969 Scale + scaling policies) ----
 

@@ -271,14 +271,33 @@ class TestControllerRaces:
         assert len(s.csi_controller_poll(n.id)) == 1
         # lease expiry hands the op to the second host
         got = s.state.csi_volume("default", "v")
-        got.controller_pending[n.id]["lease_ts"] -= 60.0
+        key = ("default", "v", n.id)
+        lessee, ts = s.state._ctrl_leases[key]
+        assert lessee == n.id
+        s.state._ctrl_leases[key] = (lessee, ts - 60.0)
         ops2 = s.csi_controller_poll(n2.id)
         assert len(ops2) == 1 and ops2[0]["op"] == "publish"
         # ...after which the first host is locked out until THAT expires
         assert s.csi_controller_poll(n.id) == []
+        # the superseded host's late report (success or error) is
+        # DISCARDED — it must not delete the live lessee's op or poison
+        # the attach with its error
         s.csi_controller_done("default", "v", n.id, "publish",
-                              {"device_path": "/dev/x"})
+                              None, "timed out", reporter=n.id)
+        assert got.controller_pending[n.id]["op"] == "publish"
+        assert n.id not in got.controller_errors
+        # the live lessee's result lands
+        s.csi_controller_done("default", "v", n.id, "publish",
+                              {"device_path": "/dev/x"}, "",
+                              reporter=n2.id)
         assert n.id not in got.controller_pending
+        assert got.publish_contexts[n.id]["device_path"] == "/dev/x"
+        assert key not in s.state._ctrl_leases
+        # leases never leak into the serialized volume (snapshot purity)
+        from nomad_tpu.structs.codec import to_wire
+
+        wire = to_wire(got)
+        assert "lease" not in str(wire)
 
     def test_readonly_claim_rides_to_controller(self, tmp_path):
         s, n, vol = self._server_with_vol(tmp_path)
