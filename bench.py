@@ -245,19 +245,56 @@ def bench_compiled_oracle(state, jobs, count: int, n_evals: int):
     stack = TPUStack(state.cluster)  # fresh _static_program cache
     total = 0
     placed = 0
+    score_sum = 0.0
     t0 = time.time()
     for job in jobs[:n_evals]:
         out = native.compiled_select(stack, job, job.task_groups[0], count)
         if out is None:
             return None
-        sel, _score = out
+        sel, score = out
         placed += int((sel >= 0).sum())
+        score_sum += float(score[sel >= 0].sum())
         total += 1
     dt = time.time() - t0
     rate = total / dt
     log(f"compiled oracle: {total} evals in {dt:.2f}s = {rate:.1f} evals/s "
         f"({placed}/{total * count} allocs placed)")
-    return rate
+
+    # Sampled mode — the reference's ACTUAL algorithm shape
+    # (scheduler/stack.go:10-18,77-89: ceil(log2 n) shuffled candidates,
+    # maxSkip 3). Orders of magnitude fewer nodes scored per alloc, paid
+    # for with placement quality; both the rate AND the mean-score delta
+    # are reported so neither baseline is overstated (round-4 Weak #3).
+    import numpy as np
+
+    stack_s = TPUStack(state.cluster)
+    rng = np.random.default_rng(11)
+    total_s = 0
+    placed_s = 0
+    score_sum_s = 0.0
+    t0 = time.time()
+    for job in jobs[:n_evals]:
+        order = rng.permutation(state.cluster.n_cap).astype(np.int32)
+        out = native.compiled_select(stack_s, job, job.task_groups[0],
+                                     count, order=order)
+        if out is None:
+            break
+        sel, score = out
+        placed_s += int((sel >= 0).sum())
+        score_sum_s += float(score[sel >= 0].sum())
+        total_s += 1
+    dt_s = time.time() - t0
+    rate_s = total_s / dt_s if total_s else None
+    if rate_s:
+        q_exact = score_sum / max(placed, 1)
+        q_sampled = score_sum_s / max(placed_s, 1)
+        log(f"compiled oracle (sampled log2(n)+maxSkip): {total_s} evals "
+            f"in {dt_s:.2f}s = {rate_s:.1f} evals/s; mean score "
+            f"{q_sampled:.4f} vs exact {q_exact:.4f} "
+            f"({placed_s}/{total_s * count} placed)")
+    return {"exact": rate, "sampled": rate_s,
+            "mean_score_exact": score_sum / max(placed, 1),
+            "mean_score_sampled": score_sum_s / max(placed_s, 1)}
 
 
 def bench_system(state, nodes, n_evals: int):
@@ -578,8 +615,21 @@ def main() -> None:
         out["workload"] = {"nodes": n_nodes, "allocs": n_allocs,
                            "evals": n_evals, "batch": batch}
     if compiled_rate:
-        out["compiled_oracle_evals_per_sec"] = round(compiled_rate, 2)
-        out["vs_compiled_oracle"] = round(tpu_rate / compiled_rate, 2)
+        out["compiled_oracle_evals_per_sec"] = round(compiled_rate["exact"],
+                                                     2)
+        out["vs_compiled_oracle"] = round(tpu_rate / compiled_rate["exact"],
+                                          2)
+        if compiled_rate.get("sampled"):
+            # the reference's actual log2(n)+maxSkip shape: faster per
+            # eval at lower placement quality — both ratios + the
+            # mean-score delta reported (round-4 Weak #3)
+            out["compiled_oracle_sampled_evals_per_sec"] = round(
+                compiled_rate["sampled"], 2)
+            out["vs_compiled_oracle_sampled"] = round(
+                tpu_rate / compiled_rate["sampled"], 2)
+            out["placement_quality_exact_vs_sampled"] = [
+                round(compiled_rate["mean_score_exact"], 4),
+                round(compiled_rate["mean_score_sampled"], 4)]
     if parity_stats:
         out.update(parity_stats)
 

@@ -60,7 +60,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.nomad_first_fit_ports.restype = ctypes.c_int
         lib.nomad_count_free_ports.restype = ctypes.c_int
         lib.nomad_core_abi_version.restype = ctypes.c_int
-        if lib.nomad_core_abi_version() != 3:
+        if lib.nomad_core_abi_version() != 4:
             return None
         _lib = lib
         return _lib
@@ -195,12 +195,18 @@ def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
                 distinct_hosts: bool, dh_counts: np.ndarray,
                 jtc: np.ndarray,
                 desired_count: float, node_ok: np.ndarray,
-                extra_mask: np.ndarray, n_allocs: int):
+                extra_mask: np.ndarray, n_allocs: int,
+                order: np.ndarray = None, limit: int = 0,
+                max_skip: int = 3, skip_threshold: float = 0.0):
     """One evaluation through the compiled scalar select loop
     (native `nomad_select_eval`) — full-node scan per alloc with in-loop
     accounting. MUTATES used/dh_counts/jtc/s_counts. `dh_counts` is the
     distinct-hosts gate vector (job-level counts for job-scoped
     distinct_hosts, job+tg counts for tg-scoped — stack.py dh_counts).
+    With `order` (a shuffled row permutation), runs the SAMPLED loop
+    instead (`nomad_select_eval_sampled` — the reference's actual
+    log2(n)-candidate + maxSkip shape, scheduler/stack.go:10-18,77-89);
+    `limit` 0 means ceil(log2(n)) like the reference.
     Returns (sel i32[M], score f32[M]) or None when the native library is
     unavailable."""
     lib = _load()
@@ -230,6 +236,34 @@ def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
         aff_lut.shape[1] if aff_lut.size else s_desired.shape[1])
     out_sel = np.empty(n_allocs, dtype=np.int32)
     out_score = np.empty(n_allocs, dtype=np.float32)
+    if order is not None:
+        order = np.ascontiguousarray(order, dtype=np.int32)
+        if not limit:
+            limit = max(int(np.ceil(np.log2(max(n, 2)))), 2)
+        lib.nomad_select_eval_sampled(
+            _ptr(capacity, ctypes.c_float), _ptr(used, ctypes.c_float),
+            n, r, _ptr(ask, ctypes.c_float),
+            _ptr(attrs, ctypes.c_int32), attrs.shape[1],
+            _ptr(key_idx, ctypes.c_int32), _ptr(lut_u8, ctypes.c_uint8),
+            lut_u8.shape[0], v,
+            _ptr(aff_key_idx, ctypes.c_int32),
+            _ptr(aff_lut, ctypes.c_float),
+            aff_lut.shape[0], ctypes.c_float(aff_inv_sum),
+            _ptr(s_key, ctypes.c_int32), _ptr(s_weight, ctypes.c_float),
+            _ptr(s_has, ctypes.c_uint8), _ptr(s_act, ctypes.c_uint8),
+            _ptr(s_desired, ctypes.c_float),
+            _ptr(s_counts, ctypes.c_float), s_key.shape[0],
+            _ptr(dp_key, ctypes.c_int32), _ptr(dp_allowed, ctypes.c_float),
+            _ptr(dp_counts, ctypes.c_float), dp_key.shape[0],
+            int(distinct_hosts), _ptr(dh_counts, ctypes.c_float),
+            _ptr(jtc, ctypes.c_float), ctypes.c_float(desired_count),
+            _ptr(node_ok_u8, ctypes.c_uint8), _ptr(extra_u8, ctypes.c_uint8),
+            extra_u8.shape[0],
+            _ptr(order, ctypes.c_int32), int(limit), int(max_skip),
+            ctypes.c_float(skip_threshold),
+            n_allocs,
+            _ptr(out_sel, ctypes.c_int32), _ptr(out_score, ctypes.c_float))
+        return out_sel, out_score
     lib.nomad_select_eval(
         _ptr(capacity, ctypes.c_float), _ptr(used, ctypes.c_float), n, r,
         _ptr(ask, ctypes.c_float),
@@ -252,7 +286,9 @@ def select_eval(capacity: np.ndarray, used: np.ndarray, ask: np.ndarray,
     return out_sel, out_score
 
 
-def compiled_select(stack, job, tg, n_allocs: int):
+def compiled_select(stack, job, tg, n_allocs: int, order=None,
+                    limit: int = 0, max_skip: int = 3,
+                    skip_threshold: float = 0.0):
     """Marshal one (job, task-group) placement through the compiled scalar
     select loop — the single entry the bench's compiled baseline AND its
     parity test share, so the benchmarked path is the tested path. Returns
@@ -295,4 +331,6 @@ def compiled_select(stack, job, tg, n_allocs: int):
         sp_key, sp_w, sp_has, sp_active, sp_desired, s_counts,
         dp_key, dp_allowed, dp_counts,
         prog["distinct"], dh_counts, jtc, float(max(tg.count, 1)),
-        np.ascontiguousarray(cl.node_ok, np.uint8), extra, n_allocs)
+        np.ascontiguousarray(cl.node_ok, np.uint8), extra, n_allocs,
+        order=order, limit=limit, max_skip=max_skip,
+        skip_threshold=skip_threshold)
