@@ -73,9 +73,10 @@ class InProcConn:
         return self.server.csi_controller_poll(node_id)
 
     def csi_controller_done(self, namespace, vol_id, node_id, op,
-                            context=None, error="", reporter=""):
+                            context=None, error="", reporter="", gen=0):
         return self.server.csi_controller_done(namespace, vol_id, node_id,
-                                               op, context, error, reporter)
+                                               op, context, error, reporter,
+                                               gen)
 
     def update_service_registrations(self, regs):
         return self.server.update_service_registrations(regs)
@@ -156,9 +157,9 @@ class RpcConn:
         return self._call("csi_controller_poll", node_id)
 
     def csi_controller_done(self, namespace, vol_id, node_id, op,
-                            context=None, error="", reporter=""):
+                            context=None, error="", reporter="", gen=0):
         return self._call("csi_controller_done", namespace, vol_id,
-                          node_id, op, context, error, reporter)
+                          node_id, op, context, error, reporter, gen)
 
     def update_service_registrations(self, regs):
         return self._call("update_service_registrations", regs)
@@ -470,6 +471,7 @@ class Client:
                     continue
                 ns, vol_id = op["namespace"], op["volume_id"]
                 node_id, kind = op["node_id"], op["op"]
+                gen = int(op.get("gen", 0))
                 try:
                     if kind == "publish":
                         ctx = plugin.controller_publish_volume(
@@ -477,17 +479,17 @@ class Client:
                             readonly=bool(op.get("readonly"))) or {}
                         self.conn.csi_controller_done(
                             ns, vol_id, node_id, "publish", ctx, "",
-                            self.node.id)
+                            self.node.id, gen)
                     elif kind == "unpublish":
                         plugin.controller_unpublish_volume(vol_id, node_id)
                         self.conn.csi_controller_done(
                             ns, vol_id, node_id, "unpublish", None, "",
-                            self.node.id)
+                            self.node.id, gen)
                 except Exception as e:  # noqa: BLE001 — report, don't die
                     try:
                         self.conn.csi_controller_done(
                             ns, vol_id, node_id, kind, None, str(e),
-                            self.node.id)
+                            self.node.id, gen)
                     except Exception:
                         pass
 

@@ -288,10 +288,11 @@ class DockerDriver(DriverPlugin):
             # sinks cross the boundary — write the rotation target files
             # directly (the logmon contract's documented path fallback)
             def _file_sink(path):
-                def sink(chunk: bytes) -> None:
-                    with open(path, "ab") as fh:
-                        fh.write(chunk)
-                return sink
+                # one unbuffered handle for the pump's lifetime (closed
+                # by GC when the pump threads drop the closure) — an
+                # open/close pair per 8 KiB chunk was pure syscall tax
+                fh = open(path, "ab", buffering=0)
+                return fh.write
 
             cfg.stdout_sink = _file_sink(cfg.stdout_path)
             cfg.stderr_sink = _file_sink(cfg.stderr_path

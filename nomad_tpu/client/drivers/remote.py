@@ -162,6 +162,17 @@ class OutOfProcessDriver(DriverPlugin):
         with self._lock:
             wanted = {t: self._tasks.get(t)
                       for t in (task_ids or list(self._tasks))}
+            client = self._client
+        if client is not None and client.alive():
+            # RPC failed but the process lives: either a transient
+            # timeout on a busy host, or a wedged host. Probe cheaply —
+            # an unresponsive-but-alive host must be killed, or _ensure
+            # would reuse it forever and every task would be falsely
+            # declared lost while its executor still runs.
+            try:
+                client.call("Driver.known_tasks", timeout=5.0)
+            except Exception:  # noqa: BLE001 — wedged: replace it
+                client.kill()
         # brief grace: the host may be mid-restart by another thread
         for attempt in range(3):
             try:
@@ -268,9 +279,16 @@ class OutOfProcessDriver(DriverPlugin):
             client.kill()
             path = self._reattach_path()
             if path:
+                # only retire the record if it is OURS — a dispense race
+                # loser killing its redundant host must not delete the
+                # winner's record and orphan the winner's host across an
+                # agent restart
                 try:
-                    os.unlink(path)
-                except OSError:
+                    with open(path) as f:
+                        rec = json.load(f)
+                    if int(rec.get("pid", 0)) == client.pid:
+                        os.unlink(path)
+                except (OSError, ValueError):
                     pass
         else:
             client.close()

@@ -510,10 +510,11 @@ class InMemState:
                 # _ctrl_leases: the client executing the unpublish must
                 # report done before the publish is handed out, keeping
                 # controller ops serial per (volume, node).
-                vol.controller_pending[node_id] = {"op": "publish",
-                                                   "readonly": readonly}
                 vol.controller_errors.pop(node_id, None)
                 vol.modify_index = next(self.index)
+                vol.controller_pending[node_id] = {
+                    "op": "publish", "readonly": readonly,
+                    "gen": vol.modify_index}
                 return
             if node_id in vol.publish_contexts:
                 return  # already attached, nothing queued against it
@@ -522,9 +523,14 @@ class InMemState:
         # on overwrite (publish→unpublish when the claim vanished) the
         # _ctrl_leases entry is left intact: an executing host finishes
         # and reports before the successor op is handed out
-        vol.controller_pending[node_id] = {"op": op, "readonly": readonly}
         vol.controller_errors.pop(node_id, None)
         vol.modify_index = next(self.index)
+        # gen: deterministic op generation (the raft-journaled index
+        # bump) echoed through poll → execute → done, so a STALE result
+        # from a superseded host can never resolve a newer op of the
+        # same kind queued after its lease expired
+        vol.controller_pending[node_id] = {"op": op, "readonly": readonly,
+                                           "gen": vol.modify_index}
 
     #: how long one controller host owns a handed-out op before another
     #: poller may retry it (the host crashed or wedged mid-op)
@@ -560,40 +566,55 @@ class InMemState:
                 out.append({"namespace": vol.namespace, "volume_id": vol.id,
                             "plugin_id": vol.plugin_id,
                             "node_id": node_id, "op": ent["op"],
-                            "readonly": bool(ent.get("readonly"))})
+                            "readonly": bool(ent.get("readonly")),
+                            "gen": int(ent.get("gen", 0))})
         return out
+
+    def csi_controller_lease(self, namespace: str, vol_id: str,
+                             node_id: str):
+        """Read-only: the live (lessee, ts) for a pending controller op,
+        for the LEADER's pre-journal reporter guard
+        (server.csi_controller_done)."""
+        return self._ctrl_leases.get((namespace, vol_id, node_id))
 
     def csi_controller_done(self, namespace: str, vol_id: str,
                             node_id: str, op: str,
                             context: Optional[dict] = None,
-                            error: str = "", reporter: str = "") -> None:
+                            error: str = "", reporter: str = "",
+                            gen: int = 0) -> None:
+        """Apply a controller-op result. RAFT-REPLAYED: must be a pure
+        function of journaled args + replicated state. The superseded-
+        lessee guard therefore lives at the leader's RPC ingress
+        (server.csi_controller_done drops reports whose reporter no
+        longer holds the lease BEFORE journaling); `reporter` is
+        accepted here only for journal-format compatibility. `gen` is
+        the deterministic generation stamped on the pending op at
+        request time — a result only resolves the op it was handed out
+        for, so a stale host's late report can never delete a NEWER op
+        of the same kind queued after its lease expired."""
         vol = self._csi.get((namespace, vol_id))
         if vol is None:
             return
-        key = (namespace, vol_id, node_id)
-        lease = self._ctrl_leases.get(key)
-        if lease is not None and reporter and lease[0] != reporter:
-            # a superseded host (its lease expired and another took the
-            # op) reporting late: ignore entirely — its error must not
-            # delete the live lessee's pending op, and its success must
-            # not install a context the live execution will contradict
-            return
         # op resolved or converted-then-reported: either way the lease is
-        # released so the successor op can be handed out
-        self._ctrl_leases.pop(key, None)
+        # released so the successor op can be handed out (empty table on
+        # replay/followers — popping is a deterministic no-op there)
+        self._ctrl_leases.pop((namespace, vol_id, node_id), None)
         pending = vol.controller_pending.get(node_id)
-        still_wanted = pending is not None and pending.get("op") == op
+        still_wanted = (pending is not None and pending.get("op") == op
+                        and (not gen or pending.get("gen", 0) == gen))
         if still_wanted:
             del vol.controller_pending[node_id]
         if error:
             if still_wanted:
                 vol.controller_errors[node_id] = error
-        elif op == "publish" and pending is not None:
-            # pending None = this result is STALE (a lease-expired host
-            # finally finished after the op was superseded and resolved)
-            # — reinstalling a context for a possibly-detached node would
-            # let a waiter mount from a dead device. pending='unpublish'
-            # is fine: the attach ran, and the queued detach will pop it.
+        elif op == "publish" and (
+                still_wanted or (pending is not None
+                                 and pending.get("op") == "unpublish")):
+            # a stale/genless result must not (re)install a context: when
+            # pending is None the op was superseded and resolved (the
+            # node may be detached); when a NEWER publish is pending its
+            # own completion will install the fresh context. A pending
+            # unpublish is fine: the attach ran, the detach will pop it.
             vol.publish_contexts[node_id] = dict(context or {})
         elif op == "unpublish" and (still_wanted or pending is not None):
             # the detach DID run: drop the context so a converted

@@ -832,12 +832,23 @@ class Server:
 
     def csi_controller_done(self, namespace: str, vol_id: str,
                             node_id: str, op: str, context=None,
-                            error: str = "", reporter: str = "") -> None:
+                            error: str = "", reporter: str = "",
+                            gen: int = 0) -> None:
         """A controller host reports a publish/unpublish result.
-        `reporter` is the reporting node — results from a host whose
-        lease was superseded are discarded (harness csi_controller_done)."""
+
+        The superseded-lessee guard runs HERE, before the state op is
+        journaled: the state mutation is raft-replayed on followers whose
+        lease tables are empty, so any lease-dependent decision inside it
+        would diverge between leader and replica. Dropping the report at
+        ingress keeps the journal itself deterministic."""
+        lease = None
+        lease_fn = getattr(self.state, "csi_controller_lease", None)
+        if lease_fn is not None:
+            lease = lease_fn(namespace, vol_id, node_id)
+        if lease is not None and reporter and lease[0] != reporter:
+            return  # superseded host reporting late: discard
         self.state.csi_controller_done(namespace, vol_id, node_id, op,
-                                       context, error, reporter)
+                                       context, error, reporter, gen)
 
     # ---- scaling (nomad/job_endpoint.go:969 Scale + scaling policies) ----
 
