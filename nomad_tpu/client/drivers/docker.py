@@ -294,9 +294,16 @@ class DockerDriver(DriverPlugin):
                 fh = open(path, "ab", buffering=0)
                 return fh.write
 
-            cfg.stdout_sink = _file_sink(cfg.stdout_path)
-            cfg.stderr_sink = _file_sink(cfg.stderr_path
-                                         or cfg.stdout_path)
+            try:
+                cfg.stdout_sink = _file_sink(cfg.stdout_path)
+                cfg.stderr_sink = _file_sink(cfg.stderr_path
+                                             or cfg.stdout_path)
+            except OSError:
+                # an unwritable log path costs log capture, never the
+                # TASK — the container is already running, and failing
+                # start_task here would leak it untracked
+                cfg.stdout_sink = None
+                cfg.stderr_sink = None
 
         if cfg is not None and cfg.stdout_sink is not None:
             def pump_logs():

@@ -562,6 +562,18 @@ def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
     return results
 
 
+@functools.partial(jax.jit, static_argnames=("spec", "max_allocs"))
+def place_packed_chain(cluster: ClusterArrays, i32buf, f32buf, u8buf,
+                       spec, max_allocs: int):
+    """Packed-transport chained placement (the SelectCoordinator's
+    dispatch): one buffer per dtype class up, four small arrays down —
+    on a tunneled TPU the ~40 per-leaf transfers of an unpacked batched
+    TGParams cost more than the kernel itself (see pack_params)."""
+    batch = _unpack_params(i32buf, f32buf, u8buf, spec)
+    r = place_task_group_chain(cluster, batch, max_allocs)
+    return r.sel_idx, r.sel_score, r.nodes_feasible, r.nodes_fit
+
+
 @functools.partial(jax.jit, static_argnames=("max_allocs",))
 def place_task_group_batch(cluster: ClusterArrays, batch: TGParams,
                            max_allocs: int) -> PlacementResult:

@@ -68,7 +68,9 @@ class SelectCoordinator:
         self._live = 0
         self._parked: List[_SelectReq] = []
         self.window_s = window_s
-        self.stats = {"dispatches": 0, "programs": 0, "batched": 0}
+        self.stats = {"dispatches": 0, "programs": 0, "batched": 0,
+                      "dispatch_ms": 0.0, "view_ms": 0.0, "pack_ms": 0.0,
+                      "kernel_ms": 0.0}
 
     # ---- scheduler-thread side ----
 
@@ -140,10 +142,11 @@ class SelectCoordinator:
                         r.event.set()
 
     def _dispatch(self, batch: List[_SelectReq]) -> None:
-        from ..kernels.placement import (place_task_group_chain,
+        from ..kernels.placement import (pack_params, place_packed_chain,
                                          place_task_group_jit)
         from ..parallel.mesh import pad_params, stack_params
 
+        t_start = time.perf_counter()
         self.stats["dispatches"] += 1
         self.stats["programs"] += len(batch)
         # resolve each request's device view NOW (post-predecessor-commit)
@@ -153,6 +156,7 @@ class SelectCoordinator:
         for r in batch:
             a = r.arrays_fn()
             by_cluster.setdefault(id(a.capacity), []).append((r, a))
+        self.stats["view_ms"] += (time.perf_counter() - t_start) * 1e3
         for pairs in by_cluster.values():
             pairs.sort(key=lambda p: p[0].order)
             reqs = [p[0] for p in pairs]
@@ -174,15 +178,25 @@ class SelectCoordinator:
             if b > len(reqs):
                 pad = _inert_program(params_list[0])
                 params_list = params_list + [pad] * (b - len(reqs))
+            t0 = time.perf_counter()
             stacked, m = stack_params(params_list)
-            res = place_task_group_chain(arrays, stacked, m)
-            sel_all = np.asarray(res.sel_idx)
-            scores = np.asarray(res.sel_score)
-            feas = np.asarray(res.nodes_feasible)
-            fit = np.asarray(res.nodes_fit)
+            # packed transport: one buffer per dtype class instead of ~40
+            # per-leaf host→device transfers — on a tunneled TPU the
+            # transfers dominated the chain kernel itself
+            ibuf, fbuf, ubuf, spec = pack_params(stacked)
+            t1 = time.perf_counter()
+            self.stats["pack_ms"] += (t1 - t0) * 1e3
+            sel_j, score_j, feas_j, fit_j = place_packed_chain(
+                arrays, ibuf, fbuf, ubuf, spec, m)
+            sel_all = np.asarray(sel_j)
+            scores = np.asarray(score_j)
+            feas = np.asarray(feas_j)
+            fit = np.asarray(fit_j)
+            self.stats["kernel_ms"] += (time.perf_counter() - t1) * 1e3
             for i, r in enumerate(reqs):
                 r.out = (sel_all[i], scores[i], int(feas[i]), fit[i])
                 r.event.set()
+        self.stats["dispatch_ms"] += (time.perf_counter() - t_start) * 1e3
 
 
 def _inert_program(p):

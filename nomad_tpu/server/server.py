@@ -46,7 +46,7 @@ class ServerConfig:
                  nack_timeout: float = 60.0, gc_interval: float = 60.0,
                  gc=None, data_dir: Optional[str] = None,
                  fsync: bool = False, snapshot_threshold: int = 8192,
-                 acl_enabled: bool = False, eval_batch: int = 16,
+                 acl_enabled: bool = False, eval_batch: int = 32,
                  mesh=None):
         self.num_schedulers = num_schedulers
         self.heartbeat_ttl = heartbeat_ttl
@@ -58,7 +58,10 @@ class ServerConfig:
         self.snapshot_threshold = snapshot_threshold
         self.acl_enabled = acl_enabled
         #: max evals one worker drains into a fused-select batch
-        #: (worker.py process_batch); 1 disables batching
+        #: (worker.py process_batch); 1 disables batching. 32 measured
+        #: best on the 2000-node e2e (369/s vs 251/s @16 — fewer chain
+        #: dispatches amortize the fixed per-dispatch cost; ≥64 pays a
+        #: longer serial scan for no further dispatch saving)
         self.eval_batch = eval_batch
         #: jax.sharding.Mesh the workers shard cluster uploads over
         #: ("env" → build from NOMAD_TPU_MESH; None → single device)

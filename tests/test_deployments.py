@@ -208,9 +208,12 @@ class TestTrackerDriven:
             return [a for a in server.state.allocs_by_job("default", v0.id)
                     if a.deployment_id == d1.id and not a.terminal_status()]
 
-        assert _wait(lambda: len(canaries()) == 1
-                     and canaries()[0].deployment_status is not None
-                     and canaries()[0].deployment_status.is_healthy())
+        def one_healthy_canary():
+            cs = canaries()  # capture once: re-querying per clause races
+            return (len(cs) == 1 and cs[0].deployment_status is not None
+                    and cs[0].deployment_status.is_healthy())
+
+        assert _wait(one_healthy_canary)
         # healthy canary alone must NOT complete the deployment
         time.sleep(0.6)
         assert _deploy_status(server, d1.id) == DEPLOYMENT_STATUS_RUNNING

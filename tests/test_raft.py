@@ -463,7 +463,7 @@ class TestSnapshotCompaction:
             want = dict(c.fsm[leader.id])
 
             f = c.restart(follower_id)
-            assert _wait(lambda: c.fsm[follower_id] == want, timeout=15.0)
+            assert _wait(lambda: c.fsm[follower_id] == want, timeout=60.0)
             # caught up via snapshot: the follower's log starts at the
             # snapshot point and it applied far fewer than 1001 entries
             assert f.log.base_index >= 500
@@ -482,14 +482,14 @@ class TestSnapshotCompaction:
             leader = c.wait_leader()
             for i in range(200):
                 leader.apply({"k": f"k{i}", "v": i})
-            assert _wait(lambda: leader.log.base_index >= 100)
+            assert _wait(lambda: leader.log.base_index >= 100, timeout=30.0)
             want = dict(c.fsm[leader.id])
             # boot n2 with itself only; then the leader adds it
             new = c._boot("n2")
             new.peers = {"n2": c.peers["n2"]}
             leader = c.leader() or c.wait_leader()
             leader.add_peer("n2", c.peers["n2"])
-            assert _wait(lambda: c.fsm["n2"] == want, timeout=15.0)
+            assert _wait(lambda: c.fsm["n2"] == want, timeout=60.0)
             assert c.nodes["n2"].log.base_index >= 100
             assert c.apply_count["n2"] <= 201 - c.nodes["n2"].log.base_index
         finally:
