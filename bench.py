@@ -542,7 +542,9 @@ _CPU_DEFAULTS = {
     "NOMAD_TPU_BENCH_ORACLE_EVALS": "2",
     "NOMAD_TPU_BENCH_COMPILED_EVALS": "128",
     "NOMAD_TPU_BENCH_SYSTEM_EVALS": "4",
-    "NOMAD_TPU_BENCH_E2E_EVALS": "256",
+    # 1024 matches the TPU-run CPU subprocess: a 256-eval window holds
+    # only ~8 steady-state chain batches and under-reads the rate ~25%
+    "NOMAD_TPU_BENCH_E2E_EVALS": "1024",
 }
 
 

@@ -296,16 +296,21 @@ class DockerDriver(DriverPlugin):
 
             try:
                 cfg.stdout_sink = _file_sink(cfg.stdout_path)
-                cfg.stderr_sink = _file_sink(cfg.stderr_path
-                                             or cfg.stdout_path)
             except OSError:
                 # an unwritable log path costs log capture, never the
                 # TASK — the container is already running, and failing
                 # start_task here would leak it untracked
                 cfg.stdout_sink = None
+            try:
+                cfg.stderr_sink = _file_sink(cfg.stderr_path
+                                             or cfg.stdout_path)
+            except OSError:
+                # keep the stdout sink: the pump already falls back to
+                # it when stderr has no sink of its own
                 cfg.stderr_sink = None
 
-        if cfg is not None and cfg.stdout_sink is not None:
+        if cfg is not None and (cfg.stdout_sink is not None
+                                or cfg.stderr_sink is not None):
             def pump_logs():
                 # docklog analog: stream stdout/stderr since container start
                 proc = subprocess.Popen(
@@ -325,13 +330,16 @@ class DockerDriver(DriverPlugin):
                             break
                     stream.close()
 
-                ts = [threading.Thread(
-                          target=read, args=(proc.stdout, cfg.stdout_sink),
-                          daemon=True),
-                      threading.Thread(
-                          target=read, args=(proc.stderr, cfg.stderr_sink
-                                             or cfg.stdout_sink),
-                          daemon=True)]
+                ts = []
+                if cfg.stdout_sink is not None:
+                    ts.append(threading.Thread(
+                        target=read, args=(proc.stdout, cfg.stdout_sink),
+                        daemon=True))
+                err_sink = cfg.stderr_sink or cfg.stdout_sink
+                if err_sink is not None:
+                    ts.append(threading.Thread(
+                        target=read, args=(proc.stderr, err_sink),
+                        daemon=True))
                 for t in ts:
                     t.start()
 

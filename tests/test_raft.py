@@ -463,7 +463,11 @@ class TestSnapshotCompaction:
             want = dict(c.fsm[leader.id])
 
             f = c.restart(follower_id)
-            assert _wait(lambda: c.fsm[follower_id] == want, timeout=60.0)
+            # wait on base_index too: restore_fn fires mid-install (before
+            # the log reset stamps base_index), so fsm equality alone can
+            # be observed in that window
+            assert _wait(lambda: c.fsm[follower_id] == want
+                         and f.log.base_index >= 500, timeout=60.0)
             # caught up via snapshot: the follower's log starts at the
             # snapshot point and it applied far fewer than 1001 entries
             assert f.log.base_index >= 500
@@ -489,7 +493,12 @@ class TestSnapshotCompaction:
             new.peers = {"n2": c.peers["n2"]}
             leader = c.leader() or c.wait_leader()
             leader.add_peer("n2", c.peers["n2"])
-            assert _wait(lambda: c.fsm["n2"] == want, timeout=60.0)
+            # wait on base_index too: restore_fn fires mid-install (before
+            # the log reset stamps base_index), so fsm equality alone can
+            # be observed in that window
+            assert _wait(lambda: c.fsm["n2"] == want
+                         and c.nodes["n2"].log.base_index >= 100,
+                         timeout=60.0)
             assert c.nodes["n2"].log.base_index >= 100
             assert c.apply_count["n2"] <= 201 - c.nodes["n2"].log.base_index
         finally:
