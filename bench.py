@@ -551,7 +551,8 @@ _CPU_DEFAULTS = {
 def main() -> None:
     from nomad_tpu.utils import pin_jax_cpu_if_requested
 
-    platform_note = None
+    # set by the supervisor when it reran us on CPU after a mid-run wedge
+    platform_note = os.environ.get("NOMAD_TPU_BENCH_PLATFORM_NOTE")
     explicit_cpu = pin_jax_cpu_if_requested()  # honest JAX_PLATFORMS=cpu
     if not explicit_cpu:
         platform_note = _probe_device()
@@ -702,18 +703,130 @@ def _e2e_subprocess_cpu(n_nodes, n_allocs, n_evals, count, workers):
         "NOMAD_TPU_BENCH_COUNT": str(count),
         "NOMAD_TPU_BENCH_E2E_WORKERS": str(workers),
     })
-    # the axon sitecustomize ignores JAX_PLATFORMS; drop its path hook
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in sys.path if p and ".axon_site" not in p)
+    env["PYTHONPATH"] = _cpu_pythonpath()
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, capture_output=True, timeout=1200)
-        line = r.stdout.decode().strip().splitlines()[-1]
+        line = _last_json_line(r.stdout)
+        if line is None:
+            log(f"e2e cpu subprocess rc={r.returncode}: no metric line")
+            return None
         data = json.loads(line)
         return {k: v for k, v in data.items() if k.startswith("e2e_")}
     except Exception as e:  # noqa: BLE001 — bench must not die here
         log(f"e2e cpu subprocess failed: {e}")
         return None
+
+
+def _cpu_pythonpath() -> str:
+    """PYTHONPATH for a CPU-pinned child: the axon sitecustomize ignores
+    JAX_PLATFORMS, so drop its path hook."""
+    return os.pathsep.join(
+        p for p in sys.path if p and ".axon_site" not in p)
+
+
+def _last_json_line(stdout: Optional[bytes]) -> Optional[str]:
+    """The final stdout line when it parses as JSON, else None."""
+    lines = (stdout or b"").decode(errors="replace").strip().splitlines()
+    if not lines:
+        return None
+    try:
+        json.loads(lines[-1])
+    except ValueError:
+        return None
+    return lines[-1]
+
+
+def _forward_child_json(stdout: Optional[bytes]) -> bool:
+    """Emit the child's final stdout line if it parses as the metric
+    JSON; returns False when there is no parseable line."""
+    line = _last_json_line(stdout)
+    if line is None:
+        return False
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+    return True
+
+
+def _run_group(cmd, env, timeout):
+    """subprocess.run(stdout=PIPE) that kills the child's WHOLE process
+    group on timeout: the bench child spawns its own e2e subprocess, and
+    an orphaned grandchild would burn every core under the CPU fallback
+    rerun — skewing the very numbers the fallback exists to protect."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            start_new_session=True)
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        stdout, _ = proc.communicate()
+        exc = subprocess.TimeoutExpired(cmd, timeout)
+        exc.stdout = stdout
+        raise exc
+    return subprocess.CompletedProcess(cmd, proc.returncode, stdout, None)
+
+
+def _supervise() -> int:
+    """Run the real bench in a child process under a hard deadline.
+
+    The startup probe (_probe_device) catches a tunnel that is ALREADY
+    wedged, but a mid-run wedge blocks the main thread inside a native
+    dispatch where no in-process watchdog can reach it (observed round
+    5: the system section hung with axon-conn-read in wait_woken after
+    three sections completed fine). The supervisor makes that case
+    un-numberless-able too: if the child hangs past the deadline or
+    dies without printing its metric line, kill it and rerun the whole
+    bench on JAX_PLATFORMS=cpu so the driver always captures rc=0 with
+    a parseable JSON line (round-4 Weak #1)."""
+    import subprocess
+
+    deadline = float(os.environ.get("NOMAD_TPU_BENCH_DEADLINE", 1800))
+    env = dict(os.environ)
+    env["NOMAD_TPU_BENCH_SUPERVISED"] = "1"
+    note = None
+    try:
+        r = _run_group([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=deadline)
+        # forward the metric line even on rc!=0: a child that printed
+        # its TPU numbers and then crashed in tunnel-client teardown
+        # (the rc=134 "exception not rethrown" case) still measured
+        if _forward_child_json(r.stdout):
+            return 0
+        note = (f"bench child exited rc={r.returncode} without a metric "
+                f"line")
+    except subprocess.TimeoutExpired as e:
+        if _forward_child_json(getattr(e, "stdout", None)):
+            return 0  # the metric line made it out before the hang
+        note = (f"bench child exceeded the {deadline:.0f}s deadline — "
+                f"mid-run accelerator wedge (tunnel/grant stuck inside a "
+                f"dispatch)")
+    log(f"supervisor: {note}; rerunning on JAX_PLATFORMS=cpu")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "NOMAD_TPU_BENCH_SUPERVISED": "1",
+        "NOMAD_TPU_BENCH_PLATFORM_NOTE": note,
+        "PYTHONPATH": _cpu_pythonpath(),
+    })
+    try:
+        r = _run_group([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=deadline)
+        if _forward_child_json(r.stdout):
+            return 0 if r.returncode == 0 else r.returncode
+        log(f"supervisor: cpu rerun exited rc={r.returncode} without a "
+            f"metric line")
+        return r.returncode or 1
+    except subprocess.TimeoutExpired as e:
+        if _forward_child_json(getattr(e, "stdout", None)):
+            return 0
+        log("supervisor: cpu rerun also exceeded the deadline")
+        return 1
 
 
 def _e2e_only_main() -> None:
@@ -741,8 +854,13 @@ if __name__ == "__main__":
     try:
         if os.environ.get("NOMAD_TPU_BENCH_E2E_ONLY"):
             _e2e_only_main()
-        else:
+        elif (os.environ.get("NOMAD_TPU_BENCH_SUPERVISED")
+                or os.environ.get("JAX_PLATFORMS", "") == "cpu"
+                or os.environ.get("NOMAD_TPU_BENCH_SUPERVISOR", "1") == "0"):
+            # CPU can't wedge mid-run; supervised children do the work
             main()
+        else:
+            code = _supervise()
     except SystemExit as e:
         code = int(e.code or 0) if not isinstance(e.code, str) else 1
     except BaseException:  # noqa: BLE001 — report, then hard-exit

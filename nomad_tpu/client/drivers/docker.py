@@ -330,16 +330,22 @@ class DockerDriver(DriverPlugin):
                             break
                     stream.close()
 
-                ts = []
-                if cfg.stdout_sink is not None:
-                    ts.append(threading.Thread(
-                        target=read, args=(proc.stdout, cfg.stdout_sink),
-                        daemon=True))
-                err_sink = cfg.stderr_sink or cfg.stdout_sink
-                if err_sink is not None:
-                    ts.append(threading.Thread(
-                        target=read, args=(proc.stderr, err_sink),
-                        daemon=True))
+                # both streams must ALWAYS be drained — an unread pipe
+                # fills and wedges `docker logs` itself, stalling the
+                # other stream's capture too; a stream whose sink failed
+                # to open is read and discarded
+                def discard(_chunk):
+                    return None
+
+                ts = [threading.Thread(
+                          target=read,
+                          args=(proc.stdout, cfg.stdout_sink or discard),
+                          daemon=True),
+                      threading.Thread(
+                          target=read,
+                          args=(proc.stderr, cfg.stderr_sink
+                                or cfg.stdout_sink or discard),
+                          daemon=True)]
                 for t in ts:
                     t.start()
 
