@@ -283,3 +283,64 @@ class TestServerBatchedPath:
             assert s.planner.stats.get("partial", 0) == 0
         finally:
             s.shutdown()
+
+    def test_poisoned_eval_does_not_sink_its_batch(self, monkeypatch):
+        """One scheduler crashing mid-batch must not stall the
+        rendezvous: its thread dies before parking at the coordinator
+        (live-count drops), the rest dispatch and complete, and the
+        poisoned eval is nacked for redelivery (worker.go:105's
+        per-eval error isolation, here across a fused batch)."""
+        import nomad_tpu.server.worker as worker_mod
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.synth import synth_node, synth_service_job
+
+        real = worker_mod.GenericScheduler
+        poison_jobs = set()
+
+        class Exploding(real):
+            def process(self, eval):
+                if eval.job_id in poison_jobs:
+                    raise RuntimeError("poisoned eval (test)")
+                return real.process(self, eval)
+
+        monkeypatch.setattr(worker_mod, "GenericScheduler", Exploding)
+        # the env knob outranks ServerConfig.eval_batch — without this a
+        # stray NOMAD_TPU_EVAL_BATCH=1 would green-light the test on the
+        # single-eval path without ever touching the rendezvous
+        monkeypatch.delenv("NOMAD_TPU_EVAL_BATCH", raising=False)
+        rng = random.Random(11)
+        s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
+                                eval_batch=8))
+        for i in range(16):
+            s.state.upsert_node(synth_node(rng, i))
+        jobs = [synth_service_job(rng, count=2) for _ in range(8)]
+        poison_jobs.add(jobs[3].id)
+        evs = [s.job_register(j) for j in jobs]
+        s.start()
+        try:
+            for i, ev in enumerate(evs):
+                if i == 3:
+                    continue
+                got = s.wait_for_eval(
+                    ev.id, statuses=("complete", "failed", "blocked",
+                                     "cancelled"), timeout=60.0)
+                assert got is not None and got.status == "complete", \
+                    (i, got)
+            # every healthy job fully placed
+            for i, j in enumerate(jobs):
+                want = 0 if i == 3 else 2
+                assert len(s.state.allocs_by_job("default", j.id)) == want
+            # the poisoned eval was redelivered (nack -> dequeue again),
+            # never completed
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                if s.broker._dequeues.get(evs[3].id, 0) >= 2:
+                    break
+                time.sleep(0.05)
+            assert s.broker._dequeues.get(evs[3].id, 0) >= 2
+            got = s.state.eval_by_id(evs[3].id)
+            assert got is None or got.status != "complete"
+            # the batch path actually engaged (fused programs ran)
+            assert s.workers[0].batch_stats.get("batched", 0) > 0
+        finally:
+            s.shutdown()
