@@ -51,7 +51,17 @@ class EvalContext:
         result = self.server.planner.try_apply_inline(plan)
         if result is None:
             fut = self.server.plan_queue.enqueue(plan)
-            result = fut.wait(timeout=10.0)
+            # backstop only — the applier's 1s poll loop recovers any
+            # missed wakeup, so this fires solely when the process is
+            # starved of CPU for the whole window (observed >10s under
+            # a fully loaded test host). Must stay WELL inside the
+            # broker's unack window: a wait that straddles nack-timeout
+            # would let a redelivered copy of this eval plan against a
+            # pre-commit snapshot while this plan is still committing
+            # (duplicate allocations until the next reconcile).
+            nack = getattr(self.server.broker, "nack_timeout", 60.0)
+            result = fut.wait(
+                timeout=min(30.0, nack * 0.5) if nack > 0 else 30.0)
         if result is None:
             raise RuntimeError("plan apply failed")
         if result.refresh_index:
