@@ -87,6 +87,9 @@ class InProcConn:
     def secret_get(self, namespace, path):
         return self.server.secret_get(namespace, path)
 
+    def services_lookup(self, namespace, name):
+        return self.server.services_lookup(namespace, name)
+
 
 class RpcConn:
     """Server connection over the msgpack-RPC fabric with failover across
@@ -169,6 +172,9 @@ class RpcConn:
 
     def secret_get(self, namespace, path):
         return self._call("secret_get", namespace, path)
+
+    def services_lookup(self, namespace, name):
+        return self._call("services_lookup", namespace, name)
 
 
 class ClientConfig:

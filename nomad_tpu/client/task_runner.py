@@ -14,6 +14,7 @@ TaskEvents (structs.go:7049 event types).
 """
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -105,6 +106,16 @@ class TaskRunner:
         #: user-requested restart in flight: the next task exit restarts
         #: immediately without consuming restart-policy budget
         self._manual_restart = False
+        #: rendered template content by dest path — the re-render
+        #: baseline the watcher diffs against
+        self._tmpl_content: Dict[str, str] = {}
+        self._tmpl_thread: Optional[threading.Thread] = None
+        #: terminal-state gate for the watcher: a naturally-completed
+        #: task must stop its polling (kill() is never called for it)
+        self._tmpl_stop = threading.Event()
+        #: last-fetched KV data per path — refresh rewrites the secrets
+        #: file only when the values actually changed
+        self._secret_data: Dict[str, dict] = {}
         self._thread: Optional[threading.Thread] = None
 
     def _restart_policy(self) -> RestartPolicy:
@@ -126,6 +137,7 @@ class TaskRunner:
             self.state.started_at = time.time()
         if state == TASK_STATE_DEAD:
             self.state.finished_at = time.time()
+            self._tmpl_stop.set()  # terminal: stop the template watcher
         if self.on_state_change is not None:
             self.on_state_change(self.task.name, self.state)
 
@@ -145,6 +157,8 @@ class TaskRunner:
             self._event(EVENT_DRIVER_FAILURE, str(e))
             self._set_state(TASK_STATE_DEAD, failed=True)
             return
+        if self.task.templates:
+            self._start_template_watch()
         recovered = self._try_recover()
         while not self._kill.is_set():
             if recovered:
@@ -324,47 +338,226 @@ class TaskRunner:
                     f"{vm.destination!r}")
             os.symlink(src, dest)
         # template hook (taskrunner/template/template.go): render each
-        # template's content with task-env interpolation into dest_path.
-        # The consul-template language is out of scope (no Consul/Vault);
-        # `${...}` env/node interpolation covers the jobspec-local uses.
+        # template's content with task-env interpolation into dest_path,
+        # then watch dynamic sources and fire change_mode on re-render
+        # (template.go:346 handleTemplateRerenders; _template_watch below)
         if self.task.templates:
-            import os
+            self._render_templates()
 
-            from .taskenv import build_env, interpolate
+    # ---- templates (taskrunner/template/template.go) ----
+    #
+    # The reference's TaskTemplateManager runs consul-template against
+    # Consul/Vault and fires change_mode on re-render
+    # (template.go:346-415, change modes structs.go:6754-6762). This
+    # build's dynamic sources are the NATIVE catalog and KV engine:
+    # `${service.<name>}` / `.addr` / `.port` resolve from the server's
+    # service registrations, NOMAD_SECRET_* from the built-in KV — the
+    # watcher polls both and re-renders, firing restart/signal/noop.
 
-            tenv = build_env(self.alloc, self.task, self.node,
-                             task_dir=self.task_dir,
-                             shared_dir=f"{self.task_dir}/alloc")
-            tenv.update(self._secret_env)
-            for tmpl in self.task.templates:
-                content = tmpl.embedded_tmpl
-                if not content and tmpl.source_path:
-                    src = os.path.normpath(os.path.join(
-                        self.task_dir, tmpl.source_path.lstrip("/")))
-                    if not src.startswith(self.task_dir + os.sep):
-                        raise RuntimeError(
-                            f"template source escapes task dir: "
-                            f"{tmpl.source_path!r}")
-                    with open(src) as f:
-                        content = f.read()
-                dest = os.path.normpath(os.path.join(
-                    self.task_dir, tmpl.dest_path.lstrip("/")))
-                if not dest.startswith(self.task_dir + os.sep):
-                    raise RuntimeError(
-                        f"template dest escapes task dir: "
-                        f"{tmpl.dest_path!r}")
-                os.makedirs(os.path.dirname(dest), exist_ok=True)
-                with open(dest, "w") as f:
-                    f.write(interpolate(content, tenv, self.node))
+    #: ${service.<name>...} references in template bodies (name charset
+    #: excludes ".", so `${service.web.addr}` captures "web")
+    _SERVICE_REF = re.compile(r"\$\{service\.([A-Za-z0-9_-]+)")
+    #: dynamic-source poll cadence; tests shrink it via the class attr
+    TEMPLATE_POLL_S = 5.0
 
-    def _ensure_secrets(self) -> None:
+    def _template_raw(self, tmpl) -> str:
+        """Template body: embedded, or read from a task-dir source."""
+        import os
+
+        if tmpl.embedded_tmpl or not tmpl.source_path:
+            return tmpl.embedded_tmpl
+        src = os.path.normpath(os.path.join(
+            self.task_dir, tmpl.source_path.lstrip("/")))
+        if not src.startswith(self.task_dir + os.sep):
+            raise RuntimeError(
+                f"template source escapes task dir: {tmpl.source_path!r}")
+        with open(src) as f:
+            return f.read()
+
+    def _template_dest(self, tmpl) -> str:
+        import os
+
+        dest = os.path.normpath(os.path.join(
+            self.task_dir, tmpl.dest_path.lstrip("/")))
+        if not dest.startswith(self.task_dir + os.sep):
+            raise RuntimeError(
+                f"template dest escapes task dir: {tmpl.dest_path!r}")
+        return dest
+
+    def _template_scope(self, raws,
+                        degraded: bool = False) -> Dict[str, str]:
+        """Interpolation scope: task env + secrets + catalog lookups for
+        every `${service.<name>}` the templates reference. A failed
+        lookup raises — callers decide the fallback. degraded=True skips
+        lookups entirely (empty catalog), for a first render with no
+        reachable server."""
+        from .taskenv import build_env
+
+        tenv = build_env(self.alloc, self.task, self.node,
+                         task_dir=self.task_dir,
+                         shared_dir=f"{self.task_dir}/alloc")
+        tenv.update(self._secret_env)
+        names = set()
+        for raw in raws:
+            names.update(self._SERVICE_REF.findall(raw))
+        for name in sorted(names):
+            regs = []
+            if not degraded and self.conn is not None:
+                regs = self.conn.services_lookup(
+                    self.alloc.namespace, name) or []
+            # passing instances only (consul-template's `service`
+            # function health filtering), deterministically ordered
+            regs = sorted((r for r in regs if r.status == "passing"),
+                          key=lambda r: (r.address, r.port, r.id))
+            tenv[f"service.{name}"] = ",".join(
+                f"{r.address}:{r.port}" for r in regs)
+            tenv[f"service.{name}.addr"] = regs[0].address if regs else ""
+            tenv[f"service.{name}.port"] = \
+                str(regs[0].port) if regs else ""
+        return tenv
+
+    @staticmethod
+    def _write_atomic(dest: str, content: str) -> None:
+        """temp + rename so a task reading its config mid-rewrite can
+        never observe a truncated file (the reference's consul-template
+        rerender path writes atomically too)."""
+        import os
+        import tempfile
+
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest),
+                                   prefix=".tmpl-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(content)
+            os.replace(tmp, dest)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _render_templates(self, strict: bool = False) -> list:
+        """Render every template; write the ones whose content changed.
+        Returns (change_mode, change_signal) for each REWRITE — the
+        first render of a dest records the baseline without reporting a
+        change, so starting the watcher never fires change_mode.
+
+        strict=True (watch ticks) propagates a failed catalog lookup so
+        a transient RPC error cannot render a half-empty file and fire a
+        spurious change_mode. strict=False (initial render) degrades: an
+        EXISTING dest file (agent-restart recovery of a live task while
+        the server is briefly unreachable) is adopted as the baseline
+        untouched — clobbering a recovered task's valid config with
+        empty values would itself fire a bogus change_mode one tick
+        later — and a missing dest renders against an empty catalog
+        rather than blocking task start forever."""
+        import os
+
+        from .taskenv import interpolate
+
+        raws = [self._template_raw(t) for t in self.task.templates]
+        try:
+            tenv = self._template_scope(raws)
+        except Exception:
+            if strict:
+                raise
+            tenv = None  # degraded: catalog unreachable
+        changed = []
+        degraded_scope = None
+        for tmpl, raw in zip(self.task.templates, raws):
+            dest = self._template_dest(tmpl)
+            if tenv is None and os.path.exists(dest):
+                with open(dest) as f:
+                    self._tmpl_content[dest] = f.read()
+                continue
+            if tenv is None:
+                if degraded_scope is None:
+                    degraded_scope = self._template_scope(
+                        raws, degraded=True)
+                scope = degraded_scope
+            else:
+                scope = tenv
+            content = interpolate(raw, scope, self.node)
+            if self._tmpl_content.get(dest) == content:
+                continue
+            first = dest not in self._tmpl_content
+            self._write_atomic(dest, content)
+            self._tmpl_content[dest] = content
+            if not first:
+                changed.append((tmpl.change_mode or "restart",
+                                tmpl.change_signal))
+        return changed
+
+    def _start_template_watch(self) -> None:
+        """Watch dynamic templates (any referencing the catalog or
+        secrets). Static templates can never re-render — their scope is
+        fixed for the task's life — so no thread is spent on them."""
+        if self._tmpl_thread is not None:
+            return
+        try:
+            raws = [self._template_raw(t) for t in self.task.templates]
+        except Exception:
+            return  # prestart already failed/raced; nothing to watch
+        if not any("${service." in r or "NOMAD_SECRET_" in r
+                   for r in raws):
+            return
+        self._tmpl_thread = threading.Thread(
+            target=self._template_watch,
+            name=f"tmpl-{self.task.name}", daemon=True)
+        self._tmpl_thread.start()
+
+    def _template_watch(self) -> None:
+        # _tmpl_stop (not _kill): a naturally-completed task never gets
+        # kill()ed, and its watcher must not poll — or fire change_mode
+        # events on a dead task — for the rest of the agent's life
+        while not self._tmpl_stop.wait(self.TEMPLATE_POLL_S):
+            try:
+                if self.task.secrets:
+                    self._ensure_secrets(refresh=True)
+                changed = self._render_templates(strict=True)
+            except Exception:  # noqa: BLE001 — transient (leader move)
+                continue
+            if not changed:
+                continue
+            modes = {m for m, _ in changed}
+            if "restart" in modes:
+                # template.go:413 — restart wins when multiple templates
+                # re-rendered with mixed modes; no policy budget consumed
+                self._event(EVENT_RESTART_SIGNALED,
+                            "Template with change_mode restart re-rendered")
+                try:
+                    self.restart()
+                except Exception:  # noqa: BLE001 — task not running now;
+                    pass  # the next launch reads the re-rendered file
+            elif "signal" in modes:
+                sigs = sorted({s or "SIGHUP" for m, s in changed
+                               if m == "signal"})
+                for sig in sigs:
+                    try:
+                        self._event(
+                            EVENT_SIGNALING,
+                            f"Template re-rendered; sending {sig}")
+                        if self.handle is not None \
+                                and self.handle.is_running():
+                            self.driver.signal_task(self.handle, sig)
+                    except Exception:  # noqa: BLE001 — racing an exit
+                        pass
+            # "noop": the file was rewritten; nothing else to do
+
+    def _ensure_secrets(self, refresh: bool = False) -> None:
         """Fetch each declared KV path from the built-in engine and
         materialize it under secrets/<path>.json (0600) + NOMAD_SECRET_*
-        env. Idempotent; re-fetches only while the env is unpopulated."""
-        if not self.task.secrets or self._secret_env:
+        env. Idempotent; re-fetches only while the env is unpopulated —
+        or always under refresh=True (the template watcher's poll, so a
+        KV write re-renders templates and the next task launch sees the
+        new values)."""
+        if not self.task.secrets or (self._secret_env and not refresh):
             return
         import json as _json
         import os
+        import tempfile
 
         if self.conn is None:
             raise RuntimeError(
@@ -378,12 +571,25 @@ class TaskRunner:
                 raise RuntimeError(
                     f"task {self.task.name}: secret {path!r} not "
                     f"found in namespace {self.alloc.namespace!r}")
-            dest = os.path.normpath(
-                os.path.join(sdir, path.replace("/", "_") + ".json"))
-            fd = os.open(dest, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
-                         0o600)
-            with os.fdopen(fd, "w") as f:
-                _json.dump(entry.data, f)
+            # rewrite only on change, atomically (temp 0600 + rename):
+            # the file is the task's to read at any time, and refresh
+            # polls must not race readers with a truncated JSON — nor
+            # burn a disk write per poll on unchanged values
+            if self._secret_data.get(path) != entry.data:
+                self._secret_data[path] = dict(entry.data)
+                dest = os.path.normpath(
+                    os.path.join(sdir, path.replace("/", "_") + ".json"))
+                fd, tmp = tempfile.mkstemp(dir=sdir, prefix=".secret-")
+                try:
+                    with os.fdopen(fd, "w") as f:
+                        _json.dump(entry.data, f)
+                    os.replace(tmp, dest)
+                except BaseException:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+                    raise
             slug = path.upper().replace("/", "_").replace("-", "_")
             for k, v in entry.data.items():
                 env[f"NOMAD_SECRET_{slug}_"
@@ -450,6 +656,7 @@ class TaskRunner:
 
     def kill(self) -> None:
         self._kill.set()
+        self._tmpl_stop.set()
 
     def detach(self) -> None:
         """Stop the runner WITHOUT stopping the task (agent shutdown —
@@ -457,6 +664,7 @@ class TaskRunner:
         client.go shutdown semantics)."""
         self._detach = True
         self._kill.set()
+        self._tmpl_stop.set()
 
     def join(self, timeout: float = 10.0) -> None:
         if self._thread is not None:

@@ -194,7 +194,11 @@ class ExecutorService:
                 continue
 
             def pump(stream=stream, rot=rot):
-                for chunk in iter(lambda: stream.read(8192), b""):
+                # read1, NOT read: BufferedReader.read(n) blocks until n
+                # bytes or EOF, which would hide a long-running task's
+                # sparse output until it exits (logs/`alloc logs -f`
+                # must see lines as they are written)
+                for chunk in iter(lambda: stream.read1(8192), b""):
                     try:
                         rot.write(chunk)
                     except Exception:

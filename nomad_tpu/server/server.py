@@ -711,6 +711,12 @@ class Server:
     def secret_get(self, namespace: str, path: str):
         return self.state.secret_get(namespace, path)
 
+    def services_lookup(self, namespace: str, name: str):
+        """Catalog lookup for client-side template rendering (the
+        consul-template `service` function's data source; this build
+        reads the native catalog instead of a Consul agent)."""
+        return self.state.services_by_name(namespace, name)
+
     def secrets_list(self, namespace: str):
         return self.state.secrets_list(namespace)
 

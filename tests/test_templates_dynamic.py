@@ -1,0 +1,235 @@
+"""Dynamic templates: re-render on catalog/secret changes + change_mode.
+
+Behavioral reference: `client/allocrunner/taskrunner/template/template.go`
+(TaskTemplateManager; handleTemplateRerenders :346-415 fires
+restart/signal/noop per `structs.go:6754-6762`). This build's dynamic
+sources are the NATIVE service catalog (`${service.<name>}`) and the
+built-in KV engine (NOMAD_SECRET_*) instead of Consul/Vault.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import NomadClient
+from nomad_tpu.client.task_runner import TaskRunner
+from nomad_tpu.structs.job import Template
+from nomad_tpu.structs.secrets import SecretEntry
+from nomad_tpu.structs.service import ServiceRegistration
+
+
+def _wait(cond, timeout=30.0, step=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+@pytest.fixture()
+def agent(tmp_path, monkeypatch):
+    monkeypatch.setattr(TaskRunner, "TEMPLATE_POLL_S", 0.25)
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+    yield a, api
+    a.shutdown()
+
+
+def _running_alloc(api, job_id):
+    return next((al for al in api.job_allocations(job_id)
+                 if al.client_status == "running"), None)
+
+
+def _logs(api, alloc_id, task):
+    """Task stdout so far; b"" while the log file does not exist yet."""
+    try:
+        return api.alloc_logs(alloc_id, task)
+    except Exception:
+        return b""
+
+
+class TestServiceTemplates:
+    def test_catalog_change_rerenders_and_signals(self, agent):
+        """A `${service.backend}` template re-renders when the catalog
+        gains a passing instance; change_mode=signal HUPs the task,
+        which cats the fresh file to its log."""
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "trap 'cat local/upstreams.conf' HUP; "
+                     "echo started; "
+                     "while :; do sleep 0.2; done"],
+        }
+        t.templates = [Template(
+            embedded_tmpl="backend=${service.backend}\n",
+            dest_path="local/upstreams.conf",
+            change_mode="signal")]
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: _running_alloc(api, job.id) is not None)
+        alloc = _running_alloc(api, job.id)
+
+        # initial render: empty catalog → empty value
+        runner = a.client.alloc_runner(alloc.id)
+        dest = None
+        for tr in runner.task_runners.values():
+            if tr.task.name == t.name:
+                dest = tr._template_dest(t.templates[0])
+        assert dest is not None
+        assert _wait(lambda: open(dest).read() == "backend=\n", timeout=10)
+
+        reg = ServiceRegistration(
+            id="_manual-backend-1", service_name="backend",
+            namespace="default", address="10.0.0.7", port=9090,
+            alloc_id="ext", status="passing")
+        a.server.update_service_registrations([reg])
+
+        # watcher re-renders and fires SIGHUP → task logs the new file
+        assert _wait(
+            lambda: b"backend=10.0.0.7:9090"
+            in _logs(api, alloc.id, t.name), timeout=20), \
+            _logs(api, alloc.id, t.name)
+        assert open(dest).read() == "backend=10.0.0.7:9090\n"
+
+    def test_scope_filters_and_orders_instances(self, agent):
+        """Only passing instances resolve, deterministically ordered;
+        .addr/.port expose the first instance."""
+        a, api = agent
+        regs = [
+            ServiceRegistration(id="b", service_name="db",
+                                namespace="default", address="10.0.0.2",
+                                port=5432, alloc_id="x", status="passing"),
+            ServiceRegistration(id="a", service_name="db",
+                                namespace="default", address="10.0.0.1",
+                                port=5432, alloc_id="x", status="passing"),
+            ServiceRegistration(id="c", service_name="db",
+                                namespace="default", address="10.0.0.9",
+                                port=5432, alloc_id="x", status="critical"),
+        ]
+        a.server.update_service_registrations(regs)
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", "cat local/db.conf"]}
+        t.templates = [Template(
+            embedded_tmpl=("all=${service.db}\n"
+                           "addr=${service.db.addr}\n"
+                           "port=${service.db.port}\n"),
+            dest_path="local/db.conf")]
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(job.id)))
+        alloc = next(al for al in api.job_allocations(job.id)
+                     if al.client_status == "complete")
+        out = api.alloc_logs(alloc.id, t.name)
+        assert b"all=10.0.0.1:5432,10.0.0.2:5432\n" in out
+        assert b"addr=10.0.0.1\n" in out
+        assert b"port=5432\n" in out
+
+
+class TestSecretTemplates:
+    def test_kv_write_rerenders_and_restarts(self, agent):
+        """A template over NOMAD_SECRET_* re-renders when the KV path is
+        rewritten; change_mode=restart relaunches the task, which sees
+        both the new file and the new env."""
+        a, api = agent
+        a.server.secret_upsert(SecretEntry(
+            namespace="default", path="db/creds",
+            data={"pass": "v1"}))
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.secrets = ["db/creds"]
+        t.config = {
+            "command": "/bin/sh",
+            "args": ["-c",
+                     "cat local/db.conf; "
+                     'echo "env=$NOMAD_SECRET_DB_CREDS_PASS"; '
+                     "sleep 60"],
+        }
+        t.templates = [Template(
+            embedded_tmpl="pass=${NOMAD_SECRET_DB_CREDS_PASS}\n",
+            dest_path="local/db.conf",
+            change_mode="restart")]
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: _running_alloc(api, job.id) is not None)
+        alloc = _running_alloc(api, job.id)
+        assert _wait(lambda: b"pass=v1" in _logs(api, alloc.id, t.name))
+
+        a.server.secret_upsert(SecretEntry(
+            namespace="default", path="db/creds",
+            data={"pass": "v2"}))
+
+        # watcher re-fetches the secret, re-renders, restarts: the new
+        # run logs the new file AND the refreshed env
+        assert _wait(
+            lambda: b"pass=v2" in _logs(api, alloc.id, t.name)
+            and b"env=v2" in _logs(api, alloc.id, t.name),
+            timeout=30), _logs(api, alloc.id, t.name)
+        states = _running_alloc(api, job.id).task_states[t.name]
+        assert states.restarts >= 1
+
+    def test_watcher_stops_when_task_completes(self, agent):
+        """A naturally-completed task's watcher exits — no perpetual
+        polling or change_mode events on a dead task."""
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", "cat local/up.conf"]}
+        t.templates = [Template(
+            embedded_tmpl="up=${service.nothere}\n",
+            dest_path="local/up.conf")]
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: any(
+            al.client_status == "complete"
+            for al in api.job_allocations(job.id)))
+        alloc = next(al for al in api.job_allocations(job.id)
+                     if al.client_status == "complete")
+        runner = a.client.alloc_runner(alloc.id)
+        tr = next(x for x in runner.task_runners.values()
+                  if x.task.name == t.name)
+        assert tr._tmpl_stop.is_set()
+        assert _wait(lambda: tr._tmpl_thread is None
+                     or not tr._tmpl_thread.is_alive(), timeout=5)
+
+    def test_static_template_spawns_no_watcher(self, agent):
+        """Templates with no dynamic source never start a watch thread."""
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", "cat local/static.conf; sleep 30"]}
+        t.templates = [Template(
+            embedded_tmpl="dc=${node.datacenter}\n",
+            dest_path="local/static.conf")]
+        api.wait_for_eval(api.register_job(job))
+        assert _wait(lambda: _running_alloc(api, job.id) is not None)
+        alloc = _running_alloc(api, job.id)
+        runner = a.client.alloc_runner(alloc.id)
+        for tr in runner.task_runners.values():
+            assert tr._tmpl_thread is None
