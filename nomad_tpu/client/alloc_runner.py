@@ -57,7 +57,8 @@ class AllocRunner:
         # hook.go; pushes to the native catalog over conn)
         from .services import ServiceHook
 
-        self.services = ServiceHook(alloc, node, conn)
+        self.services = ServiceHook(alloc, node, conn,
+                                    exec_fn=self._exec_in_task)
         #: deployment health watcher (allochealth.py; reference
         #: health_hook.go starts it only for deployment-tracked allocs)
         self.health_tracker = None
@@ -547,6 +548,17 @@ class AllocRunner:
             except RuntimeError:
                 pass
         return n
+
+    def _exec_in_task(self, task_name: str, command: str, args,
+                      timeout_s: float) -> dict:
+        """Script-check exec leg (script_check_hook.go:60): run a
+        command inside the named task via its driver."""
+        with self._lock:
+            tr = self.task_runners.get(task_name)
+        if tr is None or tr.handle is None:
+            raise RuntimeError(f"task {task_name!r} is not running")
+        return tr.driver.exec_task(tr.handle, command, list(args or []),
+                                   timeout_s=timeout_s)
 
     def kill(self) -> None:
         # a server-initiated stop of an undecided alloc (drain,

@@ -90,6 +90,9 @@ class InProcConn:
     def services_lookup(self, namespace, name):
         return self.server.services_lookup(namespace, name)
 
+    def connect_issue(self, service_name):
+        return self.server.connect_issue(service_name)
+
 
 class RpcConn:
     """Server connection over the msgpack-RPC fabric with failover across
@@ -175,6 +178,9 @@ class RpcConn:
 
     def services_lookup(self, namespace, name):
         return self._call("services_lookup", namespace, name)
+
+    def connect_issue(self, service_name):
+        return self._call("connect_issue", service_name)
 
 
 class ClientConfig:

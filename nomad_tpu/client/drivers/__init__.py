@@ -16,6 +16,7 @@ from __future__ import annotations
 from typing import Dict, Type
 
 from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
+from .connect import ConnectProxyDriver
 from .docker import DockerDriver
 from .executor_driver import (ExecDriver, ExecutorBackedDriver,
                               RawExecDriver)
@@ -31,6 +32,7 @@ BUILTIN_DRIVERS: Dict[str, Type[DriverPlugin]] = {
     "docker": DockerDriver,
     "java": JavaDriver,
     "qemu": QemuDriver,
+    "connect_proxy": ConnectProxyDriver,
 }
 
 

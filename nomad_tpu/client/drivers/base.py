@@ -1,9 +1,15 @@
 """Driver plugin contract (reference `plugins/drivers/driver.go`)."""
 from __future__ import annotations
 
+import signal as _signal
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
+
+#: signal-name → number map shared by every driver (and the executor
+#: plugin) that kills by name — one definition
+SIGNALS = {name: getattr(_signal, name) for name in dir(_signal)
+           if name.startswith("SIG") and not name.startswith("SIG_")}
 
 
 @dataclass
@@ -86,6 +92,11 @@ class DriverPlugin:
     """Base driver (plugins/drivers/driver.go DriverPlugin)."""
 
     name = "base"
+    #: whether recover_task can adopt a live task after agent restart.
+    #: Drivers without a reattach path must NOT be detached at agent
+    #: shutdown — their processes would be orphaned forever — so the
+    #: task runner kills them instead (task_runner.detach)
+    reattachable = True
 
     def __init__(self, plugin_config: Optional[dict] = None) -> None:
         #: operator-supplied driver config (agent `plugin "<name>" {}`

@@ -1,0 +1,125 @@
+"""connect_proxy driver — runs the built-in mesh sidecar.
+
+The reference runs Envoy under the docker driver with a bootstrap hook
+(`job_endpoint_hook_connect.go:25` connectSidecarDriverConfig,
+`taskrunner/envoy_bootstrap_hook.go`); this build's envoy analog is
+`nomad_tpu/connect_proxy.py`, so its driver just supervises that child
+process directly: no image pull, no bootstrap file, certs already
+materialized by the task runner's connect hook
+(`client/task_runner.py _ensure_connect_certs`).
+
+Deliberately NOT executor-backed: the proxy is framework code (trusted,
+resource-light) and must survive with minimal moving parts; a proxy
+lost to an agent restart is simply relaunched (its listeners rebind the
+same allocated ports), so no reattach machinery is carried.
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+from .base import SIGNALS, DriverPlugin, ExitResult, TaskConfig, TaskHandle
+
+
+class ConnectProxyDriver(DriverPlugin):
+    name = "connect_proxy"
+    #: no reattach (docstring) — agent shutdown must kill, not detach,
+    #: or the old proxy squats the allocated ports forever
+    reattachable = False
+
+    def start_task(self, cfg: TaskConfig) -> TaskHandle:
+        rc = cfg.raw_config
+        listen = int(cfg.ports.get(rc.get("listen_label", ""), 0) or 0)
+        target = int(cfg.env.get("NOMAD_CONNECT_TARGET_PORT", 0) or 0)
+        args = [sys.executable, "-m", "nomad_tpu.connect_proxy",
+                "--listen", str(listen), "--target", str(target),
+                "--upstreams-file",
+                os.path.join(cfg.task_dir, "local", "upstreams.json")]
+        for u in rc.get("upstreams", []) or []:
+            args += ["--upstream", f"{u['name']}={u['bind']}"]
+        certs = {k: os.path.join(cfg.task_dir, "secrets",
+                                 f"connect-{k}.pem")
+                 for k in ("ca", "cert", "key")}
+        if all(os.path.exists(p) for p in certs.values()):
+            args += ["--ca", certs["ca"], "--cert", certs["cert"],
+                     "--key", certs["key"]]
+        env = dict(cfg.env)
+        # the proxy is framework code: it must import nomad_tpu no
+        # matter what the task env says
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))),
+                env.get("PYTHONPATH", "")] if p)
+        out = open(cfg.stdout_path, "ab") if cfg.stdout_path else None
+        err = open(cfg.stderr_path, "ab") if cfg.stderr_path else None
+        try:
+            proc = subprocess.Popen(
+                args, cwd=cfg.task_dir, env=env,
+                stdout=out or subprocess.DEVNULL,
+                stderr=err or subprocess.DEVNULL,
+                stdin=subprocess.DEVNULL)
+        finally:
+            for fh in (out, err):
+                if fh is not None:
+                    fh.close()  # the child holds its own descriptors
+        handle = TaskHandle(cfg.id, self.name,
+                            driver_state={"pid": proc.pid})
+        handle._proc = proc
+
+        def reap():
+            rcode = proc.wait()
+            handle.set_exit(ExitResult(exit_code=rcode if rcode >= 0 else 0,
+                                       signal=-rcode if rcode < 0 else 0))
+
+        threading.Thread(target=reap, daemon=True).start()
+        return handle
+
+    def stop_task(self, handle: TaskHandle, timeout_s: float = 5.0,
+                  signal: str = "SIGTERM") -> None:
+        proc = getattr(handle, "_proc", None)
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.send_signal(SIGNALS.get(signal, _signal.SIGTERM))
+            proc.wait(timeout=max(timeout_s, 0.1))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        except OSError:
+            pass
+
+    def destroy_task(self, handle: TaskHandle, force: bool = False) -> None:
+        proc = getattr(handle, "_proc", None)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def signal_task(self, handle: TaskHandle, sig: str = "SIGHUP") -> bool:
+        proc = getattr(handle, "_proc", None)
+        if proc is None or proc.poll() is not None:
+            raise RuntimeError("task is not running")
+        proc.send_signal(SIGNALS.get(sig, _signal.SIGHUP))
+        return True
+
+    def recover_task(self, task_id: str,
+                     driver_state: dict) -> Optional[TaskHandle]:
+        # no reattach (docstring): relaunch is cheap and idempotent,
+        # but the orphan from the previous agent must die first or the
+        # new proxy cannot bind its ports. Verify the pid still IS a
+        # connect proxy before killing — after a host reboot the kernel
+        # may have recycled it onto an unrelated process
+        pid = int(driver_state.get("pid", 0) or 0)
+        if pid > 1:
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read()
+            except OSError:
+                cmdline = b""
+            if b"connect_proxy" in cmdline:
+                try:
+                    os.kill(pid, _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        return None

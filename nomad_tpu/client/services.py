@@ -32,12 +32,17 @@ def _resolve_port(alloc, label: str) -> int:
 class ServiceHook:
     """Per-alloc service registration lifecycle + check runner."""
 
-    def __init__(self, alloc, node, conn, check_interval: float = 1.0
-                 ) -> None:
+    def __init__(self, alloc, node, conn, check_interval: float = 1.0,
+                 exec_fn=None) -> None:
         self.alloc = alloc
         self.node = node
         self.conn = conn
         self.check_interval = check_interval
+        #: exec-in-task callback for `type = "script"` checks
+        #: (task_name, command, args, timeout_s) -> {"exit_code": int};
+        #: the reference runs these through the driver Exec API
+        #: (taskrunner/script_check_hook.go:60)
+        self.exec_fn = exec_fn
         self._lock = threading.Lock()
         #: reg id → (registration, checks)
         self._regs: Dict[str, tuple] = {}
@@ -252,6 +257,19 @@ class ServiceHook:
     def _run_check(self, reg: ServiceRegistration, chk: dict) -> bool:
         port = _resolve_port(self.alloc, chk.get("port", "")) or reg.port
         timeout = float(chk.get("timeout_s", 2))
+        if chk.get("type") == "script":
+            # run INSIDE the task via driver exec (script_check_hook.go:
+            # 60; Consul script-check exit semantics: 0 = passing).
+            # Group-level services must name the task in the check.
+            task = chk.get("task") or reg.task_name
+            if self.exec_fn is None or not task:
+                return False
+            try:
+                res = self.exec_fn(task, chk.get("command", ""),
+                                   list(chk.get("args", [])), timeout)
+                return int(res.get("exit_code", 1)) == 0
+            except Exception:  # noqa: BLE001 — dead task/driver = critical
+                return False
         if chk.get("type") == "http":
             import urllib.request
 

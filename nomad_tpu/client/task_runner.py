@@ -337,12 +337,36 @@ class TaskRunner:
                     f"volume mount destination exists and is not empty: "
                     f"{vm.destination!r}")
             os.symlink(src, dest)
+        # connect hook: a native-mesh sidecar proxy gets its leaf cert
+        # from the server's connect CA before start (structs/connect.py
+        # marks injected proxies via NOMAD_CONNECT_SERVICE)
+        if "NOMAD_CONNECT_SERVICE" in self.task.env \
+                and self.conn is not None:
+            self._ensure_connect_certs()
         # template hook (taskrunner/template/template.go): render each
         # template's content with task-env interpolation into dest_path,
         # then watch dynamic sources and fire change_mode on re-render
         # (template.go:346 handleTemplateRerenders; _template_watch below)
         if self.task.templates:
             self._render_templates()
+
+    def _ensure_connect_certs(self) -> None:
+        """Write the sidecar's mTLS material (CA + leaf) into the task's
+        secrets dir. Idempotent: a restart keeps the existing leaf (the
+        CA is stable for the cluster's life)."""
+        import os
+
+        sdir = os.path.join(self.task_dir, "secrets")
+        paths = {k: os.path.join(sdir, f"connect-{k}.pem")
+                 for k in ("ca", "cert", "key")}
+        if all(os.path.exists(p) for p in paths.values()):
+            return
+        pems = self.conn.connect_issue(
+            self.task.env["NOMAD_CONNECT_SERVICE"])
+        for k, p in paths.items():
+            fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(pems[k])
 
     # ---- templates (taskrunner/template/template.go) ----
     #
@@ -606,6 +630,14 @@ class TaskRunner:
             shared_dir=f"{self.task_dir}/alloc",
         )
         env.update(self._secret_env)
+        if "NOMAD_CONNECT_TARGET_LABEL" in self.task.env:
+            # the sidecar proxies a port owned by ANOTHER task of the
+            # group; per-task port env can't see it, so resolve across
+            # the whole alloc here
+            _ip, allp = self.alloc.port_map("")
+            lbl = self.task.env["NOMAD_CONNECT_TARGET_LABEL"]
+            if lbl in allp:
+                env["NOMAD_CONNECT_TARGET_PORT"] = str(allp[lbl])
         raw = interpolate_config(dict(self.task.config), env, self.node)
         ip, ports = self.alloc.port_map(self.task.name)
         return TaskConfig(
@@ -661,7 +693,16 @@ class TaskRunner:
     def detach(self) -> None:
         """Stop the runner WITHOUT stopping the task (agent shutdown —
         the reference leaves tasks running and recovers their handles,
-        client.go shutdown semantics)."""
+        client.go shutdown semantics). A driver with no reattach path
+        gets a kill instead: its process could never be adopted back,
+        only orphaned. The kill is SYNCHRONOUS — the runner thread is a
+        daemon, so merely setting the event would let interpreter exit
+        reap the thread before driver.stop_task ever runs, orphaning
+        the child anyway."""
+        if not getattr(self.driver, "reattachable", True):
+            self.kill()
+            self.join(timeout=self.task.kill_timeout_s + 7.0)
+            return
         self._detach = True
         self._kill.set()
         self._tmpl_stop.set()

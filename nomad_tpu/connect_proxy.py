@@ -1,0 +1,225 @@
+"""connect_proxy — the built-in userspace mTLS sidecar (envoy analog).
+
+Launched per connect-enabled service by the `connect_proxy` driver
+(`client/drivers/connect.py`); injected at admission by
+`structs/connect.py`. Reference analog: the Envoy sidecar the reference
+bootstraps per connect service (`job_endpoint_hook_connect.go:25`
+connectSidecarDriverConfig, envoy bootstrap hook in
+`client/allocrunner/taskrunner/envoy_bootstrap_hook.go`).
+
+Data plane:
+- inbound: TLS server on 0.0.0.0:--listen REQUIRING a peer certificate
+  from the mesh CA (mutual TLS — the Connect intention default of
+  "cluster members only"), spliced to 127.0.0.1:--target (the local
+  service's real port).
+- outbound: one plaintext listener per --upstream name=port on
+  127.0.0.1:port; each accepted connection dials one of the
+  destination's sidecars with this proxy's leaf cert. Destination
+  addresses come from --upstreams-file (JSON {name: "ip:port,ip:port"}),
+  maintained by the dynamic-template watcher and re-read per connection
+  (SIGHUP is handled as a benign re-read poke so the watcher's
+  change_mode=signal cannot kill the proxy).
+
+Without --ca/--cert/--key the proxy runs plaintext (dev mode, like
+connect without a CA).
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import signal
+import socket
+import ssl
+import sys
+import threading
+
+
+def _log(msg: str) -> None:
+    print(f"connect-proxy: {msg}", flush=True)
+
+
+def _splice(a: socket.socket, b: socket.socket) -> None:
+    """Bidirectional byte shuttle with TCP half-close propagation: EOF
+    on one direction only ends that direction's write side — the
+    reverse stream keeps flowing until its own EOF (a one-shot client
+    that shutdown(WR)s after its request must still receive the full
+    response). Both sockets close when BOTH directions finish."""
+
+    def pump(src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+                try:
+                    s.shutdown(how)
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=pump, args=(a, b), daemon=True)
+    t.start()
+    pump(b, a)
+    t.join()  # wait out the reverse direction — do NOT cut it short
+    for s in (a, b):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+def _accept(lsock: socket.socket) -> socket.socket:
+    """accept() that survives transient errors (EMFILE under
+    connection-burst fd pressure, ECONNABORTED): a dead listener thread
+    in a live process would be a zombie sidecar — up, unrestartable,
+    refusing everything."""
+    import time
+
+    while True:
+        try:
+            conn, _addr = lsock.accept()
+            return conn
+        except OSError as e:
+            _log(f"accept error (retrying): {e}")
+            time.sleep(0.1)
+
+
+class Proxy:
+    def __init__(self, args: argparse.Namespace) -> None:
+        self.args = args
+        self.server_ctx = None
+        self.client_ctx = None
+        if args.ca and args.cert and args.key:
+            sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            sctx.load_cert_chain(args.cert, args.key)
+            sctx.load_verify_locations(args.ca)
+            sctx.verify_mode = ssl.CERT_REQUIRED  # mutual TLS
+            self.server_ctx = sctx
+            cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            cctx.load_cert_chain(args.cert, args.key)
+            cctx.load_verify_locations(args.ca)
+            cctx.check_hostname = False  # identity = CA membership
+            cctx.verify_mode = ssl.CERT_REQUIRED
+            self.client_ctx = cctx
+        #: round-robin counters per upstream
+        self._rr = {}
+
+    # -- inbound (mesh → local service) --------------------------------
+
+    def serve_inbound(self) -> None:
+        lsock = socket.create_server(("0.0.0.0", self.args.listen),
+                                     backlog=64, reuse_port=False)
+        _log(f"inbound listening :{self.args.listen} -> "
+             f"127.0.0.1:{self.args.target} "
+             f"({'mtls' if self.server_ctx else 'plaintext'})")
+        while True:
+            conn = _accept(lsock)
+            threading.Thread(target=self._handle_inbound, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_inbound(self, conn: socket.socket) -> None:
+        try:
+            if self.server_ctx is not None:
+                conn = self.server_ctx.wrap_socket(conn, server_side=True)
+            local = socket.create_connection(
+                ("127.0.0.1", self.args.target), timeout=10.0)
+        except (OSError, ssl.SSLError) as e:
+            _log(f"inbound reject: {e}")
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        _splice(conn, local)
+
+    # -- outbound (local app → destination sidecars) -------------------
+
+    def _addresses(self, name: str) -> list:
+        try:
+            with open(self.args.upstreams_file) as f:
+                table = json.load(f)
+        except (OSError, ValueError):
+            return []
+        raw = table.get(name, "")
+        return [a for a in raw.split(",") if a and ":" in a]
+
+    def serve_outbound(self, name: str, bind: int) -> None:
+        lsock = socket.create_server(("127.0.0.1", bind), backlog=64)
+        _log(f"upstream {name!r} listening 127.0.0.1:{bind}")
+        while True:
+            conn = _accept(lsock)
+            threading.Thread(target=self._handle_outbound,
+                             args=(name, conn), daemon=True).start()
+
+    def _handle_outbound(self, name: str, conn: socket.socket) -> None:
+        addrs = self._addresses(name)
+        if not addrs:
+            _log(f"upstream {name!r}: no healthy instances")
+            conn.close()
+            return
+        rr = self._rr.setdefault(name, itertools.count())
+        host, port = addrs[next(rr) % len(addrs)].rsplit(":", 1)
+        try:
+            remote = socket.create_connection((host, int(port)),
+                                              timeout=10.0)
+            if self.client_ctx is not None:
+                remote = self.client_ctx.wrap_socket(remote)
+        except (OSError, ssl.SSLError) as e:
+            _log(f"upstream {name!r} dial {host}:{port} failed: {e}")
+            conn.close()
+            return
+        _splice(conn, remote)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="connect-proxy")
+    ap.add_argument("--listen", type=int, default=0,
+                    help="inbound mesh port (the sidecar's catalog port)")
+    ap.add_argument("--target", type=int, default=0,
+                    help="local service port to splice inbound to")
+    ap.add_argument("--upstream", action="append", default=[],
+                    metavar="NAME=PORT",
+                    help="local bind for one upstream destination")
+    ap.add_argument("--upstreams-file", default="local/upstreams.json")
+    ap.add_argument("--ca", default="")
+    ap.add_argument("--cert", default="")
+    ap.add_argument("--key", default="")
+    # FIRST: SIGHUP must never kill the proxy (default disposition is
+    # terminate). Addresses are re-read per connection, so any HUP —
+    # operator or watcher — is a benign poke. Installed before argparse
+    # and TLS setup to shrink the unprotected boot window.
+    signal.signal(signal.SIGHUP, lambda *_: _log("upstreams updated"))
+    args = ap.parse_args(argv)
+
+    proxy = Proxy(args)
+    threads = []
+    if args.listen and args.target:
+        threads.append(threading.Thread(target=proxy.serve_inbound,
+                                        daemon=True))
+    for spec in args.upstream:
+        name, _, port = spec.partition("=")
+        threads.append(threading.Thread(
+            target=proxy.serve_outbound, args=(name, int(port)),
+            daemon=True))
+    if not threads:
+        _log("nothing to do (no inbound target, no upstreams)")
+        return 1
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    for t in threads:
+        t.start()
+    _log("ready")
+    while not stop.is_set():  # NOT signal.pause(): SIGHUP must not exit
+        stop.wait(3600)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,11 +3,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from ..structs.job import (Affinity, Constraint, EphemeralDisk, Job,
+from ..structs.job import (Affinity, Connect, ConnectProxy,
+                           ConnectUpstream, Constraint, EphemeralDisk, Job,
                            LogConfig, MigrateStrategy,
                            ParameterizedJobConfig, PeriodicConfig,
                            ReschedulePolicy, RestartPolicy, ScalingPolicy,
-                           Service, Spread, SpreadTarget, Task, TaskArtifact,
+                           Service, SidecarService, Spread, SpreadTarget,
+                           Task, TaskArtifact,
                            TaskGroup, TaskLifecycle, Template,
                            UpdateStrategy, VolumeMount, VolumeRequest)
 from ..structs.resources import (NetworkResource, Port, RequestedDevice,
@@ -319,13 +321,42 @@ def _parse_service(body: Dict[str, Any]) -> Service:
             "port": str(cb.get("port", "")),
             "interval_s": _seconds(cb.get("interval", 10)),
             "timeout_s": _seconds(cb.get("timeout", 2)),
+            # script checks (parse_service.go parseChecks: command/args;
+            # `task` names the exec target for group-level services)
+            "command": cb.get("command", ""),
+            "args": list(cb.get("args", [])),
+            "task": cb.get("task", ""),
         })
+    # connect { sidecar_service { proxy { upstreams { ... } } } }
+    # (jobspec/parse_service.go parseConnect); the native mesh injects
+    # its proxy at admission — structs/connect.py
+    conn = None
+    cb = _one(body.get("connect")) if body.get("connect") else None
+    if cb is not None:
+        sb = _one(cb.get("sidecar_service")) \
+            if cb.get("sidecar_service") is not None else None
+        sidecar = None
+        if sb is not None:
+            ups = []
+            pb = _one(sb.get("proxy")) if sb.get("proxy") else {}
+            for u in _many((pb or {}).get("upstreams")):
+                ub = _one(u)
+                ups.append(ConnectUpstream(
+                    destination_name=ub.get("destination_name", ""),
+                    local_bind_port=int(ub.get("local_bind_port", 0)),
+                ))
+            sidecar = SidecarService(
+                port_label=str(sb.get("port", "")),
+                proxy=ConnectProxy(upstreams=ups),
+            )
+        conn = Connect(sidecar_service=sidecar)
     return Service(
         name=body.get("name", ""),
         port_label=str(body.get("port", "")),
         tags=list(body.get("tags", [])),
         address_mode=body.get("address_mode", "auto"),
         checks=checks,
+        connect=conn,
     )
 
 

@@ -30,8 +30,7 @@ from typing import Dict, List, Optional
 from . import isolation
 from .base import serve_plugin
 
-_signals = {name: getattr(_signal, name) for name in dir(_signal)
-            if name.startswith("SIG") and not name.startswith("SIG_")}
+from ..client.drivers.base import SIGNALS as _signals
 
 
 class ExecutorService:

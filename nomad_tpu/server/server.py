@@ -92,6 +92,8 @@ class Server:
         # under the limit and both commit (ent reference serializes via
         # the raft apply path).
         self._admission_lock = threading.RLock()
+        # serializes lazy connect-CA creation (connect_issue)
+        self._connect_ca_lock = threading.Lock()
         #: node id → latest heartbeat-carried device stats (off-raft;
         #: devicemanager stats stream — see node_heartbeat)
         self._node_device_stats: Dict[str, dict] = {}
@@ -302,6 +304,16 @@ class Server:
             return self._job_register(job)
 
     def _job_register(self, job: Job) -> Optional[Evaluation]:
+        # connect admission hook (job_endpoint_hook_connect.go Mutate
+        # :90): inject the native-mesh sidecar proxy task/port/
+        # registration BEFORE validation and upsert so schedulers and
+        # clients see the full group
+        from ..structs.connect import inject_sidecars, validate_connect
+
+        cerr = validate_connect(job)
+        if cerr:
+            raise ValueError(cerr)
+        inject_sidecars(job)
         err = job.validate() if hasattr(job, "validate") else None
         if err:
             raise ValueError(err)
@@ -699,16 +711,29 @@ class Server:
     # ---- secrets KV (the Vault-analog engine; nomad/vault.go's role
     # collapsed into replicated state — see structs/secrets.py) ----
 
+    @staticmethod
+    def _check_secret_ns(namespace: str) -> None:
+        """The `nomad/` namespace prefix is reserved for framework
+        internals (the mesh CA key lives at nomad/connect:ca) — the
+        public secrets surface must not read, overwrite, or delete it:
+        a readable CA key lets anyone mint mesh leaf certs, and a
+        delete silently splits the mesh onto a fresh CA."""
+        if namespace.startswith("nomad/"):
+            raise PermissionError(f"namespace {namespace!r} is reserved")
+
     def secret_upsert(self, entry) -> None:
+        self._check_secret_ns(entry.namespace)
         if not entry.path or entry.path.startswith("/") \
                 or ".." in entry.path.split("/"):
             raise ValueError(f"invalid secret path {entry.path!r}")
         self.state.upsert_secret(entry)
 
     def secret_delete(self, namespace: str, path: str) -> None:
+        self._check_secret_ns(namespace)
         self.state.delete_secret(namespace, path)
 
     def secret_get(self, namespace: str, path: str):
+        self._check_secret_ns(namespace)
         return self.state.secret_get(namespace, path)
 
     def services_lookup(self, namespace: str, name: str):
@@ -717,7 +742,63 @@ class Server:
         reads the native catalog instead of a Consul agent)."""
         return self.state.services_by_name(namespace, name)
 
+    # ---- native mesh CA (the Consul Connect CA analog) ----
+
+    #: reserved secrets namespace holding the mesh CA — raft-replicated
+    #: with everything else, invisible to task secret paths (those are
+    #: read from the TASK's namespace)
+    CONNECT_NS = "nomad/connect"
+
+    def connect_issue(self, service_name: str) -> dict:
+        """Issue a leaf certificate for one sidecar proxy, signed by the
+        cluster's connect CA (lazily created, stored in the replicated
+        secrets table so every server signs with the same root —
+        Consul's Connect CA model). Returns PEM strings.
+
+        Reference analog: Envoy sidecars receive leaf certs from
+        Consul's CA (`plugins`/SI-token flow); here the server IS the
+        CA and the client writes the PEMs into the proxy task's secrets
+        dir (client/task_runner.py connect hook)."""
+        import os
+        import tempfile
+
+        from ..lib import tlsutil
+        from ..structs.secrets import SecretEntry
+
+        with self._connect_ca_lock:
+            entry = self.state.secret_get(self.CONNECT_NS, "ca")
+            if entry is None:
+                with tempfile.TemporaryDirectory() as d:
+                    cert_p, key_p = tlsutil.generate_ca(
+                        d, cn="nomad-tpu-connect-ca")
+                    with open(cert_p) as f:
+                        ca_pem = f.read()
+                    with open(key_p) as f:
+                        ca_key_pem = f.read()
+                self.state.upsert_secret(SecretEntry(
+                    namespace=self.CONNECT_NS, path="ca",
+                    data={"cert": ca_pem, "key": ca_key_pem}))
+            else:
+                ca_pem = entry.data["cert"]
+                ca_key_pem = entry.data["key"]
+        with tempfile.TemporaryDirectory() as d:
+            ca_cert_p = os.path.join(d, "ca.pem")
+            ca_key_p = os.path.join(d, "ca-key.pem")
+            with open(ca_cert_p, "w") as f:
+                f.write(ca_pem)
+            with open(ca_key_p, "w") as f:
+                f.write(ca_key_pem)
+            cert_p, key_p = tlsutil.issue_cert(
+                d, ca_cert_p, ca_key_p, cn=service_name,
+                sans=[service_name, "localhost"], name="leaf")
+            with open(cert_p) as f:
+                cert_pem = f.read()
+            with open(key_p) as f:
+                key_pem = f.read()
+        return {"ca": ca_pem, "cert": cert_pem, "key": key_pem}
+
     def secrets_list(self, namespace: str):
+        self._check_secret_ns(namespace)
         return self.state.secrets_list(namespace)
 
     def node_update_allocs(self, updates: List[Allocation]) -> None:

@@ -1,0 +1,134 @@
+"""Native service mesh — sidecar injection at job admission.
+
+Behavioral reference: `nomad/job_endpoint_hook_connect.go` (Mutate :90 →
+groupConnectHook :101 injects a sidecar proxy task + port + registration
+per connect-enabled group service; sidecar resources :16, idempotency via
+getSidecarTaskForService :125). The reference bootstraps Envoy against
+Consul; this build injects a built-in userspace mTLS proxy task (driver
+`connect_proxy`, `nomad_tpu/client/drivers/connect.py`) whose upstream
+addresses ride the DYNAMIC TEMPLATE machinery over the native catalog
+(`${service.<dest>-sidecar-proxy}` + change_mode=signal), and whose leaf
+certificates are issued by the server's raft-replicated connect CA
+(`Server.connect_issue`).
+"""
+from __future__ import annotations
+
+import json
+
+from .job import Service, Task, TaskGroup, TaskLifecycle, Template
+from .resources import NetworkResource, Port, Resources
+
+#: injected task name prefix (reference: "connect-proxy-<service>")
+PROXY_TASK_PREFIX = "connect-proxy-"
+#: catalog name suffix for the sidecar's own registration (reference
+#: registers "<service>-sidecar-proxy" in Consul)
+SIDECAR_SUFFIX = "-sidecar-proxy"
+
+
+def _env_slug(name: str) -> str:
+    return name.upper().replace("-", "_").replace(".", "_")
+
+
+def proxy_port_label(svc_name: str) -> str:
+    return f"connect_proxy_{svc_name.replace('-', '_')}"
+
+
+def inject_sidecars(job) -> None:
+    """Mutate `job` in place: one proxy task + dynamic port + sidecar
+    registration per connect-enabled GROUP service, plus
+    NOMAD_UPSTREAM_ADDR_* env on the group's application tasks.
+    Idempotent — re-registering an already-injected job changes nothing
+    (job_endpoint_hook_connect.go getSidecarTaskForService)."""
+    for tg in job.task_groups:
+        for svc in tg.services:
+            if svc.connect is None or svc.connect.sidecar_service is None:
+                continue
+            _inject_group_sidecar(tg, svc)
+
+
+def validate_connect(job) -> str:
+    """Connect stanzas are group-service only (the reference rejects
+    task-service connect the same way)."""
+    for tg in job.task_groups:
+        for task in tg.tasks:
+            for svc in task.services:
+                if svc.connect is not None:
+                    return (f"task {task.name!r} service {svc.name!r}: "
+                            "connect is only valid on group services")
+    return ""
+
+
+def _inject_group_sidecar(tg: TaskGroup, svc: Service) -> None:
+    sidecar = svc.connect.sidecar_service
+    task_name = PROXY_TASK_PREFIX + svc.name
+    label = proxy_port_label(svc.name)
+    ups = list(sidecar.proxy.upstreams)
+
+    # upstream env on application tasks (reference taskenv
+    # NOMAD_UPSTREAM_ADDR_<dest>) — ASSIGNED (not setdefault) so a
+    # changed local_bind_port on re-register propagates
+    for task in tg.tasks:
+        if task.name.startswith(PROXY_TASK_PREFIX):
+            continue
+        for u in ups:
+            task.env[
+                f"NOMAD_UPSTREAM_ADDR_{_env_slug(u.destination_name)}"
+            ] = f"127.0.0.1:{u.local_bind_port}"
+
+    # the sidecar's own catalog row: how OTHER sidecars reach this
+    # service over the mesh
+    if not any(s.name == svc.name + SIDECAR_SUFFIX for s in tg.services):
+        tg.services.append(Service(
+            name=svc.name + SIDECAR_SUFFIX,
+            port_label=label,
+            tags=["connect-proxy"],
+        ))
+
+    proxy = next((t for t in tg.tasks if t.name == task_name), None)
+    if proxy is None:
+        proxy = Task(
+            name=task_name,
+            driver="connect_proxy",
+            lifecycle=TaskLifecycle(hook="prestart", sidecar=True),
+            # connectSidecarResources (job_endpoint_hook_connect.go:16):
+            # 250 MHz / 128 MiB defaults
+            resources=Resources(
+                cpu=250, memory_mb=128,
+                networks=[NetworkResource(
+                    mbits=10, dynamic_ports=[Port(label=label)])],
+            ),
+        )
+        tg.tasks.append(proxy)
+    # the rest is REBUILT on every register — a re-register that adds
+    # or rebinds upstreams must reach the proxy's listeners and its
+    # discovery template, not just the app env
+    proxy.env.update({
+        # markers the task runner resolves at start time: leaf-cert
+        # issuance (conn.connect_issue) + cross-task target port
+        "NOMAD_CONNECT_SERVICE": svc.name,
+        "NOMAD_CONNECT_TARGET_LABEL":
+            sidecar.port_label or svc.port_label,
+    })
+    proxy.config = {
+        "listen_label": label,
+        "upstreams": [
+            {"name": u.destination_name, "bind": u.local_bind_port}
+            for u in ups],
+    }
+    proxy.templates = [t for t in proxy.templates
+                       if t.dest_path != "local/upstreams.json"]
+    if ups:
+        # upstream discovery via the dynamic-template watcher: the
+        # catalog rows for each destination's sidecar render into
+        # local/upstreams.json (the consul-template→envoy xDS analog).
+        # change_mode=noop, NOT signal: the proxy re-reads the file per
+        # connection, and a signal racing the proxy's interpreter boot
+        # (before its SIGHUP handler installs) would kill it
+        mapping = {u.destination_name:
+                   "${service." + u.destination_name + SIDECAR_SUFFIX
+                   + "}" for u in ups}
+        proxy.templates.append(Template(
+            embedded_tmpl=json.dumps(mapping),
+            dest_path="local/upstreams.json",
+            change_mode="noop",
+        ))

@@ -164,6 +164,40 @@ class VolumeMount:
 
 
 @dataclass
+class ConnectUpstream:
+    """Reference `structs.ConsulUpstream` (services.go): a mesh
+    destination bound to a local port on the consuming group."""
+
+    destination_name: str = ""
+    local_bind_port: int = 0
+
+
+@dataclass
+class ConnectProxy:
+    """Reference `structs.ConsulProxy` (services.go)."""
+
+    upstreams: List[ConnectUpstream] = field(default_factory=list)
+
+
+@dataclass
+class SidecarService:
+    """Reference `structs.ConsulSidecarService` (services.go:671+)."""
+
+    port_label: str = ""
+    proxy: ConnectProxy = field(default_factory=ConnectProxy)
+
+
+@dataclass
+class Connect:
+    """Reference `structs.ConsulConnect` (services.go:671). This build's
+    mesh is NATIVE: the server injects a built-in mTLS proxy task (the
+    envoy analog) instead of bootstrapping Envoy against Consul —
+    structs/connect.py."""
+
+    sidecar_service: Optional[SidecarService] = None
+
+
+@dataclass
 class Service:
     """Service registration (reference `structs.Service`, structs.go:5244).
     Consul integration is stubbed; the shape is kept for jobspec parity."""
@@ -173,6 +207,7 @@ class Service:
     address_mode: str = "auto"
     tags: List[str] = field(default_factory=list)
     checks: List[dict] = field(default_factory=list)
+    connect: Optional[Connect] = None
 
 
 @dataclass

@@ -130,7 +130,7 @@ class TestStickyDiskMigration:
         # budget still flaked (round-5), so it carries real headroom now
         assert _wait(lambda: any(
             al.client_status == "complete" and al.job_version == 1
-            for al in api.job_allocations(job.id)), timeout=180.0), [
+            for al in api.job_allocations(job.id)), timeout=240.0), [
             (al.id[:8], al.client_status, al.desired_status,
              al.job_version,
              {t: (ts.state, ts.failed,

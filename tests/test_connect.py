@@ -1,0 +1,316 @@
+"""Native service mesh (Connect analog): admission injection, jobspec
+parse, and an end-to-end mTLS mesh between two jobs.
+
+Behavioral reference: `nomad/job_endpoint_hook_connect.go` (sidecar
+injection), `nomad/structs/services.go:671` (ConsulConnect),
+`client/allocrunner/taskrunner/envoy_bootstrap_hook.go` (the sidecar
+runtime this build replaces with `nomad_tpu/connect_proxy.py`).
+"""
+import socket
+import ssl
+import sys
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import NomadClient
+from nomad_tpu.client.task_runner import TaskRunner
+from nomad_tpu.structs.connect import inject_sidecars
+from nomad_tpu.structs.job import (Connect, ConnectProxy, ConnectUpstream,
+                                   SidecarService)
+
+
+def _wait(cond, timeout=30.0, step=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+def _logs(api, alloc_id, task):
+    try:
+        return api.alloc_logs(alloc_id, task)
+    except Exception:
+        return b""
+
+
+class TestInjection:
+    def _job(self):
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        from nomad_tpu.structs.job import Service
+
+        tg.services.append(Service(
+            name="api", port_label="http",
+            connect=Connect(sidecar_service=SidecarService(
+                proxy=ConnectProxy(upstreams=[ConnectUpstream(
+                    destination_name="db", local_bind_port=9191)])))))
+        return job
+
+    def test_sidecar_task_port_service_injected(self):
+        job = self._job()
+        inject_sidecars(job)
+        tg = job.task_groups[0]
+        proxy = next(t for t in tg.tasks
+                     if t.name == "connect-proxy-api")
+        assert proxy.driver == "connect_proxy"
+        assert proxy.lifecycle is not None and proxy.lifecycle.sidecar
+        labels = [p.label for n in proxy.resources.networks
+                  for p in n.dynamic_ports]
+        assert "connect_proxy_api" in labels
+        assert any(s.name == "api-sidecar-proxy" and
+                   s.port_label == "connect_proxy_api"
+                   for s in tg.services)
+        # upstream env on the app task, not the proxy
+        app = next(t for t in tg.tasks if t.name != proxy.name)
+        assert app.env["NOMAD_UPSTREAM_ADDR_DB"] == "127.0.0.1:9191"
+        assert "NOMAD_UPSTREAM_ADDR_DB" not in proxy.env
+        # discovery template over the destination's sidecar rows
+        assert proxy.templates and \
+            "${service.db-sidecar-proxy}" in proxy.templates[0].embedded_tmpl
+        assert proxy.templates[0].change_mode == "noop"
+
+    def test_injection_is_idempotent(self):
+        job = self._job()
+        inject_sidecars(job)
+        before = [t.name for t in job.task_groups[0].tasks]
+        inject_sidecars(job)
+        inject_sidecars(job)
+        assert [t.name for t in job.task_groups[0].tasks] == before
+        assert sum(1 for s in job.task_groups[0].services
+                   if s.name == "api-sidecar-proxy") == 1
+
+    def test_reregister_rebuilds_proxy_upstreams(self):
+        """Adding/rebinding an upstream on re-register must reach the
+        proxy's listeners and discovery template, not just app env."""
+        job = self._job()
+        inject_sidecars(job)
+        svc = next(s for s in job.task_groups[0].services
+                   if s.name == "api")
+        svc.connect.sidecar_service.proxy.upstreams.append(
+            ConnectUpstream(destination_name="cache",
+                            local_bind_port=9292))
+        svc.connect.sidecar_service.proxy.upstreams[0] \
+            .local_bind_port = 9199  # rebind db
+        inject_sidecars(job)
+        tg = job.task_groups[0]
+        proxy = next(t for t in tg.tasks
+                     if t.name == "connect-proxy-api")
+        assert {"name": "cache", "bind": 9292} in proxy.config["upstreams"]
+        assert {"name": "db", "bind": 9199} in proxy.config["upstreams"]
+        assert len(proxy.templates) == 1
+        assert "cache-sidecar-proxy" in proxy.templates[0].embedded_tmpl
+        app = next(t for t in tg.tasks if t.name != proxy.name)
+        assert app.env["NOMAD_UPSTREAM_ADDR_CACHE"] == "127.0.0.1:9292"
+        assert app.env["NOMAD_UPSTREAM_ADDR_DB"] == "127.0.0.1:9199"
+
+
+class TestParse:
+    def test_connect_stanza_parses(self):
+        from nomad_tpu.jobspec import parse
+
+        job = parse('''
+        job "mesh" {
+          group "g" {
+            service {
+              name = "api"
+              port = "http"
+              connect {
+                sidecar_service {
+                  proxy {
+                    upstreams {
+                      destination_name = "db"
+                      local_bind_port  = 9191
+                    }
+                  }
+                }
+              }
+            }
+            task "t" {
+              driver = "raw_exec"
+              config { command = "/bin/true" }
+            }
+          }
+        }
+        ''')
+        svc = job.task_groups[0].services[0]
+        assert svc.connect is not None
+        ups = svc.connect.sidecar_service.proxy.upstreams
+        assert ups[0].destination_name == "db"
+        assert ups[0].local_bind_port == 9191
+
+
+@pytest.fixture()
+def agent(tmp_path, monkeypatch):
+    monkeypatch.setattr(TaskRunner, "TEMPLATE_POLL_S", 0.25)
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+    yield a, api
+    # stop jobs BEFORE shutdown: agent shutdown detaches long-running
+    # executor tasks for recovery, and this suite's never-exiting
+    # servers would squat their dynamic ports for every later test
+    try:
+        alloc_ids = [al.id for j in api.jobs()
+                     for al in api.job_allocations(j.id)]
+        for j in api.jobs():
+            api.deregister_job(j.id)
+        _wait(lambda: all(
+            api.allocation(aid).client_status
+            in ("complete", "failed", "lost") for aid in alloc_ids),
+            timeout=15)
+        time.sleep(0.5)
+    except Exception:
+        pass
+    a.shutdown()
+
+
+class TestMeshCA:
+    def test_ca_namespace_reserved_from_secrets_surface(self, agent):
+        """The raft-replicated mesh CA key must not be readable,
+        overwritable, or deletable through the public secrets API."""
+        from nomad_tpu.structs.secrets import SecretEntry
+
+        a, api = agent
+        pems = a.server.connect_issue("svc-a")
+        assert "BEGIN CERTIFICATE" in pems["cert"]
+        # a second issue signs with the SAME root
+        assert a.server.connect_issue("svc-b")["ca"] == pems["ca"]
+        for fn in (lambda: a.server.secret_get("nomad/connect", "ca"),
+                   lambda: a.server.secret_delete("nomad/connect", "ca"),
+                   lambda: a.server.secrets_list("nomad/connect"),
+                   lambda: a.server.secret_upsert(SecretEntry(
+                       namespace="nomad/connect", path="ca",
+                       data={"cert": "x", "key": "y"}))):
+            with pytest.raises(PermissionError):
+                fn()
+
+
+_BACKEND_PY = """
+import os
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"mesh-ok")
+    def log_message(self, *a):
+        pass
+
+print("backend up", flush=True)
+HTTPServer(("127.0.0.1", int(os.environ["NOMAD_PORT_HTTP"])),
+           H).serve_forever()
+"""
+
+_FRONTEND_PY = """
+import os, time, urllib.request
+addr = os.environ["NOMAD_UPSTREAM_ADDR_API"]
+while True:
+    try:
+        with urllib.request.urlopen(f"http://{addr}/", timeout=3) as r:
+            print("got:", r.read().decode(), flush=True)
+    except Exception as e:
+        print("retry:", e, flush=True)
+    time.sleep(0.5)
+"""
+
+
+class TestMeshE2E:
+    def test_traffic_traverses_mtls_mesh(self, agent):
+        """frontend app → frontend sidecar (upstream) → TLS → backend
+        sidecar → backend app, with catalog-driven discovery; and the
+        backend sidecar refuses non-mesh (plaintext / certless) peers."""
+        from nomad_tpu.structs.job import Service
+        from nomad_tpu.structs.resources import NetworkResource, Port
+
+        a, api = agent
+
+        be = mock.job()
+        be.id = be.name = "mesh-backend"
+        tg = be.task_groups[0]
+        tg.count = 1
+        # fast retry: a dynamic port picked by this agent can collide
+        # with a dying orphan task from an earlier test's agent (shared
+        # 20000+ range); the bind failure must not park the task in the
+        # default long restart backoff
+        tg.restart_policy.delay_s = 1.0
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.resources.networks = [NetworkResource(
+            mbits=10, dynamic_ports=[Port(label="http")])]
+        t.config = {"command": sys.executable,
+                    "args": ["-c", _BACKEND_PY]}
+        tg.services = [Service(
+            name="api", port_label="http",
+            connect=Connect(sidecar_service=SidecarService()))]
+        api.wait_for_eval(api.register_job(be))
+
+        fe = mock.job()
+        fe.id = fe.name = "mesh-frontend"
+        tg = fe.task_groups[0]
+        tg.count = 1
+        tg.restart_policy.delay_s = 1.0  # see backend note
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.resources.networks = [NetworkResource(
+            mbits=10, dynamic_ports=[Port(label="fp")])]
+        t.config = {"command": sys.executable,
+                    "args": ["-c", _FRONTEND_PY]}
+        tg.services = [Service(
+            name="web", port_label="fp",
+            connect=Connect(sidecar_service=SidecarService(
+                proxy=ConnectProxy(upstreams=[ConnectUpstream(
+                    destination_name="api",
+                    local_bind_port=29391)])))) ]
+        api.wait_for_eval(api.register_job(fe))
+
+        fe_alloc = None
+
+        def fe_running():
+            nonlocal fe_alloc
+            fe_alloc = next(
+                (al for al in api.job_allocations(fe.id)
+                 if al.client_status == "running"), None)
+            return fe_alloc is not None
+        assert _wait(fe_running, timeout=60)
+
+        # the full mesh path delivers the backend's payload (90s: port
+        # collisions with orphans of earlier tests' agents can hold a
+        # task in 1s-retry for up to ~60s before the orphan exits)
+        assert _wait(
+            lambda: b"got: mesh-ok" in _logs(api, fe_alloc.id, "web"),
+            timeout=90), _logs(api, fe_alloc.id, "web")
+
+        # mTLS enforcement on the backend sidecar's public port
+        regs = a.server.services_lookup("default", "api-sidecar-proxy")
+        assert regs, "sidecar never registered"
+        port = regs[0].port
+        # plaintext HTTP straight at the mesh port: the TLS server must
+        # not answer it
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/", timeout=3) as r:
+                body = r.read()
+        except Exception:
+            body = b""
+        assert b"mesh-ok" not in body
+        # TLS WITHOUT a client cert: handshake must fail (CERT_REQUIRED)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        with pytest.raises(ssl.SSLError):
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=3) as raw:
+                tls = ctx.wrap_socket(raw)
+                tls.send(b"GET / HTTP/1.0\r\n\r\n")
+                tls.recv(64)

@@ -184,3 +184,50 @@ class TestServiceJobspec:
         assert svc.checks[0]["type"] == "http"
         assert svc.checks[0]["path"] == "/health"
         assert svc.checks[0]["interval_s"] == 5.0
+
+
+class TestScriptChecks:
+    """`check { type = "script" }` runs inside the task via driver exec
+    (reference taskrunner/script_check_hook.go:60; Consul exit-code
+    semantics: 0 = passing)."""
+
+    def test_script_check_flips_on_task_state(self, agent):
+        server, client = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh",
+                    "args": ["-c", "sleep 2; touch local/ok; sleep 60"]}
+        t.services = [Service(name="script-svc", checks=[{
+            "type": "script", "command": "/bin/sh",
+            "args": ["-c", "test -f local/ok"],
+            "interval_s": 0.5, "timeout_s": 5,
+        }])]
+        server.job_register(job)
+        regs = lambda: server.state.services_by_name(  # noqa: E731
+            "default", "script-svc")
+        assert _wait(lambda: len(regs()) == 1)
+        # critical until the task creates the probed file...
+        assert regs()[0].status == "critical"
+        # ...then passing once the in-task exec sees it
+        assert _wait(lambda: regs()[0].status == "passing", timeout=30)
+
+    def test_group_service_script_check_names_task(self, agent):
+        server, client = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh", "args": ["-c", "sleep 60"]}
+        tg.services = [Service(name="group-script-svc", checks=[{
+            "type": "script", "command": "/bin/true", "args": [],
+            "task": t.name, "interval_s": 0.5, "timeout_s": 5,
+        }])]
+        server.job_register(job)
+        regs = lambda: server.state.services_by_name(  # noqa: E731
+            "default", "group-script-svc")
+        assert _wait(lambda: len(regs()) == 1)
+        assert _wait(lambda: regs()[0].status == "passing", timeout=30)

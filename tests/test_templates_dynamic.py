@@ -37,6 +37,19 @@ def agent(tmp_path, monkeypatch):
     api = NomadClient(a.http_addr[0], a.http_addr[1])
     assert _wait(lambda: len(api.nodes()) == 1)
     yield a, api
+    # stop jobs BEFORE shutdown — shutdown detaches executor tasks for
+    # recovery, and this file's long sleeps would outlive the test
+    try:
+        alloc_ids = [al.id for j in api.jobs()
+                     for al in api.job_allocations(j.id)]
+        for j in api.jobs():
+            api.deregister_job(j.id)
+        _wait(lambda: all(
+            api.allocation(aid).client_status
+            in ("complete", "failed", "lost") for aid in alloc_ids),
+            timeout=15)
+    except Exception:
+        pass
     a.shutdown()
 
 
