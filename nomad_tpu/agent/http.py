@@ -1262,6 +1262,12 @@ class HTTPApi:
             if ns == "*":
                 raise HttpError(400,
                                 "secrets require a concrete namespace")
+            # reserved framework namespaces (the mesh CA key lives at
+            # nomad/connect:ca) — the GET/list legs below read state
+            # directly, so the server-method guard alone would not
+            # cover them (Server._check_secret_ns)
+            if ns.startswith("nomad/"):
+                raise HttpError(403, f"namespace {ns!r} is reserved")
         if parts == ["secrets"]:
             require_ns("secrets-read")
             return blocking(lambda snap: (

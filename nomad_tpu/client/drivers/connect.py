@@ -41,6 +41,8 @@ class ConnectProxyDriver(DriverPlugin):
                 os.path.join(cfg.task_dir, "local", "upstreams.json")]
         for u in rc.get("upstreams", []) or []:
             args += ["--upstream", f"{u['name']}={u['bind']}"]
+        if rc.get("public"):
+            args += ["--public"]  # ingress gateway mode
         certs = {k: os.path.join(cfg.task_dir, "secrets",
                                  f"connect-{k}.pem")
                  for k in ("ca", "cert", "key")}

@@ -74,6 +74,20 @@ def _splice(a: socket.socket, b: socket.socket) -> None:
             pass
 
 
+def _bind_or_die(addr) -> socket.socket:
+    """Bind, or EXIT the whole process. A bind failure in a daemon
+    serve thread would otherwise leave a zombie sidecar: the process
+    stays 'running' (so the restart policy never fires) and its catalog
+    row stays discoverable, while nothing listens. Exiting lets the
+    task fail visibly and restart — which also resolves transient
+    EADDRINUSE against a dying orphan's port."""
+    try:
+        return socket.create_server(addr, backlog=64)
+    except OSError as e:
+        _log(f"bind {addr} failed: {e}")
+        os._exit(1)
+
+
 def _accept(lsock: socket.socket) -> socket.socket:
     """accept() that survives transient errors (EMFILE under
     connection-burst fd pressure, ECONNABORTED): a dead listener thread
@@ -113,8 +127,7 @@ class Proxy:
     # -- inbound (mesh → local service) --------------------------------
 
     def serve_inbound(self) -> None:
-        lsock = socket.create_server(("0.0.0.0", self.args.listen),
-                                     backlog=64, reuse_port=False)
+        lsock = _bind_or_die(("0.0.0.0", self.args.listen))
         _log(f"inbound listening :{self.args.listen} -> "
              f"127.0.0.1:{self.args.target} "
              f"({'mtls' if self.server_ctx else 'plaintext'})")
@@ -126,9 +139,16 @@ class Proxy:
     def _handle_inbound(self, conn: socket.socket) -> None:
         try:
             if self.server_ctx is not None:
+                # bounded handshake: a silent peer on the PUBLIC mesh
+                # port must not pin this thread + fd forever
+                conn.settimeout(10.0)
                 conn = self.server_ctx.wrap_socket(conn, server_side=True)
+            conn.settimeout(None)
             local = socket.create_connection(
                 ("127.0.0.1", self.args.target), timeout=10.0)
+            # clear the CONNECT timeout before splicing: a 10s recv
+            # timeout would read as EOF and sever any idle connection
+            local.settimeout(None)
         except (OSError, ssl.SSLError) as e:
             _log(f"inbound reject: {e}")
             try:
@@ -150,8 +170,12 @@ class Proxy:
         return [a for a in raw.split(",") if a and ":" in a]
 
     def serve_outbound(self, name: str, bind: int) -> None:
-        lsock = socket.create_server(("127.0.0.1", bind), backlog=64)
-        _log(f"upstream {name!r} listening 127.0.0.1:{bind}")
+        # --public (ingress gateway mode): accept NON-mesh clients from
+        # anywhere; otherwise loopback only — upstream binds are for
+        # the group's own tasks
+        host = "0.0.0.0" if self.args.public else "127.0.0.1"
+        lsock = _bind_or_die((host, bind))
+        _log(f"upstream {name!r} listening {host}:{bind}")
         while True:
             conn = _accept(lsock)
             threading.Thread(target=self._handle_outbound,
@@ -170,6 +194,8 @@ class Proxy:
                                               timeout=10.0)
             if self.client_ctx is not None:
                 remote = self.client_ctx.wrap_socket(remote)
+            remote.settimeout(None)  # connect/handshake bound only —
+            # a lingering 10s recv timeout would sever idle streams
         except (OSError, ssl.SSLError) as e:
             _log(f"upstream {name!r} dial {host}:{port} failed: {e}")
             conn.close()
@@ -187,6 +213,9 @@ def main(argv=None) -> int:
                     metavar="NAME=PORT",
                     help="local bind for one upstream destination")
     ap.add_argument("--upstreams-file", default="local/upstreams.json")
+    ap.add_argument("--public", action="store_true",
+                    help="ingress gateway mode: upstream listeners "
+                         "accept non-mesh clients on all interfaces")
     ap.add_argument("--ca", default="")
     ap.add_argument("--cert", default="")
     ap.add_argument("--key", default="")

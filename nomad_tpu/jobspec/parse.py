@@ -4,7 +4,8 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from ..structs.job import (Affinity, Connect, ConnectProxy,
-                           ConnectUpstream, Constraint, EphemeralDisk, Job,
+                           ConnectUpstream, Constraint, EphemeralDisk,
+                           IngressGateway, IngressListener, Job,
                            LogConfig, MigrateStrategy,
                            ParameterizedJobConfig, PeriodicConfig,
                            ReschedulePolicy, RestartPolicy, ScalingPolicy,
@@ -349,7 +350,20 @@ def _parse_service(body: Dict[str, Any]) -> Service:
                 port_label=str(sb.get("port", "")),
                 proxy=ConnectProxy(upstreams=ups),
             )
-        conn = Connect(sidecar_service=sidecar)
+        # gateway { ingress { listener { port service } } }
+        gateway = None
+        gb = _one(cb.get("gateway")) if cb.get("gateway") else None
+        if gb is not None:
+            ib = _one(gb.get("ingress")) if gb.get("ingress") else {}
+            listeners = []
+            for ls in _many((ib or {}).get("listener")):
+                lsb = _one(ls)
+                listeners.append(IngressListener(
+                    port=int(lsb.get("port", 0)),
+                    service=str(lsb.get("service", "")),
+                ))
+            gateway = IngressGateway(listeners=listeners)
+        conn = Connect(sidecar_service=sidecar, gateway=gateway)
     return Service(
         name=body.get("name", ""),
         port_label=str(body.get("port", "")),

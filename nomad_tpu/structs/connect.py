@@ -33,28 +33,48 @@ def proxy_port_label(svc_name: str) -> str:
     return f"connect_proxy_{svc_name.replace('-', '_')}"
 
 
+#: injected ingress task name prefix (reference injects the gateway
+#: Envoy as task "connect-ingress-<service>")
+INGRESS_TASK_PREFIX = "connect-ingress-"
+
+
 def inject_sidecars(job) -> None:
     """Mutate `job` in place: one proxy task + dynamic port + sidecar
-    registration per connect-enabled GROUP service, plus
+    registration per connect-enabled GROUP service (and one gateway
+    task per `connect { gateway { ingress } }` service), plus
     NOMAD_UPSTREAM_ADDR_* env on the group's application tasks.
     Idempotent — re-registering an already-injected job changes nothing
     (job_endpoint_hook_connect.go getSidecarTaskForService)."""
     for tg in job.task_groups:
         for svc in tg.services:
-            if svc.connect is None or svc.connect.sidecar_service is None:
+            if svc.connect is None:
                 continue
-            _inject_group_sidecar(tg, svc)
+            if svc.connect.sidecar_service is not None:
+                _inject_group_sidecar(tg, svc)
+            if svc.connect.gateway is not None:
+                _inject_ingress_gateway(tg, svc)
 
 
 def validate_connect(job) -> str:
     """Connect stanzas are group-service only (the reference rejects
-    task-service connect the same way)."""
+    task-service connect the same way), and a sidecar_service must have
+    a resolvable target port — otherwise the sidecar would register a
+    mesh port nothing forwards to: a silent connection-refused outage
+    instead of an admission error."""
     for tg in job.task_groups:
         for task in tg.tasks:
             for svc in task.services:
                 if svc.connect is not None:
                     return (f"task {task.name!r} service {svc.name!r}: "
                             "connect is only valid on group services")
+        for svc in tg.services:
+            if svc.connect is None or svc.connect.sidecar_service is None:
+                continue
+            if not (svc.connect.sidecar_service.port_label
+                    or svc.port_label):
+                return (f"group {tg.name!r} service {svc.name!r}: "
+                        "connect sidecar_service needs a port — set "
+                        "the service's port or sidecar_service.port")
     return ""
 
 
@@ -132,3 +152,56 @@ def _inject_group_sidecar(tg: TaskGroup, svc: Service) -> None:
             dest_path="local/upstreams.json",
             change_mode="noop",
         ))
+
+
+def _inject_ingress_gateway(tg: TaskGroup, svc: Service) -> None:
+    """Ingress gateway (reference job_endpoint_hook_connect.go:41
+    connectGatewayDriverConfig): a proxy task whose upstream listeners
+    bind PUBLICLY on the fixed listener ports, fronting mesh services
+    for non-mesh clients. Listener ports ride the task's network as
+    reserved ports so the scheduler accounts them like any other."""
+    gw = svc.connect.gateway
+    task_name = INGRESS_TASK_PREFIX + svc.name
+    listeners = list(gw.listeners)
+
+    gateway = next((t for t in tg.tasks if t.name == task_name), None)
+    if gateway is None:
+        gateway = Task(
+            name=task_name,
+            driver="connect_proxy",
+            lifecycle=TaskLifecycle(hook="prestart", sidecar=True),
+            resources=Resources(cpu=250, memory_mb=128),
+        )
+        tg.tasks.append(gateway)
+    # rebuilt on every register (listener set may change)
+    gateway.resources.networks = [NetworkResource(
+        mbits=10,
+        reserved_ports=[Port(label=f"ingress_{ls.port}", value=ls.port)
+                        for ls in listeners],
+    )]
+    gateway.env.update({
+        # leaf cert so the gateway can dial mesh sidecars; no inbound
+        # target of its own
+        "NOMAD_CONNECT_SERVICE": svc.name,
+    })
+    gateway.config = {
+        "public": True,
+        "upstreams": [
+            {"name": ls.service, "bind": ls.port} for ls in listeners],
+    }
+    gateway.templates = [t for t in gateway.templates
+                         if t.dest_path != "local/upstreams.json"]
+    if listeners:
+        mapping = {ls.service:
+                   "${service." + ls.service + SIDECAR_SUFFIX + "}"
+                   for ls in listeners}
+        gateway.templates.append(Template(
+            embedded_tmpl=json.dumps(mapping),
+            dest_path="local/upstreams.json",
+            change_mode="noop",
+        ))
+    # the gateway's own catalog row (how external LBs/DNS find it):
+    # reuse the declaring service, pointing its port at the first
+    # listener when it names no port of its own
+    if not svc.port_label and listeners:
+        svc.port_label = f"ingress_{listeners[0].port}"

@@ -188,6 +188,23 @@ class SidecarService:
 
 
 @dataclass
+class IngressListener:
+    """One ingress listener: a fixed public port fronting one mesh
+    service (reference `structs.ConsulIngressListener`)."""
+
+    port: int = 0
+    service: str = ""
+
+
+@dataclass
+class IngressGateway:
+    """Reference `structs.ConsulIngressConfigEntry` (services.go) —
+    the mesh entry point for non-mesh clients."""
+
+    listeners: List[IngressListener] = field(default_factory=list)
+
+
+@dataclass
 class Connect:
     """Reference `structs.ConsulConnect` (services.go:671). This build's
     mesh is NATIVE: the server injects a built-in mTLS proxy task (the
@@ -195,6 +212,7 @@ class Connect:
     structs/connect.py."""
 
     sidecar_service: Optional[SidecarService] = None
+    gateway: Optional[IngressGateway] = None
 
 
 @dataclass

@@ -314,3 +314,144 @@ class TestMeshE2E:
                 tls = ctx.wrap_socket(raw)
                 tls.send(b"GET / HTTP/1.0\r\n\r\n")
                 tls.recv(64)
+
+
+class TestIngressGateway:
+    """connect { gateway { ingress } }: a public mesh entry point
+    (reference job_endpoint_hook_connect.go:41)."""
+
+    def test_injection(self):
+        from nomad_tpu.structs.connect import inject_sidecars
+        from nomad_tpu.structs.job import (IngressGateway,
+                                           IngressListener, Service)
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.services.append(Service(
+            name="edge",
+            connect=Connect(gateway=IngressGateway(listeners=[
+                IngressListener(port=28080, service="api")]))))
+        inject_sidecars(job)
+        gw = next(t for t in tg.tasks if t.name == "connect-ingress-edge")
+        assert gw.driver == "connect_proxy"
+        assert gw.config["public"] is True
+        assert gw.config["upstreams"] == [{"name": "api", "bind": 28080}]
+        ports = [p for n in gw.resources.networks
+                 for p in n.reserved_ports]
+        assert ports and ports[0].value == 28080
+        assert "api-sidecar-proxy" in gw.templates[0].embedded_tmpl
+        # the declaring service advertises the first listener
+        svc = next(s for s in tg.services if s.name == "edge")
+        assert svc.port_label == "ingress_28080"
+        # idempotent + listener rebuild on re-register
+        inject_sidecars(job)
+        assert sum(1 for t in tg.tasks
+                   if t.name == "connect-ingress-edge") == 1
+
+    def test_parse(self):
+        from nomad_tpu.jobspec import parse
+
+        job = parse('''
+        job "edge" {
+          group "g" {
+            service {
+              name = "edge"
+              connect {
+                gateway {
+                  ingress {
+                    listener { port = 28080  service = "api" }
+                    listener { port = 28081  service = "db" }
+                  }
+                }
+              }
+            }
+            task "t" {
+              driver = "raw_exec"
+              config { command = "/bin/true" }
+            }
+          }
+        }
+        ''')
+        gw = job.task_groups[0].services[0].connect.gateway
+        assert [(ls.port, ls.service) for ls in gw.listeners] == [
+            (28080, "api"), (28081, "db")]
+
+    def test_external_client_reaches_mesh_service(self, agent):
+        """A NON-mesh client hits the public ingress port and gets the
+        backend's payload through the gateway's mTLS dial."""
+        import urllib.request
+
+        from nomad_tpu.structs.job import (IngressGateway,
+                                           IngressListener, Service)
+        from nomad_tpu.structs.resources import NetworkResource, Port
+
+        a, api = agent
+
+        be = mock.job()
+        be.id = be.name = "ing-backend"
+        tg = be.task_groups[0]
+        tg.count = 1
+        tg.restart_policy.delay_s = 1.0
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.resources.networks = [NetworkResource(
+            mbits=10, dynamic_ports=[Port(label="http")])]
+        t.config = {"command": sys.executable,
+                    "args": ["-c", _BACKEND_PY]}
+        tg.services = [Service(
+            name="api", port_label="http",
+            connect=Connect(sidecar_service=SidecarService()))]
+        api.wait_for_eval(api.register_job(be))
+
+        gwj = mock.job()
+        gwj.id = gwj.name = "ing-gateway"
+        tg = gwj.task_groups[0]
+        tg.count = 1
+        tg.restart_policy.delay_s = 1.0
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.config = {"command": "/bin/sh", "args": ["-c", "sleep 120"]}
+        tg.services = [Service(
+            name="edge",
+            connect=Connect(gateway=IngressGateway(listeners=[
+                IngressListener(port=28085, service="api")])))]
+        api.wait_for_eval(api.register_job(gwj))
+
+        def fetch():
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:28085/", timeout=3) as r:
+                    return r.read()
+            except Exception:
+                return b""
+        assert _wait(lambda: fetch() == b"mesh-ok", timeout=90), fetch()
+
+
+class TestValidation:
+    def test_portless_sidecar_rejected(self, agent):
+        from nomad_tpu.api.client import ApiError
+        from nomad_tpu.structs.job import Service
+
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.services = [Service(
+            name="api", connect=Connect(
+                sidecar_service=SidecarService()))]
+        with pytest.raises(ApiError) as ei:
+            api.register_job(job)
+        assert "needs a port" in str(ei.value)
+
+    def test_reserved_namespace_blocked_over_http(self, agent):
+        from nomad_tpu.api.client import ApiError
+
+        a, api = agent
+        a.server.connect_issue("seed")  # CA exists
+        import urllib.error
+        import urllib.request
+
+        url = (f"http://{a.http_addr[0]}:{a.http_addr[1]}"
+               f"/v1/secret/ca?namespace=nomad%2Fconnect")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=5)
+        assert ei.value.code == 403
