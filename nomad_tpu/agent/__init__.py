@@ -242,7 +242,8 @@ class Agent:
             self.client = Client(conn, ClientConfig(
                 data_dir=client_dir, node=node,
                 heartbeat_interval=max(self.config.heartbeat_ttl / 3, 0.5),
-                plugin_config=self.config.plugin_config))
+                plugin_config=self.config.plugin_config,
+                tls=self.config.tls))
         self.http = HTTPApi(self, self.config.http_host,
                             self.config.http_port, tls=self.config.tls)
         # telemetry push (command/agent/command.go:952 setupTelemetry):
@@ -263,6 +264,30 @@ class Agent:
         if self.server is not None:
             self.server.start()
         if self.client is not None:
+            # advertise this agent's HTTP endpoint on the node BEFORE
+            # registration — remote ephemeral-disk migration dials the
+            # previous node's FS API through it (the reference's
+            # Node.HTTPAddr, structs.go:1708 field set by the agent).
+            # A wildcard bind is not dialable from other hosts — resolve
+            # it to this host's routable IP the same way http.start does
+            # for the gossip http_addr tag
+            host, port = self.http.addr
+            if host in ("0.0.0.0", "::", ""):
+                import socket as _socket
+
+                host = "127.0.0.1"
+                try:
+                    s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                    try:
+                        s.connect(("10.255.255.255", 1))  # no traffic
+                        host = s.getsockname()[0]
+                    finally:
+                        s.close()
+                except OSError:
+                    pass
+            scheme = "https" if self.http.tls_enabled else "http"
+            self.client.node.attributes["unique.advertise.http"] = \
+                f"{scheme}://{host}:{port}"
             self.client.start()
         self.http.start()
         if self._telemetry is not None:

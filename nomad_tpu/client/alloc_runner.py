@@ -34,9 +34,11 @@ class AllocRunner:
                  on_handle: Optional[Callable] = None,
                  recover_handles: Optional[Dict[str, dict]] = None,
                  driver_manager=None, csi_manager=None, conn=None,
-                 network_manager=None) -> None:
+                 network_manager=None, tls=None) -> None:
         self.alloc = alloc
         self.node = node
+        #: agent tls{} config — remote-migration HTTPS credentials
+        self.tls = tls
         self.on_update = on_update
         #: on_handle(task_name, driver, driver_state|None) → persisted by
         #: the client for post-restart task recovery
@@ -257,11 +259,14 @@ class AllocRunner:
         prev_id = self.alloc.previous_allocation
         if disk is None or prev_id == "" or not (disk.sticky or disk.migrate):
             return
-        # Nothing to do unless the previous alloc's data lives on this
-        # node (remote-node migration is out of scope — sticky placement
-        # makes same-node the dominant case)
-        if not os.path.isdir(os.path.join(self._base_dir, prev_id,
-                                          SHARED_ALLOC_DIR, "data")):
+        local = os.path.isdir(os.path.join(self._base_dir, prev_id,
+                                           SHARED_ALLOC_DIR, "data"))
+        # Data not on this node: with migrate=true pull it from the
+        # previous node over its FS API (allocwatcher remote migration,
+        # client/allocwatcher/alloc_watcher.go); sticky-only means
+        # sticky PLACEMENT — a cross-node move starts with a fresh disk
+        # (reference semantics)
+        if not local and not (disk.migrate and self.conn is not None):
             return
         # Wait for the previous alloc to go terminal before copying — the
         # reference allocwatcher blocks on prev-alloc completion
@@ -282,6 +287,8 @@ class AllocRunner:
                                  SHARED_ALLOC_DIR, "data")
         dest = os.path.join(self.alloc_dir.shared_dir, "data")
         if not os.path.isdir(prev_data):
+            if disk.migrate:
+                self._fetch_remote_prev_data(prev_id, dest)
             return
         for name in os.listdir(prev_data):
             src = os.path.join(prev_data, name)
@@ -293,6 +300,96 @@ class AllocRunner:
                     shutil.copy2(src, dst)
             except OSError:
                 pass  # best-effort, matching the reference's move fallback
+
+    #: remote-migration pull chunk (bounded memory per transfer)
+    _MIGRATE_CHUNK = 4 * 1024 * 1024
+
+    def _fetch_remote_prev_data(self, prev_id: str, dest: str) -> None:
+        """Remote leg of ephemeral-disk migration: walk the previous
+        node's `alloc/data` tree over its agent FS API and materialize
+        it under this alloc's shared dir (the reference streams a tar
+        snapshot via FileSystem.Snapshot — same contract, pull-based).
+
+        Failure contract matches the reference's failed-migration
+        fallback: a FRESH disk — the pull stages into a temp dir and
+        only moves into place when the whole tree transferred, so a
+        source that dies mid-pull can't leave half a dataset the task
+        would mistake for valid state. Failures are logged, not silent.
+        Under ACLs the tokenless fetch is rejected by the source (403)
+        and logged — node-identity tokens are a documented gap."""
+        import logging
+        import os
+        import shutil
+
+        log = logging.getLogger("nomad_tpu.client")
+        staging = os.path.join(os.path.dirname(dest), ".migrate-partial")
+        try:
+            prev = self.conn.alloc_get(prev_id)
+            if prev is None or not prev.node_id or (
+                    self.node is not None and prev.node_id == self.node.id):
+                return
+            node = self.conn.node_get(prev.node_id)
+            addr = (node.attributes.get("unique.advertise.http", "")
+                    if node is not None else "")
+            if not addr or ":" not in addr:
+                return
+            from ..api import NomadClient
+
+            scheme, sep, rest = addr.partition("://")
+            if not sep:
+                scheme, rest = "http", addr
+            host, _, port = rest.rpartition(":")
+            tls_kw = {}
+            if scheme == "https":
+                t = self.tls
+                if t is None or not t.ca_file:
+                    log.warning(
+                        "remote migration: %s advertises https but this "
+                        "client has no tls{} config — fresh disk", addr)
+                    return
+                tls_kw = {"ca_cert": t.ca_file,
+                          "client_cert": t.cert_file or None,
+                          "client_key": t.key_file or None}
+            # short timeout: a LOST previous node is a primary
+            # reschedule trigger, and the replacement's startup must
+            # not hang on it (best-effort contract)
+            api = NomadClient(host, int(port), timeout=10.0, **tls_kw)
+
+            def pull(rel: str, into: str) -> None:
+                os.makedirs(into, exist_ok=True)
+                for e in api.alloc_fs_list(prev_id, rel):
+                    name = e.get("Name", "")
+                    if not name or name in (".", ".."):
+                        continue
+                    sub = f"{rel}/{name}"
+                    if e.get("IsDir"):
+                        pull(sub, os.path.join(into, name))
+                        continue
+                    # chunked: never buffer whole files (migrate disks
+                    # can be GBs)
+                    with open(os.path.join(into, name), "wb") as f:
+                        off = 0
+                        while True:
+                            data = api.alloc_fs_read_at(
+                                prev_id, sub, offset=off,
+                                limit=self._MIGRATE_CHUNK)
+                            if not data:
+                                break
+                            f.write(data)
+                            off += len(data)
+
+            shutil.rmtree(staging, ignore_errors=True)
+            pull(f"{SHARED_ALLOC_DIR}/data", staging)
+            # complete: move the staged tree into the live data dir
+            os.makedirs(dest, exist_ok=True)
+            for name in os.listdir(staging):
+                os.replace(os.path.join(staging, name),
+                           os.path.join(dest, name))
+            os.rmdir(staging)
+        except Exception as e:  # noqa: BLE001 — fresh disk on failure
+            log.warning("remote migration from %s failed (fresh disk): "
+                        "%s", prev_id[:8], e)
+            shutil.rmtree(staging, ignore_errors=True)
 
     def _mount_volumes(self) -> None:
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \

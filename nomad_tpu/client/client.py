@@ -93,6 +93,9 @@ class InProcConn:
     def connect_issue(self, service_name):
         return self.server.connect_issue(service_name)
 
+    def node_get(self, node_id):
+        return self.server.node_get(node_id)
+
 
 class RpcConn:
     """Server connection over the msgpack-RPC fabric with failover across
@@ -182,6 +185,9 @@ class RpcConn:
     def connect_issue(self, service_name):
         return self._call("connect_issue", service_name)
 
+    def node_get(self, node_id):
+        return self._call("node_get", node_id)
+
 
 class ClientConfig:
     def __init__(self, data_dir: Optional[str] = None,
@@ -190,13 +196,17 @@ class ClientConfig:
                  sync_interval: float = 0.2,
                  watch_timeout: float = 5.0,
                  persist: bool = True,
-                 plugin_config: Optional[Dict[str, dict]] = None) -> None:
+                 plugin_config: Optional[Dict[str, dict]] = None,
+                 tls=None) -> None:
         self.data_dir = data_dir
         self.node = node
         self.heartbeat_interval = heartbeat_interval
         self.sync_interval = sync_interval
         self.watch_timeout = watch_timeout
         self.persist = persist
+        #: agent tls{} config (lib.tlsutil.TLSConfig) — client-to-client
+        #: HTTPS (remote disk migration) presents these credentials
+        self.tls = tls
         #: per-driver operator config (agent `plugin "<name>" {}` stanzas)
         self.plugin_config: Dict[str, dict] = plugin_config or {}
 
@@ -422,7 +432,8 @@ class Client:
                              recover_handles=recover_handles,
                              driver_manager=self.driver_manager,
                              csi_manager=self.csi, conn=self.conn,
-                             network_manager=self.network_manager)
+                             network_manager=self.network_manager,
+                             tls=self.config.tls)
         with self._lock:
             self.allocs[alloc.id] = runner
             self._known_index[alloc.id] = alloc.modify_index

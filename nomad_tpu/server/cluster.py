@@ -193,7 +193,8 @@ class ClusterServerConfig(ServerConfig):
 FORWARDED = (
     "job_register", "job_deregister", "node_register", "node_update_status",
     "node_update_drain", "node_update_eligibility", "node_heartbeat",
-    "node_update_allocs", "node_get_client_allocs", "alloc_get", "run_gc",
+    "node_update_allocs", "node_get_client_allocs", "alloc_get",
+    "node_get", "run_gc",
     "update_alloc_health", "node_device_stats",
     "csi_volume_claim", "csi_volume_get",
     "csi_controller_poll", "csi_controller_done",

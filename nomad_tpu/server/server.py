@@ -736,6 +736,13 @@ class Server:
         self._check_secret_ns(namespace)
         return self.state.secret_get(namespace, path)
 
+    def node_get(self, node_id: str):
+        """Node lookup for clients (remote ephemeral-disk migration
+        resolves the previous node's advertised HTTP address; the
+        reference ships Node info to clients the same way for
+        allocwatcher migration)."""
+        return self.state.node_by_id(node_id)
+
     def services_lookup(self, namespace: str, name: str):
         """Catalog lookup for client-side template rendering (the
         consul-template `service` function's data source; this build
