@@ -271,20 +271,11 @@ class Agent:
             # A wildcard bind is not dialable from other hosts — resolve
             # it to this host's routable IP the same way http.start does
             # for the gossip http_addr tag
+            from ..lib.netutil import routable_ip
+
             host, port = self.http.addr
             if host in ("0.0.0.0", "::", ""):
-                import socket as _socket
-
-                host = "127.0.0.1"
-                try:
-                    s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-                    try:
-                        s.connect(("10.255.255.255", 1))  # no traffic
-                        host = s.getsockname()[0]
-                    finally:
-                        s.close()
-                except OSError:
-                    pass
+                host = routable_ip()
             scheme = "https" if self.http.tls_enabled else "http"
             self.client.node.attributes["unique.advertise.http"] = \
                 f"{scheme}://{host}:{port}"

@@ -1441,7 +1441,14 @@ class HTTPApi:
         so the live kernels never see the what-if placement."""
         from ..scheduler.harness import Harness
         from ..structs import Evaluation
+        from ..structs.connect import inject_sidecars, validate_connect
 
+        # same admission mutation as Register: the plan must reflect
+        # the connect sidecar tasks/ports the real register would add
+        cerr = validate_connect(job)
+        if cerr:
+            raise HttpError(400, cerr)
+        inject_sidecars(job)
         snap = server.state.snapshot().detach_for_writes()
         h = Harness(state=snap)
         snap.upsert_job(job)

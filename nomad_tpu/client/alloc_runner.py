@@ -259,6 +259,15 @@ class AllocRunner:
         prev_id = self.alloc.previous_allocation
         if disk is None or prev_id == "" or not (disk.sticky or disk.migrate):
             return
+        # run-once guard: on client restart this hook runs again for a
+        # recovered alloc — re-copying (local or remote) would clobber
+        # the LIVE task's data with the previous alloc's stale snapshot
+        dest_probe = os.path.join(self.alloc_dir.shared_dir, "data")
+        try:
+            if os.listdir(dest_probe):
+                return  # already migrated / the task wrote data
+        except OSError:
+            pass
         local = os.path.isdir(os.path.join(self._base_dir, prev_id,
                                            SHARED_ALLOC_DIR, "data"))
         # Data not on this node: with migrate=true pull it from the
@@ -338,6 +347,8 @@ class AllocRunner:
             scheme, sep, rest = addr.partition("://")
             if not sep:
                 scheme, rest = "http", addr
+            if ":" not in rest:
+                return  # advertised without a port — nothing to dial
             host, _, port = rest.rpartition(":")
             tls_kw = {}
             if scheme == "https":
@@ -359,7 +370,11 @@ class AllocRunner:
                 os.makedirs(into, exist_ok=True)
                 for e in api.alloc_fs_list(prev_id, rel):
                     name = e.get("Name", "")
-                    if not name or name in (".", ".."):
+                    # remote-supplied names: one plain path component
+                    # only — a malicious/compromised source must not be
+                    # able to write outside the staging dir
+                    if (not name or name in (".", "..")
+                            or name != os.path.basename(name)):
                         continue
                     sub = f"{rel}/{name}"
                     if e.get("IsDir"):

@@ -78,20 +78,10 @@ def network_fingerprint(node: Node) -> None:
     """Default-interface detection (client/fingerprint/network.go): pick a
     routable IP and publish a 1000-mbit link (speed detection is sysfs-
     specific; the reference also defaults when unknown)."""
-    import socket
-
+    from ..lib.netutil import routable_ip
     from ..structs.network import NetworkResource
 
-    ip = "127.0.0.1"
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        try:
-            s.connect(("10.255.255.255", 1))  # no traffic sent
-            ip = s.getsockname()[0]
-        finally:
-            s.close()
-    except OSError:
-        pass
+    ip = routable_ip()
     node.attributes["unique.network.ip-address"] = ip
     if not node.node_resources.networks:
         node.node_resources.networks = [NetworkResource(

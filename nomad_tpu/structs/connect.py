@@ -68,13 +68,20 @@ def validate_connect(job) -> str:
                     return (f"task {task.name!r} service {svc.name!r}: "
                             "connect is only valid on group services")
         for svc in tg.services:
-            if svc.connect is None or svc.connect.sidecar_service is None:
+            if svc.connect is None:
                 continue
-            if not (svc.connect.sidecar_service.port_label
+            if svc.connect.sidecar_service is not None and not (
+                    svc.connect.sidecar_service.port_label
                     or svc.port_label):
                 return (f"group {tg.name!r} service {svc.name!r}: "
                         "connect sidecar_service needs a port — set "
                         "the service's port or sidecar_service.port")
+            if svc.connect.gateway is not None:
+                for ls in svc.connect.gateway.listeners:
+                    if ls.port <= 0 or not ls.service:
+                        return (f"group {tg.name!r} service "
+                                f"{svc.name!r}: ingress listener needs "
+                                "a positive port and a service name")
     return ""
 
 

@@ -455,3 +455,27 @@ class TestValidation:
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(url, timeout=5)
         assert ei.value.code == 403
+
+
+class TestPlan:
+    def test_job_plan_reflects_injected_sidecar(self, agent):
+        """`job plan` must count the proxy task's placement the real
+        register would create (same admission mutation)."""
+        from nomad_tpu.structs.job import Service
+        from nomad_tpu.structs.resources import NetworkResource, Port
+
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.resources.networks = [NetworkResource(
+            mbits=10, dynamic_ports=[Port(label="http")])]
+        t.config = {"command": "/bin/true"}
+        tg.services = [Service(
+            name="api", port_label="http",
+            connect=Connect(sidecar_service=SidecarService()))]
+        out = api.plan_job(job)
+        assert out["placements"] == 1  # one alloc (group), proxy inside
+        assert not out["failed_tg_allocs"], out
