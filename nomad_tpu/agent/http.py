@@ -998,6 +998,38 @@ class HTTPApi:
                 server.blocked.set_enabled(True)
                 server._restore_evals()  # pending evals re-enter the broker
                 return {"Index": state.index.value}
+        # /v1/connect/intentions — mesh source→destination allow/deny
+        # (Consul intentions analog; enforced by destination sidecars)
+        if parts == ["connect", "intentions"]:
+            if method == "GET":
+                require(acl.allow_operator_read())
+                # CamelCase like every other wire surface — GET output
+                # must round-trip into PUT
+                return [{"Source": r["source"],
+                         "Destination": r["destination"],
+                         "Action": r["action"]}
+                        for r in server.connect_intentions_list()]
+            if method in ("PUT", "POST"):
+                require(acl.allow_operator_write())
+                b = body or {}
+                try:
+                    server.connect_intention_upsert(
+                        str(b.get("Source", b.get("source", ""))),
+                        str(b.get("Destination",
+                                  b.get("destination", ""))),
+                        str(b.get("Action", b.get("action", ""))))
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"updated": True}
+            if method == "DELETE":
+                require(acl.allow_operator_write())
+                try:
+                    server.connect_intention_delete(
+                        query.get("source", ""),
+                        query.get("destination", ""))
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"deleted": True}
         # /v1/operator/scheduler/configuration
         if parts == ["operator", "scheduler", "configuration"]:
             if method == "GET":

@@ -439,6 +439,23 @@ class NomadClient:
                             params={"namespace": namespace})
         return [from_wire(r) for r in self._unblock(res)[1]]
 
+    # ---- mesh intentions (Connect intentions analog) ----
+
+    def connect_intentions(self) -> List[dict]:
+        return self._request("GET", "/v1/connect/intentions")
+
+    def connect_intention_upsert(self, source: str, destination: str,
+                                 action: str) -> None:
+        self._request("POST", "/v1/connect/intentions",
+                      body={"Source": source, "Destination": destination,
+                            "Action": action})
+
+    def connect_intention_delete(self, source: str,
+                                 destination: str) -> None:
+        self._request("DELETE", "/v1/connect/intentions",
+                      params={"source": source,
+                              "destination": destination})
+
     # ---- namespaces (api/namespace.go) ----
 
     def namespaces(self) -> List[Any]:

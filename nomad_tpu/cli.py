@@ -799,6 +799,31 @@ def cmd_service_info(args) -> int:
     return 0
 
 
+def cmd_intention_list(args) -> int:
+    """`nomad-tpu connect intention-list` (mesh authorization rules)."""
+    rows = _client(args).connect_intentions()
+    if not rows:
+        print("No intentions (default: allow)")
+        return 0
+    print(_columns(
+        [[r["Source"], r["Destination"], r["Action"]] for r in rows],
+        ["Source", "Destination", "Action"]))
+    return 0
+
+
+def cmd_intention_create(args) -> int:
+    _client(args).connect_intention_upsert(
+        args.source, args.destination, args.action)
+    print(f"Intention {args.source} -> {args.destination}: {args.action}")
+    return 0
+
+
+def cmd_intention_delete(args) -> int:
+    _client(args).connect_intention_delete(args.source, args.destination)
+    print(f"Deleted intention {args.source} -> {args.destination}")
+    return 0
+
+
 def cmd_agent_info(args) -> int:
     """`nomad-tpu agent-info` (command/agent_info.go)."""
     info = _client(args).agent_self()
@@ -1225,6 +1250,21 @@ def build_parser() -> argparse.ArgumentParser:
     svi.add_argument("name")
     svi.add_argument("-namespace", default="default")
     svi.set_defaults(fn=cmd_service_info)
+
+    conn = sub.add_parser("connect",
+                          help="service mesh").add_subparsers(
+        dest="sub", required=True)
+    cil = conn.add_parser("intention-list")
+    cil.set_defaults(fn=cmd_intention_list)
+    cic = conn.add_parser("intention-create")
+    cic.add_argument("action", choices=["allow", "deny"])
+    cic.add_argument("source")
+    cic.add_argument("destination")
+    cic.set_defaults(fn=cmd_intention_create)
+    cid = conn.add_parser("intention-delete")
+    cid.add_argument("source")
+    cid.add_argument("destination")
+    cid.set_defaults(fn=cmd_intention_delete)
 
     ag = sub.add_parser("agent", help="run an agent")
     ag.add_argument("-dev", action="store_true")

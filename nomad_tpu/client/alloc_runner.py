@@ -299,16 +299,30 @@ class AllocRunner:
             if disk.migrate:
                 self._fetch_remote_prev_data(prev_id, dest)
             return
-        for name in os.listdir(prev_data):
-            src = os.path.join(prev_data, name)
-            dst = os.path.join(dest, name)
-            try:
-                if os.path.isdir(src):
-                    shutil.copytree(src, dst, dirs_exist_ok=True)
-                else:
-                    shutil.copy2(src, dst)
-            except OSError:
-                pass  # best-effort, matching the reference's move fallback
+        # staged like the remote leg: the run-once guard treats a
+        # non-empty dest as "migrated", so a crash mid-copy must never
+        # leave a partial tree dest-side — stage, then promote whole
+        staging = os.path.join(os.path.dirname(dest), ".migrate-partial")
+        shutil.rmtree(staging, ignore_errors=True)
+        try:
+            shutil.copytree(prev_data, staging)
+            self._promote_staging(staging, dest)
+        except OSError:
+            # best-effort, matching the reference's move fallback —
+            # failure yields a fresh disk, never a partial one
+            shutil.rmtree(staging, ignore_errors=True)
+
+    @staticmethod
+    def _promote_staging(staging: str, dest: str) -> None:
+        """Move a fully-staged migration tree into the live data dir —
+        the all-or-nothing commit point both migration legs share."""
+        import os
+
+        os.makedirs(dest, exist_ok=True)
+        for name in os.listdir(staging):
+            os.replace(os.path.join(staging, name),
+                       os.path.join(dest, name))
+        os.rmdir(staging)
 
     #: remote-migration pull chunk (bounded memory per transfer)
     _MIGRATE_CHUNK = 4 * 1024 * 1024
@@ -395,12 +409,7 @@ class AllocRunner:
 
             shutil.rmtree(staging, ignore_errors=True)
             pull(f"{SHARED_ALLOC_DIR}/data", staging)
-            # complete: move the staged tree into the live data dir
-            os.makedirs(dest, exist_ok=True)
-            for name in os.listdir(staging):
-                os.replace(os.path.join(staging, name),
-                           os.path.join(dest, name))
-            os.rmdir(staging)
+            self._promote_staging(staging, dest)
         except Exception as e:  # noqa: BLE001 — fresh disk on failure
             log.warning("remote migration from %s failed (fresh disk): "
                         "%s", prev_id[:8], e)

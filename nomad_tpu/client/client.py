@@ -96,6 +96,9 @@ class InProcConn:
     def node_get(self, node_id):
         return self.server.node_get(node_id)
 
+    def connect_intentions_for(self, destination):
+        return self.server.connect_intentions_for(destination)
+
 
 class RpcConn:
     """Server connection over the msgpack-RPC fabric with failover across
@@ -187,6 +190,9 @@ class RpcConn:
 
     def node_get(self, node_id):
         return self._call("node_get", node_id)
+
+    def connect_intentions_for(self, destination):
+        return self._call("connect_intentions_for", destination)
 
 
 class ClientConfig:

@@ -38,7 +38,9 @@ class ConnectProxyDriver(DriverPlugin):
         args = [sys.executable, "-m", "nomad_tpu.connect_proxy",
                 "--listen", str(listen), "--target", str(target),
                 "--upstreams-file",
-                os.path.join(cfg.task_dir, "local", "upstreams.json")]
+                os.path.join(cfg.task_dir, "local", "upstreams.json"),
+                "--intentions-file",
+                os.path.join(cfg.task_dir, "local", "intentions.json")]
         for u in rc.get("upstreams", []) or []:
             args += ["--upstream", f"{u['name']}={u['bind']}"]
         if rc.get("public"):

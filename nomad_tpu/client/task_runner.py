@@ -381,6 +381,10 @@ class TaskRunner:
     #: ${service.<name>...} references in template bodies (name charset
     #: excludes ".", so `${service.web.addr}` captures "web")
     _SERVICE_REF = re.compile(r"\$\{service\.([A-Za-z0-9_-]+)")
+    #: ${connect.intentions.<name>} — mesh intention rules for a
+    #: destination, rendered as a JSON array (sidecar enforcement feed)
+    _INTENTION_REF = re.compile(
+        r"\$\{connect\.intentions\.([A-Za-z0-9_-]+)\}")
     #: dynamic-source poll cadence; tests shrink it via the class attr
     TEMPLATE_POLL_S = 5.0
 
@@ -438,6 +442,18 @@ class TaskRunner:
             tenv[f"service.{name}.addr"] = regs[0].address if regs else ""
             tenv[f"service.{name}.port"] = \
                 str(regs[0].port) if regs else ""
+        import json as _json
+
+        inames = set()
+        for raw in raws:
+            inames.update(self._INTENTION_REF.findall(raw))
+        for name in sorted(inames):
+            rules = []
+            if not degraded and self.conn is not None:
+                rules = self.conn.connect_intentions_for(name) or []
+            tenv[f"connect.intentions.{name}"] = _json.dumps(
+                sorted(rules, key=lambda r: (r.get("destination", ""),
+                                             r.get("source", ""))))
         return tenv
 
     @staticmethod
@@ -525,7 +541,7 @@ class TaskRunner:
         except Exception:
             return  # prestart already failed/raced; nothing to watch
         if not any("${service." in r or "NOMAD_SECRET_" in r
-                   for r in raws):
+                   or "${connect.intentions." in r for r in raws):
             return
         self._tmpl_thread = threading.Thread(
             target=self._template_watch,

@@ -136,6 +136,47 @@ class Proxy:
             threading.Thread(target=self._handle_inbound, args=(conn,),
                              daemon=True).start()
 
+    def _peer_allowed(self, conn) -> bool:
+        """Mesh intentions (Consul intentions analog): match the
+        dialing peer's leaf-cert CN — its service name — against the
+        rules the template watcher keeps in --intentions-file. Exact
+        source beats the `*` wildcard; deny beats allow at equal
+        precedence; no matching rule (or no file) = allow, Consul's
+        default-allow posture."""
+        if self.server_ctx is None or not self.args.intentions_file:
+            return True  # plaintext dev mode has no peer identity
+        try:
+            cert = conn.getpeercert() or {}
+            subject = {k: v for rdn in cert.get("subject", ())
+                       for k, v in rdn}
+            peer = subject.get("commonName", "")
+        except (ssl.SSLError, OSError):
+            peer = ""
+        try:
+            with open(self.args.intentions_file) as f:
+                rules = json.load(f)
+        except (OSError, ValueError):
+            rules = []
+        if not isinstance(rules, list):
+            rules = []
+        # Consul precedence: most specific rule tier wins — exact
+        # destination beats wildcard destination, then exact source
+        # beats wildcard source; deny beats allow within a tier. The
+        # file only ever holds rules for this sidecar's destination
+        # (or *), each row carrying its destination.
+        applicable = [r for r in rules
+                      if r.get("source") in (peer, "*")]
+        if not applicable:
+            return True
+
+        def tier(r):
+            return (0 if r.get("destination", "*") != "*" else 1,
+                    0 if r.get("source") != "*" else 1)
+
+        best = min(tier(r) for r in applicable)
+        top = [r for r in applicable if tier(r) == best]
+        return not any(r.get("action") == "deny" for r in top)
+
     def _handle_inbound(self, conn: socket.socket) -> None:
         try:
             if self.server_ctx is not None:
@@ -143,6 +184,10 @@ class Proxy:
                 # port must not pin this thread + fd forever
                 conn.settimeout(10.0)
                 conn = self.server_ctx.wrap_socket(conn, server_side=True)
+                if not self._peer_allowed(conn):
+                    _log("inbound denied by intention")
+                    conn.close()
+                    return
             conn.settimeout(None)
             local = socket.create_connection(
                 ("127.0.0.1", self.args.target), timeout=10.0)
@@ -213,6 +258,7 @@ def main(argv=None) -> int:
                     metavar="NAME=PORT",
                     help="local bind for one upstream destination")
     ap.add_argument("--upstreams-file", default="local/upstreams.json")
+    ap.add_argument("--intentions-file", default="")
     ap.add_argument("--public", action="store_true",
                     help="ingress gateway mode: upstream listeners "
                          "accept non-mesh clients on all interfaces")

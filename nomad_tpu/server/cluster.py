@@ -199,7 +199,7 @@ FORWARDED = (
     "csi_volume_claim", "csi_volume_get",
     "csi_controller_poll", "csi_controller_done",
     "update_service_registrations", "remove_service_registrations",
-    "services_lookup", "connect_issue",
+    "services_lookup", "connect_issue", "connect_intentions_for",
     "secret_upsert", "secret_delete", "secret_get",
 )
 

@@ -749,6 +749,56 @@ class Server:
         reads the native catalog instead of a Consul agent)."""
         return self.state.services_by_name(namespace, name)
 
+    # ---- mesh intentions (Consul Connect intentions analog) ----
+    #
+    # Source→destination allow/deny rules enforced by the DESTINATION
+    # sidecar against the dialing peer's leaf-cert CN (its service
+    # name). Stored in the reserved secrets namespace — raft-replicated
+    # with everything else, invisible to the public secrets surface.
+    # Reference: Consul intentions consumed by the reference's Connect
+    # integration (nomad/consul.go SI-token/ACL flow).
+
+    @staticmethod
+    def _check_intention(source: str, destination: str) -> None:
+        import re
+
+        for v in (source, destination):
+            if not re.fullmatch(r"[A-Za-z0-9_.-]+|\*", v or ""):
+                raise ValueError(f"invalid intention name {v!r}")
+
+    def connect_intention_upsert(self, source: str, destination: str,
+                                 action: str) -> None:
+        from ..structs.secrets import SecretEntry
+
+        self._check_intention(source, destination)
+        if action not in ("allow", "deny"):
+            raise ValueError(f"invalid intention action {action!r}")
+        self.state.upsert_secret(SecretEntry(
+            namespace=self.CONNECT_NS,
+            path=f"intention/{destination}/{source}",
+            data={"action": action}))
+
+    def connect_intention_delete(self, source: str,
+                                 destination: str) -> None:
+        self._check_intention(source, destination)
+        self.state.delete_secret(
+            self.CONNECT_NS, f"intention/{destination}/{source}")
+
+    def connect_intentions_list(self) -> list:
+        out = []
+        for e in self.state.secrets_list(self.CONNECT_NS):
+            parts = e.path.split("/")
+            if len(parts) == 3 and parts[0] == "intention":
+                out.append({"source": parts[2], "destination": parts[1],
+                            "action": e.data.get("action", "allow")})
+        return sorted(out, key=lambda r: (r["destination"], r["source"]))
+
+    def connect_intentions_for(self, destination: str) -> list:
+        """Rules whose destination is `destination` or the wildcard —
+        what that service's sidecar enforces inbound."""
+        return [r for r in self.connect_intentions_list()
+                if r["destination"] in (destination, "*")]
+
     # ---- native mesh CA (the Consul Connect CA analog) ----
 
     #: reserved secrets namespace holding the mesh CA — raft-replicated

@@ -143,7 +143,16 @@ def _inject_group_sidecar(tg: TaskGroup, svc: Service) -> None:
             for u in ups],
     }
     proxy.templates = [t for t in proxy.templates
-                       if t.dest_path != "local/upstreams.json"]
+                       if t.dest_path not in ("local/upstreams.json",
+                                              "local/intentions.json")]
+    # inbound authorization feed: the sidecar enforces the mesh
+    # intentions for ITS service against the dialing peer's cert CN
+    # (Consul intentions analog); kept fresh by the template watcher
+    proxy.templates.append(Template(
+        embedded_tmpl="${connect.intentions." + svc.name + "}",
+        dest_path="local/intentions.json",
+        change_mode="noop",
+    ))
     if ups:
         # upstream discovery via the dynamic-template watcher: the
         # catalog rows for each destination's sidecar render into

@@ -71,9 +71,14 @@ class TestInjection:
         assert app.env["NOMAD_UPSTREAM_ADDR_DB"] == "127.0.0.1:9191"
         assert "NOMAD_UPSTREAM_ADDR_DB" not in proxy.env
         # discovery template over the destination's sidecar rows
-        assert proxy.templates and \
-            "${service.db-sidecar-proxy}" in proxy.templates[0].embedded_tmpl
-        assert proxy.templates[0].change_mode == "noop"
+        ups_t = next(t for t in proxy.templates
+                     if t.dest_path == "local/upstreams.json")
+        assert "${service.db-sidecar-proxy}" in ups_t.embedded_tmpl
+        assert ups_t.change_mode == "noop"
+        # inbound authorization feed
+        int_t = next(t for t in proxy.templates
+                     if t.dest_path == "local/intentions.json")
+        assert "${connect.intentions.api}" in int_t.embedded_tmpl
 
     def test_injection_is_idempotent(self):
         job = self._job()
@@ -103,8 +108,10 @@ class TestInjection:
                      if t.name == "connect-proxy-api")
         assert {"name": "cache", "bind": 9292} in proxy.config["upstreams"]
         assert {"name": "db", "bind": 9199} in proxy.config["upstreams"]
-        assert len(proxy.templates) == 1
-        assert "cache-sidecar-proxy" in proxy.templates[0].embedded_tmpl
+        ups_t = [t for t in proxy.templates
+                 if t.dest_path == "local/upstreams.json"]
+        assert len(ups_t) == 1
+        assert "cache-sidecar-proxy" in ups_t[0].embedded_tmpl
         app = next(t for t in tg.tasks if t.name != proxy.name)
         assert app.env["NOMAD_UPSTREAM_ADDR_CACHE"] == "127.0.0.1:9292"
         assert app.env["NOMAD_UPSTREAM_ADDR_DB"] == "127.0.0.1:9199"
@@ -339,7 +346,9 @@ class TestIngressGateway:
         ports = [p for n in gw.resources.networks
                  for p in n.reserved_ports]
         assert ports and ports[0].value == 28080
-        assert "api-sidecar-proxy" in gw.templates[0].embedded_tmpl
+        assert "api-sidecar-proxy" in next(
+            t for t in gw.templates
+            if t.dest_path == "local/upstreams.json").embedded_tmpl
         # the declaring service advertises the first listener
         svc = next(s for s in tg.services if s.name == "edge")
         assert svc.port_label == "ingress_28080"
@@ -479,3 +488,139 @@ class TestPlan:
         out = api.plan_job(job)
         assert out["placements"] == 1  # one alloc (group), proxy inside
         assert not out["failed_tg_allocs"], out
+
+
+class TestIntentions:
+    """Mesh intentions (Consul intentions analog): source→destination
+    allow/deny enforced by the destination sidecar against the peer's
+    leaf-cert CN."""
+
+    def test_matcher_precedence(self, tmp_path):
+        import argparse
+
+        from nomad_tpu.connect_proxy import Proxy
+
+        f = tmp_path / "intentions.json"
+
+        class _Conn:
+            def getpeercert(self):
+                return {"subject": ((("commonName", "web"),),)}
+
+        def allowed(rules):
+            import json as _j
+            f.write_text(_j.dumps(rules))
+            p = Proxy(argparse.Namespace(
+                listen=0, target=0, public=False,
+                upstreams_file="", intentions_file=str(f),
+                ca="", cert="", key=""))
+            p.server_ctx = object()  # pretend TLS is on
+            return p._peer_allowed(_Conn())
+
+        assert allowed([])  # default allow
+        assert not allowed([{"source": "web", "destination": "api",
+                             "action": "deny"}])
+        # exact source beats wildcard source
+        assert allowed([{"source": "web", "destination": "api",
+                         "action": "allow"},
+                        {"source": "*", "destination": "api",
+                         "action": "deny"}])
+        assert not allowed([{"source": "other", "destination": "api",
+                             "action": "allow"},
+                            {"source": "*", "destination": "api",
+                             "action": "deny"}])
+        assert allowed([{"source": "*", "destination": "api",
+                         "action": "allow"}])
+        # exact destination beats wildcard destination: catch-all deny
+        # with a specific allow must admit the peer
+        assert allowed([{"source": "web", "destination": "api",
+                         "action": "allow"},
+                        {"source": "web", "destination": "*",
+                         "action": "deny"}])
+        assert not allowed([{"source": "web", "destination": "*",
+                             "action": "deny"}])
+
+    def test_crud_and_http(self, agent):
+        a, api = agent
+        api.connect_intention_upsert("web", "api", "deny")
+        api.connect_intention_upsert("*", "db", "allow")
+        rows = api.connect_intentions()
+        assert {"Source": "web", "Destination": "api",
+                "Action": "deny"} in rows
+        # lookup scoped to a destination includes its wildcard rules
+        assert a.server.connect_intentions_for("db") == [
+            {"source": "*", "destination": "db", "action": "allow"}]
+        api.connect_intention_delete("web", "api")
+        assert all(r["Destination"] != "api"
+                   for r in api.connect_intentions())
+
+    def test_deny_blocks_live_mesh_traffic(self, agent):
+        """Flip a deny intention on a WORKING mesh: new connections are
+        refused; delete it and traffic resumes."""
+        from nomad_tpu.structs.job import Service
+        from nomad_tpu.structs.resources import NetworkResource, Port
+
+        a, api = agent
+
+        be = mock.job()
+        be.id = be.name = "int-backend"
+        tg = be.task_groups[0]
+        tg.count = 1
+        tg.restart_policy.delay_s = 1.0
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.resources.networks = [NetworkResource(
+            mbits=10, dynamic_ports=[Port(label="http")])]
+        t.config = {"command": sys.executable,
+                    "args": ["-c", _BACKEND_PY]}
+        tg.services = [Service(
+            name="api", port_label="http",
+            connect=Connect(sidecar_service=SidecarService()))]
+        api.wait_for_eval(api.register_job(be))
+
+        fe = mock.job()
+        fe.id = fe.name = "int-frontend"
+        tg = fe.task_groups[0]
+        tg.count = 1
+        tg.restart_policy.delay_s = 1.0
+        t = tg.tasks[0]
+        t.driver = "raw_exec"
+        t.resources.networks = [NetworkResource(
+            mbits=10, dynamic_ports=[Port(label="fp")])]
+        t.config = {"command": sys.executable,
+                    "args": ["-c", _FRONTEND_PY]}
+        tg.services = [Service(
+            name="web", port_label="fp",
+            connect=Connect(sidecar_service=SidecarService(
+                proxy=ConnectProxy(upstreams=[ConnectUpstream(
+                    destination_name="api",
+                    local_bind_port=29395)])))) ]
+        api.wait_for_eval(api.register_job(fe))
+
+        fe_alloc = None
+
+        def fe_running():
+            nonlocal fe_alloc
+            fe_alloc = next(
+                (al for al in api.job_allocations(fe.id)
+                 if al.client_status == "running"), None)
+            return fe_alloc is not None
+        assert _wait(fe_running, timeout=60)
+        assert _wait(
+            lambda: b"got: mesh-ok" in _logs(api, fe_alloc.id, "web"),
+            timeout=90)
+
+        # deny web -> api; the destination sidecar's intentions file
+        # refreshes on the next watcher tick
+        api.connect_intention_upsert("web", "api", "deny")
+        time.sleep(1.5)
+        mark = len(_logs(api, fe_alloc.id, "web"))
+        time.sleep(3.0)
+        tail = _logs(api, fe_alloc.id, "web")[mark:]
+        assert b"got: mesh-ok" not in tail, tail
+
+        # remove the deny: traffic resumes
+        api.connect_intention_delete("web", "api")
+        assert _wait(
+            lambda: b"got: mesh-ok"
+            in _logs(api, fe_alloc.id, "web")[mark:], timeout=30), \
+            _logs(api, fe_alloc.id, "web")[mark:]
