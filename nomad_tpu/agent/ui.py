@@ -69,7 +69,7 @@ pre { background: var(--panel); border: 1px solid var(--line);
 <script>
 "use strict";
 const TABS = ["jobs", "nodes", "allocations", "evaluations",
-              "deployments", "services", "servers"];
+              "deployments", "services", "mesh", "servers"];
 let tab = "jobs", detail = null, timer = null;
 
 const $ = (id) => document.getElementById(id);
@@ -86,7 +86,7 @@ async function api(path) {
 
 function pill(status) {
   const ok = ["running", "complete", "ready", "passing", "successful",
-              "alive", "true"];
+              "alive", "true", "allow"];
   const warn = ["pending", "paused", "initializing", "suspect"];
   const cls = ok.includes(String(status)) ? "ok"
     : warn.includes(String(status)) ? "warn" : "bad";
@@ -166,6 +166,34 @@ const VIEWS = {
                       go: () => show("service", s.namespace,
                                      s.service_name)})),
       r => r.go());
+  },
+  async mesh() {
+    const [intentions, svcs] = await Promise.all([
+      api("/v1/connect/intentions").catch(() => null),
+      api("/v1/services?namespace=*").catch(() => []),
+    ]);
+    const sidecars = svcs.filter(s =>
+      (s.tags || []).includes("connect-proxy"));
+    let html = "<h3>Intentions</h3>";
+    if (intentions === null) {
+      // fetch failure must NOT read as "open mesh" — denies may exist
+      html += `<p class="dim">intentions unavailable ` +
+              `(insufficient token or server error)</p>`;
+    } else html += intentions.length
+      ? table(["Source", "Destination", "Action"],
+              intentions.map(i => ({cells: [esc(i.Source),
+                                            esc(i.Destination),
+                                            pill(i.Action)]})), () => {})
+      : `<p class="dim">no intentions (default: allow)</p>`;
+    html += "<h3>Sidecar proxies</h3>";
+    html += sidecars.length
+      ? table(["Service", "Namespace", "Healthy"],
+              sidecars.map(s => ({cells: [esc(s.service_name),
+                                          esc(s.namespace),
+                                          `${s.passing}/${s.count}`]})),
+              () => {})
+      : `<p class="dim">no connect-enabled services</p>`;
+    return html;
   },
   async servers() {
     const [leader, members, regions] = await Promise.all([

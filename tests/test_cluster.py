@@ -101,7 +101,7 @@ class TestCluster:
         survivors[0].call("node_register", mock.node())
         leader.shutdown()
 
-        assert _wait(lambda: leader_of(survivors) is not None, 15.0), \
+        assert _wait(lambda: leader_of(survivors) is not None), \
             "no new leader"
         new_leader = leader_of(survivors)
         assert _wait(lambda: new_leader.server._running)
