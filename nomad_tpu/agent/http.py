@@ -671,7 +671,7 @@ class HTTPApi:
         if parts and parts[0] == "job" and len(parts) >= 2:
             _job_subs = {"allocations", "evaluations", "deployments",
                          "summary", "plan", "scale", "dispatch",
-                         "versions", "revert"}
+                         "versions", "revert", "evaluate"}
             rest = parts[1:]
             if len(rest) >= 3 and rest[-2:] == ["periodic", "force"]:
                 job_id, sub = "/".join(rest[:-2]), "periodic"
@@ -704,6 +704,14 @@ class HTTPApi:
                     except ValueError as e:
                         raise HttpError(400, str(e))
                     return {"eval_id": ev.id if ev else ""}
+            if sub == "evaluate" and method in ("PUT", "POST"):
+                # Job.Evaluate (job_endpoint.go:710) — `nomad job eval`
+                require(acl.allow_namespace_operation(ns, "read-job"))
+                try:
+                    ev = server.job_evaluate(ns, job_id)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return {"eval_id": ev.id}
             if sub == "allocations":
                 require(acl.allow_namespace_operation(ns, "read-job"))
                 return blocking(lambda snap: (

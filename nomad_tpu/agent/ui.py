@@ -170,9 +170,9 @@ const VIEWS = {
   async mesh() {
     const [intentions, svcs] = await Promise.all([
       api("/v1/connect/intentions").catch(() => null),
-      api("/v1/services?namespace=*").catch(() => []),
+      api("/v1/services?namespace=*").catch(() => null),
     ]);
-    const sidecars = svcs.filter(s =>
+    const sidecars = (svcs || []).filter(s =>
       (s.tags || []).includes("connect-proxy"));
     let html = "<h3>Intentions</h3>";
     if (intentions === null) {
@@ -186,7 +186,10 @@ const VIEWS = {
                                             pill(i.Action)]})), () => {})
       : `<p class="dim">no intentions (default: allow)</p>`;
     html += "<h3>Sidecar proxies</h3>";
-    html += sidecars.length
+    if (svcs === null) {
+      html += `<p class="dim">services unavailable ` +
+              `(insufficient token or server error)</p>`;
+    } else html += sidecars.length
       ? table(["Service", "Namespace", "Healthy"],
               sidecars.map(s => ({cells: [esc(s.service_name),
                                           esc(s.namespace),

@@ -439,6 +439,12 @@ class NomadClient:
                             params={"namespace": namespace})
         return [from_wire(r) for r in self._unblock(res)[1]]
 
+    def job_evaluate(self, job_id: str,
+                     namespace: str = "default") -> str:
+        out = self._request("POST", f"/v1/job/{job_id}/evaluate",
+                            params={"namespace": namespace})
+        return out.get("eval_id", "")
+
     # ---- mesh intentions (Connect intentions analog) ----
 
     def connect_intentions(self) -> List[dict]:

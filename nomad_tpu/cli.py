@@ -799,6 +799,77 @@ def cmd_service_info(args) -> int:
     return 0
 
 
+_EXAMPLE_SPEC = '''\
+# Example job specification (`nomad-tpu job init`; reference
+# command/job_init.go). Run with: nomad-tpu job run example.nomad
+job "example" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "cache" {
+    count = 1
+
+    service {
+      name = "redis-cache"
+      port = "db"
+      check {
+        type     = "tcp"
+        interval = "10s"
+        timeout  = "2s"
+      }
+      # uncomment for the native service mesh:
+      # connect { sidecar_service {} }
+    }
+
+    task "redis" {
+      driver = "raw_exec"
+
+      config {
+        command = "/bin/sh"
+        args    = ["-c", "echo serving on $NOMAD_PORT_DB; sleep 3600"]
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+        network {
+          mbits = 10
+          port "db" {}
+        }
+      }
+    }
+  }
+}
+'''
+
+
+def cmd_job_init(args) -> int:
+    """`nomad-tpu job init` (command/job_init.go): write example.nomad."""
+    dest = args.filename
+    try:
+        with open(dest, "x") as f:  # exclusive: never clobber
+            f.write(_EXAMPLE_SPEC)
+    except FileExistsError:
+        print(f"error: {dest!r} already exists", file=sys.stderr)
+        return 1
+    print(f"Example job file written to {dest}")
+    return 0
+
+
+def cmd_job_eval(args) -> int:
+    """`nomad-tpu job eval` — force a new evaluation without changes."""
+    api = _client(args)
+    try:
+        eval_id = api.job_evaluate(args.job_id, namespace=args.namespace)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f'Created evaluation {eval_id[:8]} for job "{args.job_id}"')
+    if args.detach:
+        return 0
+    return _monitor(api, eval_id)
+
+
 def cmd_intention_list(args) -> int:
     """`nomad-tpu connect intention-list` (mesh authorization rules)."""
     rows = _client(args).connect_intentions()
@@ -1308,6 +1379,14 @@ def build_parser() -> argparse.ArgumentParser:
     jv = job.add_parser("validate")
     jv.add_argument("spec")
     jv.set_defaults(fn=cmd_job_validate)
+    ji = job.add_parser("init")
+    ji.add_argument("filename", nargs="?", default="example.nomad")
+    ji.set_defaults(fn=cmd_job_init)
+    je = job.add_parser("eval")
+    je.add_argument("job_id")
+    je.add_argument("-namespace", default="default")
+    je.add_argument("-detach", action="store_true")
+    je.set_defaults(fn=cmd_job_eval)
     jh = job.add_parser("history")
     jh.add_argument("job_id")
     jh.add_argument("-namespace", default="default")

@@ -294,6 +294,27 @@ class Server:
         self.apply_eval_update(eval)
         return eval
 
+    def job_evaluate(self, namespace: str, job_id: str) -> Evaluation:
+        """Force a fresh evaluation for an unchanged job — `nomad job
+        eval` (job_endpoint.go:710 Evaluate): re-runs the scheduler,
+        e.g. after manual node repairs, without a re-register."""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        if job.is_periodic():
+            raise ValueError("can't evaluate a periodic job "
+                             "(force it instead)")
+        if job.is_parameterized():
+            # a parameterized template only runs via dispatch children;
+            # register never evaluates it and neither may a forced eval
+            raise ValueError("can't evaluate a parameterized job "
+                             "(dispatch it instead)")
+        return self._create_eval(
+            namespace=namespace, job_id=job_id, type=job.type,
+            priority=job.priority, job_modify_index=job.modify_index,
+            triggered_by=TRIGGER_JOB_REGISTER,
+            status=EVAL_STATUS_PENDING)
+
     # ---- Job endpoint (job_endpoint.go:79) ----
 
     def job_register(self, job: Job) -> Optional[Evaluation]:

@@ -224,3 +224,37 @@ class TestCli:
         assert rc == 0
         rc, out = _run_cli(addr, "version")
         assert rc == 0 and "nomad-tpu" in out
+
+
+class TestJobInitEval:
+    def test_job_init_writes_runnable_spec(self, cli_agent, tmp_path):
+        a, addr = cli_agent
+        dest = tmp_path / "generated.nomad"
+        rc, out = _run_cli(addr, "job", "init", str(dest))
+        assert rc == 0 and dest.exists()
+        # refuses to overwrite
+        rc, out = _run_cli(addr, "job", "init", str(dest))
+        assert rc == 1  # refuses; the reason goes to stderr
+        # the generated spec actually runs
+        rc, out = _run_cli(addr, "job", "run", str(dest))
+        assert rc == 0, out
+        assert "complete" in out
+
+    def test_job_eval_forces_new_evaluation(self, cli_agent, tmp_path):
+        a, addr = cli_agent
+        spec = tmp_path / "example.nomad"
+        spec.write_text(SPEC)
+        _run_cli(addr, "job", "run", str(spec))
+        from nomad_tpu.api import NomadClient
+
+        api = NomadClient(*a.http_addr)
+        before = {e.id for e in api.job_evaluations("example")}
+        rc, out = _run_cli(addr, "job", "eval", "example")
+        assert rc == 0, out
+        assert "complete" in out
+        new = [e for e in api.job_evaluations("example")
+               if e.id not in before]
+        assert new, "no new evaluation was created"
+        # unknown job 400s
+        rc, out = _run_cli(addr, "job", "eval", "nosuch")
+        assert rc == 1
