@@ -128,15 +128,34 @@ class TestStickyDiskMigration:
         # terminal wait (itself bounded at 30s) → data copy → v1 run +
         # fast-retry restarts; under full-suite CPU contention the 90s
         # budget still flaked (round-5), so it carries real headroom now
-        assert _wait(lambda: any(
+        ok = _wait(lambda: any(
             al.client_status == "complete" and al.job_version == 1
-            for al in api.job_allocations(job.id)), timeout=240.0), [
-            (al.id[:8], al.client_status, al.desired_status,
-             al.job_version,
-             {t: (ts.state, ts.failed,
-                  [(e.type, e.message) for e in ts.events[-4:]])
-              for t, ts in al.task_states.items()})
-            for al in api.job_allocations(job.id)]
+            for al in api.job_allocations(job.id)), timeout=240.0)
+        if not ok:
+            import json as _json
+            diag = {
+                "allocs": [
+                    {"id": al.id[:8], "client": al.client_status,
+                     "desired": al.desired_status,
+                     "job_version": al.job_version,
+                     "alloc_job_ver": getattr(al.job, "version", None)
+                     if al.job else None,
+                     "task_cfg": (al.job.task_groups[0].tasks[0].config
+                                  if al.job else None),
+                     "events": {
+                         t: [(e.type, e.message) for e in ts.events]
+                         for t, ts in al.task_states.items()}}
+                    for al in api.job_allocations(job.id)],
+                "evals": [
+                    {"id": e.id[:8], "status": e.status,
+                     "triggered_by": e.triggered_by,
+                     "failed": {tg: vars(m) for tg, m in
+                                (e.failed_tg_allocs or {}).items()}}
+                    for e in api.job_evaluations(job.id)],
+            }
+            raise AssertionError(
+                "v1 never completed:\n" + _json.dumps(diag, indent=1,
+                                                      default=str))
         alloc = next(al for al in api.job_allocations(job.id)
                      if al.client_status == "complete"
                      and al.job_version == 1)

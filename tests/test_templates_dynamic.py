@@ -99,7 +99,8 @@ class TestServiceTemplates:
             if tr.task.name == t.name:
                 dest = tr._template_dest(t.templates[0])
         assert dest is not None
-        assert _wait(lambda: open(dest).read() == "backend=\n", timeout=10)
+        assert _wait(lambda: open(dest).read() == "backend=\n",
+                     timeout=30)
 
         reg = ServiceRegistration(
             id="_manual-backend-1", service_name="backend",
@@ -108,9 +109,11 @@ class TestServiceTemplates:
         a.server.update_service_registrations([reg])
 
         # watcher re-renders and fires SIGHUP → task logs the new file
+        # generous: on a 1-CPU host under the full suite, executor
+        # start + first log flush alone can eat tens of seconds
         assert _wait(
             lambda: b"backend=10.0.0.7:9090"
-            in _logs(api, alloc.id, t.name), timeout=20), \
+            in _logs(api, alloc.id, t.name), timeout=60), \
             _logs(api, alloc.id, t.name)
         assert open(dest).read() == "backend=10.0.0.7:9090\n"
 
@@ -185,7 +188,8 @@ class TestSecretTemplates:
         api.wait_for_eval(api.register_job(job))
         assert _wait(lambda: _running_alloc(api, job.id) is not None)
         alloc = _running_alloc(api, job.id)
-        assert _wait(lambda: b"pass=v1" in _logs(api, alloc.id, t.name))
+        assert _wait(lambda: b"pass=v1" in _logs(api, alloc.id, t.name),
+                     timeout=60)
 
         a.server.secret_upsert(SecretEntry(
             namespace="default", path="db/creds",
