@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..structs import (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
@@ -22,6 +23,41 @@ from ..structs import (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED,
                        TASK_STATE_DEAD, Allocation, TaskState)
 from .allocdir import SHARED_ALLOC_DIR, AllocDir
 from .task_runner import TaskRunner
+
+#: allocs currently being read as MIGRATION SOURCES (prev-alloc id →
+#: refcount). A replacement alloc copying sticky/migrate data holds a
+#: ref on its predecessor so destroy() cannot delete the source tree
+#: mid-copy — the reference's prevAllocWatcher/GC coordination
+#: (client/allocwatcher/alloc_watcher.go, client/gc.go MakeRoomFor).
+#: Same-process only, which is exactly the same-node copy case; the
+#: remote leg tolerates a vanished source by design (fresh disk).
+_MIGRATION_SOURCES: Dict[str, int] = {}
+#: sources whose destroy already passed the zero-holds check — a hold
+#: acquired NOW is too late to stop the rmtree, so it must read as
+#: unusable (fresh disk) rather than copy a half-deleted tree
+_MIGRATION_DESTROYING: set = set()
+_MIGRATION_CV = threading.Condition()
+
+
+@contextmanager
+def _migration_hold(prev_id: str):
+    """Yields True when the source may be read; False when its destroy
+    is already underway (check-then-act closed: flag and refcount flip
+    under one lock)."""
+    with _MIGRATION_CV:
+        usable = prev_id not in _MIGRATION_DESTROYING
+        _MIGRATION_SOURCES[prev_id] = \
+            _MIGRATION_SOURCES.get(prev_id, 0) + 1
+    try:
+        yield usable
+    finally:
+        with _MIGRATION_CV:
+            n = _MIGRATION_SOURCES.get(prev_id, 1) - 1
+            if n <= 0:
+                _MIGRATION_SOURCES.pop(prev_id, None)
+            else:
+                _MIGRATION_SOURCES[prev_id] = n
+            _MIGRATION_CV.notify_all()
 
 
 class _AllocHalted(Exception):
@@ -251,7 +287,6 @@ class AllocRunner:
 
     def _migrate_prev_alloc_data(self) -> None:
         import os
-        import shutil
 
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group) \
             if self.alloc.job else None
@@ -268,6 +303,21 @@ class AllocRunner:
                 return  # already migrated / the task wrote data
         except OSError:
             pass
+        # Hold the previous alloc as a migration source for the whole
+        # hook: destroy() of the prev runner (the server drops a
+        # stopped alloc from the node's set quickly) must not delete
+        # the source tree mid-copy — observed as the carried data
+        # vanishing between the terminal-wait and the copy on a 1-CPU
+        # host (the reference's prevAllocWatcher/GC coordination)
+        with _migration_hold(prev_id) as usable:
+            if not usable:
+                return  # destroy already underway: fresh disk
+            self._migrate_prev_alloc_data_held(prev_id, disk)
+
+    def _migrate_prev_alloc_data_held(self, prev_id: str, disk) -> None:
+        import os
+        import shutil
+
         local = os.path.isdir(os.path.join(self._base_dir, prev_id,
                                            SHARED_ALLOC_DIR, "data"))
         # Data not on this node: with migrate=true pull it from the
@@ -719,7 +769,25 @@ class AllocRunner:
             # shutdown() deliberately does NOT tear this down — detached
             # tasks keep running inside the netns across agent restarts
             self.network_manager.destroy(self.alloc.id)
-        self.alloc_dir.destroy()
+        # a replacement alloc may be mid-copy of this alloc's sticky/
+        # migrate data — deleting the tree under it would turn the
+        # migration into a silent fresh disk; wait it out (bounded: the
+        # copy itself is bounded by the 30s terminal-wait + IO)
+        deadline = time.time() + 60.0
+        with _MIGRATION_CV:
+            while _MIGRATION_SOURCES.get(self.alloc.id, 0) > 0:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                _MIGRATION_CV.wait(remaining)
+            # from here a late hold must read unusable — set under the
+            # SAME lock the hold checks, before any deletion starts
+            _MIGRATION_DESTROYING.add(self.alloc.id)
+        try:
+            self.alloc_dir.destroy()
+        finally:
+            with _MIGRATION_CV:
+                _MIGRATION_DESTROYING.discard(self.alloc.id)
 
     def wait(self, timeout: float = 10.0) -> bool:
         deadline = time.time() + timeout

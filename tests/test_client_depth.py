@@ -287,3 +287,72 @@ class TestAllocExecAndStats:
                    "/bin/echo", "via-cli"])
         out = capsys.readouterr().out
         assert rc == 0 and "via-cli" in out
+
+
+class TestMigrationHold:
+    """The replacement alloc holds its predecessor as a migration
+    source; destroy() of the predecessor waits the hold out so the
+    copy can never read a half-deleted tree (reference
+    prevAllocWatcher/GC coordination)."""
+
+    def test_hold_refcounts_and_releases(self):
+        from nomad_tpu.client import alloc_runner as ar
+
+        with ar._migration_hold("p1") as usable:
+            assert usable
+            assert ar._MIGRATION_SOURCES["p1"] == 1
+            with ar._migration_hold("p1") as usable2:
+                assert usable2
+                assert ar._MIGRATION_SOURCES["p1"] == 2
+            assert ar._MIGRATION_SOURCES["p1"] == 1
+        assert "p1" not in ar._MIGRATION_SOURCES
+
+    def test_hold_after_destroy_starts_is_unusable(self):
+        """A hold acquired once destroy passed its zero-count check
+        must refuse the source (fresh disk, never a half-deleted
+        copy)."""
+        from nomad_tpu.client import alloc_runner as ar
+
+        with ar._MIGRATION_CV:
+            ar._MIGRATION_DESTROYING.add("p3")
+        try:
+            with ar._migration_hold("p3") as usable:
+                assert not usable
+        finally:
+            with ar._MIGRATION_CV:
+                ar._MIGRATION_DESTROYING.discard("p3")
+
+    def test_waiter_unblocks_on_release(self):
+        import threading
+
+        from nomad_tpu.client import alloc_runner as ar
+
+        release = threading.Event()
+        done = threading.Event()
+
+        def holder():
+            with ar._migration_hold("p2"):
+                release.wait(10)
+            done.set()
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert _wait(lambda: ar._MIGRATION_SOURCES.get("p2") == 1,
+                     timeout=5)
+        # a destroy-side waiter parks until the hold drops
+        waited = []
+
+        def waiter():
+            with ar._MIGRATION_CV:
+                while ar._MIGRATION_SOURCES.get("p2", 0) > 0:
+                    ar._MIGRATION_CV.wait(5)
+            waited.append(True)
+
+        w = threading.Thread(target=waiter, daemon=True)
+        w.start()
+        time.sleep(0.3)
+        assert not waited  # still held
+        release.set()
+        assert _wait(lambda: bool(waited), timeout=5)
+        t.join(5)
+        w.join(5)
