@@ -299,7 +299,14 @@ class AllocRunner:
         # the LIVE task's data with the previous alloc's stale snapshot
         dest_probe = os.path.join(self.alloc_dir.shared_dir, "data")
         try:
-            if os.listdir(dest_probe):
+            entries = os.listdir(dest_probe)
+            if entries:
+                import logging
+
+                logging.getLogger("nomad_tpu.client").info(
+                    "migrate %s<-%s: dest already has %d entries; "
+                    "skipping", self.alloc.id[:8], prev_id[:8],
+                    len(entries))
                 return  # already migrated / the task wrote data
         except OSError:
             pass
@@ -315,9 +322,11 @@ class AllocRunner:
             self._migrate_prev_alloc_data_held(prev_id, disk)
 
     def _migrate_prev_alloc_data_held(self, prev_id: str, disk) -> None:
+        import logging
         import os
         import shutil
 
+        log = logging.getLogger("nomad_tpu.client")
         local = os.path.isdir(os.path.join(self._base_dir, prev_id,
                                            SHARED_ALLOC_DIR, "data"))
         # Data not on this node: with migrate=true pull it from the
@@ -326,6 +335,8 @@ class AllocRunner:
         # sticky PLACEMENT — a cross-node move starts with a fresh disk
         # (reference semantics)
         if not local and not (disk.migrate and self.conn is not None):
+            log.info("migrate %s<-%s: no local source, sticky-only: "
+                     "fresh disk", self.alloc.id[:8], prev_id[:8])
             return
         # Wait for the previous alloc to go terminal before copying — the
         # reference allocwatcher blocks on prev-alloc completion
@@ -346,6 +357,8 @@ class AllocRunner:
                                  SHARED_ALLOC_DIR, "data")
         dest = os.path.join(self.alloc_dir.shared_dir, "data")
         if not os.path.isdir(prev_data):
+            log.info("migrate %s<-%s: local source gone post-wait; "
+                     "trying remote", self.alloc.id[:8], prev_id[:8])
             if disk.migrate:
                 self._fetch_remote_prev_data(prev_id, dest)
             return
@@ -356,10 +369,15 @@ class AllocRunner:
         shutil.rmtree(staging, ignore_errors=True)
         try:
             shutil.copytree(prev_data, staging)
+            n = len(os.listdir(staging))
             self._promote_staging(staging, dest)
-        except OSError:
+            log.info("migrate %s<-%s: carried %d entries",
+                     self.alloc.id[:8], prev_id[:8], n)
+        except OSError as e:
             # best-effort, matching the reference's move fallback —
             # failure yields a fresh disk, never a partial one
+            log.warning("migrate %s<-%s: local copy failed (fresh "
+                        "disk): %s", self.alloc.id[:8], prev_id[:8], e)
             shutil.rmtree(staging, ignore_errors=True)
 
     @staticmethod
