@@ -115,6 +115,21 @@ class TestStickyDiskMigration:
         assert _wait(lambda: any(
             al.client_status == "running"
             for al in api.job_allocations(job.id)))
+        v0 = next(al for al in api.job_allocations(job.id)
+                  if al.client_status == "running")
+
+        # "running" means the executor LAUNCHED the task, not that its
+        # first shell line ran — on a slow host the destructive update
+        # can kill v0 before echo ever executed, and migrating an empty
+        # data dir is then correct behavior ("carried 0 entries").
+        # Wait for the FILE before updating.
+        def wrote():
+            try:
+                return b"v0-state" in api.alloc_fs_cat(
+                    v0.id, "alloc/data/state.txt")
+            except Exception:
+                return False
+        assert _wait(wrote, timeout=60), "v0 never wrote its state file"
 
         import copy
 

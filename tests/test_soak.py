@@ -92,8 +92,22 @@ class TestControlPlaneSoak:
             assert _wait(converged, timeout=60.0), \
                 "rolling update never converged on the new leader"
 
-            # scale down through the survivor — full loop still works
-            ev3 = new_leader.server.job_scale("default", job.id, "web", 2)
+            # scale down through the survivor — full loop still works.
+            # Leadership can FLAP between the two survivors on a slow
+            # host; re-resolve the leader per attempt like a real
+            # client's leader-forwarding would
+            from nomad_tpu.raft.raft import NotLeaderError
+
+            ev3 = None
+            scale_deadline = time.time() + 30.0
+            while ev3 is None and time.time() < scale_deadline:
+                ld = leader_of(survivors) or new_leader
+                try:
+                    ev3 = ld.server.job_scale(
+                        "default", job.id, "web", 2)
+                    new_leader = ld
+                except NotLeaderError:
+                    time.sleep(0.5)
             assert ev3 is not None
             new_leader.server.wait_for_eval(ev3.id, timeout=20.0)
             assert _wait(lambda: sum(
