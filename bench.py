@@ -843,7 +843,40 @@ def _e2e_only_main() -> None:
     print(json.dumps(out))
 
 
+def _lint_preflight() -> None:
+    """nomadlint gate before burning accelerator time: a hot-path
+    purity regression (NLJ0x) invalidates the numbers this bench
+    produces. Pure-ast, no jax import, <5s. NOMAD_TPU_BENCH_LINT=0
+    skips; =strict aborts the run on new findings (pre-commit mode);
+    default warns."""
+    mode = os.environ.get("NOMAD_TPU_BENCH_LINT", "warn")
+    if mode == "0" or os.environ.get("NOMAD_TPU_BENCH_E2E_ONLY") \
+            or os.environ.get("NOMAD_TPU_BENCH_SUPERVISED"):
+        return  # child process: the parent already ran the preflight
+    # children (supervisor reruns, e2e CPU subprocess) inherit the env —
+    # make sure they skip instead of re-parsing the tree per spawn
+    os.environ["NOMAD_TPU_BENCH_LINT"] = "0"
+    try:
+        from nomad_tpu.analysis import (compare_to_baseline,
+                                        load_baseline, run_tree)
+        from nomad_tpu.analysis.core import (default_baseline_path,
+                                             default_root)
+
+        new = compare_to_baseline(run_tree(default_root()),
+                                  load_baseline(default_baseline_path()))
+    except Exception as e:  # noqa: BLE001 — the bench must still run
+        log(f"lint preflight skipped: {e}")
+        return
+    for f in new:
+        log(f"LINT: {f.render()}")
+    if new and mode == "strict":
+        log(f"lint preflight: {len(new)} new finding(s) — aborting "
+            "(NOMAD_TPU_BENCH_LINT=strict)")
+        sys.exit(3)
+
+
 if __name__ == "__main__":
+    _lint_preflight()
     # Hard exit on EVERY path, skipping interpreter teardown: the e2e
     # section can leave scheduler workers parked inside an accelerator
     # RPC, and unwinding live native threads at process exit has crashed

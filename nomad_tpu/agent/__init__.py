@@ -273,7 +273,11 @@ class Agent:
             # for the gossip http_addr tag
             from ..lib.netutil import routable_ip
 
-            host, port = self.http.addr
+            # index, don't unpack: an IPv6 bind makes http.server's
+            # server_address a 4-tuple (host, port, flowinfo, scope_id)
+            # and a 2-tuple unpack would crash agent startup — same
+            # reason HTTPApi.start indexes addr[0]/addr[1]
+            host, port = self.http.addr[0], self.http.addr[1]
             if host in ("0.0.0.0", "::", ""):
                 host = routable_ip()
             scheme = "https" if self.http.tls_enabled else "http"

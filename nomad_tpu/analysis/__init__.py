@@ -1,0 +1,29 @@
+"""nomadlint — repo-native static analysis for JAX purity and
+thread-safety.
+
+The control plane's two failure domains are exactly the two things
+generic linters can't see:
+
+* impure / host-syncing code inside jit- or vmap-reachable kernels
+  (silently retraces or serializes the hot eval path — SURVEY §7), and
+* unsynchronized shared state in the threaded server/client runtime
+  (the class of bug behind the round-5 deflakes and ADVICE.md findings).
+
+Two AST-level rule families cover them (`jax_rules`: NLJ01–NLJ09,
+`thread_rules`: NLT01–NLT03); `lint_baseline.json` at the repo root
+freezes pre-existing findings so only *new* violations fail
+(`python -m nomad_tpu.analysis --fail-on-new`, and tests/test_lint.py
+under tier-1). The analyzer imports neither jax nor the analyzed
+modules — it is pure `ast`, safe and fast (<5s) anywhere.
+"""
+from .core import (Finding, baseline_key, compare_to_baseline,
+                   load_baseline, run_tree, write_baseline)
+from .jax_rules import JAX_RULES
+from .thread_rules import THREAD_RULES
+
+ALL_RULES = {**JAX_RULES, **THREAD_RULES}
+
+__all__ = [
+    "ALL_RULES", "Finding", "JAX_RULES", "THREAD_RULES", "baseline_key",
+    "compare_to_baseline", "load_baseline", "run_tree", "write_baseline",
+]

@@ -1,0 +1,101 @@
+"""`python -m nomad_tpu.analysis` — the nomadlint CLI.
+
+Modes:
+  (default)        print every finding + summary; exit 0
+  --fail-on-new    compare against the baseline; print only NEW
+                   findings; exit 2 if any (cheap enough for
+                   pre-commit / bench.py preflight: pure ast, no jax)
+  --write-baseline regenerate lint_baseline.json from the current tree
+  --json           machine-readable output
+
+Imports neither jax nor the analyzed modules, so it runs anywhere in
+well under 5s on the full tree.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from .core import (Finding, compare_to_baseline, default_baseline_path,
+                   default_root, load_baseline, run_tree, write_baseline)
+
+
+def _emit(findings: List[Finding], as_json: bool) -> None:
+    if as_json:
+        print(json.dumps([f.__dict__ for f in findings], indent=1))
+        return
+    for f in findings:
+        print(f.render())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="nomadlint: JAX purity + thread-safety analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: the "
+                         "nomad_tpu package)")
+    ap.add_argument("--baseline", default=None,
+                    help="ratchet file (default: lint_baseline.json "
+                         "next to the package)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="exit 2 when findings exceed the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings into the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    roots = args.paths or [default_root()]
+    findings: List[Finding] = []
+    for root in roots:
+        findings.extend(run_tree(root))
+    findings.sort()
+    # overlapping/duplicate path args must not double-count a finding —
+    # --fail-on-new would report baselined findings as NEW
+    seen = set()
+    unique: List[Finding] = []
+    for f in findings:
+        k = (f.path, f.line, f.rule, f.context, f.message)
+        if k not in seen:
+            seen.add(k)
+            unique.append(f)
+    findings = unique
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        if args.paths:
+            # a subtree scan would silently WIPE every frozen entry
+            # outside it and fail the next full-tree ratchet run
+            print("--write-baseline requires a full-tree scan: drop "
+                  "the explicit paths (the default root is the whole "
+                  "package)", file=sys.stderr)
+            return 1
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.fail_on_new:
+        baseline = load_baseline(baseline_path)
+        new = compare_to_baseline(findings, baseline)
+        _emit(new, args.as_json)
+        if new and not args.as_json:
+            print(f"\n{len(new)} NEW finding(s) over baseline "
+                  f"({len(findings)} total). Fix them, or if "
+                  f"legitimately unavoidable, regenerate the baseline "
+                  f"with --write-baseline and justify it in the PR.")
+        return 2 if new else 0
+
+    _emit(findings, args.as_json)
+    if not args.as_json:
+        by_rule = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{r}×{n}" for r, n in sorted(by_rule.items()))
+        print(f"\n{len(findings)} finding(s): {summary or 'clean'}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,215 @@
+"""Analyzer driver: file walking, suppression, and the baseline ratchet.
+
+The ratchet mirrors how mature codebases adopt a new checker without a
+flag-day: `lint_baseline.json` records every finding present at adoption
+(keyed by file + rule + syntactic context, NOT line numbers, so
+unrelated edits don't shift the baseline), and `--fail-on-new` fails
+only findings whose per-key count exceeds the frozen count. Burning a
+baselined finding down is always safe; regrowing one fails.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+def dotted(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains, '' otherwise.
+    Shared by both rule families."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+#: inline suppression: `# nomadlint: disable=NLJ04,NLT02`
+_SUPPRESS_RE = re.compile(r"nomadlint:\s*disable=([A-Z0-9,\s]+)")
+#: whole-file opt-out (first 5 lines): `# nomadlint: disable-file`
+_SUPPRESS_FILE_RE = re.compile(r"nomadlint:\s*disable-file")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str      # repo-relative, posix separators
+    line: int
+    rule: str
+    message: str = field(compare=False)
+    hint: str = field(compare=False, default="")
+    context: str = field(compare=False, default="")  # Class.method / func
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        hint = f" (fix: {self.hint})" if self.hint else ""
+        return f"{self.path}:{self.line}: {self.rule}{ctx} " \
+               f"{self.message}{hint}"
+
+
+def baseline_key(f: Finding) -> str:
+    return f"{f.path}::{f.rule}::{f.context}"
+
+
+def _suppressions(source: str) -> Tuple[bool, Dict[int, set]]:
+    """(file-wide opt-out, {line: {rules}}) from magic comments."""
+    lines = source.splitlines()
+    whole = any(_SUPPRESS_FILE_RE.search(ln) for ln in lines[:5])
+    per_line: Dict[int, set] = {}
+    for i, ln in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(ln)
+        if m:
+            per_line[i] = {r.strip() for r in m.group(1).split(",")
+                           if r.strip()}
+    return whole, per_line
+
+
+def analyze_file(path: str, rel: str, jit_registry=None,
+                 tree: Optional[ast.Module] = None,
+                 source: Optional[str] = None,
+                 fns=None) -> List[Finding]:
+    """All findings for one file. `rel` is the repo-relative path used in
+    reports and baseline keys. Pass pre-read `source` / pre-parsed
+    `tree` / a pre-marked `fns` map to skip re-work (run_tree's two
+    passes share them)."""
+    from .jax_rules import analyze_jax
+    from .thread_rules import analyze_threads
+
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            return [Finding(rel, e.lineno or 1, "NLP00",
+                            f"syntax error: {e.msg}")]
+    whole, per_line = _suppressions(source)
+    if whole:
+        return []
+    findings = analyze_jax(tree, rel, jit_registry=jit_registry,
+                           enable_traced="jax" in source, fns=fns)
+    findings += analyze_threads(tree, rel)
+    return [f for f in findings
+            if f.rule not in per_line.get(f.line, ())]
+
+
+def _repo_rel(path: str, fallback_root: str) -> str:
+    """Repo-relative report path, anchored at the rightmost
+    `nomad_tpu` path component so scope prefixes and baseline keys
+    match no matter which subpath the CLI was pointed at
+    (`... nomad_tpu/client` must not silently skip the thread rules)."""
+    parts = os.path.abspath(path).split(os.sep)
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == "nomad_tpu":
+            return "/".join(parts[i:])
+    return os.path.relpath(path, fallback_root).replace(os.sep, "/")
+
+
+def iter_python_files(root: str):
+    """Yield (abspath, repo-relative path) for every .py under root,
+    deterministically ordered."""
+    repo_root = os.path.dirname(os.path.abspath(root.rstrip(os.sep)))
+    if os.path.isfile(root):
+        yield root, _repo_rel(root, repo_root)
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, name)
+            yield p, _repo_rel(p, repo_root)
+
+
+def run_tree(root: str) -> List[Finding]:
+    """Analyze every .py under `root` (a package dir or a single file).
+
+    Two passes: the first collects the cross-module registry of jitted
+    functions with static argnums/argnames (NLJ09 checks call sites in
+    OTHER modules against it), the second runs the rules.
+    """
+    from .jax_rules import collect_jit_registry
+
+    files = list(iter_python_files(root))
+    registry: Dict[str, object] = {}
+    parsed: Dict[str, Tuple[ast.Module, str]] = {}
+    fns_cache: Dict[str, object] = {}
+    findings: List[Finding] = []
+    for path, rel in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            parsed[path] = (ast.parse(source, filename=rel), source)
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "NLP00",
+                                    f"syntax error: {e.msg}"))
+        except OSError:
+            continue
+        else:
+            if "jax" in source:  # cheap gate: registry needs jit decls
+                fns_cache[path] = collect_jit_registry(parsed[path][0],
+                                                       registry)
+    for path, rel in files:
+        if path in parsed:
+            tree, source = parsed[path]
+            findings.extend(analyze_file(
+                path, rel, jit_registry=registry, tree=tree,
+                source=source, fns=fns_cache.get(path)))
+    return sorted(findings)
+
+
+# ---- baseline ratchet ----
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {str(k): int(v) for k, v in data.get("findings", {}).items()}
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[baseline_key(f)] = counts.get(baseline_key(f), 0) + 1
+    payload = {
+        "comment": "nomadlint ratchet — frozen pre-existing findings. "
+                   "Burn entries down freely; regrow them never. To "
+                   "legitimately extend (new rule / unavoidable finding) "
+                   "run: python -m nomad_tpu.analysis --write-baseline "
+                   "and justify the diff in the PR.",
+        "findings": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def compare_to_baseline(findings: List[Finding],
+                        baseline: Dict[str, int]) -> List[Finding]:
+    """Findings in excess of the frozen per-key counts — the ones that
+    fail `--fail-on-new`."""
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        k = baseline_key(f)
+        seen[k] = seen.get(k, 0) + 1
+        if seen[k] > baseline.get(k, 0):
+            new.append(f)
+    return new
+
+
+def default_root() -> str:
+    """The nomad_tpu package directory (analyzer's default target)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(default_root()),
+                        "lint_baseline.json")

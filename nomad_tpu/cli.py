@@ -1223,7 +1223,8 @@ def cmd_agent(args) -> int:
         )
     agent = Agent(cfg)
     agent.start()
-    host, port = agent.http_addr
+    # index, don't unpack: IPv6 server_address is a 4-tuple
+    host, port = agent.http_addr[0], agent.http_addr[1]
     mode = "+".join(m for m, on in (("server", cfg.server),
                                     ("client", cfg.client)) if on)
     scheme = "https" if agent.http.tls_enabled else "http"

@@ -21,10 +21,13 @@ from ..structs.service import ServiceRegistration
 def _resolve_port(alloc, label: str) -> int:
     """Port by label from the alloc's assigned networks (the shared
     Allocation.port_map walk; rank.go AllocatedPortsToPortMap)."""
+    from ..structs.network import literal_port
+
     if not label:
         return 0
-    if label.isdigit():
-        return int(label)
+    lit = literal_port(label)
+    if lit:
+        return lit
     _ip, ports = alloc.port_map()
     return ports.get(label, 0)
 

@@ -14,6 +14,7 @@ TaskEvents (structs.go:7049 event types).
 """
 from __future__ import annotations
 
+import logging
 import re
 import threading
 import time
@@ -26,6 +27,8 @@ from .artifacts import fetch_artifact
 from .drivers import DriverPlugin, TaskConfig, new_driver
 from .logmon import LogMon
 from .taskenv import build_env, interpolate_config
+
+log = logging.getLogger(__name__)
 
 EVENT_RECEIVED = "Received"
 EVENT_TASK_SETUP = "Task Setup"
@@ -109,6 +112,11 @@ class TaskRunner:
         #: rendered template content by dest path — the re-render
         #: baseline the watcher diffs against
         self._tmpl_content: Dict[str, str] = {}
+        #: guards _tmpl_content/_secret_data/_secret_env: the render
+        #: baseline and secret caches are shared between the run loop
+        #: (prestart, _task_config on restart) and the template watcher
+        #: thread (ADVICE.md r5 / nomadlint NLT01)
+        self._tmpl_lock = threading.Lock()
         self._tmpl_thread: Optional[threading.Thread] = None
         #: terminal-state gate for the watcher: a naturally-completed
         #: task must stop its polling (kill() is never called for it)
@@ -412,19 +420,23 @@ class TaskRunner:
                 f"template dest escapes task dir: {tmpl.dest_path!r}")
         return dest
 
-    def _template_scope(self, raws,
-                        degraded: bool = False) -> Dict[str, str]:
+    def _template_scope(self, raws, degraded: bool = False,
+                        secret_env: Optional[Dict[str, str]] = None
+                        ) -> Dict[str, str]:
         """Interpolation scope: task env + secrets + catalog lookups for
         every `${service.<name>}` the templates reference. A failed
         lookup raises — callers decide the fallback. degraded=True skips
         lookups entirely (empty catalog), for a first render with no
-        reachable server."""
+        reachable server. `secret_env` is the caller's snapshot of
+        self._secret_env (taken under _tmpl_lock — this method runs on
+        both the run-loop and watcher threads and must not touch the
+        shared dict itself)."""
         from .taskenv import build_env
 
         tenv = build_env(self.alloc, self.task, self.node,
                          task_dir=self.task_dir,
                          shared_dir=f"{self.task_dir}/alloc")
-        tenv.update(self._secret_env)
+        tenv.update(secret_env or {})
         names = set()
         for raw in raws:
             names.update(self._SERVICE_REF.findall(raw))
@@ -493,17 +505,26 @@ class TaskRunner:
         empty values would itself fire a bogus change_mode one tick
         later — and a missing dest renders against an empty catalog
         rather than blocking task start forever."""
-        import os
-
-        from .taskenv import interpolate
-
         raws = [self._template_raw(t) for t in self.task.templates]
+        with self._tmpl_lock:
+            senv = self._secret_env
+        # catalog lookups are RPCs — resolve them OUTSIDE the lock
+        # (nomadlint NLT02: a leader-move stall here must not block the
+        # run loop's prestart/restart render on _tmpl_lock)
         try:
-            tenv = self._template_scope(raws)
+            tenv = self._template_scope(raws, secret_env=senv)
         except Exception:
             if strict:
                 raise
             tenv = None  # degraded: catalog unreachable
+        with self._tmpl_lock:
+            return self._render_templates_locked(raws, tenv)
+
+    def _render_templates_locked(self, raws, tenv) -> list:
+        import os
+
+        from .taskenv import interpolate
+
         changed = []
         degraded_scope = None
         for tmpl, raw in zip(self.task.templates, raws):
@@ -515,7 +536,8 @@ class TaskRunner:
             if tenv is None:
                 if degraded_scope is None:
                     degraded_scope = self._template_scope(
-                        raws, degraded=True)
+                        raws, degraded=True,
+                        secret_env=self._secret_env)
                 scope = degraded_scope
             else:
                 scope = tenv
@@ -552,12 +574,22 @@ class TaskRunner:
         # _tmpl_stop (not _kill): a naturally-completed task never gets
         # kill()ed, and its watcher must not poll — or fire change_mode
         # events on a dead task — for the rest of the agent's life
+        fails = 0
         while not self._tmpl_stop.wait(self.TEMPLATE_POLL_S):
             try:
                 if self.task.secrets:
                     self._ensure_secrets(refresh=True)
                 changed = self._render_templates(strict=True)
-            except Exception:  # noqa: BLE001 — transient (leader move)
+                fails = 0
+            except Exception as e:  # noqa: BLE001 — transient (leader
+                # move); first failure of a streak logs at WARNING so a
+                # permanently wedged watcher leaves a visible trace at
+                # the default log level, the rest at debug so a long
+                # outage doesn't spam a line per poll tick
+                fails += 1
+                (log.warning if fails == 1 else log.debug)(
+                    "task %s: template re-render failed: %s",
+                    self.task.name, e)
                 continue
             if not changed:
                 continue
@@ -569,8 +601,11 @@ class TaskRunner:
                             "Template with change_mode restart re-rendered")
                 try:
                     self.restart()
-                except Exception:  # noqa: BLE001 — task not running now;
-                    pass  # the next launch reads the re-rendered file
+                except Exception as e:  # noqa: BLE001 — task not
+                    # running now; the next launch reads the
+                    # re-rendered file
+                    log.info("task %s: change_mode restart skipped: %s",
+                             self.task.name, e)
             elif "signal" in modes:
                 sigs = sorted({s or "SIGHUP" for m, s in changed
                                if m == "signal"})
@@ -582,8 +617,10 @@ class TaskRunner:
                         if self.handle is not None \
                                 and self.handle.is_running():
                             self.driver.signal_task(self.handle, sig)
-                    except Exception:  # noqa: BLE001 — racing an exit
-                        pass
+                    except Exception as e:  # noqa: BLE001 — racing an
+                        # exit
+                        log.info("task %s: change_mode signal %s "
+                                 "skipped: %s", self.task.name, sig, e)
             # "noop": the file was rewritten; nothing else to do
 
     def _ensure_secrets(self, refresh: bool = False) -> None:
@@ -593,48 +630,48 @@ class TaskRunner:
         or always under refresh=True (the template watcher's poll, so a
         KV write re-renders templates and the next task launch sees the
         new values)."""
-        if not self.task.secrets or (self._secret_env and not refresh):
+        if not self.task.secrets:
             return
+        with self._tmpl_lock:
+            if self._secret_env and not refresh:
+                return
         import json as _json
         import os
-        import tempfile
 
         if self.conn is None:
             raise RuntimeError(
                 f"task {self.task.name}: secrets declared but the "
                 "client has no server connection")
-        sdir = os.path.join(self.task_dir, "secrets")
-        env: Dict[str, str] = {}
+        # fetch OUTSIDE the lock — holding _tmpl_lock across the RPC
+        # would stall the other thread's render for the round trip
+        # (nomadlint NLT02)
+        entries = {}
         for path in self.task.secrets:
             entry = self.conn.secret_get(self.alloc.namespace, path)
             if entry is None:
                 raise RuntimeError(
                     f"task {self.task.name}: secret {path!r} not "
                     f"found in namespace {self.alloc.namespace!r}")
-            # rewrite only on change, atomically (temp 0600 + rename):
-            # the file is the task's to read at any time, and refresh
-            # polls must not race readers with a truncated JSON — nor
-            # burn a disk write per poll on unchanged values
-            if self._secret_data.get(path) != entry.data:
-                self._secret_data[path] = dict(entry.data)
-                dest = os.path.normpath(
-                    os.path.join(sdir, path.replace("/", "_") + ".json"))
-                fd, tmp = tempfile.mkstemp(dir=sdir, prefix=".secret-")
-                try:
-                    with os.fdopen(fd, "w") as f:
-                        _json.dump(entry.data, f)
-                    os.replace(tmp, dest)
-                except BaseException:
-                    try:
-                        os.unlink(tmp)
-                    except OSError:
-                        pass
-                    raise
-            slug = path.upper().replace("/", "_").replace("-", "_")
-            for k, v in entry.data.items():
-                env[f"NOMAD_SECRET_{slug}_"
-                    f"{k.upper().replace('-', '_')}"] = str(v)
-        self._secret_env = env
+            entries[path] = entry
+        sdir = os.path.join(self.task_dir, "secrets")
+        env: Dict[str, str] = {}
+        with self._tmpl_lock:
+            for path, entry in entries.items():
+                # rewrite only on change, atomically (temp 0600 +
+                # rename): the file is the task's to read at any time,
+                # and refresh polls must not race readers with a
+                # truncated JSON — nor burn a disk write per poll on
+                # unchanged values
+                if self._secret_data.get(path) != entry.data:
+                    self._secret_data[path] = dict(entry.data)
+                    dest = os.path.normpath(os.path.join(
+                        sdir, path.replace("/", "_") + ".json"))
+                    self._write_atomic(dest, _json.dumps(entry.data))
+                slug = path.upper().replace("/", "_").replace("-", "_")
+                for k, v in entry.data.items():
+                    env[f"NOMAD_SECRET_{slug}_"
+                        f"{k.upper().replace('-', '_')}"] = str(v)
+            self._secret_env = env
 
     def _task_config(self) -> TaskConfig:
         # a recovered task that restarts needs its secrets back (the
@@ -645,15 +682,22 @@ class TaskRunner:
             task_dir=self.task_dir,
             shared_dir=f"{self.task_dir}/alloc",
         )
-        env.update(self._secret_env)
+        with self._tmpl_lock:  # watcher refresh rebinds it concurrently
+            env.update(self._secret_env)
         if "NOMAD_CONNECT_TARGET_LABEL" in self.task.env:
             # the sidecar proxies a port owned by ANOTHER task of the
             # group; per-task port env can't see it, so resolve across
             # the whole alloc here
+            from ..structs.network import literal_port
+
             _ip, allp = self.alloc.port_map("")
             lbl = self.task.env["NOMAD_CONNECT_TARGET_LABEL"]
             if lbl in allp:
                 env["NOMAD_CONNECT_TARGET_PORT"] = str(allp[lbl])
+            elif literal_port(lbl):
+                # literal-port form — same shared predicate as
+                # validate_connect and service registration
+                env["NOMAD_CONNECT_TARGET_PORT"] = str(literal_port(lbl))
         raw = interpolate_config(dict(self.task.config), env, self.node)
         ip, ports = self.alloc.port_map(self.task.name)
         return TaskConfig(

@@ -274,6 +274,16 @@ def main(argv=None) -> int:
 
     proxy = Proxy(args)
     threads = []
+    if args.listen and not args.target and not args.public:
+        # A sidecar with a mesh listener but no resolved local target
+        # (NOMAD_CONNECT_TARGET_PORT unresolved) must fail VISIBLY at
+        # start: serving only upstreams while <svc>-sidecar-proxy sits
+        # "passing" in the catalog is a silent connection-refused
+        # outage for every peer that dials it (ADVICE.md r5).
+        _log(f"FATAL: inbound listener port {args.listen} has no "
+             "target port — NOMAD_CONNECT_TARGET_PORT did not resolve "
+             "(sidecar target label missing from the group's networks?)")
+        return 1
     if args.listen and args.target:
         threads.append(threading.Thread(target=proxy.serve_inbound,
                                         daemon=True))

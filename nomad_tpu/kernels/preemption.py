@@ -86,7 +86,11 @@ def preempt_rank(cluster: ClusterArrays, p: TGParams,
 
     used = cluster.used
     if p.delta_idx.shape[0]:
-        used = used.at[p.delta_idx].add(-p.delta_res, mode="drop")
+        # comparison-einsum instead of scatter (TPU scatters serialize;
+        # −1 pads match no row — same idiom as the placement kernel)
+        eq = (p.delta_idx[:, None] == jnp.arange(n)[None, :]
+              ).astype(jnp.float32)
+        used = used - jnp.einsum("dn,dr->nr", eq, p.delta_res)
 
     # Sort each node's candidates by priority ascending (victims cheapest
     # first — reference filterAndGroupPreemptibleAllocs order).
@@ -110,10 +114,14 @@ def preempt_rank(cluster: ClusterArrays, p: TGParams,
     k = k_idx + 1
 
     # net priority of the minimal prefix (rank.go:747 netPriority).
+    # Per-row prefix selection as one-hot einsums, not [rows, k_idx]
+    # advanced indexing — TPU gathers serialize; every slot is finite
+    # (INF_PRIO = 1e9) so masked products stay exact.
     psum = jnp.cumsum(jnp.where(eligible, prio_s, 0.0), axis=1)  # [N, A]
-    rows = jnp.arange(n)
-    max_p = prio_s[rows, k_idx]            # sorted ascending ⇒ last = max
-    sum_p = psum[rows, k_idx]
+    k_oh = (jnp.arange(a)[None, :] == k_idx[:, None]
+            ).astype(jnp.float32)                               # [N, A]
+    max_p = jnp.einsum("na,na->n", prio_s, k_oh)  # sorted ⇒ last = max
+    sum_p = jnp.einsum("na,na->n", psum, k_oh)
     net_prio = jnp.where(max_p > 0, max_p + sum_p / jnp.maximum(max_p, 1.0),
                          0.0)
     pre_score = 1.0 / (
@@ -122,7 +130,7 @@ def preempt_rank(cluster: ClusterArrays, p: TGParams,
     )
 
     # Bin-pack score at the post-eviction utilization (funcs.go:175).
-    util_sel = util_k[rows, k_idx]                              # [N, R]
+    util_sel = jnp.einsum("nar,na->nr", util_k, k_oh)           # [N, R]
     binpack, _ = fit_scores(util_sel, cap)
 
     combined = (binpack + pre_score) / 2.0

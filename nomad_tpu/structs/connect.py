@@ -67,15 +67,42 @@ def validate_connect(job) -> str:
                 if svc.connect is not None:
                     return (f"task {task.name!r} service {svc.name!r}: "
                             "connect is only valid on group services")
+        # every port label declared on the group's networks or any
+        # task's networks — what the task runner's alloc port_map can
+        # actually resolve NOMAD_CONNECT_TARGET_LABEL against
+        declared = {
+            p.label
+            for nets in ([tg.networks]
+                         + [t.resources.networks for t in tg.tasks])
+            for nw in nets
+            for p in list(nw.reserved_ports) + list(nw.dynamic_ports)
+            if p.label
+        }
         for svc in tg.services:
             if svc.connect is None:
                 continue
-            if svc.connect.sidecar_service is not None and not (
-                    svc.connect.sidecar_service.port_label
-                    or svc.port_label):
-                return (f"group {tg.name!r} service {svc.name!r}: "
-                        "connect sidecar_service needs a port — set "
-                        "the service's port or sidecar_service.port")
+            if svc.connect.sidecar_service is not None:
+                label = (svc.connect.sidecar_service.port_label
+                         or svc.port_label)
+                if not label:
+                    return (f"group {tg.name!r} service {svc.name!r}: "
+                            "connect sidecar_service needs a port — set "
+                            "the service's port or sidecar_service.port")
+                from .network import literal_port
+
+                if label not in declared and not literal_port(label):
+                    # a typo'd target would leave
+                    # NOMAD_CONNECT_TARGET_PORT unresolved: the proxy
+                    # would register <svc>-sidecar-proxy yet splice
+                    # inbound to port 0 — a silent connection-refused
+                    # outage instead of this admission error. A valid
+                    # literal-port label (structs/network.py
+                    # literal_port, shared with service registration
+                    # and the task runner) stays admissible.
+                    return (f"group {tg.name!r} service {svc.name!r}: "
+                            f"connect sidecar target port {label!r} is "
+                            "not a port label declared on any network "
+                            "of the group or its tasks")
             if svc.connect.gateway is not None:
                 for ls in svc.connect.gateway.listeners:
                     if ls.port <= 0 or not ls.service:

@@ -36,6 +36,19 @@ class AllocatedPortMapping:
     host_ip: str = ""
 
 
+def literal_port(label: str) -> int:
+    """The literal-port form of a port label ("8080") — 0 unless the
+    label is an ASCII-digit string naming a valid port (1-65535).
+    Single source of truth for validate_connect, the task runner's
+    connect-target resolution, and service registration: a label one
+    surface accepts as a literal port must resolve the same everywhere."""
+    if label and label.isascii() and label.isdigit():
+        port = int(label)
+        if 0 < port <= 65535:
+            return port
+    return 0
+
+
 def parse_port_ranges(spec: str) -> List[int]:
     """Parse "80,443,10000-12000" into a port list (reference
     `structs.ParsePortRanges`, helper used by reserved host ports)."""

@@ -1,0 +1,60 @@
+# nomadlint fixture — near-misses that must produce ZERO findings.
+# Parsed by tests/test_lint.py, never imported.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def shape_branches(x, m):
+    # shapes/dtypes/len are static under trace — branching on them is
+    # the sanctioned kernel idiom (kernels/placement.py does exactly
+    # this with p.cand_idx.shape[0])
+    if x.shape[0]:
+        x = x + 1
+    n = len(x)
+    for _ in range(n):
+        x = x * 2
+    if x.dtype == jnp.float32:
+        x = x * 2
+    return x.reshape(m)
+
+
+@jax.jit
+def single_gather(x, i):
+    # single-array indexing is not the multi-axis gather NLJ07 targets
+    return x[i]
+
+
+@jax.jit
+def where_not_if(x):
+    # data-dependent select the right way
+    return jnp.where(x > 0, x, -x)
+
+
+def host_code(x):
+    # not traced: host-side conversion is the dispatch boundary
+    v = float(np.asarray(x)[0])
+    return int(v)
+
+
+def pad_host(a, n):
+    # host helper, never traced: numpy scatter/item are fine here
+    out = np.zeros((n, 2), dtype=np.float32)
+    out[0, 0] = float(np.asarray(a).sum())
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def unpack(buf, spec):
+    # static args forwarded under the same name stay static in callees
+    return _unpack_inner(buf, spec)
+
+
+def _unpack_inner(buf, spec):
+    out = []
+    for name, off, size in spec:
+        out.append((name, buf[off:off + size]))
+    return out

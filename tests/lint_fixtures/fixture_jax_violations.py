@@ -1,0 +1,75 @@
+# nomadlint fixture — parsed by tests/test_lint.py, never imported.
+# Trailing `# NLJxx` markers are the expected findings at those lines.
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def host_syncs(x, y):
+    a = x.item()                           # NLJ01
+    b = float(x)                           # NLJ02
+    c = np.asarray(y)                      # NLJ03
+    return a + b + c.sum()
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def control_flow(x, n):
+    if x > 0:                              # NLJ04
+        x = x + 1
+    for _ in range(n):
+        x = x * 2
+    total = jnp.sum(x)
+    while total > 0:                       # NLJ04
+        total = total - 1
+    return total
+
+
+@jax.jit
+def scatter_gather(table, idx, rows, cols):
+    table = table.at[idx].add(1.0)         # NLJ06
+    picked = table[rows, cols]             # NLJ07
+    return picked
+
+
+_ACC = []
+
+
+@jax.jit
+def impure(x):
+    global _ACC                            # NLJ08
+    _ACC.append(x)                         # NLJ08
+    return x
+
+
+def helper(x):
+    return bool(x)                         # NLJ02
+
+
+@jax.jit
+def calls_helper(x):
+    return helper(x)
+
+
+@functools.partial(jax.jit, static_argnames=("m",))
+def static_shape(x, m):
+    return x.reshape(m)
+
+
+def bad_static_call(x, y):
+    return static_shape(x, jnp.sum(y))     # NLJ09
+
+
+def scan_body_violation(xs):
+    def step(carry, x):
+        carry = carry + x.item()           # NLJ01
+        return carry, carry
+    return jax.lax.scan(step, 0.0, xs)
+
+
+def hot_path_debug(x):
+    jax.debug.print("x={}", x)             # NLJ05
+    jax.block_until_ready(x)               # NLJ05
+    return x

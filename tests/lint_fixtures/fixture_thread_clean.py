@@ -1,0 +1,84 @@
+# nomadlint fixture — thread patterns that must produce ZERO findings.
+# Parsed by tests/test_lint.py, never imported.
+import threading
+import time
+
+
+class LockedCounter:
+    """Proper lock discipline on both sides."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._count += 1
+            time.sleep(0.01)  # blocking OUTSIDE the lock
+
+    def read(self):
+        with self._lock:
+            return self._count
+
+
+class CondDrain:
+    """cv.wait on the HELD condition releases it — not NLT02; typed
+    narrow excepts in the loop are not NLT03."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._drain)
+        self._thread.start()
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def _drain(self):
+        while True:
+            try:
+                with self._cv:
+                    while not self._items:
+                        self._cv.wait(0.5)
+                    self._items.pop()
+            except OSError:
+                pass
+
+
+class LockedByConvention:
+    """`*_locked` methods are called with the owner's lock held (repo
+    convention) — their accesses are not unsynchronized."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._bump_locked()
+
+    def _bump_locked(self):
+        self._state["n"] = self._state.get("n", 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._state)

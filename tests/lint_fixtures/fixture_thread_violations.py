@@ -1,0 +1,83 @@
+# nomadlint fixture — parsed by tests/test_lint.py, never imported.
+# Trailing `# NLTxx` markers are the expected findings at those lines.
+import subprocess
+import threading
+import time
+
+
+class WatcherRace:
+    """The PRE-FIX task_runner template-watcher shape (ADVICE.md r5,
+    fixed in client/task_runner.py by _tmpl_lock): a content cache
+    mutated from two different threads with no common lock. This is
+    the concurrency lint's canonical true positive — the regression
+    test asserts NLT01 keeps catching it."""
+
+    def __init__(self):
+        self._content = {}
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._watch)
+        self._thread.start()
+        threading.Thread(target=self.run).start()
+
+    def run(self):
+        self._render()
+
+    def _render(self):
+        self._content["a"] = "rendered"            # NLT01
+        self._content, self._gen = dict(self._content), 1  # NLT01
+
+    def _watch(self):
+        while not self._stop.wait(1.0):
+            try:
+                self._render()
+            except Exception:                      # NLT03
+                continue
+
+
+class OneSidedLock:
+    """Locked writer + unlocked reader is STILL a race — NLT01 must
+    not be satisfied by one side holding the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._state["n"] = 1
+
+    def read(self):
+        return self._state.get("n")                # NLT01
+
+
+class LockAcrossBlocking:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def slow_update(self):
+        with self._lock:
+            time.sleep(1.0)                        # NLT02
+            self.value += 1
+
+    def shell_out(self):
+        with self._lock:
+            subprocess.run(["true"])               # NLT02
+
+    def wait_holding(self, evt):
+        with self._lock:
+            evt.wait(1.0)                          # NLT02
+
+    def join_holding(self, worker_thread):
+        with self._lock:
+            worker_thread.join()                   # NLT02

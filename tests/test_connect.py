@@ -451,6 +451,45 @@ class TestValidation:
             api.register_job(job)
         assert "needs a port" in str(ei.value)
 
+    def test_unresolvable_sidecar_target_port_rejected(self, agent):
+        """A sidecar target label that no group/task network declares
+        would leave NOMAD_CONNECT_TARGET_PORT unresolved — the proxy
+        would splice inbound to port 0 while registered as passing.
+        Admission must reject it (ADVICE.md r5)."""
+        from nomad_tpu.api.client import ApiError
+        from nomad_tpu.structs.job import Service
+
+        a, api = agent
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.services = [Service(
+            name="api", port_label="no_such_label",
+            connect=Connect(sidecar_service=SidecarService()))]
+        with pytest.raises(ApiError) as ei:
+            api.register_job(job)
+        assert "not a port label" in str(ei.value)
+        # the literal numeric form stays admissible (services.py
+        # _resolve_port accepts it; the task runner resolves it too)
+        from nomad_tpu.structs.connect import validate_connect
+
+        tg.services[0].port_label = "8080"
+        assert validate_connect(job) == ""
+
+    def test_proxy_exits_visibly_without_target_port(self):
+        """Defense in depth behind the validator: a sidecar that DOES
+        start with an inbound listener but no resolved target must die
+        loudly (restart-loop visibility), not serve only upstreams."""
+        import os
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "nomad_tpu.connect_proxy",
+             "--listen", "12345", "--upstream", "backend=0"],
+            capture_output=True, text=True, timeout=30, cwd=repo)
+        assert proc.returncode == 1
+        assert "target port" in (proc.stderr + proc.stdout)
+
     def test_reserved_namespace_blocked_over_http(self, agent):
         from nomad_tpu.api.client import ApiError
 

@@ -1,0 +1,213 @@
+"""nomadlint (nomad_tpu/analysis) — tier-1 gate + analyzer unit tests.
+
+`test_tree_has_no_new_findings` is the ratchet: it runs the analyzer
+over the whole package against the committed `lint_baseline.json`, so
+any NEW JAX-purity or thread-safety violation fails tier-1. Everything
+else pins the analyzer itself: fixture files with known violations
+(exact rule ids + line numbers, via trailing `# NLxxx` markers), clean
+near-miss fixtures, the baseline ratchet mechanics, the CLI exit
+codes, and the regression tests for the findings this PR burned down.
+"""
+import ast
+import os
+import re
+import shutil
+
+from nomad_tpu.analysis import (Finding, compare_to_baseline,
+                                load_baseline, run_tree, write_baseline)
+from nomad_tpu.analysis.core import analyze_file, baseline_key
+from nomad_tpu.analysis.jax_rules import collect_jit_registry
+from nomad_tpu.analysis.__main__ import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "nomad_tpu")
+BASELINE = os.path.join(REPO, "lint_baseline.json")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+_MARKER = re.compile(r"#\s*(NL[JT]\d\d)\b")
+
+_TREE_CACHE = []
+
+
+def _scope_rel(*parts):
+    """Synthetic repo-relative path mapping a fixture into a rule
+    scope — assembled at runtime so the citations checker does not
+    read these as real repo paths."""
+    return "/".join(("nomad_tpu",) + parts)
+
+
+def _tree_findings():
+    """run_tree(PKG) once per session — several tests consume it, and
+    tier-1 runs against a hard wall-clock budget."""
+    if not _TREE_CACHE:
+        _TREE_CACHE.append(run_tree(PKG))
+    return _TREE_CACHE[0]
+
+
+def _expected_markers(path):
+    out = set()
+    with open(path) as f:
+        for i, line in enumerate(f, start=1):
+            for rule in _MARKER.findall(line):
+                out.add((rule, i))
+    return out
+
+
+def _analyze_fixture(name, rel):
+    """Analyze one fixture under a scope-mapping repo-relative path."""
+    path = os.path.join(FIXTURES, name)
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=rel)
+    registry = {}
+    collect_jit_registry(tree, registry)
+    return analyze_file(path, rel, jit_registry=registry, tree=tree)
+
+
+# ---- fixtures: exact rule ids and line numbers ----
+
+def test_jax_fixture_findings_exact():
+    found = _analyze_fixture("fixture_jax_violations.py",
+                             _scope_rel("kernels", "fixture.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_jax_violations.py"))
+
+
+def test_thread_fixture_findings_exact():
+    found = _analyze_fixture("fixture_thread_violations.py",
+                             _scope_rel("server", "fixture.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_thread_violations.py"))
+
+
+def test_clean_fixtures_have_zero_findings():
+    assert _analyze_fixture("fixture_jax_clean.py",
+                            _scope_rel("kernels", "fixture_clean.py")) == []
+    assert _analyze_fixture("fixture_thread_clean.py",
+                            _scope_rel("server", "fixture_clean.py")) == []
+
+
+def test_inline_suppression(tmp_path):
+    src = ("import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return x.item()  # nomadlint: disable=NLJ01\n")
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert analyze_file(str(p), _scope_rel("kernels", "supp.py")) == []
+
+
+# ---- THE tier-1 ratchet ----
+
+def test_tree_has_no_new_findings():
+    new = compare_to_baseline(_tree_findings(), load_baseline(BASELINE))
+    assert new == [], "NEW lint findings over lint_baseline.json:\n" \
+        + "\n".join(f.render() for f in new)
+
+
+def test_baseline_has_no_dead_entries():
+    """Every baselined key still exists — burned-down findings must be
+    REMOVED from the baseline, keeping the ratchet monotone."""
+    live = {baseline_key(f) for f in _tree_findings()}
+    dead = [k for k in load_baseline(BASELINE) if k not in live]
+    assert dead == [], f"stale baseline entries (regenerate): {dead}"
+
+
+def test_ratchet_fails_on_new_violation(tmp_path):
+    """A newly introduced violation exceeds the frozen count and fails,
+    while every baselined finding still passes."""
+    findings = _tree_findings()
+    baseline = load_baseline(BASELINE)
+    assert compare_to_baseline(findings, baseline) == []
+    extra = Finding("nomad_tpu/kernels/placement.py", 1, "NLJ05",
+                    "injected", context="")
+    assert compare_to_baseline(findings + [extra], baseline) == [extra]
+    # and a SECOND instance of an already-baselined key also fails
+    if findings:
+        dupe = findings[0]
+        assert dupe in compare_to_baseline(findings + [dupe], baseline)
+    # write/load roundtrip freezes exactly the current counts
+    p = tmp_path / "bl.json"
+    write_baseline(str(p), findings + [extra])
+    assert compare_to_baseline(findings + [extra],
+                               load_baseline(str(p))) == []
+
+
+# ---- CLI (the pre-commit/bench preflight) ----
+
+def test_cli_fail_on_new_clean_then_dirty(tmp_path, capsys):
+    """End-to-end CLI ratchet on a kernels-only copy (rel paths — and
+    so baseline keys and hot-path scope — are preserved because the
+    copy root is still named nomad_tpu; a subtree keeps this cheap
+    enough for the wall-clock-bounded tier-1 run)."""
+    dst = tmp_path / "nomad_tpu"
+    shutil.copytree(os.path.join(PKG, "kernels"), dst / "kernels",
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    argv = [str(dst), "--baseline", BASELINE, "--fail-on-new"]
+    assert lint_main(argv) == 0
+    # default mode on the same copy: lists findings, exits 0
+    assert lint_main([str(dst)]) == 0
+    assert "finding(s)" in capsys.readouterr().out
+    # introduce a hot-path violation into the copy
+    with open(dst / "kernels" / "placement.py", "a") as f:
+        f.write("\n\ndef _lint_canary(x):\n"
+                "    jax.debug.print(\"{}\", x)\n"
+                "    return x\n")
+    assert lint_main(argv) == 2
+    out = capsys.readouterr().out
+    assert "NLJ05" in out
+
+
+# ---- regression: the findings this PR burned down stay fixed ----
+
+def test_task_runner_template_state_is_lock_guarded():
+    """ADVICE.md r5 / satellite: _tmpl_content, _secret_data and
+    _secret_env are shared by the run loop and the watcher thread —
+    NLT01 must stay silent on them now that _tmpl_lock guards both
+    sides, while the pre-fix shape (fixture WatcherRace) keeps being
+    caught."""
+    path = os.path.join(PKG, "client", "task_runner.py")
+    found = analyze_file(path, "nomad_tpu/client/task_runner.py")
+    contexts = {f.context for f in found if f.rule == "NLT01"}
+    for attr in ("TaskRunner._tmpl_content", "TaskRunner._secret_data",
+                 "TaskRunner._secret_env"):
+        assert attr not in contexts, f"{attr} race reintroduced"
+    # the rule itself still catches the pre-fix pattern
+    fixture = _analyze_fixture("fixture_thread_violations.py",
+                               _scope_rel("server", "fixture.py"))
+    assert any(f.rule == "NLT01" and f.context == "WatcherRace._content"
+               for f in fixture)
+
+
+def test_task_runner_watcher_swallows_are_logged():
+    path = os.path.join(PKG, "client", "task_runner.py")
+    found = analyze_file(path, "nomad_tpu/client/task_runner.py")
+    assert not any(f.rule == "NLT03"
+                   and f.context == "TaskRunner._template_watch"
+                   for f in found)
+
+
+def test_preemption_kernel_is_scatter_and_gather_free():
+    path = os.path.join(PKG, "kernels", "preemption.py")
+    found = analyze_file(path, "nomad_tpu/kernels/preemption.py")
+    assert not any(f.rule in ("NLJ06", "NLJ07") for f in found)
+
+
+def test_analyzer_needs_no_jax_import():
+    """Lint time must not pay (or require) a jax import — the CLI is a
+    pre-commit/bench preflight that must run anywhere, fast."""
+    import subprocess
+    import sys
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None  # any `import jax` now raises\n"
+        "from nomad_tpu.analysis.core import run_tree\n"
+        "fs = run_tree(sys.argv[1])\n"
+        "assert not any(f.rule.startswith('NLP') for f in fs), fs\n"
+        "print('OK', len(fs))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, os.path.join(PKG, "kernels")],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.startswith("OK")
