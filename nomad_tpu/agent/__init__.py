@@ -338,7 +338,12 @@ class Agent:
         return info
 
     def metrics(self) -> Dict[str, Any]:
-        """go-metrics /v1/metrics analog: subsystem counters."""
+        """go-metrics /v1/metrics analog: subsystem counters, the
+        server registry (counters/gauges/histograms incl. per-phase
+        eval latency) and the process-global registry (RPC transport,
+        client loop-error sinks)."""
+        from ..lib.metrics import default_registry
+
         out: Dict[str, Any] = {"uptime_s": time.time() - self._started_at}
         if self.server is not None:
             out["broker"] = dict(self.server.broker.stats)
@@ -347,9 +352,34 @@ class Agent:
             out["blocked_evals"] = self.server.blocked.blocked_count()
             out["plan_apply"] = dict(self.server.planner.stats)
             out["state_index"] = self.server.state.index.value
+            reg = getattr(self.server, "metrics", None)
+            if reg is not None:
+                snap = reg.snapshot()
+                out["telemetry"] = snap
+                # per-phase eval latency summaries, pulled up as a
+                # first-class view (the observability headline)
+                out["eval_phases"] = {
+                    name[len("eval.phase."):]: s
+                    for name, s in (snap.get("histograms") or {}).items()
+                    if name.startswith("eval.phase.")}
+        out["process"] = default_registry().snapshot()
         if self.client is not None:
             out["client_allocs"] = self.client.num_allocs()
         return out
+
+    def metrics_prometheus(self) -> str:
+        """Prometheus text exposition across both registries. Name sets
+        are disjoint (server-owned vs process-global instruments), so
+        plain concatenation is collision-free."""
+        from ..lib.metrics import default_registry
+
+        parts = []
+        if self.server is not None:
+            reg = getattr(self.server, "metrics", None)
+            if reg is not None:
+                parts.append(reg.prometheus())
+        parts.append(default_registry().prometheus())
+        return "".join(parts)
 
 
 __all__ = ["Agent", "AgentConfig", "HTTPApi"]

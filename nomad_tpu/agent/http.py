@@ -30,6 +30,16 @@ class HttpError(Exception):
         self.code = code
 
 
+class PlainText:
+    """Marker payload: serve `body` verbatim as text instead of JSON
+    (Prometheus exposition on /v1/metrics?format=prometheus)."""
+
+    def __init__(self, body: str,
+                 content_type: str = "text/plain; version=0.0.4") -> None:
+        self.body = body
+        self.content_type = content_type
+
+
 class HTTPApi:
     """Routes /v1/* to server endpoints. `agent` carries .server (leader
     methods), optional .client, and optional .cluster (ClusterServer)."""
@@ -46,9 +56,14 @@ class HTTPApi:
                 pass
 
             def _respond(self, code: int, payload: Any) -> None:
-                body = json.dumps(to_json_tree(payload)).encode()
+                if isinstance(payload, PlainText):
+                    body = payload.body.encode()
+                    ctype = payload.content_type
+                else:
+                    body = json.dumps(to_json_tree(payload)).encode()
+                    ctype = "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -475,6 +490,11 @@ class HTTPApi:
         if parts0[1:] == ["agent", "self"]:
             return self.agent.self_info()
         if parts0[1:] == ["metrics"]:
+            if query.get("format") == "prometheus":
+                # the reference's `telemetry { prometheus_metrics }`
+                # exposition, selected by query param like its
+                # /v1/metrics?format=prometheus
+                return PlainText(self.agent.metrics_prometheus())
             return self.agent.metrics()
         # /v1/client/fs/* — served by the agent hosting the alloc
         # (client/fs_endpoint.go; servers in the reference proxy to the
@@ -909,6 +929,18 @@ class HTTPApi:
                 return [to_wire(a) for a
                         in state.allocs_by_job(e.namespace, e.job_id)
                         if a.eval_id == e.id]
+            if len(parts) > 2 and parts[2] == "trace":
+                # eval-lifecycle spans (lib/trace.py): ordered phases
+                # from broker enqueue through ack. Bounded LRU — an
+                # evicted trace 404s even though the eval still exists.
+                tracer = getattr(server, "tracer", None)
+                trace = tracer.get(e.id) if tracer is not None else None
+                if trace is None:
+                    raise HttpError(
+                        404, f"no trace retained for eval {e.id!r}")
+                trace["eval_id"] = e.id
+                trace["status"] = e.status
+                return trace
             return to_wire(e)
         # /v1/deployments, /v1/deployment/...
         if parts == ["deployments"]:

@@ -43,18 +43,19 @@ class NomadClient:
 
     # ---- transport ----
 
-    def _request(self, method: str, path: str,
-                 params: Optional[Dict[str, Any]] = None,
-                 body: Any = None) -> Any:
+    def _connect(self) -> HTTPConnection:
         if self._ssl_ctx is not None:
             from http.client import HTTPSConnection
 
-            conn = HTTPSConnection(self.host, self.port,
+            return HTTPSConnection(self.host, self.port,
                                    timeout=self.timeout,
                                    context=self._ssl_ctx)
-        else:
-            conn = HTTPConnection(self.host, self.port,
-                                  timeout=self.timeout)
+        return HTTPConnection(self.host, self.port, timeout=self.timeout)
+
+    def _request(self, method: str, path: str,
+                 params: Optional[Dict[str, Any]] = None,
+                 body: Any = None) -> Any:
+        conn = self._connect()
         try:
             if self.region and not (params or {}).get("region"):
                 params = dict(params or {}, region=self.region)
@@ -419,6 +420,29 @@ class NomadClient:
 
     def metrics(self) -> dict:
         return self._request("GET", "/v1/metrics")
+
+    def metrics_prometheus(self) -> str:
+        """Raw Prometheus exposition text from /v1/metrics (text body —
+        bypasses _request's JSON decode)."""
+        conn = self._connect()
+        try:
+            headers = {}
+            if self.token:
+                headers["X-Nomad-Token"] = self.token
+            conn.request("GET", "/v1/metrics?format=prometheus",
+                         headers=headers)
+            res = conn.getresponse()
+            body = res.read().decode(errors="replace")
+            if res.status >= 400:
+                raise ApiError(res.status, body[:200])
+            return body
+        finally:
+            conn.close()
+
+    def evaluation_trace(self, eval_id: str) -> dict:
+        """Ordered lifecycle spans for one eval (GET
+        /v1/evaluation/<id>/trace)."""
+        return self._request("GET", f"/v1/evaluation/{eval_id}/trace")
 
     def status_leader(self):
         return self._request("GET", "/v1/status/leader")

@@ -647,6 +647,56 @@ def cmd_eval_status(args) -> int:
     return 0
 
 
+def cmd_eval_trace(args) -> int:
+    """`nomad-tpu eval trace <id>`: ordered lifecycle spans for one
+    evaluation (lib/trace.py span taxonomy; no reference analog — the
+    observability counterpart of `eval status -verbose`)."""
+    from .api import ApiError
+
+    api = _client(args)
+    try:
+        tr = api.evaluation_trace(args.eval_id)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Eval   = {tr.get('eval_id', args.eval_id)}")
+    print(f"Status = {tr.get('status', '')}")
+    rows = [[s["phase"], f"{s['start_s'] * 1e3:.3f}",
+             f"{s['duration_ms']:.3f}"] for s in tr.get("spans", [])]
+    print(_columns(rows, ["Phase", "Start (ms)", "Duration (ms)"]))
+    return 0
+
+
+def cmd_operator_metrics(args) -> int:
+    """`nomad-tpu operator metrics [-format prometheus]` — dump the
+    agent's telemetry (command/operator_metrics.go analog: the raw
+    /v1/metrics surface, or Prometheus exposition text)."""
+    api = _client(args)
+    if args.format == "prometheus":
+        sys.stdout.write(api.metrics_prometheus())
+        return 0
+    m = api.metrics()
+    if args.json:
+        print(json.dumps(m, indent=2, default=str))
+        return 0
+    for k in ("uptime_s", "state_index", "broker_ready", "broker_unacked",
+              "blocked_evals", "client_allocs"):
+        if k in m:
+            print(f"{k:20} = {m[k]}")
+    for section in ("broker", "plan_apply"):
+        for k, v in sorted((m.get(section) or {}).items()):
+            print(f"{section}.{k:20} = {v}")
+    phases = m.get("eval_phases") or {}
+    if phases:
+        print()
+        rows = [[name, str(s["count"]), f"{s['p50']:.3f}",
+                 f"{s['p95']:.3f}", f"{s['p99']:.3f}", f"{s['max']:.3f}"]
+                for name, s in sorted(phases.items())]
+        print(_columns(rows, ["Eval Phase", "Count", "p50 (ms)",
+                              "p95 (ms)", "p99 (ms)", "max (ms)"]))
+    return 0
+
+
 # ---- deployment ----
 
 def cmd_deployment_list(args) -> int:
@@ -1477,6 +1527,9 @@ def build_parser() -> argparse.ArgumentParser:
     evs.set_defaults(fn=cmd_eval_status)
     evl = ev.add_parser("list")
     evl.set_defaults(fn=cmd_eval_list)
+    evt = ev.add_parser("trace", help="lifecycle spans for one eval")
+    evt.add_argument("eval_id")
+    evt.set_defaults(fn=cmd_eval_trace)
 
     aclp = sub.add_parser("acl", help="ACL commands").add_subparsers(
         dest="sub", required=True)
@@ -1599,6 +1652,11 @@ def build_parser() -> argparse.ArgumentParser:
     oss = op.add_parser("scheduler-set-config")
     oss.add_argument("-algorithm", choices=["binpack", "spread"])
     oss.set_defaults(fn=cmd_operator_scheduler_set)
+    omt = op.add_parser("metrics", help="agent telemetry dump")
+    omt.add_argument("-format", choices=["pretty", "prometheus"],
+                     default="pretty")
+    omt.add_argument("-json", action="store_true")
+    omt.set_defaults(fn=cmd_operator_metrics)
 
     sysp = sub.add_parser("system", help="system commands").add_subparsers(
         dest="sub", required=True)

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..lib.metrics import ErrorStreak
 from ..structs.resources import NodeDeviceInstance, NodeDeviceResource
 
 
@@ -288,6 +289,9 @@ class DeviceManager:
         self._last_groups: Dict[str, list] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: loop-failure sink: registry counter + first-of-streak WARNING
+        #: (a wedged manager loop must leave a visible trace)
+        self._errs = ErrorStreak("client.devicemanager")
 
     def _builtin(self) -> List[DevicePlugin]:
         from ..plugins.base import oop_requested
@@ -324,8 +328,9 @@ class DeviceManager:
         for p in self.plugins:
             try:
                 groups.extend(p.fingerprint())
-            except Exception:  # noqa: BLE001 — a broken plugin loses
-                # only its own devices
+            except Exception as e:  # noqa: BLE001 — a broken plugin
+                # loses only its own devices
+                self._errs.record(e, f"fingerprint({p.name})")
                 continue
         shape = {
             g.id(): sorted((i.id, i.healthy) for i in g.instances)
@@ -350,12 +355,19 @@ class DeviceManager:
 
     def collect_stats(self) -> Dict[str, Dict[str, dict]]:
         stats: Dict[str, Dict[str, dict]] = {}
+        failed = 0
         for p in self.plugins:
             try:
                 stats.update(p.stats())
-            except Exception:  # noqa: BLE001 — a broken plugin loses
-                # only its own stats
-                continue
+            except Exception as e:  # noqa: BLE001 — a broken plugin
+                # loses only its own stats
+                self._errs.record(e, f"stats({p.name})")
+                failed += 1
+        if not failed:
+            # only a fully-clean pass re-arms the first-of-streak
+            # WARNING — a persistently broken plugin must not log one
+            # line per stats interval
+            self._errs.ok()
         with self._lock:
             self._stats = stats
         return stats
@@ -376,14 +388,17 @@ class DeviceManager:
         next_fp = time.time() + self.fingerprint_interval
         while not self._stop.wait(self.stats_interval):
             try:
+                # collect_stats manages the streak itself (per-plugin
+                # record + ok only on a fully-clean pass)
                 self.collect_stats()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                self._errs.record(e, "stats pass")
             if time.time() >= next_fp:
                 next_fp = time.time() + self.fingerprint_interval
                 try:
                     groups, shape, changed = self._detect()
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
+                    self._errs.record(e, "fingerprint pass")
                     continue
                 if not changed:
                     continue
@@ -392,8 +407,10 @@ class DeviceManager:
                     continue
                 try:
                     self.on_devices(groups)
-                except Exception:  # noqa: BLE001 — node update failed:
-                    # do NOT commit; the next pass re-reports the change
+                except Exception as e:  # noqa: BLE001 — node update
+                    # failed: do NOT commit; the next pass re-reports
+                    # the change
+                    self._errs.record(e, "on_devices node update")
                     continue
                 self._commit(shape)
 

@@ -22,6 +22,7 @@ import subprocess
 import threading
 from typing import Dict, List, Optional
 
+from ...lib.metrics import ErrorStreak
 from .base import DriverPlugin, ExitResult, TaskConfig, TaskHandle
 
 
@@ -317,6 +318,10 @@ class DockerDriver(DriverPlugin):
                     [docker, "logs", "--follow", cid],
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE)
                 handle._log_proc = proc
+                # per-container streak (shared counter name → one
+                # registry total): every container's FIRST sink death
+                # warns, not just the first in the process's lifetime
+                errs = ErrorStreak("client.docker.log_pump")
 
                 def read(stream, sink):
                     # read1: deliver whatever the pipe has NOW — a plain
@@ -326,7 +331,11 @@ class DockerDriver(DriverPlugin):
                     for chunk in iter(lambda: stream.read1(8192), b""):
                         try:
                             sink(chunk)
-                        except Exception:
+                        except Exception as e:  # noqa: BLE001 — sink
+                            # dead (rotated away/disk full): stop
+                            # capturing but keep draining via break so
+                            # `docker logs` never wedges on a full pipe
+                            errs.record(e, f"log sink {cid[:12]}")
                             break
                     stream.close()
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from ..lib.metrics import ErrorStreak
 from .drivers import BUILTIN_DRIVERS, DriverPlugin
 
 
@@ -39,6 +40,8 @@ class DriverManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: loop-failure sink: registry counter + first-of-streak WARNING
+        self._errs = ErrorStreak("client.drivermanager")
 
     def _out_of_process(self, name: str) -> bool:
         """Run this driver as its own plugin process? Operator opt-in via
@@ -113,8 +116,10 @@ class DriverManager:
             if updates and self.on_attrs is not None:
                 try:
                     self.on_attrs(updates)
-                except Exception:
-                    pass
+                    self._errs.ok()
+                except Exception as e:  # noqa: BLE001 — node update
+                    # failed; next fingerprint pass re-reports
+                    self._errs.record(e, "on_attrs node update")
 
     def shutdown(self) -> None:
         self._stop.set()

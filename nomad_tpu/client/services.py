@@ -56,6 +56,10 @@ class ServiceHook:
         #: reg ids whose checks have ALL run at least once (the health
         #: tracker refuses to call never-evaluated checks passing)
         self._checks_evaluated: set = set()
+        #: sync-failure sink: registry counter + first-of-streak WARNING
+        from ..lib.metrics import ErrorStreak
+
+        self._errs = ErrorStreak("client.services")
         #: periodic anti-entropy re-assert cadence (the reference's
         #: Consul sync loop re-syncs on an interval too)
         self.reassert_interval = 10.0
@@ -235,8 +239,10 @@ class ServiceHook:
                 if all_regs:
                     try:
                         self.conn.update_service_registrations(all_regs)
-                    except Exception:  # noqa: BLE001 — retry next round
-                        pass
+                        self._errs.ok()
+                    except Exception as e:  # noqa: BLE001 — transient
+                        # (leader move); retried next round
+                        self._errs.record(e, "anti-entropy re-push")
 
     def checks_status(self) -> tuple:
         """(n_checks, all_passing) across current registrations — the

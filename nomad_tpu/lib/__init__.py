@@ -8,10 +8,20 @@
   (ref `lib/circbufwriter/writer.go`); consumer: task log capture (logmon).
 - `TimeTable`  — wall-clock ↔ state-index mapping for GC thresholds
   (ref `nomad/timetable.go:14`); consumer: core GC scheduler.
+- `MetricsRegistry` / `ErrorStreak` — thread-safe telemetry instruments
+  (ref armon/go-metrics via command/agent/command.go setupTelemetry);
+  consumers: broker/worker/plan-apply stats, thread-loop error sinks.
+- `EvalTracer`  — per-eval lifecycle spans + phase histograms (no direct
+  reference analog; see lib/trace.py); consumers: broker, worker,
+  select coordinator, `/v1/evaluation/:id/trace`.
 """
 from .delayheap import DelayHeap, WaitItem
 from .kheap import KHeap
 from .circbuf import CircBufWriter
+from .metrics import ErrorStreak, MetricsRegistry, default_registry
 from .timetable import TimeTable
+from .trace import EvalTracer
 
-__all__ = ["DelayHeap", "WaitItem", "KHeap", "CircBufWriter", "TimeTable"]
+__all__ = ["DelayHeap", "WaitItem", "KHeap", "CircBufWriter", "TimeTable",
+           "MetricsRegistry", "ErrorStreak", "default_registry",
+           "EvalTracer"]

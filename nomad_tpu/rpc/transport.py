@@ -18,9 +18,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
+
+from ..lib.metrics import MetricsRegistry, default_registry
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -186,8 +189,13 @@ class RpcClient:
     """One pipelined connection to a peer; thread-safe call()."""
 
     def __init__(self, host: str, port: int,
-                 connect_timeout: float = 5.0, tls=None) -> None:
+                 connect_timeout: float = 5.0, tls=None,
+                 metrics: Optional[MetricsRegistry] = None) -> None:
         self.addr = (host, port)
+        # transport telemetry lands in the process-global registry by
+        # default (go-metrics global sink): clients are created deep in
+        # pools where no server registry is in reach
+        self.metrics = metrics if metrics is not None else default_registry()
         self._sock = socket.create_connection(self.addr,
                                               timeout=connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -227,6 +235,22 @@ class RpcClient:
 
     def call(self, method: str, *args: Any,
              timeout: Optional[float] = 10.0) -> Any:
+        t0 = time.perf_counter()
+        try:
+            result = self._call(method, *args, timeout=timeout)
+        except Exception:
+            self.metrics.inc("rpc.client.errors")
+            self.metrics.inc(f"rpc.client.errors.{method}")
+            raise
+        # request→response latency distribution, total + per-method
+        # (method names are a bounded set — the endpoint registry)
+        ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.add_sample("rpc.client.call_ms", ms)
+        self.metrics.add_sample(f"rpc.client.method.{method}_ms", ms)
+        return result
+
+    def _call(self, method: str, *args: Any,
+              timeout: Optional[float] = 10.0) -> Any:
         if self._closed:
             raise ConnectionError("client closed")
         with self._plock:
@@ -263,16 +287,19 @@ class ConnPool:
     """Shared RpcClient per address with reconnect-on-failure
     (helper/pool/pool.go:130)."""
 
-    def __init__(self, tls=None) -> None:
+    def __init__(self, tls=None,
+                 metrics: Optional[MetricsRegistry] = None) -> None:
         self._lock = threading.Lock()
         self._conns: Dict[Tuple[str, int], RpcClient] = {}
         self._tls = tls
+        self._metrics = metrics
 
     def _get(self, addr: Tuple[str, int]) -> RpcClient:
         with self._lock:
             c = self._conns.get(addr)
             if c is None or c._closed:
-                c = RpcClient(addr[0], addr[1], tls=self._tls)
+                c = RpcClient(addr[0], addr[1], tls=self._tls,
+                              metrics=self._metrics)
                 self._conns[addr] = c
             return c
 

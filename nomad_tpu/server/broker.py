@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils import fast_uuid
 from ..lib import DelayHeap
+from ..lib.metrics import MetricsRegistry
 from ..structs import Evaluation
 
 FAILED_QUEUE = "_failed"
@@ -41,11 +42,25 @@ class _Unack:
         self.dequeues = dequeues
 
 
+#: counter names mirrored by the legacy `stats` view
+_STAT_KEYS = ("enqueued", "dequeued", "acked", "nacked", "failed",
+              "requeued")
+
+
 class EvalBroker:
     def __init__(self, nack_timeout: float = DEFAULT_NACK_TIMEOUT,
-                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT) -> None:
+                 delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+                 metrics: Optional[MetricsRegistry] = None,
+                 tracer=None) -> None:
         self.nack_timeout = nack_timeout
         self.delivery_limit = delivery_limit
+        #: registry-backed telemetry (go-metrics IncrCounter analog);
+        #: a standalone broker gets a private registry so unit tests
+        #: never cross-count between instances
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.tracer = tracer
+        self._ctr = {k: self.metrics.counter(f"broker.{k}")
+                     for k in _STAT_KEYS}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._enabled = False
@@ -63,8 +78,11 @@ class EvalBroker:
         self._delayed = DelayHeap()
         self._delay_thread: Optional[threading.Thread] = None
         self._shutdown = False
-        self.stats = {"enqueued": 0, "dequeued": 0, "acked": 0, "nacked": 0,
-                      "failed": 0}
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Legacy counter view (now registry-backed, lock-free reads)."""
+        return {k: int(c.value) for k, c in self._ctr.items()}
 
     # ---- lifecycle ----
 
@@ -120,6 +138,10 @@ class EvalBroker:
     def _enqueue_locked(self, eval: Evaluation, token: str) -> None:
         if not self._enabled:
             return
+        if self.tracer is not None:
+            # the eval id IS the trace id; (re-)enqueue re-anchors the
+            # queue_wait span (nack redeliveries measure their own wait)
+            self.tracer.begin(eval.id)
         now = time.time()
         if eval.wait_until and eval.wait_until > now:
             if not self._delayed.push(eval.id, eval.wait_until, eval):
@@ -140,7 +162,7 @@ class EvalBroker:
             self._ready.setdefault(queue, []),
             (-eval.priority, next(self._seq), eval),
         )
-        self.stats["enqueued"] += 1
+        self._ctr["enqueued"].inc()
         self._cv.notify_all()
 
     # ---- dequeue ----
@@ -171,7 +193,11 @@ class EvalBroker:
                         )
                         un.timer.daemon = True
                         un.timer.start()
-                    self.stats["dequeued"] += 1
+                    self._ctr["dequeued"].inc()
+                    if self.tracer is not None:
+                        self.tracer.span_from_mark(eval.id, "enqueue",
+                                                   "queue_wait")
+                        self.tracer.mark(eval.id, "dequeue")
                     return eval, token
                 remaining = None
                 if deadline is not None:
@@ -222,7 +248,9 @@ class EvalBroker:
             jk = (un.eval.namespace, un.eval.job_id)
             if self._job_outstanding.get(jk) == eval_id:
                 del self._job_outstanding[jk]
-            self.stats["acked"] += 1
+            self._ctr["acked"].inc()
+            if self.tracer is not None:
+                self.tracer.record(eval_id, "ack")
             # Release the next pending eval of this job (eval_broker.go:560)
             pending = self._job_pending.get(jk)
             if pending:
@@ -243,9 +271,11 @@ class EvalBroker:
             jk = (un.eval.namespace, un.eval.job_id)
             if self._job_outstanding.get(jk) == eval_id:
                 del self._job_outstanding[jk]
-            self.stats["nacked"] += 1
+            self._ctr["nacked"].inc()
             if self._dequeues.get(eval_id, 0) >= self.delivery_limit:
-                self.stats["failed"] += 1
+                self._ctr["failed"].inc()
+            else:
+                self._ctr["requeued"].inc()
             self._enqueue_locked(un.eval, token="")
             self._cv.notify_all()
 

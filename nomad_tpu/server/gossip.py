@@ -69,6 +69,10 @@ class Membership:
             name: Member(name, tuple(addr), tags=dict(tags or {}))}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: per-TARGET probe-failure sinks (one shared counter name →
+        #: one registry counter, but per-peer first-of-streak state: a
+        #: healthy peer's success must not re-arm a dead peer's WARNING)
+        self._errs: Dict[str, "ErrorStreak"] = {}
 
     # ---- RPC surface (registered as "Gossip.exchange") ----
 
@@ -207,6 +211,18 @@ class Membership:
         threading.Thread(target=run, name="gossip-join",
                          daemon=True).start()
 
+    def _err_for(self, target: str) -> "ErrorStreak":
+        """Lazy per-peer streak (only the gossip thread touches the
+        map); all instances share one counter name, so the registry
+        count stays a single `loop_errors.server.gossip.<me>` total."""
+        from ..lib.metrics import ErrorStreak
+
+        es = self._errs.get(target)
+        if es is None:
+            es = self._errs[target] = ErrorStreak(
+                f"server.gossip.{self.name}")
+        return es
+
     def _run(self) -> None:
         round_ = 0
         while not self._stop.wait(self.interval):
@@ -237,8 +253,13 @@ class Membership:
                             t.last_seen = time.time()
                             if t.status != STATUS_ALIVE:
                                 t.status = STATUS_ALIVE
-                except Exception:  # noqa: BLE001 — probe failure
-                    pass
+                    self._err_for(target.name).ok()
+                except Exception as e:  # noqa: BLE001 — probe failure
+                    # IS the failure-detector signal (the sweep marks
+                    # the peer suspect); counted so a partitioned node
+                    # is visible in telemetry, not just by its absence
+                    self._err_for(target.name).record(
+                        e, f"probe {target.name}")
             if self._stop.is_set():
                 return
             self._sweep()

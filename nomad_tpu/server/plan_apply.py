@@ -19,8 +19,10 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
+from ..lib.metrics import MetricsRegistry
 from ..scheduler.util import proposed_allocs
 from ..structs import Allocation, Node, Plan, PlanResult, allocs_fit
 from .state import StateStore
@@ -218,8 +220,13 @@ def evaluate_node_plan(state, plan: Plan, node_id: str) -> Tuple[bool, str]:
 class PlanApplier:
     """Single-threaded plan verification + commit loop (plan_apply.go:71)."""
 
+    #: counter names mirrored by the legacy `stats` view
+    STAT_KEYS = ("applied", "partial", "rejected_nodes", "stale_token",
+                 "inline")
+
     def __init__(self, state: StateStore, queue: PlanQueue,
-                 broker=None) -> None:
+                 broker=None,
+                 metrics: Optional[MetricsRegistry] = None) -> None:
         self.state = state
         self.queue = queue
         self.broker = broker
@@ -229,8 +236,18 @@ class PlanApplier:
         # whether a plan arrives via the queue thread or a worker's
         # inline fast path
         self._apply_lock = threading.Lock()
-        self.stats = {"applied": 0, "partial": 0, "rejected_nodes": 0,
-                      "stale_token": 0, "inline": 0}
+        # registry-backed outcome counters + apply-latency histogram:
+        # the applier thread AND inline-path workers record here, so the
+        # old plain dict was the NLT01 textbook case
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._ctr = {k: self.metrics.counter(f"plan_apply.{k}")
+                     for k in self.STAT_KEYS}
+        self._apply_ms = self.metrics.histogram("plan_apply.apply_ms")
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """Legacy counter view (now registry-backed, lock-free reads)."""
+        return {k: int(c.value) for k, c in self._ctr.items()}
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -277,7 +294,7 @@ class PlanApplier:
             result = self.apply(plan)
         finally:
             self._apply_lock.release()
-        self.stats["inline"] += 1
+        self._ctr["inline"].inc()
         return result
 
     def apply(self, plan: Plan) -> PlanResult:
@@ -286,9 +303,10 @@ class PlanApplier:
         # the eval before accepting its plan — Plan.Submit → evalBroker token
         # validation, nomad/plan_endpoint.go:31). A nack-timeout redelivery
         # must not let two workers commit plans for the same eval.
+        t0 = time.perf_counter()
         if self.broker is not None and plan.eval_token:
             if not self.broker.outstanding(plan.eval_id, plan.eval_token):
-                self.stats["stale_token"] += 1
+                self._ctr["stale_token"].inc()
                 raise ValueError(
                     f"plan for eval {plan.eval_id} has a stale token"
                 )
@@ -330,7 +348,7 @@ class PlanApplier:
                         )
                 else:
                     partial = True
-                    self.stats["rejected_nodes"] += 1
+                    self._ctr["rejected_nodes"].inc()
         if partial and plan.all_at_once:
             # all-at-once plans commit nothing on any failure — including the
             # stops, or destructive updates would halt services with no
@@ -345,6 +363,7 @@ class PlanApplier:
         result.alloc_index = self.state.index.value
         if partial:
             result.refresh_index = self.state.index.value
-            self.stats["partial"] += 1
-        self.stats["applied"] += 1
+            self._ctr["partial"].inc()
+        self._ctr["applied"].inc()
+        self._apply_ms.add_sample((time.perf_counter() - t0) * 1e3)
         return result

@@ -63,14 +63,21 @@ class _SelectReq:
 class SelectCoordinator:
     """Fuses concurrent select dispatches from one eval batch."""
 
-    def __init__(self, window_s: float = 0.004) -> None:
+    def __init__(self, window_s: float = 0.004, tracer=None) -> None:
         self._cv = threading.Condition()
         self._live = 0
         self._parked: List[_SelectReq] = []
         self.window_s = window_s
+        # per-batch stats dict is safe: only the coordinator-driving
+        # worker thread mutates it (in _dispatch), readers copy after
+        # finish_batch
         self.stats = {"dispatches": 0, "programs": 0, "batched": 0,
                       "dispatch_ms": 0.0, "view_ms": 0.0, "pack_ms": 0.0,
                       "kernel_ms": 0.0}
+        #: eval-lifecycle tracer + program-order → eval-id map (worker
+        #: fills trace_ids in start_batch) for per-eval pack/kernel spans
+        self.tracer = tracer
+        self.trace_ids: Dict[int, str] = {}
 
     # ---- scheduler-thread side ----
 
@@ -147,6 +154,13 @@ class SelectCoordinator:
         from ..parallel.mesh import pad_params, stack_params
 
         t_start = time.perf_counter()
+        # stats use perf_counter; trace spans use the monotonic clock —
+        # bridge with a one-shot offset so both read the same instants
+        _off = time.monotonic() - t_start
+
+        def _mono(t: float) -> float:
+            return t + _off
+
         self.stats["dispatches"] += 1
         self.stats["programs"] += len(batch)
         # resolve each request's device view NOW (post-predecessor-commit)
@@ -163,10 +177,12 @@ class SelectCoordinator:
             arrays = pairs[0][1]
             if len(reqs) == 1:
                 r = reqs[0]
+                tk = time.monotonic()
                 (p,), m = pad_params([r.params])
                 res = place_task_group_jit(arrays, p, m)
                 r.out = (np.asarray(res.sel_idx), np.asarray(res.sel_score),
                          int(res.nodes_feasible), np.asarray(res.nodes_fit))
+                self._trace([r], "kernel", tk, time.monotonic())
                 r.event.set()
                 continue
             self.stats["batched"] += len(reqs)
@@ -186,17 +202,32 @@ class SelectCoordinator:
             ibuf, fbuf, ubuf, spec = pack_params(stacked)
             t1 = time.perf_counter()
             self.stats["pack_ms"] += (t1 - t0) * 1e3
+            self._trace(reqs, "pack", _mono(t0), _mono(t1))
             sel_j, score_j, feas_j, fit_j = place_packed_chain(
                 arrays, ibuf, fbuf, ubuf, spec, m)
             sel_all = np.asarray(sel_j)
             scores = np.asarray(score_j)
             feas = np.asarray(feas_j)
             fit = np.asarray(fit_j)
-            self.stats["kernel_ms"] += (time.perf_counter() - t1) * 1e3
+            t2 = time.perf_counter()
+            self.stats["kernel_ms"] += (t2 - t1) * 1e3
+            self._trace(reqs, "kernel", _mono(t1), _mono(t2))
             for i, r in enumerate(reqs):
                 r.out = (sel_all[i], scores[i], int(feas[i]), fit[i])
                 r.event.set()
         self.stats["dispatch_ms"] += (time.perf_counter() - t_start) * 1e3
+
+    def _trace(self, reqs: List[_SelectReq], phase: str,
+               start: float, end: float) -> None:
+        """Per-eval span for a fused phase: every program in the batch
+        rode the same host pack / device dispatch, so each gets the
+        batch's interval (monotonic clock)."""
+        if self.tracer is None:
+            return
+        for r in reqs:
+            tid = self.trace_ids.get(r.order)
+            if tid is not None:
+                self.tracer.record(tid, phase, start=start, end=end)
 
 
 def _inert_program(p):

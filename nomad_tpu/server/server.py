@@ -110,11 +110,22 @@ class Server:
             self.state.restore()
         else:
             self.state = StateStore()
-        self.broker = EvalBroker(nack_timeout=self.config.nack_timeout)
+        # Telemetry: one registry + eval-span tracer per server, threaded
+        # through broker / workers / plan applier (go-metrics setup in
+        # the reference; per-server so multi-server tests don't
+        # cross-count). Served on /v1/metrics + /v1/evaluation/:id/trace.
+        from ..lib.metrics import MetricsRegistry
+        from ..lib.trace import EvalTracer
+
+        self.metrics = MetricsRegistry()
+        self.tracer = EvalTracer(self.metrics)
+        self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
+                                 metrics=self.metrics, tracer=self.tracer)
         self.blocked = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.planner = PlanApplier(self.state, self.plan_queue,
-                                   broker=self.broker)
+                                   broker=self.broker,
+                                   metrics=self.metrics)
         self.workers: List[Worker] = [
             Worker(self, i) for i in range(self.config.num_schedulers)
         ]

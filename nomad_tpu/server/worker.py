@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import List, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
+from ..lib.metrics import MetricsRegistry
 from ..scheduler.generic import GenericScheduler
 from ..scheduler.system import SystemScheduler
 from ..structs import Evaluation, Plan, PlanResult
@@ -46,6 +48,16 @@ class EvalContext:
         plan.eval_token = self.token
         plan.snapshot_index = (self.snapshot.index_at
                                if self.snapshot is not None else 0)
+        tracer = getattr(self.server, "tracer", None)
+        t0 = time.monotonic()
+        try:
+            return self._submit_plan(plan)
+        finally:
+            if tracer is not None:
+                tracer.record(self.eval.id, "plan_apply", start=t0)
+
+    def _submit_plan(self, plan: Plan
+                     ) -> Tuple[PlanResult, Optional[object]]:
         # inline fast path (same commit-point mutex, no thread hops);
         # queue round trip only when the applier is busy
         result = self.server.planner.try_apply_inline(plan)
@@ -105,12 +117,24 @@ class Worker:
         self.eval_batch = int(
             os.environ.get("NOMAD_TPU_EVAL_BATCH", 0)
         ) or getattr(server.config, "eval_batch", 1)
-        #: cumulative coordinator stats (bench/test introspection)
-        self.batch_stats: dict = {}
+        #: server-owned telemetry (falls back to a private registry so a
+        #: bare Worker against a stub server still records safely)
+        self.metrics: MetricsRegistry = getattr(
+            server, "metrics", None) or MetricsRegistry()
+        self.tracer = getattr(server, "tracer", None)
         #: persistent scheduler-thread pool for the batch path (spawning
         #: B threads per batch measured ~0.3 ms each — a real tax at
-        #: millisecond-scale evals)
+        #: millisecond-scale evals). Guarded by _pool_lock: created by
+        #: the worker thread, read by shutdown() from the main thread.
         self._pool = None
+        self._pool_lock = threading.Lock()
+
+    @property
+    def batch_stats(self) -> Dict[str, float]:
+        """Cumulative coordinator stats (bench/test introspection) —
+        registry-backed, so the worker thread and readers never race on
+        a plain dict."""
+        return self.metrics.counters(prefix=f"worker.{self.id}.batch.")
 
     # ---- lifecycle ----
 
@@ -122,8 +146,10 @@ class Worker:
 
     def shutdown(self) -> None:
         self._stop.set()
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
+        with self._pool_lock:
+            pool = self._pool
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def join(self, timeout: float = 2.0) -> None:
         if self._thread is not None:
@@ -188,12 +214,19 @@ class Worker:
                     snapshot=None) -> None:
         """dequeue → wait-for-index → schedule → ack/nack (worker.go:105)."""
         broker = self.server.broker
+        tracer = self.tracer
+        if tracer is not None:
+            # dequeue → scheduler start (batch drain + thread handoff)
+            tracer.span_from_mark(eval.id, "dequeue", "claim")
         try:
             snap = snapshot
             if snap is None:
+                t0 = time.monotonic()
                 snap = self.server.state.snapshot_min_index(
                     max(eval.modify_index, eval.job_modify_index),
                     timeout=5.0)
+                if tracer is not None:
+                    tracer.record(eval.id, "snapshot", start=t0)
             if snap is None:
                 broker.nack(eval.id, token)
                 return
@@ -204,7 +237,10 @@ class Worker:
                                                       GenericScheduler):
                 sched.select_coordinator = coordinator
                 sched.select_order = order
+            t0 = time.monotonic()
             sched.process(eval)
+            if tracer is not None:
+                tracer.record(eval.id, "schedule", start=t0)
             if eval.type == "_core":
                 # Core schedulers don't drive update_eval themselves —
                 # a successful pass completes the eval here.
@@ -238,25 +274,34 @@ class Worker:
 
         from .select_batch import SelectCoordinator
 
-        if self._pool is None:
-            # 2× batch width: a pipelined successor batch starts its
-            # host phase while the predecessor still occupies its slots
-            self._pool = ThreadPoolExecutor(
-                max_workers=max(2 * self.eval_batch, 2),
-                thread_name_prefix=f"worker-{self.id}-eval")
+        with self._pool_lock:
+            if self._pool is None:
+                # 2× batch width: a pipelined successor batch starts its
+                # host phase while the predecessor still occupies its
+                # slots
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(2 * self.eval_batch, 2),
+                    thread_name_prefix=f"worker-{self.id}-eval")
+            pool = self._pool
         # one snapshot serves the whole batch: every eval's min-index is
         # satisfied by construction (its registration bumped the store
         # before the broker handed it out), and snapshot construction is
         # a measurable per-eval cost at scale
         need = max(max(ev.modify_index, ev.job_modify_index)
                    for ev, _ in items)
+        t0 = time.monotonic()
         snap = self.server.state.snapshot_min_index(need, timeout=5.0)
-        coord = SelectCoordinator()
+        if self.tracer is not None:
+            t1 = time.monotonic()
+            for ev, _ in items:  # one resolution serves the whole batch
+                self.tracer.record(ev.id, "snapshot", start=t0, end=t1)
+        coord = SelectCoordinator(tracer=self.tracer)
         futs = []
         for order, (ev, tok) in enumerate(items):
+            coord.trace_ids[order] = ev.id
             coord.add_thread()
             try:
-                futs.append(self._pool.submit(
+                futs.append(pool.submit(
                     self._process_in_batch, ev, tok, coord, order, snap))
             except RuntimeError:
                 # pool closed by a concurrent shutdown(): balance the
@@ -275,11 +320,11 @@ class Worker:
         coord.run()
         for f in futs:
             f.result()
+        prefix = f"worker.{self.id}.batch."
         for k, v in coord.stats.items():
-            self.batch_stats[k] = self.batch_stats.get(k, 0) + v
-        self.batch_stats["batches"] = self.batch_stats.get("batches", 0) + 1
-        self.batch_stats["evals"] = (self.batch_stats.get("evals", 0)
-                                     + len(items))
+            self.metrics.inc(prefix + k, v)
+        self.metrics.inc(prefix + "batches")
+        self.metrics.inc(prefix + "evals", len(items))
 
     def _process_in_batch(self, eval: Evaluation, token: str,
                           coord, order: int, snap) -> None:

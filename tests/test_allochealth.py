@@ -175,6 +175,7 @@ class TestDeploymentE2E:
     the client health watcher — this test NEVER calls
     update_alloc_health."""
 
+    @pytest.mark.slow  # >20s on a cold host; tier-1 budget (VERDICT r5 weak #5)
     def test_rolling_update_and_auto_revert_from_task_events(self, agent):
         server, client = agent
 

@@ -218,6 +218,7 @@ class TestClusterSnapshotCompaction:
     (RaftStateStore.fsm_snapshot/fsm_restore over fsm.py
     snapshot_state/restore_state), and state survives intact."""
 
+    @pytest.mark.slow  # sibling-covered; tier-1 budget (VERDICT r5 weak #5)
     def test_log_compacts_and_state_survives(self):
         from nomad_tpu import mock
 

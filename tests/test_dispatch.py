@@ -167,6 +167,7 @@ class TestDispatch:
 
 
 class TestDispatchE2E:
+    @pytest.mark.slow  # >10s on a cold host; tier-1 budget (VERDICT r5 weak #5)
     def test_payload_lands_in_task_local_dir(self, tmp_path):
         """Dispatched child runs on a real client; the payload appears at
         local/<file> (taskrunner/dispatch_hook.go)."""

