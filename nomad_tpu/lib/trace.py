@@ -25,6 +25,8 @@ Phase taxonomy (what each span bounds):
 - `snapshot`    state.snapshot_min_index (MVCC view resolution)
 - `schedule`    scheduler process() total (reconcile + compile + select + plan)
 - `pack`        coordinator param stack/pack (host-side batch prep)
+- `delta_apply` device cluster-view refresh at dispatch (delta row
+                update or full upload — TPUStack.device_arrays)
 - `kernel`      fused placement-kernel dispatch (device + transfer)
 - `plan_apply`  submit_plan → PlanResult (queue hop + verify + commit)
 - `ack`         broker ack/nack point (zero-length terminator)
@@ -40,7 +42,7 @@ from .metrics import MetricsRegistry
 
 #: canonical span order for display/aggregation
 PHASES = ("queue_wait", "claim", "snapshot", "schedule", "pack",
-          "kernel", "plan_apply", "ack")
+          "delta_apply", "kernel", "plan_apply", "ack")
 
 
 class _Trace:

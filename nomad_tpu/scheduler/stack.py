@@ -9,6 +9,7 @@ evaluations (vmap) — full-width over the node axis.
 """
 from __future__ import annotations
 
+import functools
 import math
 import threading
 import weakref
@@ -17,6 +18,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ..lib.metrics import default_registry
 
 from ..kernels.placement import ClusterArrays, PlacementResult, TGParams
 from ..utils import bucket as _shared_bucket, widen_lut
@@ -69,6 +72,82 @@ _DEV_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _DEV_CACHE_LOCK = threading.Lock()
 
 
+# ---- device-view delta refresh ---------------------------------------------
+# The control plane's hot loop mutates a handful of node rows per plan
+# apply, but the old device_arrays re-uploaded every hot tensor on any
+# version bump — and ports_used alone is u32[N, 2048] (16 MB at 2K rows,
+# 128 MB at 16K), so the view refresh dwarfed the placement kernel
+# (BENCH_r05: view_ms=7574 vs kernel_ms=3213). The delta path ships only
+# the rows the cluster's bounded delta log names and applies them with a
+# jitted, donated row-update kernel: row-granular dynamic_update_slice,
+# NOT element scatter (NLJ06 — TPU scatters serialize; a whole-row DMA
+# does not), in place on the cached device buffers.
+
+def _rows_update(arr, rows, vals):
+    """arr[rows[i]] = vals[i] for all i, as sequential row-slice updates
+    (rows are few — the delta log bounds them; duplicate/padded rows are
+    idempotent rewrites of current values)."""
+    import jax
+
+    def body(i, a):
+        return jax.lax.dynamic_update_index_in_dim(a, vals[i], rows[i],
+                                                   axis=0)
+
+    return jax.lax.fori_loop(0, rows.shape[0], body, arr)
+
+
+def _hot_delta_impl(used, node_ok, dyn_free, rows, used_rows, ok_rows,
+                    dyn_rows):
+    return (_rows_update(used, rows, used_rows),
+            _rows_update(node_ok, rows, ok_rows),
+            _rows_update(dyn_free, rows, dyn_rows))
+
+
+def _ports_delta_impl(ports_used, rows, port_rows):
+    return _rows_update(ports_used, rows, port_rows)
+
+
+@functools.lru_cache(maxsize=None)
+def _delta_kernels():
+    """Jitted row-update kernels, donated so the cached device buffers
+    update in place (no O(N) copy, no host re-upload). Built lazily: jax
+    import stays off the module-import path."""
+    import jax
+
+    return (jax.jit(_hot_delta_impl, donate_argnums=(0, 1, 2)),
+            jax.jit(_ports_delta_impl, donate_argnums=(0,)))
+
+
+#: fixed row-chunk width for delta applies. ONE shape means ONE XLA
+#: compile per kernel for the life of the process — size-proportional
+#: buckets put a fresh sub-second compile (too small for the persistent
+#: cache) inside the measured e2e window per new size, eating the delta
+#: win. Oversized deltas apply as several chained 32-row chunks; padding
+#: repeats the chunk's first row (an idempotent rewrite).
+_DELTA_CHUNK = 32
+
+
+def _delta_rows_host(rows, *arrays):
+    """Chunk-pad the delta row indices and gather their CURRENT host
+    values; returns arrays whose length is a multiple of _DELTA_CHUNK."""
+    r = sorted(rows)
+    b = -(-len(r) // _DELTA_CHUNK) * _DELTA_CHUNK
+    idx = np.empty(b, dtype=np.int32)
+    idx[: len(r)] = r
+    idx[len(r):] = r[0]
+    return (idx,) + tuple(a[idx] for a in arrays)
+
+
+def _apply_chunked(kernel, bufs, idx, *vals):
+    """Run `kernel` over _DELTA_CHUNK-row slices of (idx, vals),
+    threading (and re-donating) the output buffers through each call."""
+    for o in range(0, idx.shape[0], _DELTA_CHUNK):
+        s = slice(o, o + _DELTA_CHUNK)
+        out = kernel(*bufs, idx[s], *[v[s] for v in vals])
+        bufs = out if isinstance(out, tuple) else (out,)
+    return bufs
+
+
 class TPUStack:
     """Compiles placement programs and drives the placement kernel."""
 
@@ -92,20 +171,48 @@ class TPUStack:
 
     def device_arrays(self) -> ClusterArrays:
         """Device copy of the cluster tensors, cached GLOBALLY per
-        cluster object and keyed per-tensor by sub-versions.
+        cluster object, keyed per-tensor by sub-versions and refreshed
+        INCREMENTALLY from the cluster's bounded delta log.
 
         The control plane builds a fresh TPUStack per evaluation; an
         instance-level cache re-uploaded everything every eval — and
         ports_used alone is u32[N, 2048] (≈128 MB at 16K rows), which
         over a tunnel dwarfed the kernel itself. Static tensors re-upload
-        only when nodes/attrs change (node_version + shape), the port
-        bitmap only when a port flips (ports_version), and only the small
-        hot tensors (used/node_ok/dyn_free) go up per state version.
+        only when nodes/attrs change (node_version + shape); the hot
+        tensors (used/node_ok/dyn_free) and the port bitmap ship as ROW
+        DELTAS when the cached entry's version sits inside the delta-log
+        window (tensor/cluster.py hot_rows_since/port_rows_since),
+        applied by a jitted donated row-update kernel — with window
+        misses, row-bucket growth, or oversized deltas falling back to a
+        full upload.
+
+        Concurrency contract (version-chain): all version keys are
+        captured BEFORE the delta rows are read or anything is uploaded,
+        and mutators append to the delta log BEFORE bumping the version
+        they describe — so a mutation racing this refresh either ships
+        with it or leaves the stored entry stale (its captured version
+        predates the bump), and the NEXT refresh re-applies those rows
+        from the log. A concurrent mutation can delay convergence by one
+        refresh, never silently corrupt the cached view.
+
+        Donation trade-off: the delta kernels donate the cached buffers
+        (in-place update — no O(N) copy, which is the whole point for
+        the 128 MB port bitmap). On backends that enforce donation
+        (TPU/GPU), a view fetched by ANOTHER thread before a delta
+        refresh and dispatched after it can raise "Array has been
+        deleted". Every consumer path absorbs that as a transient:
+        worker.process_one and the coordinator's dispatch guard both
+        nack the eval, and the retry resolves a fresh view. The
+        SelectCoordinator additionally resolves ONE view per dispatch
+        so sibling requests in a batch can never race each other; the
+        residual window needs >=2 schedulers interleaving within one
+        refresh and costs a retried eval, not a wrong placement.
 
         When a control-plane mesh is active (parallel/mesh.py
         set_active_mesh), every upload is committed with the node axis
         split over the mesh's node ring — the SAME sharded dispatch the
-        multichip dryrun compiles, now on the live worker path."""
+        multichip dryrun compiles, now on the live worker path; delta
+        applies run on the already-sharded buffers."""
         import jax
         import jax.numpy as jnp
 
@@ -120,11 +227,13 @@ class TPUStack:
             sh = ClusterArrays(*([None] * len(ClusterArrays._fields)))
             up = lambda a, s, dtype=None: jnp.asarray(a, dtype=dtype)  # noqa: E731
 
+        reg = default_registry()
         cl = self.cluster
         with _DEV_CACHE_LOCK:
-            # capture ALL keys BEFORE uploading: a concurrent mutation
-            # mid-upload must make the stored entry look stale (next
-            # caller re-uploads), never current with old data
+            # capture ALL keys BEFORE reading delta rows or uploading: a
+            # concurrent mutation mid-refresh must make the stored entry
+            # look stale (next caller re-applies), never current with
+            # old data
             version = cl.version
             static_key = (cl.node_version, cl.n_cap, cl.k_cap, mesh)
             ports_key = (cl.ports_version, cl.n_cap, mesh)
@@ -137,23 +246,90 @@ class TPUStack:
             else:
                 capacity = up(cl.capacity, sh.capacity)
                 attrs = up(cl.attrs, sh.attrs)
+                reg.inc("view.upload_bytes",
+                        cl.capacity.nbytes + cl.attrs.nbytes)
+            # delta eligibility: same mesh commitment and row bucket —
+            # a grown n_cap changes every tensor's shape, a mesh flip
+            # its placement; neither is expressible as a row update
+            can_delta = (ent is not None and ent["n_cap"] == cl.n_cap
+                         and ent["mesh"] == mesh)
+            limit = max(8, cl.n_cap // 4)
+            prev = ent["arrays"] if ent is not None else None
+
+            did_delta = False
+            hot_rows = (cl.hot_rows_since(ent["version"], limit)
+                        if can_delta else None)
+            if hot_rows is not None:
+                if hot_rows:
+                    idx, uvals, ovals, dvals = _delta_rows_host(
+                        hot_rows, cl.used, cl.node_ok, cl.dyn_free)
+                    hot_kernel, _ = _delta_kernels()
+                    used, node_ok, dyn_free = _apply_chunked(
+                        hot_kernel,
+                        (prev.used, prev.node_ok, prev.dyn_free),
+                        idx, uvals.astype(np.float32), ovals, dvals)
+                    did_delta = True
+                    reg.inc("view.delta_rows", len(hot_rows))
+                    reg.inc("view.upload_bytes",
+                            idx.nbytes + uvals.size * 4 + ovals.nbytes
+                            + dvals.nbytes)
+                else:
+                    # version bumped without touching hot rows (job
+                    # index churn, vocab growth): the buffers are current
+                    used, node_ok, dyn_free = (prev.used, prev.node_ok,
+                                               prev.dyn_free)
+            else:
+                used = up(cl.used, sh.used, dtype=np.float32)
+                node_ok = up(cl.node_ok, sh.node_ok)
+                dyn_free = up(cl.dyn_free, sh.dyn_free)
+                reg.inc("view.full_uploads")
+                reg.inc("view.upload_bytes",
+                        cl.used.size * 4 + cl.node_ok.nbytes
+                        + cl.dyn_free.nbytes)
+
             if ent is not None and ent["ports_key"] == ports_key:
                 ports_used = ent["ports_used"]
             else:
-                ports_used = up(cl.ports_used, sh.ports_used)
+                port_rows = (cl.port_rows_since(ent["ports_version"],
+                                                limit)
+                             if can_delta else None)
+                if port_rows:
+                    pidx, pvals = _delta_rows_host(port_rows,
+                                                   cl.ports_used)
+                    _, ports_kernel = _delta_kernels()
+                    (ports_used,) = _apply_chunked(
+                        ports_kernel, (ent["ports_used"],), pidx, pvals)
+                    did_delta = True
+                    reg.inc("view.delta_rows", len(port_rows))
+                    reg.inc("view.upload_bytes",
+                            pidx.nbytes + pvals.nbytes)
+                elif port_rows is not None:
+                    ports_used = ent["ports_used"]
+                else:
+                    ports_used = up(cl.ports_used, sh.ports_used)
+                    reg.inc("view.ports_full_uploads")
+                    reg.inc("view.upload_bytes", cl.ports_used.nbytes)
+            if did_delta:
+                # one event per refresh that applied any row delta (hot
+                # and/or ports) — pure port flips must not read as "no
+                # delta activity" in the bench breakdown
+                reg.inc("view.delta_uploads")
+
             arrays = ClusterArrays(
                 capacity=capacity,
-                used=up(cl.used, sh.used, dtype=np.float32),
-                node_ok=up(cl.node_ok, sh.node_ok),
+                used=used,
+                node_ok=node_ok,
                 attrs=attrs,
                 ports_used=ports_used,
-                dyn_free=up(cl.dyn_free, sh.dyn_free),
+                dyn_free=dyn_free,
             )
             _DEV_CACHE[cl] = {
                 "version": version, "arrays": arrays,
                 "static_key": static_key, "capacity": capacity,
                 "attrs": attrs, "ports_key": ports_key,
+                "ports_version": ports_key[0],
                 "ports_used": ports_used,
+                "n_cap": cl.n_cap, "mesh": mesh,
             }
             return arrays
 

@@ -53,7 +53,10 @@ class DeploymentsWatcher:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # deployment id → last healthy count (progress tracking)
+        # deployment id → last healthy count (progress tracking), guarded
+        # by _lock: the watcher thread advances it while operator RPCs
+        # (fail) clear entries from API threads (NLT01)
+        self._lock = threading.Lock()
         self._progress: Dict[str, int] = {}
         self._enabled = False
 
@@ -151,9 +154,11 @@ class DeploymentsWatcher:
                     changed = True
 
         # Progress made since last check extends every group's deadline.
-        prev_healthy = self._progress.get(d.id, -1)
+        with self._lock:
+            prev_healthy = self._progress.get(d.id, -1)
+            if healthy_total > prev_healthy:
+                self._progress[d.id] = healthy_total
         if healthy_total > prev_healthy:
-            self._progress[d.id] = healthy_total
             if prev_healthy >= 0:
                 for ds in updated.task_groups.values():
                     if ds.progress_deadline_s > 0:
@@ -201,7 +206,8 @@ class DeploymentsWatcher:
             updated.status_description = DEPLOYMENT_DESC_SUCCESSFUL
             self.state.upsert_deployment(updated)
             self._mark_job_stable(updated)
-            self._progress.pop(updated.id, None)
+            with self._lock:
+                self._progress.pop(updated.id, None)
             return
 
         if changed:
@@ -298,7 +304,8 @@ class DeploymentsWatcher:
         d.status = DEPLOYMENT_STATUS_FAILED
         d.status_description = desc
         self.state.upsert_deployment(d)
-        self._progress.pop(d.id, None)
+        with self._lock:
+            self._progress.pop(d.id, None)
         reverted = self._auto_revert(d)
         if reverted:
             d.status_description = (

@@ -41,6 +41,9 @@ class NodeDrainer:
     def __init__(self, server, poll_interval: float = DEFAULT_POLL_INTERVAL):
         self.server = server
         self.poll_interval = poll_interval
+        # guards _deadlines: update() mutates it from API threads while
+        # the watcher loop pops expired entries (NLT01)
+        self._lock = threading.Lock()
         self._deadlines = DelayHeap()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -70,7 +73,8 @@ class NodeDrainer:
     def update(self, node: Node) -> None:
         """Node began or ended draining (reference NodeDrainer.Update)."""
         if node.drain is None:
-            self._deadlines.remove(node.id)
+            with self._lock:
+                self._deadlines.remove(node.id)
         else:
             self._track(node)
         self._wake.set()
@@ -80,8 +84,9 @@ class NodeDrainer:
         if d.deadline_s > 0 and not d.force_deadline_unix:
             d.force_deadline_unix = time.time() + d.deadline_s
         if d.force_deadline_unix:
-            if not self._deadlines.push(node.id, d.force_deadline_unix):
-                self._deadlines.update(node.id, d.force_deadline_unix)
+            with self._lock:
+                if not self._deadlines.push(node.id, d.force_deadline_unix):
+                    self._deadlines.update(node.id, d.force_deadline_unix)
 
     # ---- watcher loop ----
 
@@ -101,7 +106,9 @@ class NodeDrainer:
     def tick(self, now: Optional[float] = None) -> None:
         now = time.time() if now is None else now
         state = self.server.state
-        forced: Set[str] = {i.key for i in self._deadlines.pop_expired(now)}
+        with self._lock:
+            forced: Set[str] = {
+                i.key for i in self._deadlines.pop_expired(now)}
         draining = [n for n in state.nodes() if n.drain is not None]
         if not draining:
             return
@@ -207,4 +214,5 @@ class NodeDrainer:
         updated.drain = None
         updated.scheduling_eligibility = "ineligible"
         state.upsert_node(updated)
-        self._deadlines.remove(node.id)
+        with self._lock:
+            self._deadlines.remove(node.id)

@@ -199,14 +199,21 @@ class PeriodicDispatch:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            head = self._heap.peek()
+            # every _heap touch holds _lock: add()/remove() mutate it
+            # from API threads (NLT01 — one-sided locking is still a
+            # race); expired items are drained under the lock, then
+            # dispatched outside it (dispatch_time re-acquires)
+            with self._lock:
+                head = self._heap.peek()
             wait = 0.5 if head is None else \
                 max(min(head.wait_until - time.time(), 0.5), 0.01)
             self._wake.wait(wait)
             self._wake.clear()
             if self._stop.is_set():
                 return
-            for item in self._heap.pop_expired(time.time()):
+            with self._lock:
+                expired = list(self._heap.pop_expired(time.time()))
+            for item in expired:
                 key = item.data
                 try:
                     self.dispatch_time(key, item.wait_until)

@@ -30,6 +30,15 @@ case — each scheduler issues exactly one select) or when a short window
 expires (stragglers blocked elsewhere, e.g. in plan-apply). A thread may
 park again for later rounds (multi-TG jobs, plan-refresh retries); the
 loop runs until every thread has finished.
+
+Pipelined dispatch (ISSUE 5): a dispatch packs FIRST, resolves the
+device view at the last instant (a delta row-update against the cached
+buffers, not a re-upload — scheduler/stack.py device_arrays), launches
+the chain, and releases its waiters with LAZY outputs. Waiters
+materialize as the kernel lands and roll into their plan applies; the
+coordinator thread is immediately free to pack the next round of
+parked programs against the in-flight kernel. Host pack, view refresh,
+kernel, and result consumption no longer serialize on one thread.
 """
 from __future__ import annotations
 
@@ -56,8 +65,40 @@ class _SelectReq:
         self.n_place = n_place
         self.order = order
         self.event = threading.Event()
+        #: (_BatchOut, program index | None) — the device outputs stay
+        #: LAZY until a waiter (or the coordinator's stats pass) first
+        #: touches them, so waiters are released while the chain kernel
+        #: is still in flight
         self.out: Optional[Tuple] = None
         self.err: Optional[BaseException] = None
+
+
+class _BatchOut:
+    """Shared lazy holder for one dispatch's device outputs: the first
+    accessor pays the single device→host fetch (blocking until the
+    kernel lands) and fires `on_first_resolve` (kernel-span
+    attribution); everyone else reuses the numpy copy. Releasing
+    waiters BEFORE materializing lets their plan construction overlap
+    the in-flight kernel — and frees the coordinator thread to pack the
+    NEXT round of parked programs while this kernel is still running."""
+
+    __slots__ = ("_dev", "_np", "_lock", "_on_first")
+
+    def __init__(self, dev: Tuple, on_first_resolve=None) -> None:
+        self._dev = dev
+        self._np = None
+        self._lock = threading.Lock()
+        self._on_first = on_first_resolve
+
+    def resolve(self) -> Tuple:
+        with self._lock:
+            if self._np is None:
+                self._np = tuple(np.asarray(x) for x in self._dev)
+                self._dev = None
+                if self._on_first is not None:
+                    cb, self._on_first = self._on_first, None
+                    cb()
+            return self._np
 
 
 class SelectCoordinator:
@@ -68,9 +109,13 @@ class SelectCoordinator:
         self._live = 0
         self._parked: List[_SelectReq] = []
         self.window_s = window_s
-        # per-batch stats dict is safe: only the coordinator-driving
-        # worker thread mutates it (in _dispatch), readers copy after
-        # finish_batch
+        # stats: the coordinator-driving worker thread writes most keys
+        # in _dispatch; kernel_ms is attributed by whichever WAITER
+        # materializes a dispatch's outputs first (the coordinator no
+        # longer blocks on the kernel), so those increments go through
+        # _stats_lock. Readers copy after finish_batch, when every
+        # waiter has resolved.
+        self._stats_lock = threading.Lock()
         self.stats = {"dispatches": 0, "programs": 0, "batched": 0,
                       "dispatch_ms": 0.0, "view_ms": 0.0, "pack_ms": 0.0,
                       "kernel_ms": 0.0}
@@ -93,7 +138,9 @@ class SelectCoordinator:
     def select(self, arrays_fn, params, n_place: int, order: int = 0):
         """Park until the coordinator dispatches this program. Returns
         (sel_rows i32[M], scores f32[M], nodes_feasible int,
-        nodes_fit i32[M])."""
+        nodes_fit i32[M]). Materialization happens HERE, on the waiter
+        thread — the coordinator releases waiters at kernel launch, so
+        this blocks until the fused chain actually lands."""
         req = _SelectReq(arrays_fn, params, n_place, order)
         with self._cv:
             self._parked.append(req)
@@ -101,7 +148,11 @@ class SelectCoordinator:
         req.event.wait()
         if req.err is not None:
             raise req.err
-        return req.out
+        holder, i = req.out
+        sel, score, feas, fit = holder.resolve()
+        if i is None:
+            return sel, score, int(feas), fit
+        return sel[i], score[i], int(feas[i]), fit[i]
 
     # ---- coordinator side (the worker's batch thread) ----
 
@@ -163,26 +214,52 @@ class SelectCoordinator:
 
         self.stats["dispatches"] += 1
         self.stats["programs"] += len(batch)
-        # resolve each request's device view NOW (post-predecessor-commit)
-        # and group by cluster (capacity buffer is stable across
-        # used-version bumps; distinct clusters would be distinct states)
-        by_cluster: Dict[int, List[Tuple[_SelectReq, object]]] = {}
+        # group by owning CLUSTER without resolving the device view yet.
+        # The view is resolved exactly ONCE per group, AFTER the host
+        # pack: (a) the pack overlaps the predecessor dispatch's still
+        # in-flight kernel instead of serializing behind its view
+        # refresh, and (b) a single resolution per dispatch means a
+        # donated delta-apply can never invalidate a sibling request's
+        # already-resolved buffers mid-dispatch. An arrays_fn that is
+        # not a cluster-bound method (a lambda/partial caller) is
+        # resolved HERE and grouped by its view's capacity buffer — the
+        # pre-delta grouping rule — so same-cluster requests still fuse
+        # into one conflict-aware chain instead of racing as singles.
+        groups: Dict[tuple, List[_SelectReq]] = {}
+        resolved: Dict[tuple, object] = {}
         for r in batch:
-            a = r.arrays_fn()
-            by_cluster.setdefault(id(a.capacity), []).append((r, a))
-        self.stats["view_ms"] += (time.perf_counter() - t_start) * 1e3
-        for pairs in by_cluster.values():
-            pairs.sort(key=lambda p: p[0].order)
-            reqs = [p[0] for p in pairs]
-            arrays = pairs[0][1]
+            owner = getattr(r.arrays_fn, "__self__", None)
+            cluster = getattr(owner, "cluster", None)
+            if cluster is not None:
+                key = ("cluster", id(cluster))
+            else:
+                a = r.arrays_fn()
+                key = ("arrays", id(a.capacity))
+                resolved[key] = a
+            groups.setdefault(key, []).append(r)
+        def _kernel_done(reqs, t_launch):
+            def cb():
+                t_end = time.perf_counter()
+                with self._stats_lock:
+                    self.stats["kernel_ms"] += (t_end - t_launch) * 1e3
+                self._trace(reqs, "kernel", _mono(t_launch), _mono(t_end))
+            return cb
+
+        for key, reqs in groups.items():
+            reqs.sort(key=lambda r: r.order)
             if len(reqs) == 1:
                 r = reqs[0]
-                tk = time.monotonic()
+                tv = time.perf_counter()
+                arrays = resolved.get(key) or r.arrays_fn()
+                tk = time.perf_counter()
+                self.stats["view_ms"] += (tk - tv) * 1e3
+                self._trace([r], "delta_apply", _mono(tv), _mono(tk))
                 (p,), m = pad_params([r.params])
                 res = place_task_group_jit(arrays, p, m)
-                r.out = (np.asarray(res.sel_idx), np.asarray(res.sel_score),
-                         int(res.nodes_feasible), np.asarray(res.nodes_fit))
-                self._trace([r], "kernel", tk, time.monotonic())
+                r.out = (_BatchOut((res.sel_idx, res.sel_score,
+                                    res.nodes_feasible, res.nodes_fit),
+                                   _kernel_done([r], tk)),
+                         None)
                 r.event.set()
                 continue
             self.stats["batched"] += len(reqs)
@@ -203,17 +280,23 @@ class SelectCoordinator:
             t1 = time.perf_counter()
             self.stats["pack_ms"] += (t1 - t0) * 1e3
             self._trace(reqs, "pack", _mono(t0), _mono(t1))
-            sel_j, score_j, feas_j, fit_j = place_packed_chain(
-                arrays, ibuf, fbuf, ubuf, spec, m)
-            sel_all = np.asarray(sel_j)
-            scores = np.asarray(score_j)
-            feas = np.asarray(feas_j)
-            fit = np.asarray(fit_j)
-            t2 = time.perf_counter()
-            self.stats["kernel_ms"] += (t2 - t1) * 1e3
-            self._trace(reqs, "kernel", _mono(t1), _mono(t2))
+            # view AFTER pack, at the last possible instant before the
+            # kernel: the predecessor batch's plans have committed by
+            # now, and the delta log makes this a row-update instead of
+            # a full re-upload (BENCH_r05's dominant e2e cost)
+            arrays = resolved.get(key) or reqs[0].arrays_fn()
+            tv = time.perf_counter()
+            self.stats["view_ms"] += (tv - t1) * 1e3
+            self._trace(reqs, "delta_apply", _mono(t1), _mono(tv))
+            out = _BatchOut(place_packed_chain(
+                arrays, ibuf, fbuf, ubuf, spec, m),
+                _kernel_done(reqs, tv))
+            # release waiters at LAUNCH: each materializes the shared
+            # output as the chain lands and rolls straight into its plan
+            # apply, while this thread returns to run() and can pack the
+            # next round of parked programs against the in-flight kernel
             for i, r in enumerate(reqs):
-                r.out = (sel_all[i], scores[i], int(feas[i]), fit[i])
+                r.out = (out, i)
                 r.event.set()
         self.stats["dispatch_ms"] += (time.perf_counter() - t_start) * 1e3
 

@@ -187,7 +187,6 @@ class Server:
         self.volume_watcher.start()
         self.timetable.witness(self.state.index.value)
         self._stop_event.clear()
-        self._last_gc = time.time()  # first GC a full interval after start
         self._gc_thread = threading.Thread(target=self._run_gc_ticker,
                                            name="core-gc", daemon=True)
         self._gc_thread.start()
@@ -246,12 +245,16 @@ class Server:
         from .core_sched import (CORE_JOB_DEPLOYMENT_GC, CORE_JOB_EVAL_GC,
                                  CORE_JOB_JOB_GC, CORE_JOB_NODE_GC)
 
+        # last-GC stamp is confined to this thread (NLT01: it used to be
+        # a worker-visible attribute written from start()); the first GC
+        # still lands a full interval after the ticker starts
+        last_gc = time.time()
         while not self._stop_event.wait(min(self.config.gc_interval, 1.0)):
             self.timetable.witness(self.state.index.value)
             now = time.time()
-            if now - self._last_gc < self.config.gc_interval:
+            if now - last_gc < self.config.gc_interval:
                 continue
-            self._last_gc = now
+            last_gc = now
             for kind in (CORE_JOB_EVAL_GC, CORE_JOB_JOB_GC, CORE_JOB_NODE_GC,
                          CORE_JOB_DEPLOYMENT_GC):
                 self.enqueue_core_eval(kind)

@@ -161,7 +161,11 @@ class Worker:
         (GIL-bound) reconcile+compile on the pool — they park at their
         coordinator, which cannot dispatch until we call run() after
         batch k completes, so k+1 never places against k's un-applied
-        claims."""
+        claims. Within a batch the coordinator pipelines too: waiters
+        get lazy outputs at kernel launch (select_batch._BatchOut), so
+        k's plan applies overlap k's own in-flight chain, and k+1's
+        dispatch refreshes the device view as a row-delta against the
+        cached buffers instead of re-uploading the hot tensors."""
         inflight = None  # (coord, futs, items) started but not finished
         try:
             while not self._stop.is_set():

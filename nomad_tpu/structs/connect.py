@@ -146,16 +146,27 @@ def _inject_group_sidecar(tg: TaskGroup, svc: Service) -> None:
             lifecycle=TaskLifecycle(hook="prestart", sidecar=True),
             # connectSidecarResources (job_endpoint_hook_connect.go:16):
             # 250 MHz / 128 MiB defaults
-            resources=Resources(
-                cpu=250, memory_mb=128,
-                networks=[NetworkResource(
-                    mbits=10, dynamic_ports=[Port(label=label)])],
-            ),
+            resources=Resources(cpu=250, memory_mb=128),
         )
         tg.tasks.append(proxy)
     # the rest is REBUILT on every register — a re-register that adds
     # or rebinds upstreams must reach the proxy's listeners and its
-    # discovery template, not just the app env
+    # discovery template, not just the app env.
+    # Upstream local_bind_ports ride the network as RESERVED host ports
+    # (ADVICE r5): each upstream listener binds 127.0.0.1:<port> on the
+    # shared host loopback (connect_proxy.py serve_outbound), so two
+    # allocs of one consuming group co-placed on a node would collide at
+    # bind time — a zombie sidecar instead of a scheduling decision.
+    # Accounting the bind as a scheduled port makes the kernel's port
+    # mask and plan-apply verification keep such allocs apart.
+    proxy.resources.networks = [NetworkResource(
+        mbits=10,
+        dynamic_ports=[Port(label=label)],
+        reserved_ports=[
+            Port(label=f"connect_upstream_{_env_slug(u.destination_name).lower()}",
+                 value=u.local_bind_port)
+            for u in ups if u.local_bind_port > 0],
+    )]
     proxy.env.update({
         # markers the task runner resolves at start time: leaf-cert
         # issuance (conn.connect_issue) + cross-task target port

@@ -17,8 +17,9 @@ scatters in the kernel instead).
 """
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -41,6 +42,11 @@ PORT_WORDS = 2048                 # 65536 / 32
 MIN_DYNAMIC_PORT = 20000          # reference network.go:12
 MAX_DYNAMIC_PORT = 32000          # reference network.go:15
 DYN_PORT_SPAN = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+
+#: bounded length of the per-version delta logs (hot rows / port rows).
+#: When a log wraps, caches older than the dropped entry fall back to a
+#: full upload — the log is a window, not a journal.
+DELTA_LOG_LEN = 1024
 
 
 def _bucket(n: int, lo: int = 64) -> int:
@@ -112,6 +118,81 @@ class ClusterTensors:
         # bumped only on node-set/attribute changes (not alloc churn) —
         # freshness oracle for cached host-evaluated constraint masks
         self.node_version = 0
+        # ---- per-version delta logs (device-view incremental refresh) --
+        # Each mutation that touches a hot tensor row (used/node_ok/
+        # dyn_free) or a port-bitmap row appends (version-after-bump,
+        # rows) BEFORE bumping the matching version counter — that
+        # ordering lets a reader capture the version first and then read
+        # a superset of the rows changed since its cached version (a
+        # concurrent mutation is either fully visible or re-applied on
+        # the next refresh; it can never be silently lost). Consumed by
+        # TPUStack.device_arrays: instead of re-uploading whole tensors
+        # per version bump, it ships only the touched rows.
+        self._hot_log: Deque[Tuple[int, Tuple[int, ...]]] = deque()
+        self._hot_floor = 0     # versions < floor are not reconstructible
+        self._ports_log: Deque[Tuple[int, int]] = deque()
+        self._ports_floor = 0
+
+    # ---- delta logs ----
+
+    def _log_hot(self, *rows: int) -> None:
+        """Record hot-tensor rows about to change at `version + 1`.
+        MUST be called before the `self.version += 1` it describes.
+        A bump that touches no hot rows needs no entry — readers union
+        entries, so version gaps read as "nothing changed"."""
+        if not rows:
+            return
+        log = self._hot_log
+        if len(log) >= DELTA_LOG_LEN:
+            # floor BEFORE pop: readers copy the log then check the
+            # floor, so either they copied the doomed entry or they see
+            # the raised floor — never an unflagged incomplete window
+            self._hot_floor = log[0][0]
+            log.popleft()
+        log.append((self.version + 1, rows))
+
+    def _log_ports(self, row: int) -> None:
+        """Record a port-bitmap row about to change at `ports_version +
+        1`. MUST be called before the matching bump."""
+        log = self._ports_log
+        if len(log) >= DELTA_LOG_LEN:
+            self._ports_floor = log[0][0]   # floor BEFORE pop, see _log_hot
+            log.popleft()
+        log.append((self.ports_version + 1, row))
+
+    def hot_rows_since(self, v0: int, limit: int) -> Optional[Set[int]]:
+        """Rows whose used/node_ok/dyn_free changed in (v0, version] —
+        a SUPERSET is fine (re-applying an unchanged row is a no-op).
+        None when the window can't cover v0 or the delta would exceed
+        `limit` rows (full upload is then cheaper). The floor is
+        re-checked AFTER copying the log: a concurrent append can wrap
+        the deque and drop a needed entry between an up-front check and
+        the copy, which would silently yield an incomplete row set."""
+        rows: Set[int] = set()
+        entries = list(self._hot_log)
+        if v0 < self._hot_floor:
+            return None
+        for ver, rs in entries:
+            if ver > v0:
+                rows.update(rs)
+                if len(rows) > limit:
+                    return None
+        return rows
+
+    def port_rows_since(self, pv0: int, limit: int) -> Optional[Set[int]]:
+        """Port-bitmap rows changed in (pv0, ports_version]; None on
+        window miss or overflow (same contract — including the
+        copy-then-check floor ordering — as hot_rows_since)."""
+        rows: Set[int] = set()
+        entries = list(self._ports_log)
+        if pv0 < self._ports_floor:
+            return None
+        for ver, row in entries:
+            if ver > pv0:
+                rows.add(row)
+                if len(rows) > limit:
+                    return None
+        return rows
 
     # ---- nodes ----
 
@@ -128,6 +209,11 @@ class ClusterTensors:
         pw = np.zeros((new_cap, PORT_WORDS), dtype=np.uint32)
         pw[: self.n_cap] = self.ports_used
         self.ports_used = pw
+        # shape change: no row delta can express it — force full uploads
+        # for every cached view (the shape check in device_arrays catches
+        # this too; the floors make it explicit)
+        self._hot_floor = self.version + 1
+        self._ports_floor = self.ports_version + 1
         self.ports_version += 1
         df = np.zeros(new_cap, dtype=np.float32)
         df[: self.n_cap] = self.dyn_free
@@ -158,6 +244,7 @@ class ClusterTensors:
 
     def _set_port(self, row: int, port: int) -> None:
         self.ports_used[row, port >> 5] |= np.uint32(1 << (port & 31))
+        self._log_ports(row)
         self.ports_version += 1
         if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
             self.dyn_free[row] -= 1.0
@@ -165,6 +252,7 @@ class ClusterTensors:
     def _clear_port(self, row: int, port: int) -> None:
         self.ports_used[row, port >> 5] &= np.uint32(
             ~(1 << (port & 31)) & 0xFFFFFFFF)
+        self._log_ports(row)
         self.ports_version += 1
         if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
             self.dyn_free[row] += 1.0
@@ -268,6 +356,7 @@ class ClusterTensors:
             rsv.reserved_ports) if 0 <= p < PORT_WORDS * 32)
         self.base_ports[row] = base
         self.ports_used[row, :] = 0
+        self._log_ports(row)
         self.ports_version += 1
         self.dyn_free[row] = DYN_PORT_SPAN
         for port in base:
@@ -308,6 +397,7 @@ class ClusterTensors:
         for pid, info in (node.csi_node_plugins or {}).items():
             healthy = "1" if getattr(info, "healthy", True) else "0"
             self._set_attr(row, f"__plugin.csi.{pid}", healthy)
+        self._log_hot(row)
         self.version += 1
         self.node_version += 1
         return row
@@ -322,6 +412,7 @@ class ClusterTensors:
             self.ready_by_dc[old[0]] -= 1
         self.node_of_row[row] = None
         self.capacity[row] = 0
+        self._log_ports(row)
         self.ports_version += 1
         self.used[row] = 0
         self.node_ok[row] = False
@@ -341,6 +432,7 @@ class ClusterTensors:
             for aid in [a for a, (r, _tg) in japs.items() if r == row]:
                 del japs[aid]
         self.free_rows.append(row)
+        self._log_hot(row)
         self.version += 1
         self.node_version += 1
 
@@ -367,10 +459,15 @@ class ClusterTensors:
         """Maintain `used` and the job index. Terminal allocs release usage
         (mirrors the reference's non-terminal filter in AllocsByNodeTerminal,
         state_store usage via context.go:122)."""
+        touched = []
         prev = self.alloc_usage.pop(alloc.id, None)
         if prev is not None:
             row, usage = prev
             self.used[row] -= usage
+            touched.append(row)
+        pp = self.alloc_ports.get(alloc.id)
+        if pp is not None:
+            touched.append(pp[0])  # release flips that row's dyn_free
         self._release_alloc_ports(alloc.id)
         japs = self.job_allocs.setdefault(alloc.job_id, {})
         japs.pop(alloc.id, None)
@@ -378,11 +475,13 @@ class ClusterTensors:
         if alloc.terminal_status():
             if not japs:
                 self.job_allocs.pop(alloc.job_id, None)
+            self._log_hot(*touched)
             self.version += 1
             return
 
         row = self.row_of.get(alloc.node_id)
         if row is None:
+            self._log_hot(*touched)
             self.version += 1
             return
         usage = self.usage_row(alloc)
@@ -390,13 +489,20 @@ class ClusterTensors:
         self.alloc_usage[alloc.id] = (row, usage)
         self._add_alloc_ports(alloc.id, row, self._alloc_port_list(alloc))
         japs[alloc.id] = (row, alloc.task_group)
+        touched.append(row)
+        self._log_hot(*touched)
         self.version += 1
 
     def remove_alloc(self, alloc_id: str, job_id: str = "") -> None:
+        touched = []
         prev = self.alloc_usage.pop(alloc_id, None)
         if prev is not None:
             row, usage = prev
             self.used[row] -= usage
+            touched.append(row)
+        pp = self.alloc_ports.get(alloc_id)
+        if pp is not None:
+            touched.append(pp[0])
         self._release_alloc_ports(alloc_id)
         if job_id and job_id in self.job_allocs:
             self.job_allocs[job_id].pop(alloc_id, None)
@@ -405,6 +511,7 @@ class ClusterTensors:
                 if alloc_id in japs:
                     del japs[alloc_id]
                     break
+        self._log_hot(*touched)
         self.version += 1
 
     # ---- per-eval vectors ----

@@ -115,6 +115,78 @@ class TestInjection:
         app = next(t for t in tg.tasks if t.name != proxy.name)
         assert app.env["NOMAD_UPSTREAM_ADDR_CACHE"] == "127.0.0.1:9292"
         assert app.env["NOMAD_UPSTREAM_ADDR_DB"] == "127.0.0.1:9199"
+        # rebound local_bind_port must re-account as a scheduled port
+        reserved = {p.value for n in proxy.resources.networks
+                    for p in n.reserved_ports}
+        assert reserved == {9199, 9292}
+
+    def test_upstream_bind_is_a_scheduled_host_port(self):
+        """ADVICE r5: the upstream listener binds the shared host
+        loopback, so local_bind_port must ride the proxy's network as a
+        reserved port the scheduler accounts."""
+        job = self._job()
+        inject_sidecars(job)
+        proxy = next(t for t in job.task_groups[0].tasks
+                     if t.name == "connect-proxy-api")
+        reserved = [(p.label, p.value) for n in proxy.resources.networks
+                    for p in n.reserved_ports]
+        assert ("connect_upstream_db", 9191) in reserved
+
+
+class TestUpstreamPortScheduling:
+    """Two allocs of one upstream-consuming group must not co-place on
+    a node: both sidecars would bind 127.0.0.1:local_bind_port (ADVICE
+    r5 — the collision used to surface as a zombie sidecar at runtime
+    instead of a placement decision)."""
+
+    def _consumer(self, count):
+        from nomad_tpu.structs.job import Service
+
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = count
+        tg.tasks[0].resources.cpu = 100
+        tg.tasks[0].resources.memory_mb = 64
+        tg.services.append(Service(
+            name="web", port_label="http",
+            connect=Connect(sidecar_service=SidecarService(
+                proxy=ConnectProxy(upstreams=[ConnectUpstream(
+                    destination_name="db", local_bind_port=29191)])))))
+        return job
+
+    def _run(self, n_nodes, count):
+        from nomad_tpu.server import Server, ServerConfig
+
+        s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0))
+        for i in range(n_nodes):
+            n = mock.node()
+            n.id = f"n-{i}"
+            n.attributes["driver.connect_proxy"] = "1"
+            s.state.upsert_node(n)
+        s.start()
+        try:
+            job = self._consumer(count)
+            ev = s.job_register(job)
+            got = s.wait_for_eval(
+                ev.id, statuses=("complete", "failed", "blocked",
+                                 "cancelled"), timeout=60.0)
+            assert got is not None
+            allocs = [a for a in s.state.allocs_by_job("default", job.id)
+                      if not a.terminal_status()]
+        finally:
+            s.shutdown()
+        return got, allocs
+
+    def test_two_allocs_spread_across_nodes(self):
+        _, allocs = self._run(n_nodes=2, count=2)
+        assert len(allocs) == 2
+        assert len({a.node_id for a in allocs}) == 2, \
+            "upstream binds co-placed on one loopback"
+
+    def test_single_node_places_only_one(self):
+        got, allocs = self._run(n_nodes=1, count=2)
+        assert len(allocs) == 1
+        assert got.status in ("complete", "blocked")
 
 
 class TestParse:
