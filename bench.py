@@ -572,8 +572,11 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # snapshot before the measured window so the tail reports the
         # steady-state full-vs-delta breakdown, not warmup cold uploads
         from nomad_tpu.lib.metrics import default_registry
+        from nomad_tpu.lib.transfer import default_ledger
 
         view0 = default_registry().counters(prefix="view.")
+        led0 = default_ledger().snapshot()
+        pipe0 = _pipeline_totals(s.metrics)
         t0 = time.time()
         evals = []
         for job in jobs:
@@ -591,6 +594,8 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         dt = time.time() - t0
         stats = dict(s.planner.stats)
         view1 = default_registry().counters(prefix="view.")
+        pipeline = _pipeline_section(pipe0, _pipeline_totals(s.metrics),
+                                     led0, default_ledger().snapshot())
         view = {k: round(view1.get(k, 0) - view0.get(k, 0), 1)
                 for k in ("upload_bytes", "full_uploads",
                           "ports_full_uploads", "delta_uploads",
@@ -614,6 +619,13 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
             log("e2e: phase p50/p95 ms: " + ", ".join(
                 f"{k[:-3]}={v['p50']:.2f}/{v['p95']:.2f}"
                 for k, v in sorted(phases.items())))
+        log(f"e2e: pipeline overlap {pipeline['overlap_pct']:.1f}% "
+            f"bubble {pipeline['bubble_ms_mean']:.2f}ms/dispatch "
+            f"transfer {pipeline['transfer_bytes_per_dispatch']:.0f}B/"
+            f"{pipeline['transfer_count_per_dispatch']:.1f}x per dispatch; "
+            "top sites "
+            + ", ".join(f"{e['site']}={e['bytes']}"
+                        for e in pipeline["top_sites"][:3]))
     finally:
         s.shutdown()
     rate = done / dt if dt else 0.0
@@ -637,6 +649,66 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         + view["ports_full_uploads"],
         "e2e_view_delta_uploads": view["delta_uploads"],
         "e2e_view_delta_rows": view["delta_rows"],
+        # dispatch-pipeline + transfer-ledger attribution for the
+        # measured window (lib/transfer.py): does batch k+1's pack hide
+        # under batch k's kernel, what does each dispatch move over the
+        # host↔device link, and WHICH call sites moved it
+        "e2e_pipeline": pipeline,
+    }
+
+
+def _pipeline_totals(reg) -> dict:
+    """Monotonic pipeline totals from a server registry (counters +
+    histogram lifetime sums) — snapshot before/after the measured
+    window and difference, exactly like the view.* counters."""
+    snap = reg.snapshot()
+    c = snap.get("counters", {})
+    h = snap.get("histograms", {})
+
+    def hsum(name):
+        return float((h.get(name) or {}).get("sum", 0.0))
+
+    return {
+        "dispatches": int(c.get("pipeline.dispatches", 0)),
+        "transfer_bytes": float(c.get("pipeline.transfer_bytes", 0)),
+        "transfer_count": float(c.get("pipeline.transfer_count", 0)),
+        "host_ms": hsum("pipeline.host_ms"),
+        "overlap_ms": hsum("pipeline.overlap_ms"),
+        "bubble_ms": hsum("pipeline.bubble_ms"),
+        "bubbles": int((h.get("pipeline.bubble_ms") or {}).get("count", 0)),
+    }
+
+
+def _pipeline_section(p0: dict, p1: dict, led0: dict, led1: dict) -> dict:
+    """bench tail `e2e_pipeline`: window deltas of the pipeline metrics
+    plus the transfer ledger's top call sites. overlap_pct uses the
+    pre-kernel host-time sum (pack + buffer upload + view) as
+    denominator (overlap is only computed for dispatches with a
+    retained predecessor — with hundreds of dispatches per window the
+    first-dispatch skew is noise)."""
+    d = {k: p1[k] - p0[k] for k in p0}
+    sites = {}
+    for site, vals in led1.items():
+        prev = led0.get(site, {})
+        delta_b = vals["bytes"] - prev.get("bytes", 0)
+        if delta_b > 0:
+            sites[site] = {
+                "site": site, "bytes": delta_b,
+                "count": vals["count"] - prev.get("count", 0),
+                "ms": round(vals["ms"] - prev.get("ms", 0.0), 3)}
+    top = sorted(sites.values(), key=lambda e: -e["bytes"])[:5]
+    n = max(d["dispatches"], 1)
+    return {
+        "dispatches": d["dispatches"],
+        "overlap_pct": round(100.0 * d["overlap_ms"] / d["host_ms"], 2)
+        if d["host_ms"] else 0.0,
+        "overlap_ms_total": round(d["overlap_ms"], 2),
+        "bubble_ms_total": round(d["bubble_ms"], 2),
+        "bubble_ms_mean": round(d["bubble_ms"] / max(d["bubbles"], 1), 3),
+        "transfer_bytes_per_dispatch": round(d["transfer_bytes"] / n, 1),
+        "transfer_count_per_dispatch": round(d["transfer_count"] / n, 2),
+        "transfer_bytes_total": int(d["transfer_bytes"]),
+        "top_sites": top,
     }
 
 

@@ -362,16 +362,30 @@ class Agent:
                     name[len("eval.phase."):]: s
                     for name, s in (snap.get("histograms") or {}).items()
                     if name.startswith("eval.phase.")}
+            timeline = getattr(self.server, "timeline", None)
+            if timeline is not None:
+                # dispatch-pipeline rollup (overlap/bubble/transfer per
+                # dispatch) — the quick answer to "is pipelining
+                # actually overlapping pack with the kernel?"
+                out["pipeline"] = timeline.summary()
         out["process"] = default_registry().snapshot()
+        # per-call-site host↔device transfer attribution (the ledger):
+        # process-global like the registry it mirrors into
+        from ..lib.transfer import default_ledger
+
+        out["transfer_sites"] = default_ledger().snapshot()
         if self.client is not None:
             out["client_allocs"] = self.client.num_allocs()
         return out
 
     def metrics_prometheus(self) -> str:
-        """Prometheus text exposition across both registries. Name sets
-        are disjoint (server-owned vs process-global instruments), so
-        plain concatenation is collision-free."""
+        """Prometheus text exposition across both registries plus the
+        transfer ledger's labeled per-site series. Name sets are
+        disjoint (server-owned vs process-global instruments vs the
+        ledger's `nomad_transfer_*_total{site=...}` family), so plain
+        concatenation is collision-free."""
         from ..lib.metrics import default_registry
+        from ..lib.transfer import default_ledger
 
         parts = []
         if self.server is not None:
@@ -379,6 +393,7 @@ class Agent:
             if reg is not None:
                 parts.append(reg.prometheus())
         parts.append(default_registry().prometheus())
+        parts.append(default_ledger().prometheus())
         return "".join(parts)
 
 

@@ -1403,6 +1403,22 @@ class HTTPApi:
                                                      timeout=wait)
             return {"index": idx,
                     "events": [to_wire(e) for e in events]}
+        # /v1/scheduler/timeline — dispatch-pipeline records
+        # (lib/transfer.DispatchTimeline): index long-poll exactly like
+        # /v1/event/stream; ?summary=1 returns the aggregate view only.
+        # Operator-read gated like the other scheduler internals.
+        if parts == ["scheduler", "timeline"]:
+            require(acl.allow_operator_read())
+            timeline = getattr(server, "timeline", None)
+            if timeline is None:
+                raise HttpError(501, "this server records no timeline")
+            if query.get("summary") == "1":
+                return {"index": timeline.last_index(),
+                        "summary": timeline.summary()}
+            index = int(query.get("index", 0) or 0)
+            wait = min(float(query.get("wait", 0) or 0), 60.0)
+            idx, recs = timeline.records_after(index, timeout=wait)
+            return {"index": idx, "dispatches": recs}
         raise HttpError(404, f"no handler for {method} {path}")
 
     # ---- /v1/acl/* (acl_endpoint.go) ----

@@ -444,6 +444,24 @@ class NomadClient:
         /v1/evaluation/<id>/trace)."""
         return self._request("GET", f"/v1/evaluation/{eval_id}/trace")
 
+    def scheduler_timeline(self, index: int = 0,
+                           wait: float = 0.0) -> dict:
+        """Dispatch-pipeline records past `index` (GET
+        /v1/scheduler/timeline): pack/view/kernel intervals plus the
+        overlap/bubble pipelining metrics per fused dispatch. `wait`
+        long-polls like the event stream."""
+        params = {"index": str(index)}
+        if wait:
+            params["wait"] = str(wait)
+        return self._request("GET", "/v1/scheduler/timeline",
+                             params=params)
+
+    def scheduler_timeline_summary(self) -> dict:
+        """Aggregate pipeline view (overlap_pct, bubble totals,
+        per-dispatch transfer means) over the retained ring."""
+        return self._request("GET", "/v1/scheduler/timeline",
+                             params={"summary": "1"})
+
     def status_leader(self):
         return self._request("GET", "/v1/status/leader")
 

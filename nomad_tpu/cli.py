@@ -738,6 +738,53 @@ def cmd_deployment_fail(args) -> int:
     return 0
 
 
+def cmd_operator_timeline(args) -> int:
+    """`nomad-tpu operator timeline` — per-dispatch pipeline records
+    (/v1/scheduler/timeline): pack/view/kernel intervals plus how much
+    of each dispatch's pack hid under the predecessor's kernel
+    (overlap) and the device idle between kernels (bubble). The summary
+    line is the quick read; `-json` dumps raw records for tooling."""
+    from .api import ApiError
+
+    api = _client(args)
+    try:
+        tl = api.scheduler_timeline(index=args.index, wait=args.wait)
+        summ = api.scheduler_timeline_summary().get("summary", {})
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"summary": summ, **tl}, indent=2, default=str))
+        return 0
+    print(f"Index        = {tl.get('index', 0)}")
+    print(f"Dispatches   = {summ.get('dispatches', 0)} retained")
+    print(f"Overlap      = {summ.get('overlap_pct', 0.0):.1f}% of pack "
+          f"hidden under the in-flight kernel")
+    print(f"Bubble       = {summ.get('bubble_ms_mean', 0.0):.3f} ms mean "
+          f"device idle between kernels")
+    print(f"Transfer     = {summ.get('transfer_bytes_per_dispatch', 0.0):.0f}"
+          f" B / {summ.get('transfer_count_per_dispatch', 0.0):.1f} "
+          f"transfers per dispatch")
+    recs = tl.get("dispatches", [])
+    if recs:
+        print()
+
+        def fmt(v, nd=2):
+            return "-" if v is None else f"{v:.{nd}f}"
+
+        rows = [[str(r["seq"]), str(r["programs"]),
+                 "yes" if r["batched"] else "no",
+                 fmt(r["pack_ms"]), fmt(r.get("upload_ms")),
+                 fmt(r["view_ms"]), fmt(r["kernel_ms"]),
+                 fmt(r["overlap_ms"]), fmt(r["bubble_ms"]),
+                 str(r["transfer_bytes"])]
+                for r in recs]
+        print(_columns(rows, ["Seq", "Progs", "Fused", "Pack (ms)",
+                              "Upload (ms)", "View (ms)", "Kernel (ms)",
+                              "Overlap (ms)", "Bubble (ms)", "Bytes"]))
+    return 0
+
+
 # ---- operator / misc ----
 
 def cmd_quota(args) -> int:
@@ -1657,6 +1704,14 @@ def build_parser() -> argparse.ArgumentParser:
                      default="pretty")
     omt.add_argument("-json", action="store_true")
     omt.set_defaults(fn=cmd_operator_metrics)
+    otl = op.add_parser("timeline",
+                        help="dispatch-pipeline timeline (overlap/bubble)")
+    otl.add_argument("-index", type=int, default=0,
+                     help="only records past this seq (long-poll cursor)")
+    otl.add_argument("-wait", type=float, default=0.0,
+                     help="block up to this many seconds for new records")
+    otl.add_argument("-json", action="store_true")
+    otl.set_defaults(fn=cmd_operator_timeline)
 
     sysp = sub.add_parser("system", help="system commands").add_subparsers(
         dest="sub", required=True)

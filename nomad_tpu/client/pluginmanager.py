@@ -89,20 +89,26 @@ class DriverManager:
 
     def fingerprint_once(self) -> Dict[str, str]:
         """Run every driver's fingerprint; returns the merged attribute
-        map including explicit '' tombstones for attrs that vanished."""
+        map including explicit '' tombstones for attrs that vanished.
+        `_last_attrs` is shared between the fingerprint thread and
+        direct callers (client startup fingerprints synchronously), so
+        its read-compare-write runs under the manager lock; the driver
+        fingerprint itself stays outside (it can block on a plugin)."""
         merged: Dict[str, str] = {}
         for name, cls in BUILTIN_DRIVERS.items():
             try:
                 attrs = self.dispense(name).fingerprint()
             except Exception:
                 attrs = {}
-            prev = self._last_attrs.get(name, {})
-            # clear attrs a now-undetected driver previously published
-            for k in prev:
-                if k not in attrs:
-                    merged[k] = ""
-            merged.update(attrs)
-            self._last_attrs[name] = dict(attrs)
+            with self._lock:
+                prev = self._last_attrs.get(name, {})
+                # clear attrs a now-undetected driver previously
+                # published
+                for k in prev:
+                    if k not in attrs:
+                        merged[k] = ""
+                merged.update(attrs)
+                self._last_attrs[name] = dict(attrs)
         return merged
 
     def start(self) -> None:

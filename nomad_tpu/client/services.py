@@ -120,9 +120,11 @@ class ServiceHook:
                 rest = [r for r, _ in self._regs.values()]
             if rest and not self._stop.is_set():
                 self.conn.update_service_registrations(rest)
-            self._dirty = False
+            with self._lock:
+                self._dirty = False
         except Exception:  # noqa: BLE001 — transient (leader move)
-            self._dirty = True
+            with self._lock:
+                self._dirty = True
 
     def stop(self) -> None:
         """Alloc terminal/destroyed: drop everything. The dereg RPC runs
@@ -222,7 +224,9 @@ class ServiceHook:
                     changed.append(reg)
             if changed:
                 self._push(changed)
-            if self._dirty:
+            with self._lock:
+                dirty = self._dirty
+            if dirty:
                 # a dereg/push failed earlier: full fence (remove then
                 # re-push) so stale rows cannot outlive their task;
                 # retried every loop tick until it lands

@@ -28,6 +28,27 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping (exposition format
+    spec): backslash, double-quote, and line-feed are the only three
+    characters with escapes — in THAT order, or an embedded `\\` in the
+    input would corrupt the escapes added after it."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prometheus_line(name: str, labels: Dict[str, str], value: float) -> str:
+    """One labeled sample line (`name{k="v",...} value`). Label VALUES
+    are escaped; names are the caller's contract (the ledger uses fixed
+    keys). Shared by the labeled exposers (lib/transfer.py ledger) so
+    the escaping lives — and is tested — in exactly one place."""
+    if labels:
+        body = ",".join(f'{k}="{escape_label_value(v)}"'
+                        for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {value:g}"
+    return f"{name} {value:g}"
+
+
 def flatten(tree: Dict, prefix: str = "nomad") -> Dict[str, float]:
     out: Dict[str, float] = {}
     for k, v in tree.items():

@@ -135,10 +135,18 @@ def params_sharding(mesh: Mesh, batched: bool = True) -> TGParams:
 
 
 def shard_cluster(arrays: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    from ..lib.transfer import default_ledger
+
     shardings = cluster_sharding(mesh)
-    return ClusterArrays(
-        *[jax.device_put(a, s) for a, s in zip(arrays, shardings)]
-    )
+    # .nbytes reads metadata on numpy AND jax arrays — np.asarray here
+    # would round-trip device-resident inputs through the host just to
+    # size them, adding exactly the traffic this ledger exists to expose
+    nb = sum(a.nbytes for a in arrays)
+    with default_ledger().timed("mesh.shard_cluster", nb,
+                                count=len(arrays)):
+        return ClusterArrays(
+            *[jax.device_put(a, s) for a, s in zip(arrays, shardings)]
+        )
 
 
 def _pad_rows(a: np.ndarray, n: int, fill) -> np.ndarray:

@@ -140,10 +140,18 @@ def _delta_rows_host(rows, *arrays):
 
 def _apply_chunked(kernel, bufs, idx, *vals):
     """Run `kernel` over _DELTA_CHUNK-row slices of (idx, vals),
-    threading (and re-donating) the output buffers through each call."""
+    threading (and re-donating) the output buffers through each call.
+    Chunk slices are transferred EXPLICITLY (jnp.asarray) rather than
+    left to jit dispatch: same bytes either way, but explicit transfers
+    are visible to the transfer ledger's guard contract — the delta
+    apply runs inside the coordinator's `transfer_guard` scope, where
+    an implicit host upload is a counted (or in tests, fatal) miss."""
+    import jax.numpy as jnp
+
     for o in range(0, idx.shape[0], _DELTA_CHUNK):
         s = slice(o, o + _DELTA_CHUNK)
-        out = kernel(*bufs, idx[s], *[v[s] for v in vals])
+        out = kernel(*bufs, jnp.asarray(idx[s]),
+                     *[jnp.asarray(v[s]) for v in vals])
         bufs = out if isinstance(out, tuple) else (out,)
     return bufs
 
@@ -227,7 +235,10 @@ class TPUStack:
             sh = ClusterArrays(*([None] * len(ClusterArrays._fields)))
             up = lambda a, s, dtype=None: jnp.asarray(a, dtype=dtype)  # noqa: E731
 
+        from ..lib.transfer import default_ledger
+
         reg = default_registry()
+        led = default_ledger()
         cl = self.cluster
         with _DEV_CACHE_LOCK:
             # capture ALL keys BEFORE reading delta rows or uploading: a
@@ -244,10 +255,11 @@ class TPUStack:
             if ent is not None and ent["static_key"] == static_key:
                 capacity, attrs = ent["capacity"], ent["attrs"]
             else:
-                capacity = up(cl.capacity, sh.capacity)
-                attrs = up(cl.attrs, sh.attrs)
-                reg.inc("view.upload_bytes",
-                        cl.capacity.nbytes + cl.attrs.nbytes)
+                nb = cl.capacity.nbytes + cl.attrs.nbytes
+                with led.timed("stack.static_full", nb, count=2):
+                    capacity = up(cl.capacity, sh.capacity)
+                    attrs = up(cl.attrs, sh.attrs)
+                reg.inc("view.upload_bytes", nb)
             # delta eligibility: same mesh commitment and row bucket —
             # a grown n_cap changes every tensor's shape, a mesh flip
             # its placement; neither is expressible as a row update
@@ -264,28 +276,34 @@ class TPUStack:
                     idx, uvals, ovals, dvals = _delta_rows_host(
                         hot_rows, cl.used, cl.node_ok, cl.dyn_free)
                     hot_kernel, _ = _delta_kernels()
-                    used, node_ok, dyn_free = _apply_chunked(
-                        hot_kernel,
-                        (prev.used, prev.node_ok, prev.dyn_free),
-                        idx, uvals.astype(np.float32), ovals, dvals)
+                    nb = (idx.nbytes + uvals.size * 4 + ovals.nbytes
+                          + dvals.nbytes)
+                    # 4 arrays per chunk: transfer COUNT must reflect
+                    # the actual round-trips (each is a tunnel RTT —
+                    # the very cost this ledger attributes)
+                    nch = idx.shape[0] // _DELTA_CHUNK
+                    with led.timed("stack.hot_delta", nb, count=4 * nch):
+                        used, node_ok, dyn_free = _apply_chunked(
+                            hot_kernel,
+                            (prev.used, prev.node_ok, prev.dyn_free),
+                            idx, uvals.astype(np.float32), ovals, dvals)
                     did_delta = True
                     reg.inc("view.delta_rows", len(hot_rows))
-                    reg.inc("view.upload_bytes",
-                            idx.nbytes + uvals.size * 4 + ovals.nbytes
-                            + dvals.nbytes)
+                    reg.inc("view.upload_bytes", nb)
                 else:
                     # version bumped without touching hot rows (job
                     # index churn, vocab growth): the buffers are current
                     used, node_ok, dyn_free = (prev.used, prev.node_ok,
                                                prev.dyn_free)
             else:
-                used = up(cl.used, sh.used, dtype=np.float32)
-                node_ok = up(cl.node_ok, sh.node_ok)
-                dyn_free = up(cl.dyn_free, sh.dyn_free)
+                nb = (cl.used.size * 4 + cl.node_ok.nbytes
+                      + cl.dyn_free.nbytes)
+                with led.timed("stack.hot_full", nb, count=3):
+                    used = up(cl.used, sh.used, dtype=np.float32)
+                    node_ok = up(cl.node_ok, sh.node_ok)
+                    dyn_free = up(cl.dyn_free, sh.dyn_free)
                 reg.inc("view.full_uploads")
-                reg.inc("view.upload_bytes",
-                        cl.used.size * 4 + cl.node_ok.nbytes
-                        + cl.dyn_free.nbytes)
+                reg.inc("view.upload_bytes", nb)
 
             if ent is not None and ent["ports_key"] == ports_key:
                 ports_used = ent["ports_used"]
@@ -297,23 +315,32 @@ class TPUStack:
                     pidx, pvals = _delta_rows_host(port_rows,
                                                    cl.ports_used)
                     _, ports_kernel = _delta_kernels()
-                    (ports_used,) = _apply_chunked(
-                        ports_kernel, (ent["ports_used"],), pidx, pvals)
+                    nb = pidx.nbytes + pvals.nbytes
+                    nch = pidx.shape[0] // _DELTA_CHUNK
+                    with led.timed("stack.ports_delta", nb,
+                                   count=2 * nch):
+                        (ports_used,) = _apply_chunked(
+                            ports_kernel, (ent["ports_used"],), pidx,
+                            pvals)
                     did_delta = True
                     reg.inc("view.delta_rows", len(port_rows))
-                    reg.inc("view.upload_bytes",
-                            pidx.nbytes + pvals.nbytes)
+                    reg.inc("view.upload_bytes", nb)
                 elif port_rows is not None:
                     ports_used = ent["ports_used"]
                 else:
-                    ports_used = up(cl.ports_used, sh.ports_used)
+                    nb = cl.ports_used.nbytes
+                    with led.timed("stack.ports_full", nb):
+                        ports_used = up(cl.ports_used, sh.ports_used)
                     reg.inc("view.ports_full_uploads")
-                    reg.inc("view.upload_bytes", cl.ports_used.nbytes)
+                    reg.inc("view.upload_bytes", nb)
             if did_delta:
                 # one event per refresh that applied any row delta (hot
                 # and/or ports) — pure port flips must not read as "no
                 # delta activity" in the bench breakdown
                 reg.inc("view.delta_uploads")
+            st = cl.delta_stats()
+            reg.set_gauge("view.hot_log_len", st["hot_log_len"])
+            reg.set_gauge("view.ports_log_len", st["ports_log_len"])
 
             arrays = ClusterArrays(
                 capacity=capacity,
@@ -970,10 +997,12 @@ def _pad_lut(lut: np.ndarray, v: int, fill, dtype) -> np.ndarray:
 
 def _to_device(params: TGParams) -> TGParams:
     # Intentional no-op: the jitted call ingests the numpy pytree and
-    # transfers it in ONE dispatch. Explicit per-field jnp.asarray was
-    # ~40 tiny device_puts per select (a third of per-eval wall time on
-    # the e2e control-plane path); even a batched jax.device_put of the
-    # pytree ahead of the call measured slower than letting dispatch do
-    # it. (The batched kernel path has its own transfer pipeline — this
-    # only serves the per-program select/system/preemption dispatches.)
+    # lets jit dispatch transfer the leaves. Whether that beats an
+    # explicit up-front transfer is a MEASURED question now, not a
+    # remembered one: the transfer ledger (lib/transfer.py, `operator
+    # timeline`, bench's `e2e_pipeline.top_sites`) attributes every
+    # dispatch-path transfer per call site, so re-litigate with its
+    # numbers. Note this path is OUTSIDE the transfer-guard scope for
+    # exactly this reason — the batched coordinator path transfers
+    # explicitly (packed buffers) and is the one held guard-clean.
     return params

@@ -39,6 +39,17 @@ materialize as the kernel lands and roll into their plan applies; the
 coordinator thread is immediately free to pack the next round of
 parked programs against the in-flight kernel. Host pack, view refresh,
 kernel, and result consumption no longer serialize on one thread.
+
+Observability (ISSUE 6): the packed buffers transfer EXPLICITLY and
+every transfer on the fused path is recorded in the process transfer
+ledger (lib/transfer.py — sites `select_batch.pack_buffers`,
+`select_batch.fetch`, plus the `stack.*` view sites resolved inside
+the dispatch); the whole device-touching section runs under a
+`jax.transfer_guard` scope so implicit transfers are logged (prod) or
+fatal (tests). Each dispatch commits a record to the server's
+DispatchTimeline — pack/view/kernel intervals plus the overlap/bubble
+metric that says whether batch k+1's pack actually hid under batch
+k's kernel.
 """
 from __future__ import annotations
 
@@ -76,11 +87,14 @@ class _SelectReq:
 class _BatchOut:
     """Shared lazy holder for one dispatch's device outputs: the first
     accessor pays the single device→host fetch (blocking until the
-    kernel lands) and fires `on_first_resolve` (kernel-span
-    attribution); everyone else reuses the numpy copy. Releasing
-    waiters BEFORE materializing lets their plan construction overlap
-    the in-flight kernel — and frees the coordinator thread to pack the
-    NEXT round of parked programs while this kernel is still running."""
+    kernel lands) and fires `on_first_resolve` with the numpy tuple
+    (kernel-span + timeline + fetch-ledger attribution); everyone else
+    reuses the numpy copy. Releasing waiters BEFORE materializing lets
+    their plan construction overlap the in-flight kernel — and frees
+    the coordinator thread to pack the NEXT round of parked programs
+    while this kernel is still running. The fetch is `np.asarray`, an
+    EXPLICIT device→host transfer under jax's transfer-guard taxonomy,
+    so waiters stay clean under `transfer_guard("disallow")`."""
 
     __slots__ = ("_dev", "_np", "_lock", "_on_first")
 
@@ -97,14 +111,15 @@ class _BatchOut:
                 self._dev = None
                 if self._on_first is not None:
                     cb, self._on_first = self._on_first, None
-                    cb()
+                    cb(self._np)
             return self._np
 
 
 class SelectCoordinator:
     """Fuses concurrent select dispatches from one eval batch."""
 
-    def __init__(self, window_s: float = 0.004, tracer=None) -> None:
+    def __init__(self, window_s: float = 0.004, tracer=None,
+                 timeline=None) -> None:
         self._cv = threading.Condition()
         self._live = 0
         self._parked: List[_SelectReq] = []
@@ -114,15 +129,20 @@ class SelectCoordinator:
         # materializes a dispatch's outputs first (the coordinator no
         # longer blocks on the kernel), so those increments go through
         # _stats_lock. Readers copy after finish_batch, when every
-        # waiter has resolved.
+        # waiter has resolved. pack_bytes counts the packed-transport
+        # buffers independently of the ledger — the attribution test
+        # cross-checks the two.
         self._stats_lock = threading.Lock()
         self.stats = {"dispatches": 0, "programs": 0, "batched": 0,
                       "dispatch_ms": 0.0, "view_ms": 0.0, "pack_ms": 0.0,
-                      "kernel_ms": 0.0}
+                      "kernel_ms": 0.0, "pack_bytes": 0}
         #: eval-lifecycle tracer + program-order → eval-id map (worker
         #: fills trace_ids in start_batch) for per-eval pack/kernel spans
         self.tracer = tracer
         self.trace_ids: Dict[int, str] = {}
+        #: dispatch-pipeline timeline (lib/transfer.DispatchTimeline,
+        #: server-owned); None for bare coordinators in tests
+        self.timeline = timeline
 
     # ---- scheduler-thread side ----
 
@@ -202,8 +222,10 @@ class SelectCoordinator:
     def _dispatch(self, batch: List[_SelectReq]) -> None:
         from ..kernels.placement import (pack_params, place_packed_chain,
                                          place_task_group_jit)
+        from ..lib.transfer import default_ledger, guard_scope
         from ..parallel.mesh import pad_params, stack_params
 
+        led = default_ledger()
         t_start = time.perf_counter()
         # stats use perf_counter; trace spans use the monotonic clock —
         # bridge with a one-shot offset so both read the same instants
@@ -237,12 +259,22 @@ class SelectCoordinator:
                 key = ("arrays", id(a.capacity))
                 resolved[key] = a
             groups.setdefault(key, []).append(r)
-        def _kernel_done(reqs, t_launch):
-            def cb():
+        def _kernel_done(reqs, t_launch, seq):
+            def cb(np_out):
                 t_end = time.perf_counter()
                 with self._stats_lock:
                     self.stats["kernel_ms"] += (t_end - t_launch) * 1e3
                 self._trace(reqs, "kernel", _mono(t_launch), _mono(t_end))
+                # the device→host fetch happened HERE (np.asarray on the
+                # first-resolving waiter's thread): credit it to the
+                # dispatch's timeline record + the fetch ledger site
+                fetch = sum(int(getattr(a, "nbytes", 0)) for a in np_out)
+                led.record("select_batch.fetch", fetch,
+                           count=len(np_out))
+                if self.timeline is not None:
+                    self.timeline.kernel_end(seq, _mono(t_end),
+                                             fetch_bytes=fetch,
+                                             fetch_count=len(np_out))
             return cb
 
         for key, reqs in groups.items():
@@ -250,15 +282,27 @@ class SelectCoordinator:
             if len(reqs) == 1:
                 r = reqs[0]
                 tv = time.perf_counter()
-                arrays = resolved.get(key) or r.arrays_fn()
+                with led.scope() as moved:
+                    arrays = resolved.get(key) or r.arrays_fn()
                 tk = time.perf_counter()
                 self.stats["view_ms"] += (tk - tv) * 1e3
                 self._trace([r], "delta_apply", _mono(tv), _mono(tk))
                 (p,), m = pad_params([r.params])
                 res = place_task_group_jit(arrays, p, m)
+                seq = 0
+                if self.timeline is not None:
+                    # zero-length pack: the single path has no packed
+                    # transport; its params ride jit dispatch (see
+                    # stack._to_device — deliberately outside the guard)
+                    seq = self.timeline.commit(
+                        programs=1, batched=False,
+                        pack=(_mono(tv), _mono(tv)),
+                        view=(_mono(tv), _mono(tk)),
+                        kernel_start=_mono(tk),
+                        transfer_bytes=moved[0], transfer_count=moved[1])
                 r.out = (_BatchOut((res.sel_idx, res.sel_score,
                                     res.nodes_feasible, res.nodes_fit),
-                                   _kernel_done([r], tk)),
+                                   _kernel_done([r], tk, seq)),
                          None)
                 r.event.set()
                 continue
@@ -280,17 +324,44 @@ class SelectCoordinator:
             t1 = time.perf_counter()
             self.stats["pack_ms"] += (t1 - t0) * 1e3
             self._trace(reqs, "pack", _mono(t0), _mono(t1))
-            # view AFTER pack, at the last possible instant before the
-            # kernel: the predecessor batch's plans have committed by
-            # now, and the delta log makes this a row-update instead of
-            # a full re-upload (BENCH_r05's dominant e2e cost)
-            arrays = resolved.get(key) or reqs[0].arrays_fn()
-            tv = time.perf_counter()
-            self.stats["view_ms"] += (tv - t1) * 1e3
-            self._trace(reqs, "delta_apply", _mono(t1), _mono(tv))
-            out = _BatchOut(place_packed_chain(
-                arrays, ibuf, fbuf, ubuf, spec, m),
-                _kernel_done(reqs, tv))
+            # Everything device-touching from here to launch runs under
+            # the transfer guard (NOMAD_TPU_TRANSFER_GUARD): transfers
+            # on this path are all EXPLICIT and ledger-accounted, so a
+            # guard hit is an unattributed host↔device round-trip — the
+            # runtime analog of a new NLJ finding.
+            with guard_scope():
+                import jax.numpy as jnp
+
+                nb = ibuf.nbytes + fbuf.nbytes + ubuf.nbytes
+                with led.timed("select_batch.pack_buffers", nb, count=3):
+                    dibuf = jnp.asarray(ibuf)
+                    dfbuf = jnp.asarray(fbuf)
+                    dubuf = jnp.asarray(ubuf)
+                self.stats["pack_bytes"] += nb
+                t2 = time.perf_counter()
+                # view AFTER pack, at the last possible instant before
+                # the kernel: the predecessor batch's plans have
+                # committed by now, and the delta log makes this a
+                # row-update instead of a full re-upload (BENCH_r05's
+                # dominant e2e cost)
+                with led.scope() as moved:
+                    arrays = resolved.get(key) or reqs[0].arrays_fn()
+                tv = time.perf_counter()
+                self.stats["view_ms"] += (tv - t2) * 1e3
+                self._trace(reqs, "delta_apply", _mono(t2), _mono(tv))
+                dev_out = place_packed_chain(arrays, dibuf, dfbuf, dubuf,
+                                             spec, m)
+            seq = 0
+            if self.timeline is not None:
+                seq = self.timeline.commit(
+                    programs=len(reqs), batched=True,
+                    pack=(_mono(t0), _mono(t1)),
+                    upload=(_mono(t1), _mono(t2)),
+                    view=(_mono(t2), _mono(tv)),
+                    kernel_start=_mono(tv),
+                    transfer_bytes=nb + moved[0],
+                    transfer_count=3 + moved[1])
+            out = _BatchOut(dev_out, _kernel_done(reqs, tv, seq))
             # release waiters at LAUNCH: each materializes the shared
             # output as the chain lands and rolls straight into its plan
             # apply, while this thread returns to run() and can pack the

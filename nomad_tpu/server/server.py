@@ -116,9 +116,15 @@ class Server:
         # cross-count). Served on /v1/metrics + /v1/evaluation/:id/trace.
         from ..lib.metrics import MetricsRegistry
         from ..lib.trace import EvalTracer
+        from ..lib.transfer import DispatchTimeline
 
         self.metrics = MetricsRegistry()
         self.tracer = EvalTracer(self.metrics)
+        # dispatch-pipeline timeline (pack/view/kernel overlap per fused
+        # dispatch): fed by the workers' SelectCoordinators, served on
+        # /v1/scheduler/timeline + `operator timeline` + bench's
+        # e2e_pipeline tail
+        self.timeline = DispatchTimeline(self.metrics)
         self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
                                  metrics=self.metrics, tracer=self.tracer)
         self.blocked = BlockedEvals(self.broker)

@@ -299,7 +299,9 @@ class Worker:
             t1 = time.monotonic()
             for ev, _ in items:  # one resolution serves the whole batch
                 self.tracer.record(ev.id, "snapshot", start=t0, end=t1)
-        coord = SelectCoordinator(tracer=self.tracer)
+        coord = SelectCoordinator(tracer=self.tracer,
+                                  timeline=getattr(self.server,
+                                                   "timeline", None))
         futs = []
         for order, (ev, tok) in enumerate(items):
             coord.trace_ids[order] = ev.id

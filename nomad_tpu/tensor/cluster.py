@@ -194,6 +194,21 @@ class ClusterTensors:
                     return None
         return rows
 
+    def delta_stats(self) -> Dict[str, int]:
+        """Delta-log health for the observability surfaces (stack.py
+        gauges these per refresh): log occupancy vs DELTA_LOG_LEN says
+        how close the window is to wrapping (a wrap downgrades stale
+        caches to full uploads), the floors say how far back a cache may
+        lag and still refresh incrementally."""
+        return {
+            "hot_log_len": len(self._hot_log),
+            "hot_floor": self._hot_floor,
+            "ports_log_len": len(self._ports_log),
+            "ports_floor": self._ports_floor,
+            "version": self.version,
+            "ports_version": self.ports_version,
+        }
+
     # ---- nodes ----
 
     def _grow_rows(self) -> None:

@@ -285,3 +285,27 @@ class TestJobsParseAndNodePurge:
         eval_ids = api.node_purge(target)
         assert a.server.state.node_by_id(target) is None
         assert eval_ids  # replacements queued
+
+
+class TestSchedulerTimeline:
+    """/v1/scheduler/timeline (ISSUE 6): endpoint shape + long-poll
+    cursor semantics. Record CONTENT is covered at the coordinator
+    layer (tests/test_transfer.py) — a dev-mode agent's single evals
+    bypass the batched coordinator, so the ring here is legally empty."""
+
+    def test_timeline_shape_summary_and_long_poll(self, agent):
+        a, api = agent
+        tl = api.scheduler_timeline()
+        assert set(tl) == {"index", "dispatches"}
+        assert isinstance(tl["dispatches"], list)
+        summ = api.scheduler_timeline_summary()
+        assert summ["index"] == tl["index"]
+        for k in ("dispatches", "overlap_pct", "bubble_ms_mean",
+                  "transfer_bytes_per_dispatch"):
+            assert k in summ["summary"]
+        # long-poll with no new records returns after the wait, not 60s
+        t0 = time.time()
+        tl2 = api.scheduler_timeline(index=tl["index"], wait=0.3)
+        assert 0.2 <= time.time() - t0 < 5.0
+        assert tl2["index"] >= tl["index"]
+        assert all(r["seq"] > tl["index"] for r in tl2["dispatches"])

@@ -1,0 +1,134 @@
+"""Event-broker semantics under wrap and concurrency (ISSUE 6
+satellite).
+
+`server/events.py` is the index-long-poll idiom `/v1/scheduler/timeline`
+reuses (lib/transfer.DispatchTimeline.records_after), so its contract is
+pinned here: indexes are strictly monotonic, `events_after` never
+returns a duplicate or an out-of-order event, the bounded ring drops
+only the OLDEST events on wrap, and a long-poller wakes on publish
+instead of sleeping out its timeout."""
+import threading
+import time
+
+from nomad_tpu.server.events import Event, EventBroker, TOPIC_JOB, TOPIC_NODE
+
+
+def _ev(topic=TOPIC_JOB, key="k", index=0):
+    return Event(topic=topic, type="T", key=key, index=index)
+
+
+class TestRingWrap:
+    def test_wrap_keeps_newest_and_stays_monotonic(self):
+        b = EventBroker(size=8)
+        for i in range(20):
+            b.publish(_ev(key=f"k{i}"))
+        idx, out = b.events_after(0)
+        # only the newest `size` survive; the dropped ones are the oldest
+        assert len(out) == 8
+        assert [e.key for e in out] == [f"k{i}" for i in range(12, 20)]
+        assert [e.index for e in out] == list(range(13, 21))
+        assert idx == 20
+        assert b.last_index() == 20
+
+    def test_cursor_past_wrap_sees_no_duplicates(self):
+        b = EventBroker(size=8)
+        for i in range(10):
+            b.publish(_ev(key=f"k{i}"))
+        idx, first = b.events_after(0)
+        cursor = max(e.index for e in first)
+        # wrap the ring completely past the cursor
+        for i in range(10, 26):
+            b.publish(_ev(key=f"k{i}"))
+        _, second = b.events_after(cursor)
+        seen = [e.index for e in first] + [e.index for e in second]
+        assert len(seen) == len(set(seen)), "duplicate event indexes"
+        assert seen == sorted(seen), "events out of index order"
+
+    def test_topic_filter_across_wrap(self):
+        b = EventBroker(size=6)
+        for i in range(12):
+            b.publish(_ev(topic=TOPIC_JOB if i % 2 else TOPIC_NODE,
+                          key=f"k{i}"))
+        _, jobs = b.events_after(0, topics=[TOPIC_JOB])
+        assert jobs and all(e.topic == TOPIC_JOB for e in jobs)
+        assert [e.index for e in jobs] == sorted(e.index for e in jobs)
+
+    def test_explicit_index_advances_assignment(self):
+        """A publisher-supplied index (raft-applied state index) must
+        advance the auto-assign floor so later auto events stay above."""
+        b = EventBroker(size=8)
+        b.publish(_ev(index=100))
+        b.publish(_ev())  # auto
+        _, out = b.events_after(0)
+        assert [e.index for e in out] == [100, 101]
+
+
+class TestConcurrentPublishLongPoll:
+    def test_no_lost_or_duplicated_under_concurrent_publish(self):
+        """4 publishers × 50 events race one long-polling consumer: with
+        a ring large enough to never wrap past the cursor, every event
+        is delivered exactly once and in index order."""
+        b = EventBroker(size=4096)
+        n_pub, per = 4, 50
+        done = threading.Event()
+
+        def pub(p):
+            for i in range(per):
+                b.publish(_ev(key=f"p{p}-{i}"))
+
+        threads = [threading.Thread(target=pub, args=(p,), daemon=True)
+                   for p in range(n_pub)]
+
+        got = []
+
+        def consume():
+            cursor = 0
+            while True:
+                _, out = b.events_after(cursor, timeout=0.2)
+                if out:
+                    got.extend(out)
+                    cursor = max(e.index for e in out)
+                elif done.is_set() and len(got) >= n_pub * per:
+                    return
+
+        c = threading.Thread(target=consume, daemon=True)
+        c.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+        done.set()
+        c.join(10.0)
+        assert not c.is_alive()
+        assert len(got) == n_pub * per
+        idxs = [e.index for e in got]
+        assert idxs == sorted(idxs), "long-poll returned out of order"
+        assert len(set(idxs)) == len(idxs), "duplicated event"
+        assert {e.key for e in got} == {
+            f"p{p}-{i}" for p in range(n_pub) for i in range(per)}
+        # per-publisher order preserved through the global index order
+        for p in range(n_pub):
+            mine = [e.key for e in got if e.key.startswith(f"p{p}-")]
+            assert mine == [f"p{p}-{i}" for i in range(per)]
+
+    def test_long_poll_wakes_on_publish(self):
+        b = EventBroker()
+        b.publish(_ev())
+        idx = b.last_index()
+
+        def later():
+            time.sleep(0.15)
+            b.publish(_ev(key="late"))
+
+        threading.Thread(target=later, daemon=True).start()
+        t0 = time.time()
+        _, out = b.events_after(idx, timeout=5.0)
+        dt = time.time() - t0
+        assert out and out[0].key == "late"
+        assert dt < 2.0, f"long-poll slept {dt:.2f}s past the publish"
+
+    def test_long_poll_times_out_empty(self):
+        b = EventBroker()
+        t0 = time.time()
+        idx, out = b.events_after(0, timeout=0.2)
+        assert out == [] and time.time() - t0 >= 0.15

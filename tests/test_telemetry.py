@@ -380,3 +380,85 @@ class TestStatsdRoundTrip:
     def test_flatten_skips_non_numeric(self):
         out = flatten({"a": {"b": 1, "s": "text"}, "ok": True})
         assert out == {"nomad.a.b": 1.0, "nomad.ok": 1.0}
+
+
+class TestLabeledExposition:
+    """Labeled Prometheus series for the transfer ledger + pipeline
+    counters (ISSUE 6 satellite): label-value escaping lives in
+    lib/metrics.py and is pinned here byte-for-byte."""
+
+    def test_escape_label_value(self):
+        from nomad_tpu.lib.metrics import escape_label_value
+
+        assert escape_label_value("plain.site") == "plain.site"
+        assert escape_label_value('say "hi"') == 'say \\"hi\\"'
+        assert escape_label_value("a\\b") == "a\\\\b"
+        assert escape_label_value("line\nbreak") == "line\\nbreak"
+        # backslash escapes FIRST: a literal `\"` must not double-escape
+        # into a broken sequence
+        assert escape_label_value('\\"') == '\\\\\\"'
+
+    def test_prometheus_line(self):
+        from nomad_tpu.lib.metrics import prometheus_line
+
+        assert prometheus_line("m", {}, 2.0) == "m 2"
+        # labels sort by key for deterministic output
+        line = prometheus_line("m", {"b": "2", "a": "1"}, 1.5)
+        assert line == 'm{a="1",b="2"} 1.5'
+        line = prometheus_line("m", {"site": 'we"ird\\x'}, 3)
+        assert line == 'm{site="we\\"ird\\\\x"} 3'
+
+    def test_ledger_exposition_labels_and_escaping(self):
+        from nomad_tpu.lib.transfer import TransferLedger
+
+        led = TransferLedger()
+        led.record("stack.hot_delta", 100, seconds=0.001, count=2)
+        led.record('odd"site\\n', 7)
+        text = led.prometheus()
+        lines = text.splitlines()
+        assert "# TYPE nomad_transfer_bytes_total counter" in lines
+        assert 'nomad_transfer_bytes_total{site="stack.hot_delta"} 100' \
+            in lines
+        assert 'nomad_transfer_count_total{site="stack.hot_delta"} 2' \
+            in lines
+        assert 'nomad_transfer_ms_total{site="stack.hot_delta"} 1' in lines
+        assert 'nomad_transfer_bytes_total{site="odd\\"site\\\\n"} 7' \
+            in lines
+        assert text.endswith("\n")
+        # empty ledger exposes nothing (no dangling TYPE headers)
+        assert TransferLedger().prometheus() == ""
+
+    def test_timeline_counters_reach_registry_exposition(self):
+        from nomad_tpu.lib.transfer import DispatchTimeline
+
+        reg = MetricsRegistry()
+        tl = DispatchTimeline(registry=reg)
+        b = tl.mono_anchor
+        s1 = tl.commit(programs=2, batched=True, pack=(b, b + 0.001),
+                       view=(b + 0.001, b + 0.002),
+                       kernel_start=b + 0.002, transfer_bytes=64,
+                       transfer_count=3)
+        tl.kernel_end(s1, b + 0.004)
+        text = reg.prometheus()
+        assert "# TYPE nomad_pipeline_dispatches counter" in text
+        assert "nomad_pipeline_dispatches 1" in text
+        assert "nomad_pipeline_transfer_bytes 64" in text
+        assert "# TYPE nomad_pipeline_kernel_ms summary" in text
+        assert "# TYPE nomad_pipeline_pack_ms summary" in text
+
+    def test_agent_exposition_carries_ledger_sites(self):
+        """The agent's /v1/metrics?format=prometheus concatenation
+        includes the process ledger's labeled family."""
+        from nomad_tpu.lib.transfer import default_ledger
+
+        default_ledger().record("test.exposition_site", 11)
+        from nomad_tpu.agent import Agent, AgentConfig
+
+        a = Agent(AgentConfig(client=False, heartbeat_ttl=60.0))
+        a.start()
+        try:
+            text = a.metrics_prometheus()
+        finally:
+            a.shutdown()
+        assert ('nomad_transfer_bytes_total{site="test.exposition_site"}'
+                in text)
