@@ -150,6 +150,55 @@ def bench_tpu(state, jobs, stack, count: int, batch: int) -> float:
     return rate
 
 
+def bench_explain(state, jobs, stack, count: int, batch: int = 32,
+                  iters: int = 8):
+    """Explain-overhead A/B on the production fused dispatch
+    (place_packed_chain, the SelectCoordinator's kernel): same packed
+    buffers, explain off vs on, warmed. Reports the wall overhead (the
+    acceptance bar is ≤5%), the extra device→host fetch bytes the
+    attribution leaves add, and whether sel_idx/sel_score stayed
+    bit-identical — "free and honest", measured every round."""
+    import numpy as np
+
+    from nomad_tpu.kernels.placement import pack_params, place_packed_chain
+    from nomad_tpu.parallel import stack_params
+
+    b = min(batch, 32, len(jobs))
+    params = [stack.compile_tg(j, j.task_groups[0], count)[0]
+              for j in jobs[:b]]
+    batched, m = stack_params(params)
+    ibuf, fbuf, ubuf, spec = pack_params(batched)
+    arrays = stack.device_arrays()
+
+    def run(explain):
+        out = place_packed_chain(arrays, ibuf, fbuf, ubuf, spec, m,
+                                 explain=explain)
+        return tuple(np.asarray(x) for x in out)
+
+    base = run(False)  # compile + warm both variants
+    ex = run(True)
+    identical = (np.array_equal(base[0], ex[0])
+                 and np.array_equal(base[1], ex[1]))
+    t0 = time.time()
+    for _ in range(iters):
+        run(False)
+    dt_off = time.time() - t0
+    t0 = time.time()
+    for _ in range(iters):
+        run(True)
+    dt_on = time.time() - t0
+    overhead = 100.0 * (dt_on - dt_off) / dt_off if dt_off else 0.0
+    extra = sum(x.nbytes for x in ex) - sum(x.nbytes for x in base)
+    log(f"explain: {b}-program chain {dt_off / iters * 1e3:.2f} -> "
+        f"{dt_on / iters * 1e3:.2f} ms/dispatch ({overhead:+.1f}%), "
+        f"+{extra}B fetch, bit-identical={identical}")
+    return {
+        "explain_overhead_pct": round(overhead, 2),
+        "explain_extra_fetch_bytes": int(extra),
+        "explain_bit_identical": bool(identical),
+    }
+
+
 def bench_oracle(state, nodes, jobs, stack, count: int, n_evals: int,
                  parity: bool = True):
     """Scalar oracle path (the measured baseline): full-node-scan Select per
@@ -547,10 +596,24 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
     s.start()
     try:
         warm_n = min(32, max(n_evals // 8, 1))
-        jobs = [synth_service_job(
+
+        def _scenario(i: int) -> str:
+            tags = []
+            if i % 2 == 0:
+                tags.append("affinity")
+            if i % 3 == 0:
+                tags.append("spread")
+            if i % 5 == 0:
+                tags.append("distinct-hosts")
+            if i % 4 == 0:
+                tags.append("devices")
+            return "+".join(tags) or "binpack"
+
+        jobs = [(synth_service_job(
             rng, count=count,
             with_affinity=(i % 2 == 0), with_spread=(i % 3 == 0),
-            distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0))
+            distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0)),
+            _scenario(i))
             for i in range(n_evals + warm_n)]
         # warmup: pays the XLA compiles / persistent-cache loads for the
         # program shape buckets so the measured window is steady-state.
@@ -559,7 +622,7 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # compile inside the measured window — on a tunneled TPU that
         # mis-measured e2e by >10x (35 vs 200+ evals/s, round 5)
         t0 = time.time()
-        warm_evs = [s.job_register(job) for job in jobs[:warm_n]]
+        warm_evs = [s.job_register(job) for job, _scen in jobs[:warm_n]]
         for ev in warm_evs:
             if ev is not None:
                 s.wait_for_eval(ev.id,
@@ -579,19 +642,22 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         pipe0 = _pipeline_totals(s.metrics)
         t0 = time.time()
         evals = []
-        for job in jobs:
+        for job, scen in jobs:
             ev = s.job_register(job)
             if ev is not None:
-                evals.append(ev.id)
+                evals.append((ev.id, scen, job.namespace, job.id))
         deadline = time.time() + max(120.0, n_evals * 2.0)
         done = 0
-        for eid in evals:
+        for eid, _scen, _ns, _jid in evals:
             ev = s.wait_for_eval(
                 eid, statuses=("complete", "failed", "blocked", "cancelled"),
                 timeout=max(deadline - time.time(), 0.1))
             if ev is not None:
                 done += 1
         dt = time.time() - t0
+        # attribution reads state per eval — OUTSIDE the measured
+        # window, or the round that adds it reads as an e2e regression
+        attribution = _e2e_attribution(s, evals)
         stats = dict(s.planner.stats)
         view1 = default_registry().counters(prefix="view.")
         pipeline = _pipeline_section(pipe0, _pipeline_totals(s.metrics),
@@ -654,7 +720,55 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # under batch k's kernel, what does each dispatch move over the
         # host↔device link, and WHICH call sites moved it
         "e2e_pipeline": pipeline,
+        # per-scenario placement attribution (kernel-native AllocMetric,
+        # ISSUE 8): which scenario regresses, and WHY — filtered vs
+        # exhausted, by constraint label and resource dimension
+        "e2e_attribution": attribution,
     }
+
+
+def _e2e_attribution(s, evals) -> dict:
+    """bench tail `e2e_attribution`: per-scenario rollup of the
+    kernel-native AllocMetric carried on every device-path placement and
+    failed task group (the ROADMAP item-4 regression-attribution read).
+    `evals` is [(eval_id, scenario, namespace, job_id)]."""
+    out = {}
+    for eid, scen, ns, jid in evals:
+        agg = out.setdefault(scen, {
+            "evals": 0, "placements": 0, "failed_groups": 0,
+            "blocked": 0, "nodes_evaluated": 0, "nodes_filtered": 0,
+            "nodes_exhausted": 0, "dimension_exhausted": {},
+            "constraint_filtered": {}})
+        agg["evals"] += 1
+        ev = s.state.eval_by_id(eid)
+        metrics = []
+        if ev is not None:
+            if ev.status == "blocked" or ev.blocked_eval:
+                agg["blocked"] += 1
+            metrics.extend((ev.failed_tg_allocs or {}).values())
+            agg["failed_groups"] += len(ev.failed_tg_allocs or {})
+        for a in s.state.allocs_by_job(ns, jid):
+            if a.eval_id != eid:
+                continue
+            agg["placements"] += 1
+            metrics.append(a.metrics)
+        for m in metrics:
+            agg["nodes_evaluated"] += m.nodes_evaluated
+            agg["nodes_filtered"] += m.nodes_filtered
+            agg["nodes_exhausted"] += m.nodes_exhausted
+            for dim, n in (m.dimension_exhausted or {}).items():
+                agg["dimension_exhausted"][dim] = \
+                    agg["dimension_exhausted"].get(dim, 0) + n
+            for lab, n in (m.constraint_filtered or {}).items():
+                agg["constraint_filtered"][lab] = \
+                    agg["constraint_filtered"].get(lab, 0) + n
+    for scen, agg in sorted(out.items()):
+        log(f"e2e attribution [{scen}]: {agg['evals']} evals, "
+            f"{agg['placements']} placed, {agg['failed_groups']} failed "
+            f"groups, filtered {agg['nodes_filtered']} exhausted "
+            f"{agg['nodes_exhausted']} "
+            f"dims {agg['dimension_exhausted'] or '{}'}")
+    return out
 
 
 def _pipeline_totals(reg) -> dict:
@@ -809,6 +923,11 @@ def main() -> None:
     state, nodes, jobs, stack = build(n_nodes, n_allocs, n_evals + batch, count)
 
     tpu_rate = bench_tpu(state, jobs, stack, count, batch)
+    try:
+        explain_stats = bench_explain(state, jobs, stack, count)
+    except Exception as e:  # noqa: BLE001 — attribution A/B is additive
+        log(f"explain: A/B failed: {e}")
+        explain_stats = {}
     oracle_rate, parity_stats = bench_oracle(
         state, nodes, jobs, stack, count, oracle_evals, parity=parity)
     compiled_evals = int(os.environ.get(
@@ -852,6 +971,8 @@ def main() -> None:
                 round(compiled_rate["mean_score_sampled"], 4)]
     if parity_stats:
         out.update(parity_stats)
+    if explain_stats:
+        out.update(explain_stats)
 
     if os.environ.get("NOMAD_TPU_BENCH_PROFILE", "0") == "1":
         # roofline/profiling mode: extra dispatches AFTER the measured

@@ -350,6 +350,10 @@ class Agent:
             out["broker_ready"] = self.server.broker.ready_count()
             out["broker_unacked"] = self.server.broker.unacked_count()
             out["blocked_evals"] = self.server.blocked.blocked_count()
+            # live "what is the cluster short of" view: exhausted
+            # dimensions across currently-blocked evals (kernel-native
+            # attribution carried on their failed_tg_allocs)
+            out["blocked_dimensions"] = self.server.blocked.dimension_stats()
             out["plan_apply"] = dict(self.server.planner.stats)
             out["state_index"] = self.server.state.index.value
             reg = getattr(self.server, "metrics", None)

@@ -941,6 +941,28 @@ class HTTPApi:
                 trace["eval_id"] = e.id
                 trace["status"] = e.status
                 return trace
+            if len(parts) > 2 and parts[2] == "placement":
+                # Placement explainability (kernel-native AllocMetric):
+                # per-alloc attribution for everything this eval placed
+                # plus the failed-TG metrics for what it couldn't — the
+                # HTTP face of `structs.AllocMetric` (structs.go:9172),
+                # state-backed (no LRU: metrics live on allocs/evals)
+                placements = [
+                    {"alloc_id": a.id, "task_group": a.task_group,
+                     "node_id": a.node_id, "node_name": a.node_name,
+                     "metrics": to_wire(a.metrics)}
+                    for a in state.allocs_by_job(e.namespace, e.job_id)
+                    if a.eval_id == e.id]
+                return {
+                    "eval_id": e.id,
+                    "status": e.status,
+                    "status_description": e.status_description,
+                    "blocked_eval": e.blocked_eval,
+                    "failed_tg_allocs": {
+                        tg: to_wire(m)
+                        for tg, m in (e.failed_tg_allocs or {}).items()},
+                    "placements": placements,
+                }
             return to_wire(e)
         # /v1/deployments, /v1/deployment/...
         if parts == ["deployments"]:

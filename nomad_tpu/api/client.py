@@ -444,6 +444,20 @@ class NomadClient:
         /v1/evaluation/<id>/trace)."""
         return self._request("GET", f"/v1/evaluation/{eval_id}/trace")
 
+    def evaluation_placement(self, eval_id: str) -> dict:
+        """Placement explainability for one eval (GET
+        /v1/evaluation/<id>/placement): per-alloc AllocMetric — nodes
+        evaluated/filtered/exhausted, per-constraint and per-dimension
+        counts, top-K score breakdown — plus failed-TG metrics.
+        `metrics`/`failed_tg_allocs` values decode to AllocMetric."""
+        out = self._request("GET", f"/v1/evaluation/{eval_id}/placement")
+        out["failed_tg_allocs"] = {
+            tg: from_wire(m)
+            for tg, m in (out.get("failed_tg_allocs") or {}).items()}
+        for p in out.get("placements", []):
+            p["metrics"] = from_wire(p["metrics"])
+        return out
+
     def scheduler_timeline(self, index: int = 0,
                            wait: float = 0.0) -> dict:
         """Dispatch-pipeline records past `index` (GET

@@ -656,7 +656,9 @@ def cmd_eval_trace(args) -> int:
     api = _client(args)
     try:
         tr = api.evaluation_trace(args.eval_id)
-    except ApiError as e:
+    except (ApiError, OSError) as e:
+        # unknown/evicted id (404) or unreachable agent: one-line
+        # error + exit 1, never a traceback
         print(f"Error: {e}", file=sys.stderr)
         return 1
     print(f"Eval   = {tr.get('eval_id', args.eval_id)}")
@@ -664,6 +666,86 @@ def cmd_eval_trace(args) -> int:
     rows = [[s["phase"], f"{s['start_s'] * 1e3:.3f}",
              f"{s['duration_ms']:.3f}"] for s in tr.get("spans", [])]
     print(_columns(rows, ["Phase", "Start (ms)", "Duration (ms)"]))
+    return 0
+
+
+def _fmt_counts(d: dict) -> str:
+    return ", ".join(f"{k}={int(v)}" for k, v in sorted((d or {}).items()))
+
+
+def _print_metric_detail(m, indent: str) -> None:
+    """Shared AllocMetric detail block: filter/exhaustion counts + the
+    ranked top-K score breakdown (one formatter so the failed-placement
+    and -verbose views cannot drift)."""
+    if m.constraint_filtered:
+        print(f"{indent}Filtered by: {_fmt_counts(m.constraint_filtered)}")
+    if m.dimension_exhausted:
+        print(f"{indent}Exhausted dimensions: "
+              f"{_fmt_counts(m.dimension_exhausted)}")
+    for rank, sm in enumerate(m.score_meta):
+        print(f"{indent}#{rank + 1} {sm.node_id[:8]}  "
+              f"norm={sm.norm_score:.4f}  "
+              + " ".join(f"{k}={v:.3f}"
+                         for k, v in sorted(sm.scores.items())
+                         if k != "normalized-score"))
+
+
+def cmd_eval_placement(args) -> int:
+    """`nomad-tpu eval placement <id>`: placement explainability for one
+    evaluation — the kernel-native AllocMetric (nodes evaluated /
+    filtered / exhausted, per-constraint and per-dimension counts, top-K
+    score breakdown) for everything the eval placed or failed to place
+    (the `nomad alloc status -verbose` metrics block, eval-wide)."""
+    from .api import ApiError
+
+    api = _client(args)
+    try:
+        out = api.evaluation_placement(args.eval_id)
+    except (ApiError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(f"Eval    = {out.get('eval_id', args.eval_id)}")
+    print(f"Status  = {out.get('status', '')}")
+    if out.get("status_description"):
+        print(f"Desc    = {out['status_description']}")
+    if out.get("blocked_eval"):
+        print(f"Blocked = {out['blocked_eval']}")
+    failed = out.get("failed_tg_allocs") or {}
+    if failed:
+        print("\nFailed placements:")
+        for tg, m in sorted(failed.items()):
+            print(f"  Group {tg!r}: {m.nodes_evaluated} evaluated, "
+                  f"{m.nodes_filtered} filtered, "
+                  f"{m.nodes_exhausted} exhausted"
+                  + (f", {m.coalesced_failures} more failures coalesced"
+                     if m.coalesced_failures else ""))
+            _print_metric_detail(m, "    ")
+    placements = out.get("placements") or []
+    if placements:
+        rows = []
+        for p in placements:
+            m = p["metrics"]
+            rows.append([p["alloc_id"][:8], p["task_group"],
+                         (p.get("node_name") or p["node_id"][:8]),
+                         str(m.nodes_evaluated), str(m.nodes_filtered),
+                         str(m.nodes_exhausted),
+                         f"{m.score_meta[0].norm_score:.4f}"
+                         if m.score_meta else "-"])
+        print()
+        print(_columns(rows, ["Alloc", "Group", "Node", "Evaluated",
+                              "Filtered", "Exhausted", "Score"]))
+        if getattr(args, "verbose", False):
+            for p in placements:
+                m = p["metrics"]
+                if not (m.score_meta or m.dimension_exhausted
+                        or m.constraint_filtered):
+                    continue
+                print(f"\nAlloc {p['alloc_id'][:8]} "
+                      f"(group {p['task_group']!r}):")
+                _print_metric_detail(m, "  ")
+    if not failed and not placements:
+        print("\nNo placements and no failed task groups recorded "
+              "(no-op eval, or the eval predates explainability)")
     return 0
 
 
@@ -750,7 +832,9 @@ def cmd_operator_timeline(args) -> int:
     try:
         tl = api.scheduler_timeline(index=args.index, wait=args.wait)
         summ = api.scheduler_timeline_summary().get("summary", {})
-    except ApiError as e:
+    except (ApiError, OSError) as e:
+        # timeline-less server (501), bad args, or unreachable agent:
+        # one-line error + exit 1, never a traceback
         print(f"Error: {e}", file=sys.stderr)
         return 1
     if args.json:
@@ -1577,6 +1661,11 @@ def build_parser() -> argparse.ArgumentParser:
     evt = ev.add_parser("trace", help="lifecycle spans for one eval")
     evt.add_argument("eval_id")
     evt.set_defaults(fn=cmd_eval_trace)
+    evp = ev.add_parser("placement",
+                        help="placement explainability for one eval")
+    evp.add_argument("eval_id")
+    evp.add_argument("-verbose", action="store_true")
+    evp.set_defaults(fn=cmd_eval_placement)
 
     aclp = sub.add_parser("acl", help="ACL commands").add_subparsers(
         dest="sub", required=True)

@@ -113,6 +113,9 @@ class AllocRunner:
         # the next transition — observed under CPU load on scale-ups).
         self._status_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        # lifecycle flags: written by destroy()/shutdown() (client
+        # thread), read by the alloc thread's _halted() polls and the
+        # status publisher — guarded by _lock on both sides
         self._destroyed = False
         self._shutting_down = False
         self.client_status = ALLOC_CLIENT_PENDING
@@ -588,7 +591,8 @@ class AllocRunner:
                                        time=time.time(), message=message))
 
     def _halted(self) -> bool:
-        return self._destroyed or self._shutting_down
+        with self._lock:
+            return self._destroyed or self._shutting_down
 
     def _wait_dead(self, runners) -> bool:
         """Wait for runners to die; False when halted first."""
@@ -649,6 +653,7 @@ class AllocRunner:
         with self._status_lock:
             with self._lock:
                 states = list(self.task_states.values())
+                shutting = self._shutting_down
             if not states:
                 status = ALLOC_CLIENT_PENDING
             elif any(s.failed for s in states):
@@ -662,7 +667,7 @@ class AllocRunner:
             self.client_status = status
             if status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED):
                 self.services.stop()
-            if self.on_update is not None and not self._shutting_down:
+            if self.on_update is not None and not shutting:
                 # Fires on every task-state transition (not just status
                 # flips): the server needs restart counts and events too;
                 # the client sync loop coalesces bursts.
@@ -766,7 +771,8 @@ class AllocRunner:
         still-running tasks (alloc_runner.go Shutdown vs Destroy
         distinction; executor tasks survive because the executor plugin
         lives in its own session)."""
-        self._shutting_down = True
+        with self._lock:
+            self._shutting_down = True
         if self.health_tracker is not None:
             self.health_tracker.stop()
         with self._lock:
@@ -775,7 +781,8 @@ class AllocRunner:
             tr.detach()
 
     def destroy(self) -> None:
-        self._destroyed = True
+        with self._lock:
+            self._destroyed = True
         if self.health_tracker is not None:
             self.health_tracker.stop()
         self.services.stop()

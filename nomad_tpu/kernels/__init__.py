@@ -2,7 +2,10 @@
 reference's scalar iterator chain, `scheduler/stack.go:321`)."""
 
 from .placement import (  # noqa: F401
+    EXPLAIN_SCORE_NAMES,
+    EXPLAIN_TOPK,
     ClusterArrays,
+    PlacementExplain,
     PlacementResult,
     TGParams,
     place_task_group,

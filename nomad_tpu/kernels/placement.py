@@ -115,6 +115,48 @@ class TGParams(NamedTuple):
     spread_active: jax.Array     # bool[S]
 
 
+#: top-K score-breakdown width (reference `lib/kheap` capacity used by
+#: AllocMetric.PopulateScoreMetaData — structs.go:9370 keeps 5)
+EXPLAIN_TOPK = 5
+
+#: score components carried per top-K node, in order (reference rank.go
+#: iterator names as they appear in NodeScoreMeta.Scores)
+EXPLAIN_SCORE_NAMES = ("binpack", "job-anti-affinity",
+                       "node-reschedule-penalty", "node-affinity",
+                       "allocation-spread")
+
+
+class PlacementExplain(NamedTuple):
+    """Reduced attribution outputs for one placement program — the
+    device half of `structs.AllocMetric` (structs.go:9172). Everything
+    here is a REDUCTION of masks the kernel already computes: emitting
+    it adds no per-node work beyond a handful of sums and one top_k, so
+    `sel_idx`/`sel_score` are bit-identical with explain on or off
+    (tests/test_explain.py pins this).
+
+    Stage taxonomy mirrors the reference iterator chain: static
+    feasibility first (constraint/class/driver LUT, then the
+    host-evaluated device-plugin/CSI mask), then per-step checks in
+    chain order — distinct_hosts, distinct_property (both "filtered",
+    feasible.go), then rank-time exhaustion (BinPack's resource
+    dimensions in column order, dynamic ports, reserved ports —
+    rank.go:231-320 ranks port-infeasible nodes out as exhausted, not
+    filtered)."""
+
+    nodes_evaluated: jax.Array    # i32 — candidate nodes entering the chain
+    filt_constraint: jax.Array    # i32[C] — evaluated nodes failing LUT row c
+    filt_lut: jax.Array           # i32 — evaluated nodes failing ANY LUT row
+    filt_extra: jax.Array         # i32 — LUT-clean nodes failing extra_mask
+    filt_distinct: jax.Array      # i32[M] — feasible, distinct_hosts collision
+    filt_dp: jax.Array            # i32[M] — feasible, distinct_property full
+    exh_dim: jax.Array            # i32[M, R] — first-exhausted resource column
+    exh_dyn_ports: jax.Array      # i32[M] — resource-fit, dynamic ports short
+    exh_res_ports: jax.Array      # i32[M] — resource-fit, reserved port taken
+    topk_idx: jax.Array           # i32[M, K] — best node rows by masked score
+    topk_score: jax.Array         # f32[M, K] — their normalized final scores
+    topk_parts: jax.Array         # f32[M, K, 5] — EXPLAIN_SCORE_NAMES values
+
+
 class PlacementResult(NamedTuple):
     sel_idx: jax.Array       # i32[M] — chosen node row per alloc, −1 = failed
     sel_score: jax.Array     # f32[M] — normalized score of the chosen node
@@ -122,6 +164,7 @@ class PlacementResult(NamedTuple):
     nodes_feasible: jax.Array  # i32 — nodes passing constraint masks
     nodes_fit: jax.Array     # i32[M] — nodes passing fit per step
     final_scores0: jax.Array  # f32[N] — first step's normalized score vector
+    explain: Optional[PlacementExplain] = None  # set iff explain=True
 
 
 def fit_scores(util: jax.Array, cap: jax.Array
@@ -299,23 +342,46 @@ def _spread_boost(
     return jnp.sum(boost, axis=1)                   # [N]
 
 
-def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
-                     ) -> PlacementResult:
+def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int,
+                     explain: bool = False) -> PlacementResult:
     """Place up to `max_allocs` allocations of one task group.
 
     Pure function: jit/vmap-safe. The scan carry mirrors the plan-relative
     state the reference threads through `ctx.Plan()` (context.go:120).
+
+    `explain` (static) additionally emits PlacementExplain — reduced
+    attribution counters + a top-K score breakdown in the SAME dispatch.
+    The selection math is untouched either way: explain only reduces
+    masks the kernel already computes.
     """
     cap = cluster.capacity
     n = cap.shape[0]
 
     # ---- static (per-group) feasibility, computed once ----
     feas_c = _lut_gather(p.lut, p.key_idx, cluster.attrs)          # [N, C] bool
-    feas = cluster.node_ok & p.extra_mask & jnp.all(feas_c, axis=1)
+    lut_all = jnp.all(feas_c, axis=1)
+    feas = cluster.node_ok & p.extra_mask & lut_all
+    in_cand = None
     if p.cand_idx.shape[0]:
         in_cand = jnp.any(p.cand_idx[:, None] == jnp.arange(n)[None, :],
                           axis=0)
         feas = feas & (in_cand | ~p.use_cand)
+
+    if explain:
+        # candidate base: every node the iterator chain would scan
+        # (sampled mode restricts the scan itself — unscanned nodes are
+        # not "evaluated", matching the reference Limit iterator)
+        base = cluster.node_ok
+        if p.cand_idx.shape[0]:
+            base = base & (in_cand | ~p.use_cand)
+        ex_evaluated = jnp.sum(base.astype(jnp.int32))
+        # per-LUT-row filtered counts (independent per row — padding
+        # rows are all-true and count 0); plus first-fail stage totals
+        ex_filt_constraint = jnp.sum(
+            (~feas_c) & base[:, None], axis=0).astype(jnp.int32)
+        ex_filt_lut = jnp.sum((base & ~lut_all).astype(jnp.int32))
+        ex_filt_extra = jnp.sum(
+            (base & lut_all & ~p.extra_mask).astype(jnp.int32))
 
     aff_vals = _lut_gather(p.aff_lut, p.aff_key_idx, cluster.attrs)  # [N, A] f32
     aff_score = jnp.sum(aff_vals, axis=1) * p.aff_inv_sum            # [N]
@@ -364,15 +430,20 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         penalty = jnp.any(pen_idx[:, None] == jnp.arange(n)[None, :], axis=0)
 
         util = used + p.ask[None, :]                       # [N, R]
-        fits = jnp.all(util <= cap, axis=1)
-        ports_ok = (dyn_free - splaced * p.n_dyn) >= p.n_dyn
-        ports_ok = ports_ok & res_free & ~(has_res_ask & (splaced > 0))
+        res_over = util > cap                              # [N, R]
+        fits = ~jnp.any(res_over, axis=1)
+        dyn_ok = (dyn_free - splaced * p.n_dyn) >= p.n_dyn
+        res_ok = res_free & ~(has_res_ask & (splaced > 0))
+        ports_ok = dyn_ok & res_ok
         fits = fits & ports_ok
         ok = feas & fits
-        ok = ok & ~(p.distinct_hosts & (job_cnt > 0))
+        dh_collide = p.distinct_hosts & (job_cnt > 0)
+        ok = ok & ~dh_collide
 
+        dp_mask = None
         if dcounts.shape[0]:
-            ok = ok & _dp_feasible(dtok, dtok_oh, dcounts, p)
+            dp_mask = _dp_feasible(dtok, dtok_oh, dcounts, p)
+            ok = ok & dp_mask
 
         # ---- fused scoring (rank.go semantics) ----
         binpack, spreadfit = fit_scores(util, cap)
@@ -432,12 +503,57 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
             dcounts = dcounts + dupd
 
         n_fit = jnp.sum((feas & fits).astype(jnp.int32))
-        return (used, job_cnt, tg_cnt, scounts, dcounts, splaced), (
+        ys = (
             sel,
             jnp.where(found, final[idx], 0.0),
             n_fit,
             masked,
         )
+        if explain:
+            # chain-order attribution over masks already computed above:
+            # distinct_hosts / distinct_property are feasibility stages
+            # (filtered); resource/port shortfalls at rank time are
+            # exhaustion (rank.go:231-320 BinPack rank-out)
+            dh_fail = feas & dh_collide
+            dp_fail = jnp.zeros_like(feas)
+            if dcounts.shape[0]:
+                dp_fail = feas & ~dh_fail & ~dp_mask
+            cand_m = feas & ~dh_fail & ~dp_fail
+            any_over = jnp.any(res_over, axis=1)
+            # first-exceeded resource column (AllocsFit reports the
+            # FIRST dimension over, structs/funcs.go:103)
+            ff = jnp.argmax(res_over, axis=1)                  # [N]
+            r_tot = cap.shape[1]
+            ff_oh = (ff[:, None] == jnp.arange(r_tot)[None, :]) \
+                & (cand_m & any_over)[:, None]
+            ex_dim = jnp.sum(ff_oh.astype(jnp.int32), axis=0)  # [R]
+            ex_dyn = jnp.sum((cand_m & ~any_over
+                              & ~dyn_ok).astype(jnp.int32))
+            ex_res = jnp.sum((cand_m & ~any_over & dyn_ok
+                              & ~res_ok).astype(jnp.int32))
+            # top-K score breakdown (the kheap idiom, device-side):
+            # K best masked scores + their per-component values. The
+            # component vectors are the INCLUDED values (0 when a term
+            # did not apply — rank.go's conditional inclusion).
+            k = min(EXPLAIN_TOPK, n)
+            tk_score, tk_idx = jax.lax.top_k(masked, k)
+            parts = jnp.stack(
+                (fit_score,
+                 jnp.where(collide, anti, 0.0),
+                 jnp.where(penalty, -1.0, 0.0),
+                 jnp.where(inc_aff, aff_score, 0.0),
+                 jnp.where(inc_spread, spread_score, 0.0)),
+                axis=1)                                        # [N, 5]
+            tk_oh = (tk_idx[:, None] == jnp.arange(n)[None, :]
+                     ).astype(jnp.float32)                     # [K, N]
+            tk_parts = jnp.einsum("kn,np->kp", tk_oh, parts)   # [K, 5]
+            ys = ys + (
+                jnp.sum(dh_fail.astype(jnp.int32)),
+                jnp.sum(dp_fail.astype(jnp.int32)),
+                ex_dim, ex_dyn, ex_res,
+                tk_idx.astype(jnp.int32), tk_score, tk_parts,
+            )
+        return (used, job_cnt, tg_cnt, scounts, dcounts, splaced), ys
 
     job_cnt0 = _scatter_counts(p.jc_idx, p.jc_val, n)
     tg_cnt0 = _scatter_counts(p.jtc_idx, p.jtc_val, n)
@@ -445,9 +561,26 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
     init = (used0, job_cnt0, tg_cnt0, p.spread_counts0, p.dp_counts0,
             splaced0)
     xs = (jnp.arange(max_allocs), p.penalty_idx, p.preferred_idx)
-    (used_f, _, _, _, _, _), (sels, scores, n_fits, finals) = jax.lax.scan(
-        step, init, xs
-    )
+    (used_f, _, _, _, _, _), ys = jax.lax.scan(step, init, xs)
+    sels, scores, n_fits, finals = ys[:4]
+    ex = None
+    if explain:
+        (filt_dh, filt_dp, ex_dim, ex_dyn, ex_res,
+         tk_idx, tk_score, tk_parts) = ys[4:]
+        ex = PlacementExplain(
+            nodes_evaluated=ex_evaluated,
+            filt_constraint=ex_filt_constraint,
+            filt_lut=ex_filt_lut,
+            filt_extra=ex_filt_extra,
+            filt_distinct=filt_dh,
+            filt_dp=filt_dp,
+            exh_dim=ex_dim,
+            exh_dyn_ports=ex_dyn,
+            exh_res_ports=ex_res,
+            topk_idx=tk_idx,
+            topk_score=tk_score,
+            topk_parts=tk_parts,
+        )
     return PlacementResult(
         sel_idx=sels.astype(jnp.int32),
         sel_score=scores,
@@ -455,13 +588,14 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int
         nodes_feasible=nodes_feasible,
         nodes_fit=n_fits,
         final_scores0=finals[0],
+        explain=ex,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("max_allocs",))
-def place_task_group_jit(cluster: ClusterArrays, p: TGParams, max_allocs: int
-                         ) -> PlacementResult:
-    return place_task_group(cluster, p, max_allocs)
+@functools.partial(jax.jit, static_argnames=("max_allocs", "explain"))
+def place_task_group_jit(cluster: ClusterArrays, p: TGParams, max_allocs: int,
+                         explain: bool = False) -> PlacementResult:
+    return place_task_group(cluster, p, max_allocs, explain=explain)
 
 
 # ---- packed transport ------------------------------------------------------
@@ -529,9 +663,10 @@ def place_packed_batch(cluster: ClusterArrays, i32buf, f32buf, u8buf,
     return r.sel_idx, r.sel_score
 
 
-@functools.partial(jax.jit, static_argnames=("max_allocs",))
+@functools.partial(jax.jit, static_argnames=("max_allocs", "explain"))
 def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
-                           max_allocs: int) -> PlacementResult:
+                           max_allocs: int,
+                           explain: bool = False) -> PlacementResult:
     """Chained batched placement: scan over the program axis carrying
     (used, dyn_free) so program i sees programs 0..i-1's placements.
 
@@ -551,7 +686,7 @@ def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
     def prog(carry, p):
         used, dyn = carry
         cl = cluster._replace(used=used, dyn_free=dyn)
-        r = place_task_group(cl, p, max_allocs)
+        r = place_task_group(cl, p, max_allocs, explain=explain)
         placed = jnp.sum(
             ((r.sel_idx[:, None] == jnp.arange(n)[None, :])
              & (r.sel_idx >= 0)[:, None]).astype(jnp.float32), axis=0)
@@ -562,26 +697,35 @@ def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
     return results
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "max_allocs"))
+@functools.partial(jax.jit,
+                   static_argnames=("spec", "max_allocs", "explain"))
 def place_packed_chain(cluster: ClusterArrays, i32buf, f32buf, u8buf,
-                       spec, max_allocs: int):
+                       spec, max_allocs: int, explain: bool = False):
     """Packed-transport chained placement (the SelectCoordinator's
     dispatch): one buffer per dtype class up, four small arrays down —
     on a tunneled TPU the ~40 per-leaf transfers of an unpacked batched
-    TGParams cost more than the kernel itself (see pack_params)."""
+    TGParams cost more than the kernel itself (see pack_params). With
+    `explain` the PlacementExplain leaves ride the SAME fetch, flattened
+    after the four base outputs (every leaf gains a leading program
+    axis from the chain scan)."""
     batch = _unpack_params(i32buf, f32buf, u8buf, spec)
-    r = place_task_group_chain(cluster, batch, max_allocs)
-    return r.sel_idx, r.sel_score, r.nodes_feasible, r.nodes_fit
+    r = place_task_group_chain(cluster, batch, max_allocs, explain=explain)
+    base = (r.sel_idx, r.sel_score, r.nodes_feasible, r.nodes_fit)
+    if explain:
+        return base + tuple(r.explain)
+    return base
 
 
-@functools.partial(jax.jit, static_argnames=("max_allocs",))
+@functools.partial(jax.jit, static_argnames=("max_allocs", "explain"))
 def place_task_group_batch(cluster: ClusterArrays, batch: TGParams,
-                           max_allocs: int) -> PlacementResult:
+                           max_allocs: int,
+                           explain: bool = False) -> PlacementResult:
     """Batched placement: vmap over independent evaluations against one shared
     snapshot — the TPU analog of the reference's N scheduler workers racing on
     MVCC snapshots (`nomad/worker.go:105`); conflicts are resolved at
     plan-apply exactly as in the reference (`nomad/plan_apply.go:437`)."""
-    fn = functools.partial(place_task_group, max_allocs=max_allocs)
+    fn = functools.partial(place_task_group, max_allocs=max_allocs,
+                           explain=explain)
     return jax.vmap(fn, in_axes=(None, 0))(cluster, batch)
 
 

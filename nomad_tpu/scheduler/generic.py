@@ -143,6 +143,11 @@ class GenericScheduler:
             self.blocked.status_description = BLOCKED_EVAL_MAX_PLAN_DESC
         else:
             self.blocked.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS
+            # carry the failure attribution (dimension_exhausted,
+            # constraint_filtered) onto the blocked eval itself: the
+            # blocked tracker's diagnostics and "why is this stuck"
+            # reads key off it (server/blocked.py dimension_stats)
+            self.blocked.failed_tg_allocs = dict(self.failed_tg_allocs)
         self.planner.create_eval(self.blocked)
 
     # ---- one attempt ----
@@ -277,6 +282,83 @@ class GenericScheduler:
             results.destructive_update, results.place
         )
 
+    def _registry(self):
+        """Metrics registry for scheduler.* counters: the owning server's
+        when scheduling for a real server (EvalContext planner), else the
+        process-global one (harness / tests / bare stacks)."""
+        srv = getattr(self.planner, "server", None)
+        reg = getattr(srv, "metrics", None)
+        if reg is None:
+            from ..lib.metrics import default_registry
+
+            reg = default_registry()
+        return reg
+
+    def _record_explain_metrics(self, ex: dict) -> None:
+        """Fold one select's attribution into the `scheduler.filter.*` /
+        `scheduler.exhausted.*` counter families (go-metrics
+        `nomad.nomad.blocked_evals`-style rollups; Prometheus exposition
+        rides the registry). Dimension keys keep their display names —
+        the exposition layer mangles to [a-z0-9_]."""
+        reg = self._registry()
+        if ex.get("filtered_constraint"):
+            reg.inc("scheduler.filter.constraint", ex["filtered_constraint"])
+        if ex.get("filtered_device_plugin"):
+            reg.inc("scheduler.filter.device_plugin",
+                    ex["filtered_device_plugin"])
+        dh = sum(s["filtered_distinct_hosts"] for s in ex["steps"])
+        dp = sum(s["filtered_distinct_property"] for s in ex["steps"])
+        if dh:
+            reg.inc("scheduler.filter.distinct_hosts", dh)
+        if dp:
+            reg.inc("scheduler.filter.distinct_property", dp)
+        dims: Dict[str, int] = {}
+        for s in ex["steps"]:
+            for dim, n in s["dimension_exhausted"].items():
+                dims[dim] = dims.get(dim, 0) + n
+        for dim, n in dims.items():
+            reg.inc(f"scheduler.exhausted.{dim}", n)
+
+    @staticmethod
+    def _apply_explain(metrics: AllocMetric, ex: dict, step: int) -> None:
+        """Fill one placement's AllocMetric from the kernel attribution
+        (reference: the iterator chain fills these as it walks,
+        feasible.go filter_node / rank.go exhausted_node / kheap score
+        meta — here the fused kernel already counted, so this is a
+        host-side copy, not a recount)."""
+        # the kernel count supersedes the host's per-DC ready count: it
+        # respects sampled-candidate restriction, and the
+        # evaluated−filtered−exhausted arithmetic only closes against
+        # the same taxonomy (DC membership is a counted LUT row here)
+        metrics.nodes_evaluated = ex["nodes_evaluated"]
+        metrics.nodes_filtered = ex["nodes_filtered"]
+        for label, n in ex["constraint_filtered"].items():
+            metrics.constraint_filtered[label] = (
+                metrics.constraint_filtered.get(label, 0) + n)
+        if ex["filtered_device_plugin"]:
+            metrics.constraint_filtered["device-plugin/host checks"] = \
+                ex["filtered_device_plugin"]
+        if step < len(ex["steps"]):
+            s = ex["steps"][step]
+            if s["filtered_distinct_hosts"]:
+                metrics.nodes_filtered += s["filtered_distinct_hosts"]
+                metrics.constraint_filtered["distinct_hosts"] = \
+                    s["filtered_distinct_hosts"]
+            if s["filtered_distinct_property"]:
+                metrics.nodes_filtered += s["filtered_distinct_property"]
+                metrics.constraint_filtered["distinct_property"] = \
+                    s["filtered_distinct_property"]
+            metrics.nodes_exhausted = s["nodes_exhausted"]
+            for dim, n in s["dimension_exhausted"].items():
+                metrics.dimension_exhausted[dim] = (
+                    metrics.dimension_exhausted.get(dim, 0) + n)
+            for entry in s["top_nodes"]:
+                for name, v in entry["scores"].items():
+                    if v != 0.0:
+                        metrics.score_node(entry["node_id"], name, v)
+                metrics.score_node(entry["node_id"], "normalized-score",
+                                   entry["norm_score"])
+
     def _compute_placements(
         self,
         destructive: List[AllocDestructiveResult],
@@ -323,6 +405,8 @@ class GenericScheduler:
             volumes = resolve_volume_asks(self.state, self.job.namespace, tg)
             result = self.stack.select(self.job, tg, len(entries), plan_ctx,
                                        volumes=volumes)
+            if result.explain is not None:
+                self._record_explain_metrics(result.explain)
 
             for i, (p, prev, _dest) in enumerate(entries):
                 node_id = result.node_ids[i]
@@ -331,6 +415,11 @@ class GenericScheduler:
                 metrics = AllocMetric()
                 metrics.nodes_evaluated = n_ready
                 metrics.nodes_available = dict(by_dc)
+                if result.explain is not None:
+                    # kernel-native attribution (same fused dispatch):
+                    # filtered stages, exhausted dimensions, top-K score
+                    # breakdown — for successes AND failures
+                    self._apply_explain(metrics, result.explain, i)
                 if node_id is None and self.preemption_enabled:
                     # Second pass with eviction enabled (reference
                     # selectNextOption, generic_sched.go:720-738)
@@ -351,13 +440,17 @@ class GenericScheduler:
                     if existing is not None:
                         existing.coalesced_failures += 1
                     else:
-                        metrics.nodes_filtered = (
-                            n_ready - result.nodes_feasible
-                        )
-                        metrics.nodes_exhausted = (
-                            result.nodes_feasible - result.nodes_fit[i]
-                            if i < len(result.nodes_fit) else 0
-                        )
+                        if result.explain is None:
+                            # coarse legacy counts when the dispatch ran
+                            # without attribution (NOMAD_TPU_EXPLAIN=0)
+                            metrics.nodes_filtered = (
+                                n_ready - result.nodes_feasible
+                            )
+                            metrics.nodes_exhausted = (
+                                result.nodes_feasible - result.nodes_fit[i]
+                                if i < len(result.nodes_fit) else 0
+                            )
+                        metrics.populate_score_meta()
                         self.failed_tg_allocs[tg.name] = metrics
                     continue
 
@@ -479,8 +572,12 @@ class GenericScheduler:
             if not rows:
                 break
             plan_ctx = self._plan_context_for(tg, [entry])
+            # no attribution on the retry dispatch: only node/score are
+            # consumed here, and the group's main select already
+            # recorded this placement's metrics
             sel = self.stack.select(self.job, tg, 1, plan_ctx,
-                                    volumes=volumes, sampled_rows=rows)
+                                    volumes=volumes, sampled_rows=rows,
+                                    explain=False)
             node_id = sel.node_ids[0]
             if node_id is None:
                 break

@@ -367,6 +367,158 @@ def select_option(
     return best
 
 
+def explain_select(
+    ctx: OracleContext,
+    job: Job,
+    tg: TaskGroup,
+    csi_volumes: Optional[dict] = None,
+    candidates: Optional[List[Node]] = None,
+) -> Dict[str, object]:
+    """Scalar attribution oracle for ONE Select step — the host-side
+    ground truth the kernel's PlacementExplain is pinned against
+    (tests/test_explain.py). Walks the same stage order the kernel
+    counts in: ready → constraint/class/driver/volume LUT stage →
+    distinct_hosts → distinct_property → resource dimensions in column
+    order (cpu, memory, disk, network — first exceeded wins, the
+    AllocsFit convention) → dynamic ports → reserved ports.
+
+    Scope matches the kernel's clean split: jobs with host-evaluated
+    constraints or device asks fold those into the extra mask
+    ("device-plugin/host checks") which this oracle does not model —
+    the parity suite keeps to LUT-expressible scenarios."""
+    from ..structs.network import (MAX_DYNAMIC_PORT, MIN_DYNAMIC_PORT,
+                                   parse_port_ranges)
+
+    combined_constraints = list(job.constraints) + list(tg.constraints)
+    for t in tg.tasks:
+        combined_constraints.extend(t.constraints)
+    drivers = {t.driver for t in tg.tasks}
+    job_distinct = any(
+        c.operand == CONSTRAINT_DISTINCT_HOSTS for c in job.constraints
+    )
+    tg_distinct = any(
+        c.operand == CONSTRAINT_DISTINCT_HOSTS for c in tg.constraints
+    )
+    dp_sets: List[Tuple[str, Optional[float], bool]] = []
+    for c, tg_scope in ([(c, False) for c in job.constraints]
+                        + [(c, True) for c in tg.constraints]):
+        if c.operand != CONSTRAINT_DISTINCT_PROPERTY:
+            continue
+        allowed: Optional[float] = 1.0
+        if c.rtarget:
+            try:
+                allowed = float(int(c.rtarget))
+                if allowed < 0:
+                    allowed = None
+            except ValueError:
+                allowed = None
+        dp_sets.append((c.ltarget, allowed, tg_scope))
+    dp_use_maps = [
+        _dp_use_map(ctx, job, tg, ltarget, tg_scope)
+        for ltarget, _a, tg_scope in dp_sets
+    ]
+    ask = job.combined_task_resources(tg)
+    ask_bw = sum(nw.mbits for nw in tg.networks) + sum(
+        nw.mbits for t in tg.tasks for nw in t.resources.networks
+    )
+    asks = [tg.networks] + [t.resources.networks for t in tg.tasks]
+    n_dyn = sum(len(nw.dynamic_ports) for nets in asks for nw in nets)
+    res_asks = [pt.value for nets in asks for nw in nets
+                for pt in nw.reserved_ports if 0 <= pt.value < 65536]
+
+    out = {
+        "nodes_evaluated": 0,
+        "filtered_constraint": 0,
+        "filtered_distinct_hosts": 0,
+        "filtered_distinct_property": 0,
+        "dimension_exhausted": {},
+    }
+
+    def exhaust(dim: str) -> None:
+        out["dimension_exhausted"][dim] = \
+            out["dimension_exhausted"].get(dim, 0) + 1
+
+    for node in (candidates if candidates is not None else ctx.nodes):
+        if not node.ready():
+            continue
+        out["nodes_evaluated"] += 1
+        # -- constraint/class/driver/volume LUT stage --
+        if (node.datacenter not in job.datacenters
+                or not all(driver_ok(node, d) for d in drivers)
+                or not meets_constraints(node, combined_constraints)
+                or not volumes_ok(node, tg, csi_volumes)):
+            out["filtered_constraint"] += 1
+            continue
+        proposed = ctx.proposed_allocs(node.id)
+        # -- distinct_hosts --
+        if job_distinct or tg_distinct:
+            if any((a.job_id == job.id and job_distinct)
+                   or (a.job_id == job.id and a.task_group == tg.name)
+                   for a in proposed):
+                out["filtered_distinct_hosts"] += 1
+                continue
+        # -- distinct_property --
+        if dp_sets:
+            dp_ok = True
+            for (ltarget, allowed, _s), use in zip(dp_sets, dp_use_maps):
+                if allowed is None:
+                    dp_ok = False
+                    break
+                val, ok = resolve_target(ltarget, node)
+                if not ok or use.get(val, 0) >= allowed:
+                    dp_ok = False
+                    break
+            if not dp_ok:
+                out["filtered_distinct_property"] += 1
+                continue
+        # -- resource dimensions, kernel column order --
+        util = ComparableResources()
+        for a in proposed:
+            util.add(a.comparable_resources())
+        util.cpu += ask.cpu
+        util.memory_mb += ask.memory_mb
+        util.disk_mb += ask.disk_mb
+        available = node.comparable_resources()
+        available.subtract(node.comparable_reserved_resources())
+        used_bw = sum(nw.mbits for a in proposed
+                      for nw in a.comparable_resources().networks)
+        avail_bw = sum(nw.mbits for nw in node.node_resources.networks)
+        dims = (("cpu", util.cpu, available.cpu),
+                ("memory", util.memory_mb, available.memory_mb),
+                ("disk", util.disk_mb, available.disk_mb),
+                ("network", used_bw + ask_bw, avail_bw))
+        over = next((name for name, want, have in dims if want > have),
+                    None)
+        if over is not None:
+            exhaust(over)
+            continue
+        # -- ports: dynamic count first, then reserved values (the
+        # kernel's rank-time order) --
+        used = set(parse_port_ranges(
+            node.reserved_resources.reserved_ports))
+        for a in proposed:
+            ar = a.allocated_resources
+            if ar is None:
+                continue
+            nets = [nw for tr in ar.tasks.values() for nw in tr.networks]
+            nets += list(ar.shared.networks)
+            for nw in nets:
+                for pt in list(nw.reserved_ports) + list(nw.dynamic_ports):
+                    if pt.value >= 0:
+                        used.add(pt.value)
+        if n_dyn:
+            dyn_used = sum(1 for pv in used
+                           if MIN_DYNAMIC_PORT <= pv <= MAX_DYNAMIC_PORT)
+            if (MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1) - dyn_used < n_dyn:
+                exhaust("dynamic-ports")
+                continue
+        if any(pv in used for pv in res_asks):
+            exhaust("reserved-ports")
+            continue
+    out["nodes_exhausted"] = sum(out["dimension_exhausted"].values())
+    return out
+
+
 def _dp_use_map(ctx: OracleContext, job: Job, tg: TaskGroup,
                 ltarget: str, tg_scope: bool) -> Dict[str, int]:
     """Combined distinct_property use map (propertyset.go:250

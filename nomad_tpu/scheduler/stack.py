@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 import threading
 import weakref
 from collections import OrderedDict
@@ -21,7 +22,8 @@ import numpy as np
 
 from ..lib.metrics import default_registry
 
-from ..kernels.placement import ClusterArrays, PlacementResult, TGParams
+from ..kernels.placement import (EXPLAIN_SCORE_NAMES, ClusterArrays,
+                                 PlacementExplain, PlacementResult, TGParams)
 from ..utils import bucket as _shared_bucket, widen_lut
 from ..structs import Allocation, Job, TaskGroup
 from ..structs.job import (CONSTRAINT_DISTINCT_HOSTS,
@@ -64,6 +66,25 @@ class SelectResult:
     nodes_feasible: int
     nodes_fit: List[int]
     raw: PlacementResult = None
+    #: host-shaped attribution (see TPUStack._explain_host) — None when
+    #: the dispatch ran without explain outputs
+    explain: Optional[dict] = None
+
+
+def explain_enabled() -> bool:
+    """Kernel-native placement attribution default: ON (the acceptance
+    bar is that it is free — sel/score bit-identical, ≤5% dispatch
+    overhead); NOMAD_TPU_EXPLAIN=0 opts a deployment out."""
+    return os.environ.get("NOMAD_TPU_EXPLAIN", "1").strip().lower() \
+        not in ("0", "off", "false")
+
+
+#: base resource-dimension display names, column order of the cluster
+#: tensors (tensor/cluster.py R_CPU..R_BW); device columns resolve by
+#: pool name. The strings are AllocMetric.dimension_exhausted keys and
+#: must stay stable — the bench attribution section and the blocked-eval
+#: diagnostics aggregate on them.
+DIMENSION_NAMES = ("cpu", "memory", "disk", "network")
 
 
 #: cluster object → last device upload, keyed per-tensor by sub-version
@@ -160,10 +181,13 @@ class TPUStack:
     """Compiles placement programs and drives the placement kernel."""
 
     def __init__(self, cluster: ClusterTensors, algorithm: str = "binpack",
-                 jit: bool = True) -> None:
+                 jit: bool = True, explain: Optional[bool] = None) -> None:
         self.cluster = cluster
         self.algorithm = algorithm
         self._jit = jit
+        #: emit kernel-native attribution with every dispatch (the
+        #: AllocMetric feed); None defers to NOMAD_TPU_EXPLAIN
+        self.explain = explain_enabled() if explain is None else explain
         #: when set (server/select_batch.py SelectCoordinator), select()
         #: parks its compiled program there and the coordinator fuses the
         #: batch into one chained kernel dispatch
@@ -917,12 +941,20 @@ class TPUStack:
         plan: Optional[PlanContext] = None,
         volumes: Optional[list] = None,
         sampled_rows: Optional[Sequence[int]] = None,
+        explain: Optional[bool] = None,
     ) -> SelectResult:
-        """Place `n_place` allocs of one task group. One kernel dispatch."""
+        """Place `n_place` allocs of one task group. One kernel dispatch.
+
+        `explain` (default: the stack's flag) makes the SAME dispatch
+        emit reduced attribution outputs; SelectResult.explain carries
+        the host-shaped mapping (constraint labels, dimension names,
+        top-K node ids) that AllocMetric population consumes."""
         from ..kernels.placement import place_task_group, place_task_group_jit
 
+        want_ex = self.explain if explain is None else explain
         params, m = self.compile_tg(job, tg, n_place, plan, volumes=volumes,
                                     sampled_rows=sampled_rows)
+        ex_np = None
         if self.coordinator is not None:
             # batched path: park the raw program; the coordinator pads,
             # stacks, and runs ONE chained kernel for the whole eval batch
@@ -931,9 +963,10 @@ class TPUStack:
             # here — under pipelining the previous batch's plans commit
             # between this park and the dispatch, and placing against a
             # park-time snapshot would ignore them.
-            sel, scores, n_feas, n_fit = self.coordinator.select(
+            sel, scores, n_feas, n_fit, ex_np = self.coordinator.select(
                 self.device_arrays, params, n_place,
-                order=getattr(self, "coordinator_order", 0))
+                order=getattr(self, "coordinator_order", 0),
+                explain=want_ex)
             result = None
         else:
             arrays = self.device_arrays()
@@ -947,13 +980,18 @@ class TPUStack:
 
             (params,), _ = pad_params([params])
             if self._jit:
-                result = place_task_group_jit(arrays, _to_device(params), m)
+                result = place_task_group_jit(arrays, _to_device(params), m,
+                                              explain=want_ex)
             else:
-                result = place_task_group(arrays, _to_device(params), m)
+                result = place_task_group(arrays, _to_device(params), m,
+                                          explain=want_ex)
             sel = np.asarray(result.sel_idx)
             scores = np.asarray(result.sel_score)
             n_feas = int(result.nodes_feasible)
             n_fit = np.asarray(result.nodes_fit)
+            if result.explain is not None:
+                ex_np = PlacementExplain(
+                    *(np.asarray(x) for x in result.explain))
         snap_rows = self.cluster.node_of_row
         node_ids: List[Optional[str]] = []
         out_scores: List[float] = []
@@ -961,13 +999,86 @@ class TPUStack:
             row = int(sel[i])
             node_ids.append(snap_rows[row] if row >= 0 else None)
             out_scores.append(float(scores[i]))
+        explain_host = None
+        if ex_np is not None:
+            prog = self._static_program(job, tg, volumes)
+            explain_host = self._explain_host(ex_np, prog["cc"].labels,
+                                              n_place)
         return SelectResult(
             node_ids=node_ids,
             scores=out_scores,
             nodes_feasible=n_feas,
             nodes_fit=[int(x) for x in np.asarray(n_fit)[:n_place]],
             raw=result,
+            explain=explain_host,
         )
+
+    def _dimension_names(self) -> List[str]:
+        """Resource-column display names (AllocMetric.dimension_exhausted
+        keys): the base columns, then registered device pools by name."""
+        names = list(DIMENSION_NAMES) + [
+            f"resource[{i}]" for i in range(len(DIMENSION_NAMES), R_TOTAL)]
+        for pool, col in self.cluster.device_cols.items():
+            names[col] = f"devices: {pool}"
+        return names
+
+    def _explain_host(self, ex: PlacementExplain, labels: Sequence[str],
+                      n_place: int) -> dict:
+        """Numpy PlacementExplain → the host-shaped attribution dict.
+
+        All counts become plain Python ints (the wire codec rejects
+        numpy scalars). Constraint columns beyond `labels` are padding
+        (all-true rows) and always count 0; top-K rows with scores at
+        the mask floor are infeasible tail entries and are dropped."""
+        dim_names = self._dimension_names()
+        rows = self.cluster.node_of_row
+        cfilt = {}
+        for c, label in enumerate(labels):
+            v = int(ex.filt_constraint[c])
+            if v:
+                cfilt[label] = cfilt.get(label, 0) + v
+        steps = []
+        for i in range(min(n_place, int(ex.filt_distinct.shape[0]))):
+            dims = {}
+            for r, name in enumerate(dim_names):
+                v = int(ex.exh_dim[i, r])
+                if v:
+                    dims[name] = v
+            if int(ex.exh_dyn_ports[i]):
+                dims["dynamic-ports"] = int(ex.exh_dyn_ports[i])
+            if int(ex.exh_res_ports[i]):
+                dims["reserved-ports"] = int(ex.exh_res_ports[i])
+            top = []
+            for k in range(ex.topk_idx.shape[1]):
+                score = float(ex.topk_score[i, k])
+                row = int(ex.topk_idx[i, k])
+                if score <= -1e29 or row < 0 or row >= len(rows):
+                    continue  # infeasible tail of the top-K
+                nid = rows[row]
+                if nid is None:
+                    continue
+                top.append({
+                    "node_id": nid,
+                    "norm_score": score,
+                    "scores": {name: float(ex.topk_parts[i, k, j])
+                               for j, name in
+                               enumerate(EXPLAIN_SCORE_NAMES)},
+                })
+            steps.append({
+                "filtered_distinct_hosts": int(ex.filt_distinct[i]),
+                "filtered_distinct_property": int(ex.filt_dp[i]),
+                "nodes_exhausted": sum(dims.values()),
+                "dimension_exhausted": dims,
+                "top_nodes": top,
+            })
+        return {
+            "nodes_evaluated": int(ex.nodes_evaluated),
+            "filtered_constraint": int(ex.filt_lut),
+            "filtered_device_plugin": int(ex.filt_extra),
+            "nodes_filtered": int(ex.filt_lut) + int(ex.filt_extra),
+            "constraint_filtered": cfilt,
+            "steps": steps,
+        }
 
 
 def _sparse_counts(counts: Dict[int, float]) -> Tuple[np.ndarray, np.ndarray]:

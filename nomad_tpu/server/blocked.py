@@ -25,8 +25,12 @@ from .broker import EvalBroker
 
 
 class BlockedEvals:
-    def __init__(self, broker: EvalBroker) -> None:
+    def __init__(self, broker: EvalBroker, registry=None) -> None:
         self.broker = broker
+        #: optional MetricsRegistry: blocked-by-dimension counters land
+        #: in `scheduler.blocked.<dim>` (the monotonic companions to the
+        #: live dimension_stats() view)
+        self.registry = registry
         self._lock = threading.Lock()
         self._enabled = False
         # eval id -> eval (with class_eligibility captured)
@@ -59,6 +63,15 @@ class BlockedEvals:
         with self._lock:
             if not self._enabled:
                 return
+            # count the blocked ATTEMPT's exhausted dimensions up front:
+            # every later path (missed-unblock requeue, system evals,
+            # capture) represents an eval that DID block on these
+            # dimensions, and the monotonic counters must not depend on
+            # which branch returns first (dimension_stats() stays the
+            # live currently-blocked view)
+            if self.registry is not None:
+                for dim, n in _eval_dimensions(eval).items():
+                    self.registry.inc(f"scheduler.blocked.{dim}", n)
             jk = (eval.namespace, eval.job_id)
             existing = self._jobs.get(jk)
             if existing is not None and existing != eval.id:
@@ -175,3 +188,27 @@ class BlockedEvals:
     def blocked_count(self) -> int:
         with self._lock:
             return len(self._captured) + len(self._escaped)
+
+    def dimension_stats(self) -> Dict[str, int]:
+        """LIVE exhausted-dimension view over currently-blocked evals
+        (kernel-native attribution carried on the blocked eval's
+        failed_tg_allocs — scheduler/generic.py _create_blocked_eval):
+        'what is the cluster short of right now'. Unblocked evals drop
+        out automatically because this recomputes from the live maps."""
+        with self._lock:
+            evals = list(self._captured.values()) \
+                + list(self._escaped.values())
+        out: Dict[str, int] = {}
+        for ev in evals:
+            for dim, n in _eval_dimensions(ev).items():
+                out[dim] = out.get(dim, 0) + n
+        return out
+
+
+def _eval_dimensions(eval: Evaluation) -> Dict[str, int]:
+    """Exhausted-dimension counts across an eval's failed task groups."""
+    out: Dict[str, int] = {}
+    for m in (eval.failed_tg_allocs or {}).values():
+        for dim, n in getattr(m, "dimension_exhausted", {}).items():
+            out[dim] = out.get(dim, 0) + int(n)
+    return out

@@ -50,6 +50,13 @@ fatal (tests). Each dispatch commits a record to the server's
 DispatchTimeline — pack/view/kernel intervals plus the overlap/bubble
 metric that says whether batch k+1's pack actually hid under batch
 k's kernel.
+
+Explainability (ISSUE 8): when any program in a dispatch asks for it,
+the chain runs with `explain=True` and the PlacementExplain leaves
+(nodes evaluated / per-stage filtered / per-dimension exhausted /
+top-K score breakdown) ride the SAME lazy `_BatchOut` fetch — one
+device→host transfer, ledger-accounted at `select_batch.fetch`,
+timeline-compatible, and guard-clean like the base outputs.
 """
 from __future__ import annotations
 
@@ -63,10 +70,11 @@ from ..utils import bucket as _bucket
 
 
 class _SelectReq:
-    __slots__ = ("arrays_fn", "params", "n_place", "order", "event", "out",
-                 "err")
+    __slots__ = ("arrays_fn", "params", "n_place", "order", "explain",
+                 "event", "out", "err")
 
-    def __init__(self, arrays_fn, params, n_place: int, order: int) -> None:
+    def __init__(self, arrays_fn, params, n_place: int, order: int,
+                 explain: bool = False) -> None:
         #: zero-arg callable returning the CURRENT device cluster view
         #: (TPUStack.device_arrays) — resolved at dispatch time, because
         #: under pipelining the predecessor batch's plans commit between
@@ -75,6 +83,10 @@ class _SelectReq:
         self.params = params
         self.n_place = n_place
         self.order = order
+        #: request wants PlacementExplain outputs; a fused dispatch runs
+        #: with explain when ANY of its programs asked (the leaves ride
+        #: the shared lazy fetch either way)
+        self.explain = explain
         self.event = threading.Event()
         #: (_BatchOut, program index | None) — the device outputs stay
         #: LAZY until a waiter (or the coordinator's stats pass) first
@@ -155,13 +167,15 @@ class SelectCoordinator:
             self._live -= 1
             self._cv.notify_all()
 
-    def select(self, arrays_fn, params, n_place: int, order: int = 0):
+    def select(self, arrays_fn, params, n_place: int, order: int = 0,
+               explain: bool = False):
         """Park until the coordinator dispatches this program. Returns
         (sel_rows i32[M], scores f32[M], nodes_feasible int,
-        nodes_fit i32[M]). Materialization happens HERE, on the waiter
-        thread — the coordinator releases waiters at kernel launch, so
-        this blocks until the fused chain actually lands."""
-        req = _SelectReq(arrays_fn, params, n_place, order)
+        nodes_fit i32[M], explain PlacementExplain|None — numpy leaves,
+        this program's slice). Materialization happens HERE, on the
+        waiter thread — the coordinator releases waiters at kernel
+        launch, so this blocks until the fused chain actually lands."""
+        req = _SelectReq(arrays_fn, params, n_place, order, explain)
         with self._cv:
             self._parked.append(req)
             self._cv.notify_all()
@@ -169,10 +183,27 @@ class SelectCoordinator:
         if req.err is not None:
             raise req.err
         holder, i = req.out
-        sel, score, feas, fit = holder.resolve()
+        out = holder.resolve()
+        sel, score, feas, fit = out[:4]
+        # a fused dispatch runs with explain when ANY program asked —
+        # but a program that opted out must not receive attribution it
+        # didn't request (its scheduler would record counters the
+        # caller explicitly disabled)
+        ex_leaves = out[4:] if explain else ()
+        ex = None
         if i is None:
-            return sel, score, int(feas), fit
-        return sel[i], score[i], int(feas[i]), fit[i]
+            if ex_leaves:
+                from ..kernels.placement import PlacementExplain
+
+                ex = PlacementExplain(*ex_leaves)
+            return sel, score, int(feas), fit, ex
+        if ex_leaves:
+            from ..kernels.placement import PlacementExplain
+
+            # chained dispatch: every explain leaf has a leading
+            # program axis — slice this program's row
+            ex = PlacementExplain(*(leaf[i] for leaf in ex_leaves))
+        return sel[i], score[i], int(feas[i]), fit[i], ex
 
     # ---- coordinator side (the worker's batch thread) ----
 
@@ -279,6 +310,10 @@ class SelectCoordinator:
 
         for key, reqs in groups.items():
             reqs.sort(key=lambda r: r.order)
+            # one fused dispatch compiles per (spec, m, explain): run
+            # with explain when ANY program in the group asked — the
+            # others just ignore the extra leaves
+            want_ex = any(r.explain for r in reqs)
             if len(reqs) == 1:
                 r = reqs[0]
                 tv = time.perf_counter()
@@ -288,7 +323,7 @@ class SelectCoordinator:
                 self.stats["view_ms"] += (tk - tv) * 1e3
                 self._trace([r], "delta_apply", _mono(tv), _mono(tk))
                 (p,), m = pad_params([r.params])
-                res = place_task_group_jit(arrays, p, m)
+                res = place_task_group_jit(arrays, p, m, explain=want_ex)
                 seq = 0
                 if self.timeline is not None:
                     # zero-length pack: the single path has no packed
@@ -300,10 +335,11 @@ class SelectCoordinator:
                         view=(_mono(tv), _mono(tk)),
                         kernel_start=_mono(tk),
                         transfer_bytes=moved[0], transfer_count=moved[1])
-                r.out = (_BatchOut((res.sel_idx, res.sel_score,
-                                    res.nodes_feasible, res.nodes_fit),
-                                   _kernel_done([r], tk, seq)),
-                         None)
+                dev = (res.sel_idx, res.sel_score,
+                       res.nodes_feasible, res.nodes_fit)
+                if res.explain is not None:
+                    dev = dev + tuple(res.explain)
+                r.out = (_BatchOut(dev, _kernel_done([r], tk, seq)), None)
                 r.event.set()
                 continue
             self.stats["batched"] += len(reqs)
@@ -350,7 +386,7 @@ class SelectCoordinator:
                 self.stats["view_ms"] += (tv - t2) * 1e3
                 self._trace(reqs, "delta_apply", _mono(t2), _mono(tv))
                 dev_out = place_packed_chain(arrays, dibuf, dfbuf, dubuf,
-                                             spec, m)
+                                             spec, m, explain=want_ex)
             seq = 0
             if self.timeline is not None:
                 seq = self.timeline.commit(

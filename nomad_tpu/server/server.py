@@ -127,7 +127,7 @@ class Server:
         self.timeline = DispatchTimeline(self.metrics)
         self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
                                  metrics=self.metrics, tracer=self.tracer)
-        self.blocked = BlockedEvals(self.broker)
+        self.blocked = BlockedEvals(self.broker, registry=self.metrics)
         self.plan_queue = PlanQueue()
         self.planner = PlanApplier(self.state, self.plan_queue,
                                    broker=self.broker,

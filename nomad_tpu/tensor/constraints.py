@@ -132,6 +132,10 @@ class CompiledConstraints:
     needs_host: List[Constraint] = field(default_factory=list)
     distinct_hosts_job: bool = False
     distinct_hosts_tg: bool = False
+    #: display label per LUT row (AllocMetric.constraint_filtered keys —
+    #: the reference renders the failing constraint's string,
+    #: feasible.go:690); len == lut.shape[0]
+    labels: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -178,24 +182,29 @@ def compile_constraints(
     `__driver.<name>` pseudo-key.
     """
     pending: List[Tuple[int, object]] = []  # (key token, fn(value, found))
+    labels: List[str] = []
     needs_host: List[Constraint] = []
     dh_job = False
     dh_tg = False
 
-    def add_lut_row(key: str, fn) -> None:
+    def add_lut_row(key: str, fn, label: str) -> None:
         pending.append((vocab.intern_key(key), fn))
+        labels.append(label)
 
-    def add_poison() -> None:
+    def add_poison(label: str) -> None:
         # Constant-false: an always-false row on a dummy key
         pending.append((vocab.intern_key("node.datacenter"),
                         lambda v, found: False))
+        labels.append(label)
 
     if datacenters is not None:
         dcs = set(datacenters)
-        add_lut_row("node.datacenter", lambda v, found: found and v in dcs)
+        add_lut_row("node.datacenter", lambda v, found: found and v in dcs,
+                    "datacenter")
 
     for drv in drivers or ():
-        add_lut_row(f"__driver.{drv}", lambda v, found: found and v == "1")
+        add_lut_row(f"__driver.{drv}", lambda v, found: found and v == "1",
+                    f"missing drivers: {drv}")
 
     # Volume feasibility rows (HostVolumeChecker feasible.go:117,
     # CSIVolumeChecker feasible.go:194 — the per-node half). Entries:
@@ -208,12 +217,14 @@ def compile_constraints(
             add_lut_row(
                 f"__volume.host.{name}",
                 lambda v, found, ro=ro: found and (v == "rw"
-                                                   or (ro and v == "ro")))
+                                                   or (ro and v == "ro")),
+                f"missing host volume: {name}")
         elif kind == "csi":
             add_lut_row(f"__plugin.csi.{name}",
-                        lambda v, found: found and v == "1")
+                        lambda v, found: found and v == "1",
+                        f"missing CSI plugin: {name}")
         else:  # missing volume: poison
-            add_poison()
+            add_poison(f"missing volume: {name}")
 
     for c in constraints:
         if c.operand == CONSTRAINT_DISTINCT_HOSTS:
@@ -223,6 +234,7 @@ def compile_constraints(
             # enforced by the scheduler stack's dp program
             # (stack.py _dp_program / kernel dp_counts), not a LUT row
             continue
+        clabel = f"{c.ltarget} {c.operand} {c.rtarget}".strip()
         key = target_to_key(c.ltarget)
         rkey = target_to_key(c.rtarget)
         if rkey is not None:
@@ -233,18 +245,19 @@ def compile_constraints(
             # Literal LTarget: constant verdict — fold in as a 0-or-all row
             verdict = check_constraint(c.operand, c.ltarget, c.rtarget, True, True)
             if not verdict:
-                add_poison()
+                add_poison(clabel)
             continue
         if key == "__unresolvable__":
             verdict = check_constraint(c.operand, None, c.rtarget, False, True)
             if not verdict:
-                add_poison()
+                add_poison(clabel)
             continue
         add_lut_row(
             key,
             lambda v, found, op=c.operand, r=c.rtarget: check_constraint(
                 op, v, r, found, True
             ),
+            clabel,
         )
 
     width = _program_width(vocab, [k for k, _ in pending], lut_bucket)
@@ -264,6 +277,7 @@ def compile_constraints(
         lut=lut,
         needs_host=needs_host,
         distinct_hosts_job=dh_job,
+        labels=labels,
     )
 
 

@@ -1,0 +1,578 @@
+"""Kernel-native placement explainability (ISSUE 8).
+
+Three contracts:
+
+1. FREE — `sel_idx`/`sel_score` are bit-identical with explain on vs
+   off, on both the direct jit path and the production packed-chain
+   dispatch (the attribution is reductions of masks the kernel already
+   computes; turning it on must not perturb selection).
+2. HONEST — the kernel's PlacementExplain counts agree with the scalar
+   oracle's stage walk (`oracle.explain_select`) on the kernel-parity
+   scenarios: per-stage filtered counts, per-dimension exhaustion in
+   column order, rank-time port exhaustion split dyn/reserved.
+3. SURFACED — every device-path placement and blocked eval carries a
+   real AllocMetric end to end: scheduler harness, blocked tracker,
+   HTTP `/v1/evaluation/:id/placement`, SDK, CLI (`eval placement`),
+   and the scheduler.filter.*/scheduler.exhausted.* counters.
+"""
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.oracle import (OracleContext, explain_select,
+                                        select_option)
+from nomad_tpu.scheduler.stack import TPUStack
+from nomad_tpu.structs import Constraint, NetworkResource, Port
+
+from test_kernel_parity import make_cluster, placed_alloc, seed_allocs
+
+SEED = 7
+
+
+# ---- 1. free: bit-identity ------------------------------------------------
+
+
+class TestBitIdentity:
+    def _setup(self, n_nodes=24, n_place=3):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(n_nodes, rng)
+        job = mock.job()
+        other = mock.job()
+        seed_allocs(cl, nodes, [job, other], rng, 16)
+        stack = TPUStack(cl)
+        return cl, stack, job, n_place
+
+    def test_direct_jit_bit_identical(self):
+        from nomad_tpu.kernels.placement import place_task_group_jit
+        from nomad_tpu.parallel.mesh import pad_params
+
+        cl, stack, job, n_place = self._setup()
+        params, m = stack.compile_tg(job, job.task_groups[0], n_place)
+        (params,), _ = pad_params([params])
+        arrays = stack.device_arrays()
+        off = place_task_group_jit(arrays, params, m)
+        on = place_task_group_jit(arrays, params, m, explain=True)
+        assert np.array_equal(np.asarray(off.sel_idx),
+                              np.asarray(on.sel_idx))
+        # bit-identical, not allclose: same float words
+        assert np.asarray(off.sel_score).tobytes() == \
+            np.asarray(on.sel_score).tobytes()
+        assert off.explain is None and on.explain is not None
+
+    def test_packed_chain_bit_identical(self):
+        from nomad_tpu.kernels.placement import (pack_params,
+                                                 place_packed_chain)
+        from nomad_tpu.parallel.mesh import stack_params
+
+        cl, stack, job, n_place = self._setup()
+        jobs = [job, mock.job(), mock.job()]
+        params = [stack.compile_tg(j, j.task_groups[0], n_place)[0]
+                  for j in jobs]
+        batched, m = stack_params(params)
+        ibuf, fbuf, ubuf, spec = pack_params(batched)
+        arrays = stack.device_arrays()
+        off = place_packed_chain(arrays, ibuf, fbuf, ubuf, spec, m)
+        on = place_packed_chain(arrays, ibuf, fbuf, ubuf, spec, m,
+                                explain=True)
+        assert np.asarray(off[0]).tobytes() == np.asarray(on[0]).tobytes()
+        assert np.asarray(off[1]).tobytes() == np.asarray(on[1]).tobytes()
+        assert len(off) == 4 and len(on) > 4
+        # explain leaves carry the chained program axis
+        from nomad_tpu.kernels.placement import PlacementExplain
+
+        ex = PlacementExplain(*on[4:])
+        assert ex.nodes_evaluated.shape[0] == len(jobs)
+
+    def test_topk_matches_final_scores(self):
+        """topk_score must be the descending top-K of the masked score
+        vector the kernel already returns (final_scores0)."""
+        from nomad_tpu.kernels.placement import place_task_group_jit
+        from nomad_tpu.parallel.mesh import pad_params
+
+        cl, stack, job, n_place = self._setup()
+        params, m = stack.compile_tg(job, job.task_groups[0], n_place)
+        (params,), _ = pad_params([params])
+        on = place_task_group_jit(stack.device_arrays(), params, m,
+                                  explain=True)
+        finals = np.asarray(on.final_scores0)
+        want = np.sort(finals)[::-1][: on.explain.topk_score.shape[1]]
+        got = np.asarray(on.explain.topk_score)[0]
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+# ---- 2. honest: kernel vs oracle ------------------------------------------
+
+
+def _oracle_ctx(cl, nodes, seeded):
+    abn = {}
+    for a in seeded:
+        abn.setdefault(a.node_id, []).append(a)
+    return OracleContext(nodes=nodes, allocs_by_node=abn)
+
+
+class TestExplainOracleParity:
+    """Kernel PlacementExplain vs oracle explain_select — same stage
+    taxonomy, same counts (device-path AllocMetric == host oracle's)."""
+
+    def _compare(self, ex_host, want, step=0):
+        s = ex_host["steps"][step]
+        assert ex_host["nodes_evaluated"] == want["nodes_evaluated"]
+        assert ex_host["filtered_constraint"] == want["filtered_constraint"]
+        assert ex_host["filtered_device_plugin"] == 0
+        assert s["filtered_distinct_hosts"] == \
+            want["filtered_distinct_hosts"]
+        assert s["filtered_distinct_property"] == \
+            want["filtered_distinct_property"]
+        assert s["dimension_exhausted"] == want["dimension_exhausted"]
+        assert s["nodes_exhausted"] == want["nodes_exhausted"]
+
+    def _run(self, job, n_nodes=24, n_seed=16, n_place=1, mutate=None):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(n_nodes, rng)
+        if mutate:
+            mutate(nodes, cl)
+        other = mock.job()
+        seeded = seed_allocs(cl, nodes, [job, other], rng, n_seed)
+        stack = TPUStack(cl)
+        tg = job.task_groups[0]
+        res = stack.select(job, tg, n_place)
+        assert res.explain is not None
+        ctx = _oracle_ctx(cl, nodes, seeded)
+        for i in range(n_place):
+            want = explain_select(ctx, job, tg)
+            self._compare(res.explain, want, step=i)
+            # feed the kernel's choice so later steps see the same
+            # evolving plan (the parity-suite idiom)
+            got = res.node_ids[i]
+            if got is not None:
+                ctx.plan_node_alloc.setdefault(got, []).append(
+                    placed_alloc(job, tg, got))
+        return res
+
+    def test_no_filtering(self):
+        self._run(mock.job())
+
+    def test_constraint_filtered(self):
+        job = mock.job()
+        job.constraints.append(Constraint("${attr.rack}", "r1", "="))
+        self._run(job)
+
+    def test_datacenter_filtered(self):
+        job = mock.job()
+        job.datacenters = ["dc2"]
+
+        def mutate(nodes, cl):
+            for n in nodes[:5]:
+                n.datacenter = "dc2"
+                cl.upsert_node(n)
+
+        self._run(job, mutate=mutate)
+
+    def test_cpu_exhaustion_multi_step(self):
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.cpu = 3500
+
+        def mutate(nodes, cl):
+            for n in nodes:
+                n.node_resources.cpu = 4000
+                cl.upsert_node(n)
+
+        self._run(job, n_place=3, mutate=mutate)
+
+    def test_memory_exhaustion(self):
+        job = mock.job()
+        job.task_groups[0].tasks[0].resources.memory_mb = 100_000
+        self._run(job)
+
+    def test_distinct_hosts_filtered(self):
+        job = mock.job()
+        job.constraints.append(Constraint("", "", "distinct_hosts"))
+        self._run(job, n_nodes=8, n_seed=20, n_place=2)
+
+    def test_distinct_property_filtered(self):
+        job = mock.job()
+        job.constraints.append(
+            Constraint("${attr.rack}", "", "distinct_property"))
+        self._run(job, n_nodes=12, n_seed=0, n_place=3)
+
+    def test_reserved_port_exhaustion(self):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(4, rng)
+        other = mock.job()
+        held = []
+        for n in nodes[:3]:
+            a = mock.alloc(job=other)
+            a.job_id = other.id
+            a.node_id = n.id
+            a.client_status = "running"
+            a.allocated_resources = mock.alloc_resources(
+                networks=[NetworkResource(
+                    ip=n.node_resources.networks[0].ip, mbits=1,
+                    reserved_ports=[Port("http", 8080)])])
+            cl.upsert_alloc(a)
+            held.append(a)
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.networks = [NetworkResource(
+            mbits=1, reserved_ports=[Port("http", 8080)])]
+        res = TPUStack(cl).select(job, tg, 1)
+        ctx = _oracle_ctx(cl, nodes, held)
+        want = explain_select(ctx, job, tg)
+        assert want["dimension_exhausted"] == {"reserved-ports": 3}
+        self._compare(res.explain, want)
+
+    def test_dynamic_port_exhaustion(self):
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(2, rng)
+        nodes[0].reserved_resources.reserved_ports = "20000-32000"
+        cl.upsert_node(nodes[0])
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.networks = [NetworkResource(
+            mbits=1, dynamic_ports=[Port("rpc", 0)])]
+        res = TPUStack(cl).select(job, tg, 1)
+        ctx = _oracle_ctx(cl, nodes, [])
+        want = explain_select(ctx, job, tg)
+        assert want["dimension_exhausted"] == {"dynamic-ports": 1}
+        self._compare(res.explain, want)
+
+    def test_constraint_labels_name_the_filter(self):
+        job = mock.job()
+        job.constraints.append(Constraint("${attr.rack}", "r1", "="))
+        res = self._run(job)
+        labels = set(res.explain["constraint_filtered"])
+        assert "${attr.rack} = r1" in labels
+        total = sum(res.explain["constraint_filtered"].values())
+        assert total >= res.explain["filtered_constraint"]
+
+
+# ---- 2b. coordinator path -------------------------------------------------
+
+
+class TestCoordinatorExplain:
+    def test_fused_batch_carries_explain(self):
+        """The batched SelectCoordinator dispatch returns per-program
+        explain slices identical in shape/meaning to the direct path."""
+        from nomad_tpu.server.select_batch import SelectCoordinator
+
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(12, rng)
+        jobs = [mock.job() for _ in range(3)]
+        jobs[1].constraints.append(Constraint("${attr.rack}", "r1", "="))
+        coord = SelectCoordinator()
+        results = {}
+
+        def one(i, job):
+            stack = TPUStack(cl)
+            stack.coordinator = coord
+            stack.coordinator_order = i
+            try:
+                results[i] = stack.select(job, job.task_groups[0], 1)
+            finally:
+                coord.thread_done()
+
+        threads = []
+        for i, j in enumerate(jobs):
+            coord.add_thread()
+            threads.append(threading.Thread(target=one, args=(i, j),
+                                            daemon=True))
+        for t in threads:
+            t.start()
+        coord.run()
+        for t in threads:
+            t.join(30.0)
+        assert coord.stats["batched"] == 3
+        for i, job in enumerate(jobs):
+            ex = results[i].explain
+            assert ex is not None
+            assert ex["nodes_evaluated"] == 12
+        # the constrained program sees its own filtering, siblings none
+        assert results[1].explain["filtered_constraint"] > 0
+        assert results[0].explain["filtered_constraint"] == 0
+        # and the batched counts agree with a solo dispatch of the same
+        # program against the same snapshot
+        solo = TPUStack(cl).select(jobs[1], jobs[1].task_groups[0], 1)
+        assert solo.explain["filtered_constraint"] == \
+            results[1].explain["filtered_constraint"]
+        assert solo.explain["constraint_filtered"] == \
+            results[1].explain["constraint_filtered"]
+
+    def test_opted_out_program_gets_no_explain_in_mixed_batch(self):
+        """A program that opted out must not receive attribution just
+        because a batch-mate asked for it (its scheduler would record
+        counters the caller explicitly disabled)."""
+        from nomad_tpu.server.select_batch import SelectCoordinator
+
+        rng = random.Random(SEED)
+        cl, nodes = make_cluster(8, rng)
+        jobs = [mock.job(), mock.job()]
+        coord = SelectCoordinator()
+        results = {}
+
+        def one(i, job, want):
+            stack = TPUStack(cl, explain=want)
+            stack.coordinator = coord
+            stack.coordinator_order = i
+            try:
+                results[i] = stack.select(job, job.task_groups[0], 1)
+            finally:
+                coord.thread_done()
+
+        threads = []
+        for i, (j, want) in enumerate(zip(jobs, (True, False))):
+            coord.add_thread()
+            threads.append(threading.Thread(target=one, args=(i, j, want),
+                                            daemon=True))
+        for t in threads:
+            t.start()
+        coord.run()
+        for t in threads:
+            t.join(30.0)
+        assert coord.stats["batched"] == 2
+        assert results[0].explain is not None
+        assert results[1].explain is None
+
+
+# ---- 3. surfaced: AllocMetric end to end ----------------------------------
+
+
+class TestAllocMetricPopulation:
+    def _harness(self, n_nodes=8, n_allocs=4, seed=5):
+        from nomad_tpu.scheduler.harness import Harness
+        from nomad_tpu.synth import build_synthetic_state
+
+        state, nodes = build_synthetic_state(n_nodes, n_allocs, seed=seed)
+        return Harness(state=state), state, nodes
+
+    def _eval(self, job):
+        from nomad_tpu.structs import Evaluation
+
+        return Evaluation(namespace=job.namespace, job_id=job.id,
+                          type="service", triggered_by="job-register",
+                          status="pending")
+
+    def test_placed_alloc_carries_score_breakdown(self):
+        import random as _r
+
+        from nomad_tpu.synth import synth_service_job
+
+        h, state, nodes = self._harness()
+        job = synth_service_job(_r.Random(1), count=2, with_affinity=True)
+        state.upsert_job(job)
+        h.process(self._eval(job))
+        allocs = [a for v in h.plans[-1].node_allocation.values()
+                  for a in v]
+        assert allocs
+        for a in allocs:
+            m = a.metrics
+            assert m.nodes_evaluated == 8
+            assert m.score_meta, "top-K score breakdown missing"
+            # descending, selected node present with normalized-score
+            norms = [sm.norm_score for sm in m.score_meta]
+            assert norms == sorted(norms, reverse=True)
+            assert any("binpack" in sm.scores for sm in m.score_meta)
+
+    def test_failed_placement_reports_dimension(self):
+        import random as _r
+
+        from nomad_tpu.synth import synth_service_job
+
+        h, state, nodes = self._harness()
+        job = synth_service_job(_r.Random(2), count=1)
+        job.task_groups[0].tasks[0].resources.cpu = 10**7
+        state.upsert_job(job)
+        h.process(self._eval(job))
+        failed = {}
+        for e in h.evals:
+            failed.update(e.failed_tg_allocs or {})
+        assert failed
+        m = next(iter(failed.values()))
+        assert m.nodes_exhausted == 8
+        assert m.dimension_exhausted == {"cpu": 8}
+        assert m.nodes_filtered == 0
+
+    def test_scheduler_counters_recorded(self):
+        import random as _r
+
+        from nomad_tpu.lib.metrics import default_registry
+        from nomad_tpu.synth import synth_service_job
+
+        reg = default_registry()
+        before = reg.counters(prefix="scheduler.exhausted.").get("cpu", 0)
+        h, state, nodes = self._harness()
+        job = synth_service_job(_r.Random(3), count=1)
+        job.task_groups[0].tasks[0].resources.cpu = 10**7
+        state.upsert_job(job)
+        h.process(self._eval(job))
+        after = reg.counters(prefix="scheduler.exhausted.").get("cpu", 0)
+        assert after - before == 8
+
+    def test_explain_off_keeps_legacy_counts(self, monkeypatch):
+        import random as _r
+
+        from nomad_tpu.synth import synth_service_job
+
+        monkeypatch.setenv("NOMAD_TPU_EXPLAIN", "0")
+        h, state, nodes = self._harness()
+        job = synth_service_job(_r.Random(4), count=1)
+        job.task_groups[0].tasks[0].resources.cpu = 10**7
+        state.upsert_job(job)
+        h.process(self._eval(job))
+        failed = {}
+        for e in h.evals:
+            failed.update(e.failed_tg_allocs or {})
+        m = next(iter(failed.values()))
+        # coarse counts survive the opt-out; attribution dicts are empty
+        assert m.nodes_exhausted == 8
+        assert not m.dimension_exhausted
+
+
+def _wait(cond, timeout=15.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+@pytest.fixture()
+def agent(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api import NomadClient
+
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+    yield a, api
+    a.shutdown()
+
+
+def _mock_job(cpu=100, count=1):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    t = tg.tasks[0]
+    t.driver = "mock_driver"
+    t.config = {"run_for": 0.05}
+    t.resources.cpu = cpu
+    return job
+
+
+class TestBlockedEvalExhaustionE2E:
+    """Satellite: a saturated cluster blocks an eval whose status
+    reports the exhausted dimension end-to-end — broker → scheduler →
+    blocked tracker → HTTP/SDK/CLI."""
+
+    def test_blocked_eval_reports_dimension(self, agent):
+        a, api = agent
+        job = _mock_job(cpu=10**7)
+        eval_id = api.register_job(job)
+        ev = api.wait_for_eval(eval_id)
+        assert ev.status == "complete"
+        assert ev.failed_tg_allocs
+        m = next(iter(ev.failed_tg_allocs.values()))
+        assert m.dimension_exhausted.get("cpu") == 1
+        assert ev.blocked_eval
+
+        # blocked eval carries the attribution too (broker → blocked)
+        blocked = api.evaluation(ev.blocked_eval)
+        assert blocked.status == "blocked"
+        bm = next(iter(blocked.failed_tg_allocs.values()))
+        assert bm.dimension_exhausted.get("cpu") == 1
+
+        # blocked tracker live diagnostics + metrics surface
+        assert a.server.blocked.dimension_stats().get("cpu", 0) >= 1
+        metrics = api.metrics()
+        assert metrics["blocked_dimensions"].get("cpu", 0) >= 1
+        # monotonic counter families with Prometheus exposition
+        text = api.metrics_prometheus()
+        assert "nomad_scheduler_exhausted_cpu" in text
+        assert "nomad_scheduler_blocked_cpu" in text
+
+        # /placement endpoint (SDK decode): failure attribution
+        out = api.evaluation_placement(eval_id)
+        fm = next(iter(out["failed_tg_allocs"].values()))
+        assert fm.dimension_exhausted.get("cpu") == 1
+        assert out["blocked_eval"] == ev.blocked_eval
+        assert out["placements"] == []
+
+    def test_placement_endpoint_for_successful_eval(self, agent):
+        a, api = agent
+        job = _mock_job(cpu=50, count=2)
+        eval_id = api.register_job(job)
+        ev = api.wait_for_eval(eval_id)
+        assert ev.status == "complete"
+        out = api.evaluation_placement(eval_id)
+        assert len(out["placements"]) == 2
+        for p in out["placements"]:
+            m = p["metrics"]
+            assert m.nodes_evaluated == 1
+            assert m.score_meta
+            assert m.score_meta[0].norm_score == pytest.approx(
+                m.score_meta[0].scores["normalized-score"])
+
+    def test_placement_endpoint_404(self, agent):
+        from nomad_tpu.api import ApiError
+
+        a, api = agent
+        with pytest.raises(ApiError):
+            api.evaluation_placement("no-such-eval")
+
+
+class TestCliRobustness:
+    """Satellite: `eval trace`, `eval placement`, `operator timeline`
+    exit 1 with a one-line error on unknown/missing ids or an
+    unreachable agent — never a traceback."""
+
+    def _run(self, addr, *argv):
+        import io
+        import sys as _sys
+
+        from nomad_tpu.cli import main
+
+        out, err = io.StringIO(), io.StringIO()
+        old = _sys.stdout, _sys.stderr
+        _sys.stdout, _sys.stderr = out, err
+        try:
+            rc = main(["-address", addr, *argv])
+        finally:
+            _sys.stdout, _sys.stderr = old
+        return rc, out.getvalue(), err.getvalue()
+
+    def test_unknown_ids_exit_one(self, agent):
+        a, api = agent
+        addr = f"{a.http_addr[0]}:{a.http_addr[1]}"
+        for argv in (("eval", "trace", "nope"),
+                     ("eval", "placement", "nope")):
+            rc, out, err = self._run(addr, *argv)
+            assert rc == 1, argv
+            assert err.startswith("Error:"), argv
+            assert "Traceback" not in err
+
+    def test_unreachable_agent_exits_one(self):
+        # nothing listens on this port: connection errors must be a
+        # one-line error, not an OSError traceback
+        for argv in (("eval", "trace", "x"),
+                     ("eval", "placement", "x"),
+                     ("operator", "timeline")):
+            rc, out, err = self._run("127.0.0.1:1", *argv)
+            assert rc == 1, argv
+            assert err.startswith("Error:"), argv
+
+    def test_eval_placement_happy_path(self, agent):
+        a, api = agent
+        addr = f"{a.http_addr[0]}:{a.http_addr[1]}"
+        job = _mock_job(cpu=10**7)
+        eval_id = api.register_job(job)
+        api.wait_for_eval(eval_id)
+        rc, out, err = self._run(addr, "eval", "placement", eval_id)
+        assert rc == 0, err
+        assert "cpu=1" in out
+        assert "Failed placements:" in out

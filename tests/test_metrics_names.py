@@ -1,0 +1,202 @@
+"""Prometheus series-name stability (ISSUE 8 satellite).
+
+Dashboards and alert rules key on metric/label NAMES; a rename ships a
+silent observability outage. This test drives one representative
+control-plane flow (batched fused dispatch, a successful placement, a
+constraint-filtered failure, a dimension-exhausted blocked eval) and
+snapshots every exposed series name:
+
+- REQUIRED names must all be present — renaming any of them fails here
+  DELIBERATELY (update the frozen list in the same PR as the rename).
+- every observed name must belong to an ALLOWED family — a brand-new
+  family must be added here consciously, not leak in silently.
+- label names (and the transfer ledger's site values) are pinned too.
+"""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+
+
+def _wait(cond, timeout=20.0, every=0.05):
+    dl = time.time() + timeout
+    while time.time() < dl:
+        if cond():
+            return True
+        time.sleep(every)
+    return cond()
+
+
+#: every series name the repo PROMISES (post-mangle, nomad_ prefix).
+#: Renaming any of these must be a deliberate, reviewed act.
+REQUIRED = {
+    # broker (eval_broker.go stats)
+    "nomad_broker_enqueued", "nomad_broker_dequeued", "nomad_broker_acked",
+    "nomad_broker_nacked", "nomad_broker_failed", "nomad_broker_requeued",
+    # plan applier
+    "nomad_plan_apply_applied", "nomad_plan_apply_partial",
+    "nomad_plan_apply_rejected_nodes", "nomad_plan_apply_stale_token",
+    "nomad_plan_apply_inline", "nomad_plan_apply_apply_ms",
+    # eval-lifecycle phase histograms (lib/trace.py taxonomy)
+    "nomad_eval_phase_schedule_ms", "nomad_eval_phase_plan_apply_ms",
+    # device-view delta refresh (scheduler/stack.py)
+    "nomad_view_upload_bytes", "nomad_view_full_uploads",
+    "nomad_view_hot_log_len", "nomad_view_ports_log_len",
+    # transfer ledger mirrors + labeled per-site exposition
+    "nomad_transfer_bytes", "nomad_transfer_count", "nomad_transfer_ms",
+    "nomad_transfer_bytes_total", "nomad_transfer_count_total",
+    "nomad_transfer_ms_total",
+    # dispatch pipeline (lib/transfer.DispatchTimeline)
+    "nomad_pipeline_dispatches", "nomad_pipeline_programs",
+    "nomad_pipeline_transfer_bytes", "nomad_pipeline_transfer_count",
+    # scheduler explainability counters (ISSUE 8)
+    "nomad_scheduler_filter_constraint",
+    "nomad_scheduler_exhausted_cpu",
+    "nomad_scheduler_blocked_cpu",
+}
+
+#: every family a series may legally belong to; a new prefix here is a
+#: conscious taxonomy extension
+ALLOWED_PREFIXES = (
+    "nomad_broker_",
+    "nomad_plan_apply_",
+    "nomad_eval_phase_",
+    "nomad_worker_",          # worker.<id>.batch.* coordinator stats
+    "nomad_pipeline_",
+    "nomad_view_",
+    "nomad_transfer_",
+    "nomad_scheduler_filter_",
+    "nomad_scheduler_exhausted_",
+    "nomad_scheduler_blocked_",
+    "nomad_rpc_",             # rpc.client.* transport latencies
+    "nomad_loop_errors_",     # ErrorStreak sinks
+)
+
+#: the only label names any exposed series may carry
+ALLOWED_LABELS = {"site", "quantile"}
+
+#: the transfer ledger's site vocabulary (the `site` label values) —
+#: renames here break `top_sites` dashboards exactly like metric renames
+ALLOWED_SITES = {
+    "stack.static_full", "stack.hot_full", "stack.hot_delta",
+    "stack.ports_full", "stack.ports_delta",
+    "select_batch.pack_buffers", "select_batch.fetch",
+    "mesh.shard_cluster",
+}
+
+
+def _parse(text):
+    """-> (names, label_names, site_values) from exposition text."""
+    names, labels, sites = set(), set(), set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        series = line.split(" ")[0]
+        if "{" in series:
+            name, rest = series.split("{", 1)
+            body = rest.rsplit("}", 1)[0]
+            for pair in body.split(","):
+                if not pair:
+                    continue
+                k, _, v = pair.partition("=")
+                labels.add(k)
+                if k == "site":
+                    sites.add(v.strip('"'))
+        else:
+            name = series
+        names.add(name)
+    return names, labels, sites
+
+
+def _strip_histo_suffix(name):
+    for suf in ("_sum", "_count"):
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+@pytest.fixture()
+def loaded_agent(tmp_path, monkeypatch):
+    """Dev agent driven through a BATCHED eval round (the fused
+    coordinator dispatch) plus a filtered failure and an exhausted
+    blocked eval — the flow that populates every promised family."""
+    # batch the worker BEFORE the server (Worker reads the env in init)
+    monkeypatch.setenv("NOMAD_TPU_EVAL_BATCH", "4")
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api import NomadClient
+    from nomad_tpu.structs import Constraint
+
+    a = Agent(AgentConfig(data_dir=str(tmp_path / "data"),
+                          heartbeat_ttl=60.0))
+    a.start()
+    api = NomadClient(a.http_addr[0], a.http_addr[1])
+    assert _wait(lambda: len(api.nodes()) == 1)
+
+    def job(cpu=50, constraint=None):
+        j = mock.job()
+        t = j.task_groups[0].tasks[0]
+        t.driver = "mock_driver"
+        t.config = {"run_for": 0.05}
+        t.resources.cpu = cpu
+        if constraint is not None:
+            j.constraints.append(constraint)
+        return j
+
+    # park registrations while the broker is disabled, then restore —
+    # the 6 pending evals drain as ONE worker batch (fused dispatch)
+    s = a.server
+    s.broker.set_enabled(False)
+    eval_ids = [api.register_job(job()) for _ in range(4)]
+    eval_ids.append(api.register_job(job(cpu=10**7)))  # exhausted → blocked
+    eval_ids.append(api.register_job(job(
+        constraint=Constraint("${attr.nope}", "x", "="))))  # filtered
+    s.broker.set_enabled(True)
+    s._restore_evals()
+    for eid in eval_ids:
+        ev = api.wait_for_eval(eid, timeout=30.0)
+        assert ev is not None and ev.status == "complete"
+    yield a, api
+    a.shutdown()
+
+
+class TestSeriesNameStability:
+    def test_every_promised_name_is_exposed(self, loaded_agent):
+        a, api = loaded_agent
+        names, _, _ = _parse(api.metrics_prometheus())
+        missing = REQUIRED - names
+        assert not missing, (
+            f"promised series missing/renamed: {sorted(missing)} — if this "
+            f"is a deliberate rename, update REQUIRED in the same PR")
+
+    def test_no_series_outside_allowed_families(self, loaded_agent):
+        a, api = loaded_agent
+        names, _, _ = _parse(api.metrics_prometheus())
+        stray = sorted(
+            n for n in names
+            if not any(n.startswith(p)
+                       or _strip_histo_suffix(n).startswith(p)
+                       for p in ALLOWED_PREFIXES))
+        assert not stray, (
+            f"series outside the frozen family taxonomy: {stray} — a new "
+            f"family must be added to ALLOWED_PREFIXES deliberately")
+
+    def test_label_names_and_site_values_pinned(self, loaded_agent):
+        a, api = loaded_agent
+        _, labels, sites = _parse(api.metrics_prometheus())
+        assert labels <= ALLOWED_LABELS, labels - ALLOWED_LABELS
+        assert sites <= ALLOWED_SITES, sites - ALLOWED_SITES
+        # the fused-dispatch sites must actually be present (the flow
+        # above ran a batched coordinator round)
+        assert "select_batch.fetch" in sites
+        assert "select_batch.pack_buffers" in sites
+
+    def test_batched_flow_populated_pipeline(self, loaded_agent):
+        """Guard the fixture itself: if the batched path silently stops
+        batching, the pipeline/worker families would vanish from the
+        exposition and the stability test would be vacuous."""
+        a, api = loaded_agent
+        snap = a.server.metrics.snapshot()
+        assert snap["counters"].get("pipeline.dispatches", 0) >= 1
+        assert any(k.startswith("worker.0.batch.")
+                   for k in snap["counters"])
