@@ -662,10 +662,20 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         view1 = default_registry().counters(prefix="view.")
         pipeline = _pipeline_section(pipe0, _pipeline_totals(s.metrics),
                                      led0, default_ledger().snapshot())
+        # D2D plan-delta counters ride the pipeline section so the r06
+        # artifact is self-attributing: how many dispatches fed their
+        # carry back device-to-device (adopts), how many rows never
+        # re-crossed the host↔device link (carry_rows), and how often
+        # the proof obligations failed back to host uploads (rejects)
+        pipeline["d2d"] = {
+            k: round(view1.get(k, 0) - view0.get(k, 0), 1)
+            for k in ("carry_adopts", "carry_rows", "carry_rejects",
+                      "ports_words", "copy_slots")}
         view = {k: round(view1.get(k, 0) - view0.get(k, 0), 1)
                 for k in ("upload_bytes", "full_uploads",
                           "ports_full_uploads", "delta_uploads",
-                          "delta_rows")}
+                          "delta_rows", "carry_adopts", "carry_rows",
+                          "carry_rejects", "ports_words", "copy_slots")}
         log("e2e: view uploads "
             + ", ".join(f"{k}={v}" for k, v in sorted(view.items())))
         wstats = dict(s.workers[0].batch_stats) if s.workers else {}
@@ -692,6 +702,8 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
             "top sites "
             + ", ".join(f"{e['site']}={e['bytes']}"
                         for e in pipeline["top_sites"][:3]))
+        log("e2e: d2d " + ", ".join(
+            f"{k}={v}" for k, v in sorted(pipeline["d2d"].items())))
     finally:
         s.shutdown()
     rate = done / dt if dt else 0.0

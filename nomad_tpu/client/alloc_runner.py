@@ -584,9 +584,11 @@ class AllocRunner:
         self._csi_mounted.clear()
 
     def _event_all(self, message: str) -> None:
-        for ts in self.task_states.values():
-            from ..structs import TaskEvent
+        from ..structs import TaskEvent
 
+        with self._lock:
+            states = list(self.task_states.values())
+        for ts in states:
             ts.events.append(TaskEvent(type="Setup Failure",
                                        time=time.time(), message=message))
 
@@ -787,7 +789,9 @@ class AllocRunner:
             self.health_tracker.stop()
         self.services.stop()
         self.kill()
-        for tr in list(self.task_runners.values()):
+        with self._lock:
+            runners = list(self.task_runners.values())
+        for tr in runners:
             tr.join(timeout=5.0)
         self._unmount_volumes()
         if self.network_manager is not None:

@@ -616,6 +616,32 @@ _PACK_U8 = ("lut", "extra_mask", "distinct_hosts", "use_cand", "dp_active",
             "spread_has_targets", "spread_active")
 
 
+#: TGParams partition for the device-resident program table (ISSUE 10).
+#: STATIC fields are plan-independent — they come from the job spec's
+#: compiled program (`TPUStack._static_program`) and are identical every
+#: time the same job spec is evaluated, so their packed rows live ON
+#: DEVICE in a persistent table (server/program_table.py) and steady-state
+#: dispatches ship only a row index. DYNAMIC fields are per-eval
+#: plan-relative state (deltas, counts, penalty rows) and ship per
+#: dispatch as one small packed row per program.
+STATIC_FIELDS = (
+    "ask", "desired_count", "algorithm", "key_idx", "lut", "aff_key_idx",
+    "aff_lut", "aff_inv_sum", "extra_mask", "distinct_hosts", "res_ports",
+    "n_dyn", "dp_key_idx", "dp_allowed", "dp_active", "spread_key_idx",
+    "spread_weight", "spread_has_targets", "spread_desired",
+    "spread_active",
+)
+DYN_FIELDS = tuple(f for f in TGParams._fields if f not in STATIC_FIELDS)
+
+
+def _pack_class(name: str):
+    if name in _PACK_I32:
+        return "i", np.int32
+    if name in _PACK_F32:
+        return "f", np.float32
+    return "u", np.uint8
+
+
 def pack_params(batch: TGParams):
     """Flatten a (batched) TGParams into (i32, f32, u8) numpy buffers plus a
     static spec for the on-device unpack."""
@@ -623,12 +649,7 @@ def pack_params(batch: TGParams):
     spec = []
     for name in TGParams._fields:
         a = np.asarray(getattr(batch, name))
-        if name in _PACK_I32:
-            cls, dt = "i", np.int32
-        elif name in _PACK_F32:
-            cls, dt = "f", np.float32
-        else:
-            cls, dt = "u", np.uint8
+        cls, dt = _pack_class(name)
         flat = np.ascontiguousarray(a, dtype=dt).reshape(-1)
         off = sum(x.size for x in bufs[cls])
         bufs[cls].append(flat)
@@ -637,6 +658,31 @@ def pack_params(batch: TGParams):
            for (c, v), d in zip(bufs.items(),
                                 (np.int32, np.float32, np.uint8))}
     return cat["i"], cat["f"], cat["u"], tuple(spec)
+
+
+def pack_param_rows(p: TGParams, fields):
+    """Pack ONE program's `fields` into flat (i32, f32, u8) rows + spec.
+
+    Row-major per program (unlike `pack_params`, which concatenates
+    field-major across a whole batch): rows of programs packed at the
+    same shapes are interchangeable table entries, and a batch of them
+    stacks into [B, L] buffers whose on-device unpack slices static
+    column ranges."""
+    bufs = {"i": [], "f": [], "u": []}
+    spec = []
+    for name in fields:
+        a = np.asarray(getattr(p, name))
+        cls, dt = _pack_class(name)
+        flat = np.ascontiguousarray(a, dtype=dt).reshape(-1)
+        off = sum(x.size for x in bufs[cls])
+        bufs[cls].append(flat)
+        spec.append((name, cls, off, a.shape))
+    cat = {c: (np.concatenate(v) if v else np.zeros(0, dtype=d))
+           for (c, v), d in zip(bufs.items(),
+                                (np.int32, np.float32, np.uint8))}
+    return cat["i"], cat["f"], cat["u"], tuple(spec)
+
+
 
 
 def _unpack_params(i32buf, f32buf, u8buf, spec) -> TGParams:
@@ -663,6 +709,29 @@ def place_packed_batch(cluster: ClusterArrays, i32buf, f32buf, u8buf,
     return r.sel_idx, r.sel_score
 
 
+def _chain_with_carry(cluster: ClusterArrays, batch: TGParams,
+                      max_allocs: int, explain: bool = False):
+    """Chain body shared by the packed and table dispatches: scan over
+    the program axis; ALSO returns the final (used, dyn_free) carry —
+    the device-resident post-placement view the D2D plan-delta path
+    (scheduler/stack.py carry adoption) feeds back into the cached
+    cluster buffers without a host round-trip."""
+    n = cluster.used.shape[0]
+
+    def prog(carry, p):
+        used, dyn = carry
+        cl = cluster._replace(used=used, dyn_free=dyn)
+        r = place_task_group(cl, p, max_allocs, explain=explain)
+        placed = jnp.sum(
+            ((r.sel_idx[:, None] == jnp.arange(n)[None, :])
+             & (r.sel_idx >= 0)[:, None]).astype(jnp.float32), axis=0)
+        return (r.new_used, dyn - placed * p.n_dyn), r
+
+    (used_f, dyn_f), results = jax.lax.scan(
+        prog, (cluster.used, cluster.dyn_free), batch)
+    return results, (used_f, dyn_f)
+
+
 @functools.partial(jax.jit, static_argnames=("max_allocs", "explain"))
 def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
                            max_allocs: int,
@@ -681,19 +750,8 @@ def place_task_group_chain(cluster: ClusterArrays, batch: TGParams,
     still resolved at apply (port VALUES are assigned host-side).
     Serial over B programs on-device, but it's ONE dispatch; the inner
     node-axis work stays full-width SPMD."""
-    n = cluster.used.shape[0]
-
-    def prog(carry, p):
-        used, dyn = carry
-        cl = cluster._replace(used=used, dyn_free=dyn)
-        r = place_task_group(cl, p, max_allocs, explain=explain)
-        placed = jnp.sum(
-            ((r.sel_idx[:, None] == jnp.arange(n)[None, :])
-             & (r.sel_idx >= 0)[:, None]).astype(jnp.float32), axis=0)
-        return (r.new_used, dyn - placed * p.n_dyn), r
-
-    (_, _), results = jax.lax.scan(
-        prog, (cluster.used, cluster.dyn_free), batch)
+    results, _carry = _chain_with_carry(cluster, batch, max_allocs,
+                                        explain=explain)
     return results
 
 
@@ -714,6 +772,55 @@ def place_packed_chain(cluster: ClusterArrays, i32buf, f32buf, u8buf,
     if explain:
         return base + tuple(r.explain)
     return base
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("sspec", "dspec", "max_allocs",
+                                    "explain"))
+def place_table_chain(cluster: ClusterArrays, ti, tf, tu, rows,
+                      di, df, du, sspec, dspec, max_allocs: int,
+                      explain: bool = False):
+    """Device-resident chained placement (ISSUE 10): the STATIC half of
+    every program is a row of a persistent device table (ti/tf/tu, one
+    per dtype class — server/program_table.py), so the dispatch ships
+    only `rows` (i32[B] table indices) and the small DYNAMIC rows
+    (di/df/du, [B, Ld*]) instead of whole packed programs.
+
+    Assembly is a per-class ROW gather (`jnp.take` along the table axis
+    — embedding-style whole-row DMA, not an element gather) followed by
+    the static-offset unpack; both fuse into the chain compile. Returns
+    the flat fetchable outputs (sel/score/feasible/fit [+ explain
+    leaves]) plus the final (used, dyn_free) carry as DEVICE arrays —
+    the carry never rides the host fetch; it is handed to the view
+    cache for the device-to-device plan-delta update."""
+    gi = jnp.take(ti, rows, axis=0)
+    gf = jnp.take(tf, rows, axis=0)
+    gu = jnp.take(tu, rows, axis=0)
+
+    # [B, L*] class buffers → {field: [B, *shape]} via STATIC column
+    # slices (fuse to nothing under jit — the `_unpack_params` contract
+    # with a leading batch axis). Inlined here so the loops run over the
+    # statically-named specs.
+    fields = {}
+    sbufs = {"i": gi, "f": gf, "u": gu}
+    for name, cls, off, shape in sspec:
+        size = int(np.prod(shape)) if shape else 1
+        seg = sbufs[cls][:, off:off + size]
+        a = seg.reshape((seg.shape[0],) + tuple(shape))
+        fields[name] = (a != 0) if cls == "u" else a
+    dbufs = {"i": di, "f": df, "u": du}
+    for name, cls, off, shape in dspec:
+        size = int(np.prod(shape)) if shape else 1
+        seg = dbufs[cls][:, off:off + size]
+        a = seg.reshape((seg.shape[0],) + tuple(shape))
+        fields[name] = (a != 0) if cls == "u" else a
+    batch = TGParams(**fields)
+    r, carry = _chain_with_carry(cluster, batch, max_allocs,
+                                 explain=explain)
+    base = (r.sel_idx, r.sel_score, r.nodes_feasible, r.nodes_fit)
+    if explain:
+        base = base + tuple(r.explain)
+    return base, carry
 
 
 @functools.partial(jax.jit, static_argnames=("max_allocs", "explain"))

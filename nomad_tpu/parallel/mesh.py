@@ -157,7 +157,41 @@ def _pad_rows(a: np.ndarray, n: int, fill) -> np.ndarray:
     return np.concatenate([a, pad], axis=0)
 
 
-def pad_params(params_list: Sequence[TGParams]
+#: pad_params dims that shape STATIC program fields (the LUT block the
+#: device program table holds per job spec); everything else shapes only
+#: per-eval dynamic rows and is free to vary per dispatch.
+STATIC_DIMS = ("v", "c", "a_n", "e_n", "s_n", "dp_n", "rp_n")
+
+
+def param_dims(params_list: Sequence[TGParams]) -> dict:
+    """Bucketed common shape dims a set of programs needs (the pad_params
+    targets, exposed so the device program table can hold shape FLOORS
+    stable across dispatches — shape churn is compile churn)."""
+    ps = [TGParams(*[np.asarray(x) for x in p]) for p in params_list]
+    return {
+        "v": _bucket(max(max(p.lut.shape[1] if p.lut.size else 2,
+                             p.aff_lut.shape[1] if p.aff_lut.size else 2,
+                             p.spread_desired.shape[1]) for p in ps), lo=2),
+        "c": _bucket(max(p.key_idx.shape[0] for p in ps)),
+        "a_n": _bucket(max(p.aff_key_idx.shape[0] for p in ps)),
+        "m": _bucket(max(p.penalty_idx.shape[0] for p in ps)),
+        "p_n": _bucket(max(p.penalty_idx.shape[1] for p in ps)),
+        "d_n": _bucket(max(p.delta_idx.shape[0] for p in ps)),
+        "s_n": _bucket(max(p.spread_key_idx.shape[0] for p in ps)),
+        "j_n": _bucket(max(p.jc_idx.shape[0] for p in ps)),
+        "j2_n": _bucket(max(p.jtc_idx.shape[0] for p in ps)),
+        "e_n": max(p.extra_mask.shape[0] for p in ps),
+        "l_n": _bucket(max(p.cand_idx.shape[0] for p in ps)),
+        "dp_n": _bucket(max(p.dp_key_idx.shape[0] for p in ps)),
+        "rp_n": _bucket(max(p.res_ports.shape[0] for p in ps)),
+        "pc_n": _bucket(max(p.pclr_idx.shape[0] for p in ps)),
+        "pst_n": _bucket(max(p.pset_idx.shape[0] for p in ps)),
+    }
+
+
+def pad_params(params_list: Sequence[TGParams],
+               dims: Optional[dict] = None,
+               need: Optional[dict] = None
                ) -> Tuple[Tuple[TGParams, ...], int]:
     """Bucket-pad heterogeneous per-eval placement programs to common shapes
     so they batch along one leading axis (SURVEY §7 hard-part (d): variable
@@ -166,26 +200,24 @@ def pad_params(params_list: Sequence[TGParams]
     Padding is semantically inert: extra constraint rows are all-true LUTs,
     extra affinity/spread rows carry zero weight / inactive flags, extra
     penalty/preferred/delta rows are −1 (dropped scatters), and extra scan
-    steps sit beyond `n_place`. Returns (padded params, common scan length).
-    """
+    steps sit beyond `n_place`. `dims` (optional) sets per-dim FLOORS —
+    the program table passes its running caps so the padded shapes (and
+    therefore the packed row layout + the chain's XLA compile) stay
+    identical across dispatches; `need` short-circuits the dim
+    computation when the caller already ran param_dims on the same list
+    (the program table's ceiling check). Returns (padded params, common
+    scan length)."""
     ps = [TGParams(*[np.asarray(x) for x in p]) for p in params_list]
-    v = _bucket(max(max(p.lut.shape[1] if p.lut.size else 2,
-                        p.aff_lut.shape[1] if p.aff_lut.size else 2,
-                        p.spread_desired.shape[1]) for p in ps), lo=2)
-    c = _bucket(max(p.key_idx.shape[0] for p in ps))
-    a_n = _bucket(max(p.aff_key_idx.shape[0] for p in ps))
-    m = _bucket(max(p.penalty_idx.shape[0] for p in ps))
-    p_n = _bucket(max(p.penalty_idx.shape[1] for p in ps))
-    d_n = _bucket(max(p.delta_idx.shape[0] for p in ps))
-    s_n = _bucket(max(p.spread_key_idx.shape[0] for p in ps))
-    j_n = _bucket(max(p.jc_idx.shape[0] for p in ps))
-    j2_n = _bucket(max(p.jtc_idx.shape[0] for p in ps))
-    e_n = max(p.extra_mask.shape[0] for p in ps)
-    l_n = _bucket(max(p.cand_idx.shape[0] for p in ps))
-    dp_n = _bucket(max(p.dp_key_idx.shape[0] for p in ps))
-    rp_n = _bucket(max(p.res_ports.shape[0] for p in ps))
-    pc_n = _bucket(max(p.pclr_idx.shape[0] for p in ps))
-    pst_n = _bucket(max(p.pset_idx.shape[0] for p in ps))
+    need = dict(need) if need is not None else param_dims(ps)
+    if dims:
+        for k, floor in dims.items():
+            if k in need:
+                need[k] = max(need[k], floor)
+    v, c, a_n, m = need["v"], need["c"], need["a_n"], need["m"]
+    p_n, d_n, s_n = need["p_n"], need["d_n"], need["s_n"]
+    j_n, j2_n, e_n = need["j_n"], need["j2_n"], need["e_n"]
+    l_n, dp_n, rp_n = need["l_n"], need["dp_n"], need["rp_n"]
+    pc_n, pst_n = need["pc_n"], need["pst_n"]
 
     out = []
     for p in ps:

@@ -69,6 +69,14 @@ class SelectResult:
     #: host-shaped attribution (see TPUStack._explain_host) — None when
     #: the dispatch ran without explain outputs
     explain: Optional[dict] = None
+    #: the compiled ask vector (f32[R]) this selection placed against —
+    #: the scheduler compares each committed placement's usage row to it
+    #: to certify the plan carry-exact (device-resident plan deltas)
+    ask: Optional[np.ndarray] = None
+    #: fused-dispatch token (table path only): the scheduler stamps it
+    #: on its plan (carry_token) so the commit window binds to the
+    #: dispatch whose carry actually contains these placements
+    carry_token: Optional[int] = None
 
 
 def explain_enabled() -> bool:
@@ -128,15 +136,39 @@ def _ports_delta_impl(ports_used, rows, port_rows):
     return _rows_update(ports_used, rows, port_rows)
 
 
+def _ports_word_impl(ports_used, rows, words, vals):
+    """ports_used[rows[i], words[i]] = vals[i] — single-WORD updates of
+    the packed port bitmap (a port flip touches one u32; shipping the
+    whole 8 KB row per flip was the dominant steady-state port cost).
+    dynamic_update_slice of a (1, 1) window, not element scatter."""
+    import jax
+
+    def body(i, a):
+        return jax.lax.dynamic_update_slice(
+            a, vals[i].reshape(1, 1), (rows[i], words[i]))
+
+    return jax.lax.fori_loop(0, rows.shape[0], body, ports_used)
+
+
 @functools.lru_cache(maxsize=None)
-def _delta_kernels():
-    """Jitted row-update kernels, donated so the cached device buffers
-    update in place (no O(N) copy, no host re-upload). Built lazily: jax
+def _delta_kernels(donate: bool = True):
+    """Jitted row/word-update kernels. `donate=True` updates the cached
+    device buffers in place (no O(N) copy — the point, for the 128 MB
+    port bitmap). `donate=False` is the DOUBLE-BUFFER slot path: while a
+    dispatch's kernel is still in flight against the current buffers
+    (stack-level view lease, keyed by the dispatch token), the
+    refresh copies into fresh buffers instead — the in-flight kernel
+    keeps slot A, the next dispatch reads slot B, and the
+    "Array has been deleted" transient the donation contract documented
+    becomes structurally impossible on leased views. Built lazily: jax
     import stays off the module-import path."""
     import jax
 
-    return (jax.jit(_hot_delta_impl, donate_argnums=(0, 1, 2)),
-            jax.jit(_ports_delta_impl, donate_argnums=(0,)))
+    kw = {"donate_argnums": (0, 1, 2)} if donate else {}
+    pw = {"donate_argnums": (0,)} if donate else {}
+    return (jax.jit(_hot_delta_impl, **kw),
+            jax.jit(_ports_delta_impl, **pw),
+            jax.jit(_ports_word_impl, **pw))
 
 
 #: fixed row-chunk width for delta applies. ONE shape means ONE XLA
@@ -177,6 +209,65 @@ def _apply_chunked(kernel, bufs, idx, *vals):
     return bufs
 
 
+# ---- view leases + dispatch carry (device-resident plan deltas) ------------
+# The SelectCoordinator's fused dispatch produces, besides its fetchable
+# outputs, the chain's final (used, dyn_free) carry — the post-placement
+# cluster view, already ON DEVICE. Once the batch's plans commit, the
+# next refresh can ADOPT that carry instead of re-uploading the rows the
+# plans just touched: zero host→device traffic for kernel-committed
+# placements (the fetch→mutate→re-upload round trip the BENCH_r05
+# attribution blamed). The adoption proof obligations live in
+# device_arrays; the coordinator only notes the carry here.
+#
+# Leases implement the double-buffer half: a dispatch leases the view it
+# launched against (registered ATOMICALLY with the resolve via
+# device_arrays(lease_token=), keyed by the dispatch token) and releases
+# at kernel end. A refresh that finds live leases must not donate the
+# leased buffers — it copies into a second slot instead (see
+# _delta_kernels).
+
+
+def release_view(cluster, token) -> None:
+    with _DEV_CACHE_LOCK:
+        ent = _DEV_CACHE.get(cluster)
+        if ent is not None:
+            ent.setdefault("leases", set()).discard(token)
+
+
+def note_dispatch_carry(cluster, token, base_arrays, evals, stop_rows,
+                        used, dyn_free) -> None:
+    """Attach a dispatch's device-resident carry to the view cache.
+    `base_arrays` is the exact ClusterArrays the chain consumed —
+    adoption later requires the cached entry to STILL be that object
+    (identity, not version: any interleaved refresh rebuilds the
+    namedtuple and auto-invalidates the carry). `evals` are the eval ids
+    chained (order-aligned with the dispatch); `stop_rows` the node rows
+    the programs' plan-relative deltas touch (stops/preempts/in-plan
+    placements) — their host commits adjust dyn_free/ports in ways the
+    carry deliberately does not model, so they always re-upload."""
+    with _DEV_CACHE_LOCK:
+        ent = _DEV_CACHE.get(cluster)
+        if ent is None or ent.get("arrays") is not base_arrays:
+            return
+        ent["carry"] = {
+            "token": token, "base_arrays": base_arrays,
+            "evals": set(evals), "stop_rows": set(stop_rows),
+            "used": used, "dyn_free": dyn_free, "predicted": None,
+        }
+
+
+def carry_predicted(cluster, token, predicted: Dict[str, set]) -> None:
+    """Second half of the carry note, filled when the dispatch's outputs
+    land host-side (the first _BatchOut resolver): per-eval node rows
+    the kernel actually selected. Until this arrives the carry is not
+    adoptable — an unresolved dispatch has unprovable placements."""
+    with _DEV_CACHE_LOCK:
+        ent = _DEV_CACHE.get(cluster)
+        c = ent.get("carry") if ent is not None else None
+        if c is not None and c["token"] == token:
+            c["predicted"] = predicted
+
+
 class TPUStack:
     """Compiles placement programs and drives the placement kernel."""
 
@@ -201,10 +292,16 @@ class TPUStack:
 
     # ---- device snapshot management ----
 
-    def device_arrays(self) -> ClusterArrays:
+    def device_arrays(self, lease_token=None) -> ClusterArrays:
         """Device copy of the cluster tensors, cached GLOBALLY per
         cluster object, keyed per-tensor by sub-versions and refreshed
         INCREMENTALLY from the cluster's bounded delta log.
+
+        `lease_token` (the fused dispatch's token) registers a view
+        lease ATOMICALLY with the resolve, under the cache lock — a
+        lease taken after returning would leave a window where a
+        concurrent refresh donates the buffers this caller is about to
+        launch against.
 
         The control plane builds a fresh TPUStack per evaluation; an
         instance-level cache re-uploaded everything every eval — and
@@ -213,7 +310,7 @@ class TPUStack:
         only when nodes/attrs change (node_version + shape); the hot
         tensors (used/node_ok/dyn_free) and the port bitmap ship as ROW
         DELTAS when the cached entry's version sits inside the delta-log
-        window (tensor/cluster.py hot_rows_since/port_rows_since),
+        window (tensor/cluster.py hot_entries_since/port_words_since),
         applied by a jitted donated row-update kernel — with window
         misses, row-bucket growth, or oversized deltas falling back to a
         full upload.
@@ -270,19 +367,43 @@ class TPUStack:
             # look stale (next caller re-applies), never current with
             # old data
             version = cl.version
-            static_key = (cl.node_version, cl.n_cap, cl.k_cap, mesh)
+            # attrs compaction: vocab tokens are small ints — int16
+            # halves the second-largest static tensor (exact: the kernel
+            # widens to f32 either way, and every in-gate token is
+            # < 2^15 ≪ 2^24). Falls back to int32 if any key's vocab
+            # ever approaches the i16 range; the dtype rides the static
+            # key so the flip is a clean re-upload.
+            attr_dt = np.int16 if cl.vocab.max_vocab < 32000 else np.int32
+            static_key = (cl.node_version, cl.n_cap, cl.k_cap, mesh,
+                          attr_dt)
             ports_key = (cl.ports_version, cl.n_cap, mesh)
             ent = _DEV_CACHE.get(cl)
             if ent is not None and ent["version"] == version \
                     and ent["static_key"] == static_key:
+                if lease_token is not None:
+                    ent.setdefault("leases", set()).add(lease_token)
                 return ent["arrays"]
+            #: live view leases (dispatches in flight against the cached
+            #: buffers): with any held, updates must COPY into a second
+            #: buffer slot instead of donating in place — the active
+            #: double-buffer management (ISSUE 10 part c). The set
+            #: object is shared with device_arrays(lease_token=)/
+            #: release_view and carries forward across refreshes.
+            leases = ent.get("leases") if ent is not None else None
+            if leases is None:
+                leases = set()
+            donate = not leases
+            if not donate:
+                reg.inc("view.copy_slots")
+            carry = ent.pop("carry", None) if ent is not None else None
             if ent is not None and ent["static_key"] == static_key:
                 capacity, attrs = ent["capacity"], ent["attrs"]
             else:
-                nb = cl.capacity.nbytes + cl.attrs.nbytes
+                nb = (cl.capacity.nbytes
+                      + cl.attrs.size * np.dtype(attr_dt).itemsize)
                 with led.timed("stack.static_full", nb, count=2):
                     capacity = up(cl.capacity, sh.capacity)
-                    attrs = up(cl.attrs, sh.attrs)
+                    attrs = up(cl.attrs, sh.attrs, dtype=attr_dt)
                 reg.inc("view.upload_bytes", nb)
             # delta eligibility: same mesh commitment and row bucket —
             # a grown n_cap changes every tensor's shape, a mesh flip
@@ -293,13 +414,60 @@ class TPUStack:
             prev = ent["arrays"] if ent is not None else None
 
             did_delta = False
-            hot_rows = (cl.hot_rows_since(ent["version"], limit)
-                        if can_delta else None)
-            if hot_rows is not None:
+            hot_entries = (cl.hot_entries_since(ent["version"], limit)
+                           if can_delta else None)
+            hot_rows = None
+            if hot_entries is not None:
+                hot_rows = set()
+                for _ver, rs in hot_entries:
+                    hot_rows.update(rs)
+            skip: set = set()
+            adopted = False
+            if carry is not None and hot_rows:
+                skip = self._carry_skip_rows(cl, ent, carry, prev,
+                                             hot_entries, mesh)
+                adopted = skip is not None
+                if not adopted:
+                    skip = set()
+                    reg.inc("view.carry_rejects")
+            elif carry is not None:
+                reg.inc("view.carry_rejects")
+            if adopted:
+                # D2D plan delta: the dispatch's own chain carry IS the
+                # post-commit view for the rows its plans placed — adopt
+                # it wholesale (a buffer swap, zero transfer) and
+                # overlay only the rows something ELSE touched from
+                # host. node_ok never changes via plan commits, so the
+                # previous buffer rides along. stop_rows ALWAYS overlay,
+                # even when unchanged host-side: the carry baked every
+                # program's plan-relative delta subtraction into used0,
+                # and a plan that never committed would otherwise leave
+                # a phantom release on rows no hot entry names.
+                used, dyn_free = carry["used"], carry["dyn_free"]
+                node_ok = prev.node_ok
+                overlay = (hot_rows - skip) | {
+                    r for r in carry["stop_rows"] if r < cl.n_cap}
+                reg.inc("view.carry_adopts")
+                reg.inc("view.carry_rows", len(skip))
+                if overlay:
+                    idx, uvals, ovals, dvals = _delta_rows_host(
+                        overlay, cl.used, cl.node_ok, cl.dyn_free)
+                    hot_kernel = _delta_kernels(donate)[0]
+                    nb = (idx.nbytes + uvals.size * 4 + ovals.nbytes
+                          + dvals.nbytes)
+                    nch = idx.shape[0] // _DELTA_CHUNK
+                    with led.timed("stack.hot_delta", nb, count=4 * nch):
+                        used, node_ok, dyn_free = _apply_chunked(
+                            hot_kernel, (used, node_ok, dyn_free),
+                            idx, uvals.astype(np.float32), ovals, dvals)
+                    did_delta = True
+                    reg.inc("view.delta_rows", len(overlay))
+                    reg.inc("view.upload_bytes", nb)
+            elif hot_rows is not None:
                 if hot_rows:
                     idx, uvals, ovals, dvals = _delta_rows_host(
                         hot_rows, cl.used, cl.node_ok, cl.dyn_free)
-                    hot_kernel, _ = _delta_kernels()
+                    hot_kernel = _delta_kernels(donate)[0]
                     nb = (idx.nbytes + uvals.size * 4 + ovals.nbytes
                           + dvals.nbytes)
                     # 4 arrays per chunk: transfer COUNT must reflect
@@ -332,24 +500,15 @@ class TPUStack:
             if ent is not None and ent["ports_key"] == ports_key:
                 ports_used = ent["ports_used"]
             else:
-                port_rows = (cl.port_rows_since(ent["ports_version"],
-                                                limit)
-                             if can_delta else None)
-                if port_rows:
-                    pidx, pvals = _delta_rows_host(port_rows,
-                                                   cl.ports_used)
-                    _, ports_kernel = _delta_kernels()
-                    nb = pidx.nbytes + pvals.nbytes
-                    nch = pidx.shape[0] // _DELTA_CHUNK
-                    with led.timed("stack.ports_delta", nb,
-                                   count=2 * nch):
-                        (ports_used,) = _apply_chunked(
-                            ports_kernel, (ent["ports_used"],), pidx,
-                            pvals)
+                port_words = (cl.port_words_since(ent["ports_version"],
+                                                  limit)
+                              if can_delta else None)
+                if port_words:
+                    ports_used = self._apply_port_words(
+                        cl, ent["ports_used"], port_words, donate, led,
+                        reg)
                     did_delta = True
-                    reg.inc("view.delta_rows", len(port_rows))
-                    reg.inc("view.upload_bytes", nb)
-                elif port_rows is not None:
+                elif port_words is not None:
                     ports_used = ent["ports_used"]
                 else:
                     nb = cl.ports_used.nbytes
@@ -374,6 +533,8 @@ class TPUStack:
                 ports_used=ports_used,
                 dyn_free=dyn_free,
             )
+            if lease_token is not None:
+                leases.add(lease_token)
             _DEV_CACHE[cl] = {
                 "version": version, "arrays": arrays,
                 "static_key": static_key, "capacity": capacity,
@@ -381,8 +542,109 @@ class TPUStack:
                 "ports_version": ports_key[0],
                 "ports_used": ports_used,
                 "n_cap": cl.n_cap, "mesh": mesh,
+                "leases": leases, "carry": None,
             }
             return arrays
+
+    @staticmethod
+    def _carry_skip_rows(cl, ent, carry, prev, hot_entries, mesh):
+        """Decide whether a dispatch carry is adoptable and which rows
+        it covers. Returns the SKIP row set (rows whose device values
+        the carry already holds — no upload needed), or None to reject.
+
+        Proof obligations, all host-side and cheap:
+        - the cached entry still holds the exact arrays the chain
+          consumed (object identity — any interleaved refresh rebuilt
+          the namedtuple and invalidates);
+        - the dispatch's outputs have landed (predicted rows known);
+        - every chained eval that predicted placements committed its
+          plan CLEAN (full commit) and EXACT (scheduler certified
+          usage == kernel ask, integral), and that plan's carry_token
+          matches THIS dispatch — a later retry plan of the same eval
+          (different dispatch, or no dispatch at all) can never vouch
+          for this carry's placements. Otherwise a placement the carry
+          contains might never have committed (phantom usage on a row
+          no overlay would ever fix), so the whole carry is dropped;
+        - a row only skips if EVERY change to it came from a covered
+          plan window, it was a predicted placement row, and no
+          program's plan-relative deltas (stops/preempts — their port
+          credits adjust dyn_free in ways the chain carry deliberately
+          does not model) touched it. Everything else overlays from
+          host, which is always authoritative."""
+        if mesh is not None or ent["mesh"] is not None:
+            return None
+        if carry["base_arrays"] is not prev:
+            return None
+        predicted = carry["predicted"]
+        if predicted is None:
+            return None
+        windows = cl.plan_windows_since(ent["version"])
+        token = carry["token"]
+        covered_evals = {w[2] for w in windows
+                         if w[3] and w[4] == token
+                         and w[2] in carry["evals"]}
+        for eid, rows in predicted.items():
+            if rows and eid not in covered_evals:
+                return None
+        covered_rows: set = set()
+        uncovered_rows: set = set()
+        for ver, rs in hot_entries:
+            cov = False
+            for v_lo, v_hi, eid, ok, w_tok in windows:
+                if v_lo < ver <= v_hi:
+                    cov = (ok and w_tok == token
+                           and eid in covered_evals)
+                    break
+            (covered_rows if cov else uncovered_rows).update(rs)
+        pred_rows: set = set()
+        for rows in predicted.values():
+            pred_rows.update(rows)
+        return ((covered_rows & pred_rows) - uncovered_rows
+                - carry["stop_rows"])
+
+    @staticmethod
+    def _apply_port_words(cl, ports_buf, port_words, donate, led, reg):
+        """Apply a word-granular port delta: whole-row updates for
+        rebuilt rows (node upsert/remove), single-u32 updates for port
+        flips — the steady-state case ships 4-byte words instead of
+        8 KB rows (`stack.ports_word_delta`)."""
+        full_rows = sorted(r for r, ws in port_words.items()
+                           if ws is None)
+        word_items = sorted((r, w) for r, ws in port_words.items()
+                            if ws is not None for w in ws)
+        kernels = _delta_kernels(donate)
+        if full_rows:
+            pidx, pvals = _delta_rows_host(full_rows, cl.ports_used)
+            nb = pidx.nbytes + pvals.nbytes
+            nch = pidx.shape[0] // _DELTA_CHUNK
+            with led.timed("stack.ports_delta", nb, count=2 * nch):
+                (ports_buf,) = _apply_chunked(
+                    kernels[1], (ports_buf,), pidx, pvals)
+            reg.inc("view.delta_rows", len(full_rows))
+            reg.inc("view.upload_bytes", nb)
+        if word_items:
+            rows_a = np.fromiter((r for r, _ in word_items),
+                                 dtype=np.int32, count=len(word_items))
+            words_a = np.fromiter((w for _, w in word_items),
+                                  dtype=np.int32, count=len(word_items))
+            vals_a = cl.ports_used[rows_a, words_a]
+            b = -(-rows_a.shape[0] // _DELTA_CHUNK) * _DELTA_CHUNK
+            if b > rows_a.shape[0]:
+                extra = b - rows_a.shape[0]
+                rows_a = np.concatenate(
+                    [rows_a, np.repeat(rows_a[:1], extra)])
+                words_a = np.concatenate(
+                    [words_a, np.repeat(words_a[:1], extra)])
+                vals_a = np.concatenate(
+                    [vals_a, np.repeat(vals_a[:1], extra)])
+            nb = rows_a.nbytes + words_a.nbytes + vals_a.nbytes
+            nch = rows_a.shape[0] // _DELTA_CHUNK
+            with led.timed("stack.ports_word_delta", nb, count=3 * nch):
+                (ports_buf,) = _apply_chunked(
+                    kernels[2], (ports_buf,), rows_a, words_a, vals_a)
+            reg.inc("view.ports_words", len(word_items))
+            reg.inc("view.upload_bytes", nb)
+        return ports_buf
 
     # ---- program compilation ----
 
@@ -963,12 +1225,14 @@ class TPUStack:
             # here — under pipelining the previous batch's plans commit
             # between this park and the dispatch, and placing against a
             # park-time snapshot would ignore them.
-            sel, scores, n_feas, n_fit, ex_np = self.coordinator.select(
+            (sel, scores, n_feas, n_fit, ex_np,
+             carry_token) = self.coordinator.select(
                 self.device_arrays, params, n_place,
                 order=getattr(self, "coordinator_order", 0),
                 explain=want_ex)
             result = None
         else:
+            carry_token = None
             arrays = self.device_arrays()
             # Bucket-pad this single program (parallel/mesh.py pad_params —
             # the same inert padding the batched path uses): without it
@@ -1011,6 +1275,8 @@ class TPUStack:
             nodes_fit=[int(x) for x in np.asarray(n_fit)[:n_place]],
             raw=result,
             explain=explain_host,
+            ask=np.asarray(params.ask, dtype=np.float32),
+            carry_token=carry_token,
         )
 
     def _dimension_names(self) -> List[str]:

@@ -359,7 +359,28 @@ class PlanApplier:
             result.deployment = None
             result.deployment_updates = []
 
-        self.state.upsert_plan_results(plan, result)
+        # Plan-commit window (device-resident plan deltas, ISSUE 10):
+        # bracket the commit's cluster-version range and tag it with the
+        # eval + the clean/exact verdicts, so the device-view refresh
+        # can adopt the dispatch's on-device carry for exactly these
+        # rows instead of re-uploading them. The mark MUST share the
+        # commit's mutation lock — a foreign upsert interleaving into
+        # the window would be mis-attributed to the kernel. Raft-routed
+        # stores commit on the FSM applier thread where this bracketing
+        # is meaningless; their mutations stay on the host re-upload
+        # path (the windows simply never cover them).
+        cl = getattr(self.state, "cluster", None)
+        if (cl is not None and getattr(self.state, "raft", None) is None
+                and hasattr(self.state, "mutation_lock")):
+            with self.state.mutation_lock():
+                v_lo = cl.version
+                self.state.upsert_plan_results(plan, result)
+                cl.mark_plan_window(
+                    plan.eval_id, v_lo, cl.version, clean=not partial,
+                    exact=bool(getattr(plan, "carry_exact", False)),
+                    token=getattr(plan, "carry_token", None))
+        else:
+            self.state.upsert_plan_results(plan, result)
         result.alloc_index = self.state.index.value
         if partial:
             result.refresh_index = self.state.index.value

@@ -1,0 +1,311 @@
+"""Device-resident program table — the on-device half of `pack_params`.
+
+BENCH_r05 / the PR 4 transfer ledger put the e2e frontier on the
+host↔device boundary: every fused dispatch re-packed its programs on the
+host and shipped the whole packed batch (`select_batch.pack_buffers`,
+3 transfers of tens-to-hundreds of KB) even when the SAME job specs were
+being re-evaluated round after round. On a tunneled TPU each transfer is
+a full network round trip, so the upload — not the chain kernel — set
+the dispatch floor.
+
+This module keeps the STATIC half of every compiled placement program
+(`kernels/placement.py STATIC_FIELDS`: the constraint/affinity/spread
+LUT block, ask vector, port asks — everything derived from the job spec
+alone) ON DEVICE, one packed row per distinct program content, in three
+class tables (i32/f32/u8). A dispatch then ships:
+
+  - `rows` i32[B] — table indices, a few bytes;
+  - the DYNAMIC rows [B, Ld*] — per-eval plan-relative state (deltas,
+    counts, penalty/preferred, sampled candidates), usually ~KBs;
+  - cold-miss static rows only for programs never seen before
+    (`select_batch.table_insert` — zero in steady state).
+
+`place_table_chain` gathers the static rows device-side (whole-row
+`jnp.take`, an embedding-style DMA — not an element gather) and runs the
+same conflict-aware chain as the packed path, bit-identically
+(tests/test_program_table.py pins sel/score equality).
+
+Shape discipline: rows are only interchangeable if every program packs
+at the SAME shapes, so the table owns running FLOOR dims for the
+static-field shapes (`parallel/mesh.py STATIC_DIMS`) — monotone,
+bucketed, and ceilinged. A program that exceeds a ceiling (e.g. a
+constraint on `node.unique.id` whose LUT width tracks the node count)
+would permanently balloon every row, so the whole dispatch falls back to
+the legacy packed transport instead. Cap growth is rare and monotone;
+it flushes the table (generation bump) and the next dispatches re-insert
+on demand.
+
+Content addressing makes correctness trivial: a row key is the blake2b
+digest of the packed static bytes, so a changed job spec (new version,
+grown vocab, node-set change re-materializing the host mask) is simply a
+NEW row; stale rows age out of the LRU. Tables are per-cluster (the
+host-check mask is node-axis shaped) and meshless — the multichip path
+keeps the replicated packed transport.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..kernels.placement import (DYN_FIELDS, STATIC_FIELDS, TGParams,
+                                 pack_param_rows)
+from ..parallel.mesh import STATIC_DIMS, pad_params, param_dims
+
+#: per-dim ceilings for table residency: a program past any of these
+#: would balloon every row in the table (caps are GLOBAL floors), so it
+#: rides the legacy packed transport instead. v tracks the widest vocab
+#: a program references — node.unique.id-style constraints exceed this
+#: by design.
+DIM_CEILINGS = {"v": 512, "c": 128, "a_n": 128, "s_n": 32, "dp_n": 32,
+                "rp_n": 128}
+#: dynamic-row ceilings: candidate restriction (reselect ships ~all
+#: rows) is the one dyn dim that can approach the node count
+DYN_CEILINGS = {"l_n": 512}
+
+#: table row capacity (LRU-evicted); env-tunable for huge job fleets
+TABLE_ROWS_ENV = "NOMAD_TPU_PROG_TABLE_ROWS"
+
+#: fixed insert-chunk width — one XLA compile for the row-insert kernel
+#: regardless of how many cold programs a dispatch carries
+_INSERT_CHUNK = 8
+
+
+class _Prep:
+    """One dispatch's assembled transport (host side)."""
+
+    __slots__ = ("gen", "rows", "dyn_i", "dyn_f", "dyn_u", "sspec",
+                 "dspec", "m")
+
+    def __init__(self, gen, rows, dyn_i, dyn_f, dyn_u, sspec, dspec, m):
+        self.gen = gen
+        self.rows = rows
+        self.dyn_i = dyn_i
+        self.dyn_f = dyn_f
+        self.dyn_u = dyn_u
+        self.sspec = sspec
+        self.dspec = dspec
+        self.m = m
+
+
+_INSERT_JIT = None
+
+
+def _get_insert_jit():
+    """Jitted row-insert: writes K static rows into the three class
+    tables (dynamic_update_index, not scatter — the row-DMA idiom of
+    scheduler/stack.py's delta kernels). Deliberately NOT donated:
+    inserts are the cold path, and donating the shared table buffers
+    would invalidate handles another coordinator's commit() already
+    returned but has not yet launched a gather against — the copy is
+    the cross-dispatch double-buffer here."""
+    global _INSERT_JIT
+    if _INSERT_JIT is None:
+        import jax
+
+        def impl(ti, tf, tu, idx, ri, rf, ru):
+            def body(j, bufs):
+                a, b, c = bufs
+                return (
+                    jax.lax.dynamic_update_index_in_dim(a, ri[j], idx[j], 0),
+                    jax.lax.dynamic_update_index_in_dim(b, rf[j], idx[j], 0),
+                    jax.lax.dynamic_update_index_in_dim(c, ru[j], idx[j], 0),
+                )
+
+            return jax.lax.fori_loop(0, idx.shape[0], body, (ti, tf, tu))
+
+        _INSERT_JIT = jax.jit(impl)
+    return _INSERT_JIT
+
+
+class DeviceProgramTable:
+    """Content-addressed device table of packed static program rows."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self.capacity = capacity or int(
+            os.environ.get(TABLE_ROWS_ENV, "512"))
+        #: running shape floors for the static dims; growth bumps `gen`
+        #: and flushes the device tables
+        self.caps: Dict[str, int] = {}
+        self.gen = 0
+        #: content digest → row index (LRU: recently used rows last)
+        self._rows: "OrderedDict[bytes, int]" = OrderedDict()
+        self._free: List[int] = []
+        self._next_row = 0
+        #: row → (si, sf, su) uploaded lazily at the next commit (a
+        #: second prepare() hitting the same content before the first
+        #: commit must still find real data on device)
+        self._pending: Dict[int, Tuple[np.ndarray, np.ndarray,
+                                       np.ndarray]] = {}
+        self._widths = None          # (Li, Lf, Lu)
+        self._ti = self._tf = self._tu = None
+        #: inserts since construction (test/bench introspection)
+        self.inserts = 0
+        self.flushes = 0
+
+    # ---- host side ----
+
+    def prepare(self, params_list: List[TGParams]) -> Optional[_Prep]:
+        """Pad the batch to the table's shape floors, resolve (or
+        reserve) a table row per program, and pack the dynamic rows.
+        Returns None when any program exceeds a residency ceiling — the
+        caller then uses the legacy packed transport for the whole
+        dispatch (programs must share one chain)."""
+        need = param_dims(params_list)
+        for k, ceil in DIM_CEILINGS.items():
+            if need[k] > ceil:
+                return None
+        for k, ceil in DYN_CEILINGS.items():
+            if need[k] > ceil:
+                return None
+        with self._lock:
+            grown = False
+            for k in STATIC_DIMS:
+                if need[k] > self.caps.get(k, 0):
+                    self.caps[k] = need[k]
+                    grown = True
+            if grown:
+                self._flush_locked()
+            padded, m = pad_params(params_list, dims=self.caps,
+                                   need=need)
+            rows = np.empty(len(padded), dtype=np.int32)
+            sspec = None
+            for i, p in enumerate(padded):
+                si, sf, su, spec = pack_param_rows(p, STATIC_FIELDS)
+                if sspec is None:
+                    sspec = spec
+                if self._widths is None:
+                    self._widths = (si.size, sf.size, su.size)
+                h = hashlib.blake2b(digest_size=16)
+                h.update(si.tobytes())
+                h.update(sf.tobytes())
+                h.update(su.tobytes())
+                key = h.digest()
+                row = self._rows.get(key)
+                if row is None:
+                    row = self._alloc_row_locked()
+                    if row is None:
+                        return None  # capacity full of pending rows
+                    self._rows[key] = row
+                    self._pending[row] = (si, sf, su)
+                    self.inserts += 1
+                else:
+                    self._rows.move_to_end(key)
+                rows[i] = row
+            dyn_i = []
+            dyn_f = []
+            dyn_u = []
+            dspec = None
+            for p in padded:
+                di, df, du, dsp = pack_param_rows(p, DYN_FIELDS)
+                if dspec is None:
+                    dspec = dsp
+                dyn_i.append(di)
+                dyn_f.append(df)
+                dyn_u.append(du)
+            return _Prep(self.gen, rows, np.stack(dyn_i), np.stack(dyn_f),
+                         np.stack(dyn_u), sspec, dspec, m)
+
+    def _alloc_row_locked(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._next_row < self.capacity:
+            r = self._next_row
+            self._next_row += 1
+            return r
+        # LRU-evict the oldest non-pending row and reuse its slot (a
+        # pending row's content is not on device yet — a prepare that
+        # reserved it may still be pre-commit)
+        for key, row in self._rows.items():
+            if row not in self._pending:
+                del self._rows[key]
+                return row
+        return None
+
+    def _flush_locked(self) -> None:
+        self.gen += 1
+        self._rows.clear()
+        self._free = []
+        self._next_row = 0
+        self._pending.clear()
+        self._ti = self._tf = self._tu = None
+        self._widths = None
+        self.flushes += 1
+
+    # ---- device side (call inside the coordinator's guard scope) ----
+
+    def commit(self, prep: _Prep, ledger) -> Optional[Tuple]:
+        """Flush pending static-row inserts to the device tables and
+        return the current (ti, tf, tu) handles plus the bytes uploaded.
+        Returns None when `prep` predates a caps flush (the caller falls
+        back to the legacy transport for this dispatch). EXPLICIT
+        transfers only — runs clean under transfer_guard."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if prep.gen != self.gen:
+                return None
+            if self._ti is None:
+                li, lf, lu = self._widths
+                t = self.capacity
+                self._ti = jnp.zeros((t, li), dtype=jnp.int32)
+                self._tf = jnp.zeros((t, lf), dtype=jnp.float32)
+                self._tu = jnp.zeros((t, lu), dtype=jnp.uint8)
+            nb = 0
+            count = 0
+            if self._pending:
+                items = sorted(self._pending.items())
+                self._pending.clear()
+                idx = np.fromiter((r for r, _ in items), dtype=np.int32,
+                                  count=len(items))
+                ri = np.stack([v[0] for _, v in items])
+                rf = np.stack([v[1] for _, v in items])
+                ru = np.stack([v[2] for _, v in items])
+                pad = -(-idx.shape[0] // _INSERT_CHUNK) * _INSERT_CHUNK
+                if pad > idx.shape[0]:
+                    extra = pad - idx.shape[0]
+                    idx = np.concatenate([idx, np.repeat(idx[:1], extra)])
+                    ri = np.concatenate([ri, np.repeat(ri[:1], extra, 0)])
+                    rf = np.concatenate([rf, np.repeat(rf[:1], extra, 0)])
+                    ru = np.concatenate([ru, np.repeat(ru[:1], extra, 0)])
+                nb = idx.nbytes + ri.nbytes + rf.nbytes + ru.nbytes
+                kern = _get_insert_jit()
+                nch = idx.shape[0] // _INSERT_CHUNK
+                count = 4 * nch
+                with ledger.timed("select_batch.table_insert", nb,
+                                  count=count):
+                    bufs = (self._ti, self._tf, self._tu)
+                    for o in range(0, idx.shape[0], _INSERT_CHUNK):
+                        s = slice(o, o + _INSERT_CHUNK)
+                        bufs = kern(*bufs, jnp.asarray(idx[s]),
+                                    jnp.asarray(ri[s]), jnp.asarray(rf[s]),
+                                    jnp.asarray(ru[s]))
+                    self._ti, self._tf, self._tu = bufs
+            return self._ti, self._tf, self._tu, nb, count
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"rows": len(self._rows), "capacity": self.capacity,
+                    "inserts": self.inserts, "flushes": self.flushes,
+                    "gen": self.gen}
+
+
+#: cluster object → its program table (the _DEV_CACHE precedent: tables
+#: hold node-axis-shaped host masks, so they are per-cluster; weak so a
+#:  dead cluster frees its HBM rows)
+_TABLES: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_TABLES_LOCK = threading.Lock()
+
+
+def table_for(cluster) -> DeviceProgramTable:
+    with _TABLES_LOCK:
+        t = _TABLES.get(cluster)
+        if t is None:
+            t = _TABLES[cluster] = DeviceProgramTable()
+        return t

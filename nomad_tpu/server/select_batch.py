@@ -60,6 +60,7 @@ timeline-compatible, and guard-clean like the base outputs.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -67,6 +68,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils import bucket as _bucket
+
+#: process-unique dispatch tokens: view-lease keys AND the carry/plan
+#: binding (structs.Plan.carry_token ↔ stack note tokens). Module-level
+#: so two coordinators (multi-worker servers) can never collide.
+_DISPATCH_TOKENS = itertools.count(1)
 
 
 class _SelectReq:
@@ -172,9 +178,12 @@ class SelectCoordinator:
         """Park until the coordinator dispatches this program. Returns
         (sel_rows i32[M], scores f32[M], nodes_feasible int,
         nodes_fit i32[M], explain PlacementExplain|None — numpy leaves,
-        this program's slice). Materialization happens HERE, on the
-        waiter thread — the coordinator releases waiters at kernel
-        launch, so this blocks until the fused chain actually lands."""
+        this program's slice — plus the dispatch token, None off the
+        table path; the scheduler stamps it on its plan as carry_token
+        so the commit window binds to THIS dispatch's carry).
+        Materialization happens HERE, on the waiter thread — the
+        coordinator releases waiters at kernel launch, so this blocks
+        until the fused chain actually lands."""
         req = _SelectReq(arrays_fn, params, n_place, order, explain)
         with self._cv:
             self._parked.append(req)
@@ -182,7 +191,7 @@ class SelectCoordinator:
         req.event.wait()
         if req.err is not None:
             raise req.err
-        holder, i = req.out
+        holder, i, token = req.out
         out = holder.resolve()
         sel, score, feas, fit = out[:4]
         # a fused dispatch runs with explain when ANY program asked —
@@ -196,14 +205,14 @@ class SelectCoordinator:
                 from ..kernels.placement import PlacementExplain
 
                 ex = PlacementExplain(*ex_leaves)
-            return sel, score, int(feas), fit, ex
+            return sel, score, int(feas), fit, ex, token
         if ex_leaves:
             from ..kernels.placement import PlacementExplain
 
             # chained dispatch: every explain leaf has a leading
             # program axis — slice this program's row
             ex = PlacementExplain(*(leaf[i] for leaf in ex_leaves))
-        return sel[i], score[i], int(feas[i]), fit[i], ex
+        return sel[i], score[i], int(feas[i]), fit[i], ex, token
 
     # ---- coordinator side (the worker's batch thread) ----
 
@@ -290,7 +299,7 @@ class SelectCoordinator:
                 key = ("arrays", id(a.capacity))
                 resolved[key] = a
             groups.setdefault(key, []).append(r)
-        def _kernel_done(reqs, t_launch, seq):
+        def _kernel_done(reqs, t_launch, seq, cluster=None, token=None):
             def cb(np_out):
                 t_end = time.perf_counter()
                 with self._stats_lock:
@@ -306,6 +315,22 @@ class SelectCoordinator:
                     self.timeline.kernel_end(seq, _mono(t_end),
                                              fetch_bytes=fetch,
                                              fetch_count=len(np_out))
+                if cluster is not None:
+                    # table-path dispatch: the chain has landed — fill
+                    # the carry note's predicted placement rows (per
+                    # eval, from sel_idx) and release the view lease so
+                    # the next refresh may donate again
+                    from ..scheduler import stack as stack_mod
+
+                    sel = np.asarray(np_out[0])
+                    predicted: Dict[Optional[str], set] = {}
+                    for i, r in enumerate(reqs):
+                        eid = self.trace_ids.get(r.order)
+                        rows = {int(x) for x in sel[i].reshape(-1)
+                                if x >= 0}
+                        predicted[eid] = predicted.get(eid, set()) | rows
+                    stack_mod.carry_predicted(cluster, token, predicted)
+                    stack_mod.release_view(cluster, token)
             return cb
 
         for key, reqs in groups.items():
@@ -314,6 +339,22 @@ class SelectCoordinator:
             # with explain when ANY program in the group asked — the
             # others just ignore the extra leaves
             want_ex = any(r.explain for r in reqs)
+            # device-resident path first (ISSUE 10): programs whose
+            # static half fits the per-cluster program table dispatch as
+            # table-row indices + small dynamic rows — no packed-program
+            # upload, and the chain's carry feeds the D2D plan-delta
+            # update. Falls back to the legacy packed/single transport
+            # on residency ceilings, caps flush races, active meshes, or
+            # coordinator-less (bare arrays) callers.
+            if key[0] == "cluster":
+                from ..parallel.mesh import get_active_mesh
+
+                owner = getattr(reqs[0].arrays_fn, "__self__", None)
+                cluster = getattr(owner, "cluster", None)
+                if cluster is not None and get_active_mesh() is None:
+                    if self._dispatch_table(reqs, cluster, want_ex, led,
+                                            _mono, _kernel_done):
+                        continue
             if len(reqs) == 1:
                 r = reqs[0]
                 tv = time.perf_counter()
@@ -339,7 +380,8 @@ class SelectCoordinator:
                        res.nodes_feasible, res.nodes_fit)
                 if res.explain is not None:
                     dev = dev + tuple(res.explain)
-                r.out = (_BatchOut(dev, _kernel_done([r], tk, seq)), None)
+                r.out = (_BatchOut(dev, _kernel_done([r], tk, seq)),
+                         None, None)
                 r.event.set()
                 continue
             self.stats["batched"] += len(reqs)
@@ -403,9 +445,112 @@ class SelectCoordinator:
             # apply, while this thread returns to run() and can pack the
             # next round of parked programs against the in-flight kernel
             for i, r in enumerate(reqs):
-                r.out = (out, i)
+                r.out = (out, i, None)
                 r.event.set()
         self.stats["dispatch_ms"] += (time.perf_counter() - t_start) * 1e3
+
+    def _dispatch_table(self, reqs, cluster, want_ex, led, _mono,
+                        _kernel_done) -> bool:
+        """Dispatch one cluster group through the device program table.
+        Returns False (nothing dispatched, no side effects on reqs) when
+        the group can't ride the table — the caller then runs the legacy
+        transport."""
+        from ..kernels.placement import place_table_chain
+        from ..lib.transfer import guard_scope
+        from ..scheduler import stack as stack_mod
+        from .program_table import table_for
+
+        table = table_for(cluster)
+        params_list = [r.params for r in reqs]
+        # pad the program axis to a power of two with inert programs so
+        # chain compiles are shared across batch sizes; the pad shares
+        # program 0's static table row (identical content) with a
+        # no-effect dynamic row
+        b = _bucket(len(reqs), lo=2)
+        if b > len(reqs):
+            pad = _inert_program(params_list[0])
+            params_list = params_list + [pad] * (b - len(reqs))
+        t0 = time.perf_counter()
+        prep = table.prepare(params_list)
+        if prep is None:
+            return False
+        t1 = time.perf_counter()
+        with guard_scope():
+            import jax.numpy as jnp
+
+            com = table.commit(prep, led)
+            if com is None:
+                return False  # caps flush raced this prepare — the
+                # legacy fallback re-packs, so no stats/spans were
+                # recorded yet (they would double-count)
+            ti, tf, tu, ins_nb, ins_count = com
+            self.stats["pack_ms"] += (t1 - t0) * 1e3
+            self._trace(reqs, "pack", _mono(t0), _mono(t1))
+            if len(reqs) > 1:
+                self.stats["batched"] += len(reqs)
+            nb = (prep.rows.nbytes + prep.dyn_i.nbytes
+                  + prep.dyn_f.nbytes + prep.dyn_u.nbytes)
+            with led.timed("select_batch.dyn_rows", nb, count=4):
+                drows = jnp.asarray(prep.rows)
+                di = jnp.asarray(prep.dyn_i)
+                df = jnp.asarray(prep.dyn_f)
+                du = jnp.asarray(prep.dyn_u)
+            self.stats["pack_bytes"] += nb + ins_nb
+            t2 = time.perf_counter()
+            # view AFTER pack, at the last possible instant before the
+            # kernel (the predecessor batch's plans have committed and,
+            # when its carry survived, resolve here as a zero-transfer
+            # buffer adoption). The dispatch token leases the resolved
+            # buffers ATOMICALLY with the resolve — a concurrent
+            # refresh can then never donate them out from under the
+            # launch below.
+            token = next(_DISPATCH_TOKENS)
+            try:
+                with led.scope() as moved:
+                    arrays = reqs[0].arrays_fn(lease_token=token)
+                tv = time.perf_counter()
+                self.stats["view_ms"] += (tv - t2) * 1e3
+                self._trace(reqs, "delta_apply", _mono(t2), _mono(tv))
+                out, carry = place_table_chain(
+                    arrays, ti, tf, tu, drows, di, df, du,
+                    prep.sspec, prep.dspec, prep.m, explain=want_ex)
+            except BaseException:
+                # the lease is normally released by the first resolver's
+                # kernel_end; a failed launch has no resolvers
+                stack_mod.release_view(cluster, token)
+                raise
+        seq = 0
+        if self.timeline is not None:
+            seq = self.timeline.commit(
+                programs=len(reqs), batched=len(reqs) > 1,
+                pack=(_mono(t0), _mono(t1)),
+                upload=(_mono(t1), _mono(t2)),
+                view=(_mono(t2), _mono(tv)),
+                kernel_start=_mono(tv),
+                transfer_bytes=nb + ins_nb + moved[0],
+                transfer_count=4 + ins_count + moved[1])
+        # carry note: once this dispatch's outputs land and its plans
+        # commit, the next refresh may adopt the chain's (used,
+        # dyn_free) carry instead of re-uploading the committed rows.
+        # The token (already leased at resolve) also rides the waiters'
+        # results onto their plans (carry_token): a commit window
+        # covers the carry only when it came from THIS dispatch.
+        evals = [self.trace_ids.get(r.order) for r in reqs]
+        stop_rows = set()
+        for r in reqs:
+            p = r.params
+            for arr in (p.delta_idx, p.pclr_idx, p.pset_idx):
+                a = np.asarray(arr).reshape(-1)
+                stop_rows.update(int(x) for x in a[a >= 0])
+        stack_mod.note_dispatch_carry(cluster, token, arrays, evals,
+                                      stop_rows, carry[0], carry[1])
+        holder = _BatchOut(
+            tuple(out),
+            _kernel_done(reqs, tv, seq, cluster=cluster, token=token))
+        for i, r in enumerate(reqs):
+            r.out = (holder, i, token)
+            r.event.set()
+        return True
 
     def _trace(self, reqs: List[_SelectReq], phase: str,
                start: float, end: float) -> None:
@@ -423,12 +568,14 @@ class SelectCoordinator:
 def _inert_program(p):
     """A zero-effect pad program: places nothing (n_place=0) and carries
     no plan-relative deltas, so the chain's (used, dyn_free) carry passes
-    through it unchanged."""
+    through it unchanged. Only DYNAMIC fields are touched — n_place=0
+    already makes the (static) ask/n_dyn unreachable (no step is active,
+    so nothing is ever added to the carry), and keeping the static half
+    bit-identical to the template program lets the pad share its device
+    program-table row instead of inserting a near-duplicate."""
     z = np.zeros_like
     return p._replace(
         n_place=np.int32(0),
-        ask=z(np.asarray(p.ask)),
-        n_dyn=np.float32(0.0),
         delta_idx=np.full_like(np.asarray(p.delta_idx), -1),
         delta_res=z(np.asarray(p.delta_res)),
         pclr_idx=np.full_like(np.asarray(p.pclr_idx), -1),

@@ -59,6 +59,19 @@ class Plan:
     deployment_updates: List[DeploymentStatusUpdate] = field(default_factory=list)
     annotations: Optional[PlanAnnotations] = None
     snapshot_index: int = 0
+    #: scheduler certification for the device-resident plan-delta path
+    #: (ISSUE 10): True iff every placement in this plan commits EXACTLY
+    #: what the fused kernel dispatch predicted — same node rows, usage
+    #: rows bit-equal to the compiled ask vector, all-integral values —
+    #: and nothing post-kernel (preemption victims, offer-time
+    #: reselects, in-place updates) diverged. Only then may the device
+    #: view adopt the dispatch's on-device carry for this plan's rows.
+    carry_exact: bool = False
+    #: the fused-dispatch token the plan's (last) selection came from —
+    #: binds the commit window to ONE dispatch carry, so a later retry
+    #: plan of the same eval can never vouch for an earlier dispatch's
+    #: uncommitted placements
+    carry_token: Optional[int] = None
 
     def append_stopped_alloc(self, alloc: Allocation, desired_desc: str,
                              client_status: str = "") -> None:

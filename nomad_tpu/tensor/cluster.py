@@ -130,8 +130,54 @@ class ClusterTensors:
         # per version bump, it ships only the touched rows.
         self._hot_log: Deque[Tuple[int, Tuple[int, ...]]] = deque()
         self._hot_floor = 0     # versions < floor are not reconstructible
-        self._ports_log: Deque[Tuple[int, int]] = deque()
+        #: (ports_version-after-bump, row, word | None). `word` is the
+        #: touched u32 word of the packed bitmap when the mutation was a
+        #: single port flip — the device refresh then ships one word
+        #: instead of the whole 8 KB row; None means the whole row
+        #: changed (node upsert/remove rebuilds)
+        self._ports_log: Deque[Tuple[int, int, Optional[int]]] = deque()
         self._ports_floor = 0
+        # ---- plan-commit windows (device-view D2D plan deltas) --------
+        # The plan applier marks each committed plan's (version-before,
+        # version-after] range here (under the store's mutation lock, so
+        # no foreign bump can land inside a window). The device-view
+        # cache uses it to tell KERNEL-committed rows — already present
+        # in the dispatch's device-resident carry — from every other
+        # mutation, which must re-upload from host. `clean` = the plan
+        # committed in full (no partial/rejections); `exact` = the
+        # scheduler certified every placement's usage row equals the
+        # kernel's ask vector bit-for-bit (structs.Plan.carry_exact);
+        # `token` = the fused-dispatch token the plan's selection came
+        # from (structs.Plan.carry_token) — a window only ever covers
+        # the carry of the SAME dispatch, so a retry plan of an eval
+        # whose earlier dispatch never committed can't whitewash that
+        # dispatch's phantom placements into an adoption.
+        self._plan_windows: Deque[Tuple[int, int, str, bool,
+                                        Optional[int]]] = deque()
+
+    # ---- plan-commit windows ----
+
+    PLAN_WINDOW_LEN = 256
+
+    def mark_plan_window(self, eval_id: str, v_lo: int, v_hi: int,
+                        clean: bool, exact: bool,
+                        token: Optional[int] = None) -> None:
+        """Record that versions (v_lo, v_hi] were one plan's commit.
+        MUST be called under the same lock as the commit itself — a
+        foreign mutation interleaving into the window would be
+        mis-attributed as kernel-committed."""
+        log = self._plan_windows
+        if len(log) >= self.PLAN_WINDOW_LEN:
+            log.popleft()
+        log.append((v_lo, v_hi, eval_id, bool(clean and exact), token))
+
+    def plan_windows_since(self, v0: int):
+        """[(v_lo, v_hi, eval_id, covered, token)] for windows
+        overlapping (v0, version]. `covered` folds clean+exact: True
+        means every row change inside the window matches what the
+        committing eval's kernel dispatch predicted; `token` names that
+        dispatch."""
+        return [w for w in list(self._plan_windows) if w[1] > v0]
 
     # ---- delta logs ----
 
@@ -151,14 +197,16 @@ class ClusterTensors:
             log.popleft()
         log.append((self.version + 1, rows))
 
-    def _log_ports(self, row: int) -> None:
+    def _log_ports(self, row: int, word: Optional[int] = None) -> None:
         """Record a port-bitmap row about to change at `ports_version +
-        1`. MUST be called before the matching bump."""
+        1`. MUST be called before the matching bump. `word` names the
+        single touched u32 word for port flips; None means the whole
+        row (rebuilds)."""
         log = self._ports_log
         if len(log) >= DELTA_LOG_LEN:
             self._ports_floor = log[0][0]   # floor BEFORE pop, see _log_hot
             log.popleft()
-        log.append((self.ports_version + 1, row))
+        log.append((self.ports_version + 1, row, word))
 
     def hot_rows_since(self, v0: int, limit: int) -> Optional[Set[int]]:
         """Rows whose used/node_ok/dyn_free changed in (v0, version] —
@@ -168,31 +216,60 @@ class ClusterTensors:
         re-checked AFTER copying the log: a concurrent append can wrap
         the deque and drop a needed entry between an up-front check and
         the copy, which would silently yield an incomplete row set."""
+        entries = self.hot_entries_since(v0, limit)
+        if entries is None:
+            return None
+        rows: Set[int] = set()
+        for _ver, rs in entries:
+            rows.update(rs)
+        return rows
+
+    def hot_entries_since(self, v0: int, limit: int
+                          ) -> Optional[list]:
+        """Version-attributed form of hot_rows_since: [(version, rows)]
+        for entries in (v0, version], None on window miss or when the
+        row union exceeds `limit`. The versions let the device-view
+        refresh classify each change against the plan-commit windows
+        (kernel-committed → covered by the dispatch carry; anything
+        else → host re-upload)."""
+        out = []
         rows: Set[int] = set()
         entries = list(self._hot_log)
         if v0 < self._hot_floor:
             return None
         for ver, rs in entries:
             if ver > v0:
+                out.append((ver, rs))
                 rows.update(rs)
                 if len(rows) > limit:
                     return None
-        return rows
+        return out
 
-    def port_rows_since(self, pv0: int, limit: int) -> Optional[Set[int]]:
-        """Port-bitmap rows changed in (pv0, ports_version]; None on
-        window miss or overflow (same contract — including the
-        copy-then-check floor ordering — as hot_rows_since)."""
-        rows: Set[int] = set()
+    def port_words_since(self, pv0: int, limit: int
+                         ) -> Optional[Dict[int, Optional[Set[int]]]]:
+        """Word-granular port delta: {row: set of touched u32 words, or
+        None for a whole-row rebuild} for changes in (pv0,
+        ports_version]. None on window miss or row-count overflow (the
+        hot_rows_since contract, including the copy-then-check floor
+        ordering). A port flip names one word, so a steady-state
+        refresh ships 4-byte words instead of 8 KB rows — the
+        transfer-compaction half of the D2D plan-delta path."""
+        out: Dict[int, Optional[Set[int]]] = {}
         entries = list(self._ports_log)
         if pv0 < self._ports_floor:
             return None
-        for ver, row in entries:
-            if ver > pv0:
-                rows.add(row)
-                if len(rows) > limit:
-                    return None
-        return rows
+        for ver, row, word in entries:
+            if ver <= pv0:
+                continue
+            if word is None:
+                out[row] = None
+            elif row not in out:
+                out[row] = {word}
+            elif out[row] is not None:
+                out[row].add(word)
+            if len(out) > limit:
+                return None
+        return out
 
     def delta_stats(self) -> Dict[str, int]:
         """Delta-log health for the observability surfaces (stack.py
@@ -259,7 +336,7 @@ class ClusterTensors:
 
     def _set_port(self, row: int, port: int) -> None:
         self.ports_used[row, port >> 5] |= np.uint32(1 << (port & 31))
-        self._log_ports(row)
+        self._log_ports(row, port >> 5)
         self.ports_version += 1
         if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
             self.dyn_free[row] -= 1.0
@@ -267,7 +344,7 @@ class ClusterTensors:
     def _clear_port(self, row: int, port: int) -> None:
         self.ports_used[row, port >> 5] &= np.uint32(
             ~(1 << (port & 31)) & 0xFFFFFFFF)
-        self._log_ports(row)
+        self._log_ports(row, port >> 5)
         self.ports_version += 1
         if MIN_DYNAMIC_PORT <= port <= MAX_DYNAMIC_PORT:
             self.dyn_free[row] += 1.0

@@ -255,6 +255,7 @@ class TestMeshCA:
     def test_ca_namespace_reserved_from_secrets_surface(self, agent):
         """The raft-replicated mesh CA key must not be readable,
         overwritable, or deletable through the public secrets API."""
+        pytest.importorskip("cryptography")  # connect_issue mints X.509
         from nomad_tpu.structs.secrets import SecretEntry
 
         a, api = agent
@@ -565,6 +566,7 @@ class TestValidation:
         assert "target port" in (proc.stderr + proc.stdout)
 
     def test_reserved_namespace_blocked_over_http(self, agent):
+        pytest.importorskip("cryptography")  # connect_issue mints X.509
         from nomad_tpu.api.client import ApiError
 
         a, api = agent

@@ -43,6 +43,8 @@ REQUIRED = {
     # device-view delta refresh (scheduler/stack.py)
     "nomad_view_upload_bytes", "nomad_view_full_uploads",
     "nomad_view_hot_log_len", "nomad_view_ports_log_len",
+    # device-to-device plan deltas (ISSUE 10: dispatch-carry adoption)
+    "nomad_view_carry_adopts", "nomad_view_carry_rows",
     # transfer ledger mirrors + labeled per-site exposition
     "nomad_transfer_bytes", "nomad_transfer_count", "nomad_transfer_ms",
     "nomad_transfer_bytes_total", "nomad_transfer_count_total",
@@ -50,6 +52,12 @@ REQUIRED = {
     # dispatch pipeline (lib/transfer.DispatchTimeline)
     "nomad_pipeline_dispatches", "nomad_pipeline_programs",
     "nomad_pipeline_transfer_bytes", "nomad_pipeline_transfer_count",
+    # pipeline phase + overlap/bubble histograms — the r06 acceptance
+    # read (overlap_pct) aggregates from these; renames break it
+    "nomad_pipeline_pack_ms", "nomad_pipeline_upload_ms",
+    "nomad_pipeline_view_ms", "nomad_pipeline_host_ms",
+    "nomad_pipeline_kernel_ms", "nomad_pipeline_overlap_ms",
+    "nomad_pipeline_bubble_ms",
     # scheduler explainability counters (ISSUE 8)
     "nomad_scheduler_filter_constraint",
     "nomad_scheduler_exhausted_cpu",
@@ -80,8 +88,9 @@ ALLOWED_LABELS = {"site", "quantile"}
 #: renames here break `top_sites` dashboards exactly like metric renames
 ALLOWED_SITES = {
     "stack.static_full", "stack.hot_full", "stack.hot_delta",
-    "stack.ports_full", "stack.ports_delta",
+    "stack.ports_full", "stack.ports_delta", "stack.ports_word_delta",
     "select_batch.pack_buffers", "select_batch.fetch",
+    "select_batch.table_insert", "select_batch.dyn_rows",
     "mesh.shard_cluster",
 }
 
@@ -144,18 +153,27 @@ def loaded_agent(tmp_path, monkeypatch):
         return j
 
     # park registrations while the broker is disabled, then restore —
-    # the 6 pending evals drain as ONE worker batch (fused dispatch)
+    # each wave's pending evals drain as ONE worker batch (fused
+    # dispatch). TWO waves: the second wave's dispatch pairs with the
+    # first in the pipeline timeline (overlap/bubble histograms) and
+    # adopts the first wave's device carry (view.carry_* counters) —
+    # both promised families must be populated, not vacuously absent.
     s = a.server
-    s.broker.set_enabled(False)
-    eval_ids = [api.register_job(job()) for _ in range(4)]
-    eval_ids.append(api.register_job(job(cpu=10**7)))  # exhausted → blocked
-    eval_ids.append(api.register_job(job(
-        constraint=Constraint("${attr.nope}", "x", "="))))  # filtered
-    s.broker.set_enabled(True)
-    s._restore_evals()
-    for eid in eval_ids:
-        ev = api.wait_for_eval(eid, timeout=30.0)
-        assert ev is not None and ev.status == "complete"
+    eval_ids = []
+    for wave in range(2):
+        s.broker.set_enabled(False)
+        wave_ids = [api.register_job(job()) for _ in range(4)]
+        if wave == 1:
+            wave_ids.append(
+                api.register_job(job(cpu=10**7)))  # exhausted → blocked
+            wave_ids.append(api.register_job(job(
+                constraint=Constraint("${attr.nope}", "x", "="))))  # filtered
+        s.broker.set_enabled(True)
+        s._restore_evals()
+        for eid in wave_ids:
+            ev = api.wait_for_eval(eid, timeout=30.0)
+            assert ev is not None and ev.status == "complete"
+        eval_ids.extend(wave_ids)
     yield a, api
     a.shutdown()
 
@@ -187,9 +205,11 @@ class TestSeriesNameStability:
         assert labels <= ALLOWED_LABELS, labels - ALLOWED_LABELS
         assert sites <= ALLOWED_SITES, sites - ALLOWED_SITES
         # the fused-dispatch sites must actually be present (the flow
-        # above ran a batched coordinator round)
+        # above ran batched coordinator rounds on the device-resident
+        # program-table transport)
         assert "select_batch.fetch" in sites
-        assert "select_batch.pack_buffers" in sites
+        assert "select_batch.table_insert" in sites
+        assert "select_batch.dyn_rows" in sites
 
     def test_batched_flow_populated_pipeline(self, loaded_agent):
         """Guard the fixture itself: if the batched path silently stops

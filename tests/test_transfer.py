@@ -376,7 +376,12 @@ class TestLedgerAttribution:
                 if site.startswith(prefix))
 
         stack_bytes = site_delta("stack.")
-        pack_bytes = site_delta("select_batch.pack_buffers")
+        # program transport: table-row inserts + per-dispatch dynamic
+        # rows (the device-resident path) plus the legacy packed buffers
+        # (fallback dispatches) — all mirrored in coord pack_bytes
+        pack_bytes = (site_delta("select_batch.pack_buffers")
+                      + site_delta("select_batch.dyn_rows")
+                      + site_delta("select_batch.table_insert"))
         fetch_bytes = site_delta("select_batch.fetch")
         # exact reconciliation vs the two independent accumulators
         assert stack_bytes == v1 - v0
@@ -451,6 +456,8 @@ class TestLedgerAttributionE2E:
 
         ledger_h2d = (site_delta("stack.")
                       + site_delta("select_batch.pack_buffers")
+                      + site_delta("select_batch.dyn_rows")
+                      + site_delta("select_batch.table_insert")
                       + site_delta("mesh."))
         expected = ((v1 - v0)
                     + w1.get("pack_bytes", 0) - w0.get("pack_bytes", 0))

@@ -203,7 +203,7 @@ class TestDeltaParity:
         cl.upsert_alloc(_alloc(nodes[1].id, cpu=50))
 
         racer = _alloc(nodes[5].id, cpu=999)
-        real = ClusterTensors.hot_rows_since
+        real = ClusterTensors.hot_entries_since
         fired = {}
 
         def racing(self_cl, v0, limit):
@@ -214,14 +214,14 @@ class TestDeltaParity:
                 self_cl.upsert_alloc(racer)
             return rows
 
-        monkeypatch.setattr(ClusterTensors, "hot_rows_since", racing)
+        monkeypatch.setattr(ClusterTensors, "hot_entries_since", racing)
         stack.device_arrays()
         assert fired, "race hook never ran"
         ent = _DEV_CACHE.get(cl)
         assert ent["version"] < cl.version, \
             "entry marked current despite concurrent mutation"
         # next refresh converges on the racer's rows
-        monkeypatch.setattr(ClusterTensors, "hot_rows_since", real)
+        monkeypatch.setattr(ClusterTensors, "hot_entries_since", real)
         delta_np = _np_view(stack.device_arrays())
         row5 = cl.row_of[nodes[5].id]
         assert delta_np["used"][row5, 0] == pytest.approx(999.0)
