@@ -704,6 +704,20 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
                         for e in pipeline["top_sites"][:3]))
         log("e2e: d2d " + ", ".join(
             f"{k}={v}" for k, v in sorted(pipeline["d2d"].items())))
+        # HBM residency tail (lib/hbm.py): the memory trajectory next
+        # to the speed one — what the device-resident loop keeps live
+        # per site, the lease high-water, the allocator cross-check,
+        # and the ROADMAP item-3 projection (does 100k nodes / 1M
+        # allocs fit one HBM, measured per-row costs)
+        hbm_tail = _e2e_hbm()
+        log(f"e2e: hbm live {hbm_tail['live_bytes']}B "
+            f"peak {hbm_tail['peak_bytes']}B "
+            f"leases hw {hbm_tail['lease_high_water']} "
+            f"(oldest {hbm_tail['lease_age_high_water_s']}s); "
+            f"100k-node plan "
+            f"{hbm_tail['plan_100k']['projected_bytes']}B "
+            + ("fits" if hbm_tail["plan_100k"]["fits"] else
+               f"needs {hbm_tail['plan_100k']['shards_needed']} shards"))
     finally:
         s.shutdown()
     rate = done / dt if dt else 0.0
@@ -736,6 +750,35 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # ISSUE 8): which scenario regresses, and WHY — filtered vs
         # exhausted, by constraint label and resource dimension
         "e2e_attribution": attribution,
+        # device-buffer residency (lib/hbm.py): live/peak per site,
+        # lease high-water, allocator cross-check, 100k-node capacity
+        # projection — BENCH_r06+ carries a memory trajectory alongside
+        # the speed one (ROADMAP item 3's steering read)
+        "e2e_hbm": hbm_tail,
+    }
+
+
+def _e2e_hbm() -> dict:
+    """bench tail `e2e_hbm`: per-site residency + lease lifetime
+    high-water + the 100k-node / 1M-alloc capacity projection from the
+    per-row costs this very run measured."""
+    from nomad_tpu.lib import hbm as hbm_mod
+
+    ledger = hbm_mod.default_hbm()
+    summ = ledger.summary()
+    rec = hbm_mod.reconcile(ledger)
+    return {
+        "sites": {site: {k: v[k] for k in ("live_bytes", "peak_bytes",
+                                           "buffers")}
+                  for site, v in sorted(ledger.snapshot().items())},
+        "live_bytes": summ["live_bytes"],
+        "peak_bytes": summ["peak_bytes"],
+        "outstanding_leases": summ["outstanding_leases"],
+        "lease_high_water": summ["lease_high_water"],
+        "lease_age_high_water_s": summ["lease_age_high_water_s"],
+        "device_bytes_in_use": rec["device_bytes_in_use"],
+        "coverage_pct": rec["coverage_pct"],
+        "plan_100k": hbm_mod.plan_capacity(100_000, 1_000_000, ledger),
     }
 
 

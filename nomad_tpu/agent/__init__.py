@@ -378,6 +378,14 @@ class Agent:
         from ..lib.transfer import default_ledger
 
         out["transfer_sites"] = default_ledger().snapshot()
+        # device-buffer residency (lib/hbm.py): live/peak bytes per
+        # site plus lease state — snapshot() also runs the stuck-lease
+        # watermark check, so a scrape is enough to surface a leak
+        from ..lib.hbm import default_hbm
+
+        hbm = default_hbm()
+        out["hbm_sites"] = hbm.snapshot()
+        out["hbm"] = hbm.summary()
         if self.client is not None:
             out["client_allocs"] = self.client.num_allocs()
         return out
@@ -386,8 +394,10 @@ class Agent:
         """Prometheus text exposition across both registries plus the
         transfer ledger's labeled per-site series. Name sets are
         disjoint (server-owned vs process-global instruments vs the
-        ledger's `nomad_transfer_*_total{site=...}` family), so plain
+        ledgers' labeled `nomad_transfer_*_total{site=...}` /
+        `nomad_hbm_*{site=...,shard=...}` families), so plain
         concatenation is collision-free."""
+        from ..lib.hbm import default_hbm
         from ..lib.metrics import default_registry
         from ..lib.transfer import default_ledger
 
@@ -398,6 +408,7 @@ class Agent:
                 parts.append(reg.prometheus())
         parts.append(default_registry().prometheus())
         parts.append(default_ledger().prometheus())
+        parts.append(default_hbm().prometheus())
         return "".join(parts)
 
 

@@ -1441,6 +1441,35 @@ class HTTPApi:
             wait = min(float(query.get("wait", 0) or 0), 60.0)
             idx, recs = timeline.records_after(index, timeout=wait)
             return {"index": idx, "dispatches": recs}
+        # /v1/operator/hbm — device-buffer residency (lib/hbm.py):
+        # summary + per-site + per-shard breakdown, the allocator
+        # cross-check, ?watermarks=1 for lease ages, and the mesh
+        # capacity planner (?plan=1&nodes=N&allocs=M). Operator-read
+        # gated like the other scheduler internals.
+        if parts == ["operator", "hbm"]:
+            require(acl.allow_operator_read())
+            from ..lib import hbm as hbm_mod
+
+            ledger = hbm_mod.default_hbm()
+            out = {
+                "summary": ledger.summary(),
+                "sites": ledger.snapshot(),
+                "shards": ledger.shards(),
+                "reconciliation": hbm_mod.reconcile(ledger),
+            }
+            if query.get("watermarks") == "1":
+                out["leases"] = ledger.leases()
+            if query.get("plan") == "1":
+                try:
+                    nodes = int(query["nodes"])
+                    allocs = int(query["allocs"])
+                    out["plan"] = hbm_mod.plan_capacity(nodes, allocs,
+                                                        ledger)
+                except (KeyError, ValueError) as e:
+                    raise HttpError(
+                        400, f"plan needs integer nodes > 0 and "
+                             f"allocs >= 0: {e}")
+            return out
         raise HttpError(404, f"no handler for {method} {path}")
 
     # ---- /v1/acl/* (acl_endpoint.go) ----

@@ -476,6 +476,24 @@ class NomadClient:
         return self._request("GET", "/v1/scheduler/timeline",
                              params={"summary": "1"})
 
+    def operator_hbm(self, watermarks: bool = False,
+                     plan: Optional[Tuple[int, int]] = None) -> dict:
+        """Device-buffer residency (GET /v1/operator/hbm): summary +
+        per-site + per-shard live/peak bytes, the
+        `jax.Device.memory_stats()` cross-check, lease ages with
+        `watermarks=True`, and — with `plan=(nodes, allocs)` — the mesh
+        capacity projection (fits / headroom / shards needed) from
+        measured per-row costs."""
+        params: Dict[str, str] = {}
+        if watermarks:
+            params["watermarks"] = "1"
+        if plan is not None:
+            nodes, allocs = plan
+            params.update({"plan": "1", "nodes": str(nodes),
+                           "allocs": str(allocs)})
+        return self._request("GET", "/v1/operator/hbm",
+                             params=params or None)
+
     def status_leader(self):
         return self._request("GET", "/v1/status/leader")
 

@@ -869,6 +869,113 @@ def cmd_operator_timeline(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GB"
+
+
+def cmd_operator_hbm(args) -> int:
+    """`nomad-tpu operator hbm [-watermarks] [-plan -nodes N -allocs M]`
+    — device-buffer residency (/v1/operator/hbm): what is living in HBM
+    per site and shard, whether any view lease is stuck past the age
+    watermark, and — with `-plan` — whether a target cluster size fits
+    one device or how many node-axis shards it needs (the ROADMAP
+    item-3 "will it fit / when to shard" read)."""
+    from .api import ApiError
+
+    plan = None
+    if args.plan:
+        # malformed -plan args: one-line error + exit 1, the eval
+        # trace / operator timeline convention
+        if args.nodes is None or args.allocs is None:
+            print("Error: -plan requires -nodes and -allocs",
+                  file=sys.stderr)
+            return 1
+        if args.nodes <= 0 or args.allocs < 0:
+            print(f"Error: -plan needs nodes > 0 and allocs >= 0 "
+                  f"(got nodes={args.nodes}, allocs={args.allocs})",
+                  file=sys.stderr)
+            return 1
+        plan = (args.nodes, args.allocs)
+    api = _client(args)
+    try:
+        out = api.operator_hbm(watermarks=args.watermarks, plan=plan)
+    except (ApiError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    summ = out.get("summary", {})
+    rec = out.get("reconciliation", {})
+    print(f"Live         = {_fmt_bytes(summ.get('live_bytes', 0))} in "
+          f"{summ.get('buffers', 0)} device buffers")
+    print(f"Peak         = {_fmt_bytes(summ.get('peak_bytes', 0))}")
+    print(f"Leases       = {summ.get('outstanding_leases', 0)} "
+          f"outstanding (high water {summ.get('lease_high_water', 0)}, "
+          f"oldest ever {summ.get('lease_age_high_water_s', 0.0):.1f}s, "
+          f"watermark {summ.get('lease_watermark_s', 0.0):.0f}s)")
+    cov = rec.get("coverage_pct")
+    if cov is not None:
+        print(f"Coverage     = {cov:.1f}% of allocator bytes_in_use "
+              f"({_fmt_bytes(rec.get('device_bytes_in_use') or 0)}) "
+              f"is ledger-attributed")
+    else:
+        print("Coverage     = n/a (backend exposes no memory_stats)")
+    sites = out.get("sites", {})
+    if sites:
+        print()
+        rows = [[site, _fmt_bytes(v["live_bytes"]), str(v["buffers"]),
+                 _fmt_bytes(v["peak_bytes"])]
+                for site, v in sorted(
+                    sites.items(),
+                    key=lambda kv: -kv[1]["live_bytes"])]
+        print(_columns(rows, ["Site", "Live", "Buffers", "Peak"]))
+    if args.watermarks:
+        leases = out.get("leases", [])
+        print()
+        if leases:
+            rows = [[str(l["token"]), l["site"], f"{l['age_s']:.1f}",
+                     "STUCK" if l["stuck"] else "ok"]
+                    for l in leases]
+            print(_columns(rows, ["Token", "Site", "Age (s)", "State"]))
+        else:
+            print("No outstanding leases")
+    p = out.get("plan")
+    if p:
+        print()
+        print(f"Plan for {p['nodes']} nodes / {p['allocs']} allocs "
+              f"(row capacity {p['projected_n_cap']}):")
+        if not p.get("measured"):
+            print("  WARNING: no node-axis residency measured yet — "
+                  "projection covers fixed/transient state only")
+        print(f"  projected  = {_fmt_bytes(p['projected_bytes'])} "
+              f"({_fmt_bytes(p['per_node_bytes'])}/node x "
+              f"{p['projected_n_cap']} + "
+              f"{_fmt_bytes(p['fixed_bytes'])} fixed + "
+              f"{_fmt_bytes(p['transient_peak_bytes'])} transient)")
+        print(f"  device     = {_fmt_bytes(p['device_limit_bytes'])} "
+              f"({p['limit_source']})")
+        if p["fits"]:
+            print(f"  fits: yes — headroom "
+                  f"{_fmt_bytes(p['headroom_bytes'])}")
+        elif p["shards_needed"]:
+            print(f"  fits: NO — short {_fmt_bytes(-p['headroom_bytes'])}"
+                  f"; shard the node axis over {p['shards_needed']} "
+                  f"devices (parallel/mesh.py cluster_sharding)")
+        else:
+            print(f"  fits: NO — short {_fmt_bytes(-p['headroom_bytes'])}"
+                  f", and the replicated per-shard state (fixed + "
+                  f"transient) leaves no workable node budget on any "
+                  f"sane mesh — node-axis sharding cannot help; shrink "
+                  f"the program table / dispatch width first")
+    return 0
+
+
 # ---- operator / misc ----
 
 def cmd_quota(args) -> int:
@@ -1801,6 +1908,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="block up to this many seconds for new records")
     otl.add_argument("-json", action="store_true")
     otl.set_defaults(fn=cmd_operator_timeline)
+    ohb = op.add_parser("hbm",
+                        help="device-buffer residency + capacity planner")
+    ohb.add_argument("-watermarks", action="store_true",
+                     help="list outstanding view leases with ages")
+    ohb.add_argument("-plan", action="store_true",
+                     help="project a target cluster's device footprint")
+    ohb.add_argument("-nodes", type=int, default=None,
+                     help="target node count for -plan")
+    ohb.add_argument("-allocs", type=int, default=None,
+                     help="target allocation count for -plan")
+    ohb.add_argument("-json", action="store_true")
+    ohb.set_defaults(fn=cmd_operator_hbm)
 
     sysp = sub.add_parser("system", help="system commands").add_subparsers(
         dest="sub", required=True)

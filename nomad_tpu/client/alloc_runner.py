@@ -570,18 +570,23 @@ class AllocRunner:
                     readonly=req.read_only,
                     publish_context=publish_context)
                 self.volume_paths[name] = path
-                self._csi_mounted.append((vol.plugin_id, vol.id))
+                # _lock: the mount list is written by the alloc run
+                # thread and drained by destroy() (client thread) —
+                # NLT01 per the per-class thread-root analysis
+                with self._lock:
+                    self._csi_mounted.append((vol.plugin_id, vol.id))
 
     def _unmount_volumes(self) -> None:
         if self.csi_manager is None:
             return
-        for plugin_id, vol_id in self._csi_mounted:
+        with self._lock:
+            mounted, self._csi_mounted = self._csi_mounted, []
+        for plugin_id, vol_id in mounted:
             try:
                 self.csi_manager.unmount_volume(plugin_id, vol_id,
                                                 self.alloc.id)
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
-        self._csi_mounted.clear()
 
     def _event_all(self, message: str) -> None:
         from ..structs import TaskEvent

@@ -105,7 +105,12 @@ class TaskRunner:
         self.logmon: Optional[LogMon] = None
         self.handle = None
         self._kill = threading.Event()
+        #: agent-shutdown detach flag: written by detach() (client
+        #: shutdown thread), read by the run loop after _kill fires —
+        #: guarded by _detach_lock on both sides (NLT01 per the
+        #: per-class thread-root analysis)
         self._detach = False
+        self._detach_lock = threading.Lock()
         #: user-requested restart in flight: the next task exit restarts
         #: immediately without consuming restart-policy budget
         self._manual_restart = False
@@ -187,7 +192,9 @@ class TaskRunner:
             while result is None and not self._kill.is_set():
                 result = self.driver.wait_task(self.handle, timeout=0.1)
             if self._kill.is_set():
-                if self._detach:
+                with self._detach_lock:
+                    detach = self._detach
+                if detach:
                     # agent shutdown: leave the task running; the handle
                     # is persisted, the next agent recovers it
                     return
@@ -266,7 +273,9 @@ class TaskRunner:
         self._event(EVENT_RESTARTING, f"Task restarting in {delay:.1f}s")
         self._set_state(TASK_STATE_PENDING)
         if self._kill.wait(delay):
-            if not self._detach:
+            with self._detach_lock:
+                detach = self._detach
+            if not detach:
                 self._set_state(TASK_STATE_DEAD, failed=False)
             return False
         return True
@@ -763,7 +772,8 @@ class TaskRunner:
             self.kill()
             self.join(timeout=self.task.kill_timeout_s + 7.0)
             return
-        self._detach = True
+        with self._detach_lock:
+            self._detach = True
         self._kill.set()
         self._tmpl_stop.set()
 

@@ -135,6 +135,7 @@ def params_sharding(mesh: Mesh, batched: bool = True) -> TGParams:
 
 
 def shard_cluster(arrays: ClusterArrays, mesh: Mesh) -> ClusterArrays:
+    from ..lib.hbm import default_hbm
     from ..lib.transfer import default_ledger
 
     shardings = cluster_sharding(mesh)
@@ -144,9 +145,16 @@ def shard_cluster(arrays: ClusterArrays, mesh: Mesh) -> ClusterArrays:
     nb = sum(a.nbytes for a in arrays)
     with default_ledger().timed("mesh.shard_cluster", nb,
                                 count=len(arrays)):
-        return ClusterArrays(
+        out = ClusterArrays(
             *[jax.device_put(a, s) for a, s in zip(arrays, shardings)]
         )
+    # residency ledger: book the sharded snapshot per device shard (the
+    # ledger splits a sharded array by addressable_shards), with the
+    # node-axis length so the capacity planner can price a node row
+    hbm = default_hbm()
+    for a in out:
+        hbm.track("mesh.cluster", a, rows=int(a.shape[0]))
+    return out
 
 
 def _pad_rows(a: np.ndarray, n: int, fill) -> np.ndarray:

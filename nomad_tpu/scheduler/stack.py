@@ -228,10 +228,15 @@ def _apply_chunked(kernel, bufs, idx, *vals):
 
 
 def release_view(cluster, token) -> None:
+    from ..lib.hbm import default_hbm
+
     with _DEV_CACHE_LOCK:
         ent = _DEV_CACHE.get(cluster)
         if ent is not None:
             ent.setdefault("leases", set()).discard(token)
+    # residency ledger: the lease's owner-token lifetime ends here
+    # (idempotent — failed launches release defensively)
+    default_hbm().release_lease(token)
 
 
 def note_dispatch_carry(cluster, token, base_arrays, evals, stop_rows,
@@ -356,10 +361,12 @@ class TPUStack:
             sh = ClusterArrays(*([None] * len(ClusterArrays._fields)))
             up = lambda a, s, dtype=None: jnp.asarray(a, dtype=dtype)  # noqa: E731
 
+        from ..lib.hbm import default_hbm
         from ..lib.transfer import default_ledger
 
         reg = default_registry()
         led = default_ledger()
+        hbm = default_hbm()
         cl = self.cluster
         with _DEV_CACHE_LOCK:
             # capture ALL keys BEFORE reading delta rows or uploading: a
@@ -382,6 +389,7 @@ class TPUStack:
                     and ent["static_key"] == static_key:
                 if lease_token is not None:
                     ent.setdefault("leases", set()).add(lease_token)
+                    hbm.lease(lease_token, "stack.view")
                 return ent["arrays"]
             #: live view leases (dispatches in flight against the cached
             #: buffers): with any held, updates must COPY into a second
@@ -533,8 +541,17 @@ class TPUStack:
                 ports_used=ports_used,
                 dyn_free=dyn_free,
             )
+            # residency ledger: book the refreshed view slots by site
+            # class. Buffers carried forward are already booked (no-op);
+            # an adopted carry RE-SITES from select_batch.carry to the
+            # view (the buffer swap moves ownership, not bytes);
+            # replaced buffers auto-release once their last reference
+            # (an in-flight kernel's lease, slot B's copy source)
+            # drops.
+            hbm.track_cluster("stack.view", arrays, cl.n_cap)
             if lease_token is not None:
                 leases.add(lease_token)
+                hbm.lease(lease_token, "stack.view")
             _DEV_CACHE[cl] = {
                 "version": version, "arrays": arrays,
                 "static_key": static_key, "capacity": capacity,

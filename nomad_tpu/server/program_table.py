@@ -212,6 +212,14 @@ class DeviceProgramTable:
             return _Prep(self.gen, rows, np.stack(dyn_i), np.stack(dyn_f),
                          np.stack(dyn_u), sspec, dspec, m)
 
+    def _row_bytes(self) -> int:
+        """Device bytes one table row spans across the three class
+        tables (0 before the first commit sizes them)."""
+        if self._widths is None:
+            return 0
+        li, lf, lu = self._widths
+        return li * 4 + lf * 4 + lu
+
     def _alloc_row_locked(self) -> Optional[int]:
         if self._free:
             return self._free.pop()
@@ -225,6 +233,14 @@ class DeviceProgramTable:
         for key, row in self._rows.items():
             if row not in self._pending:
                 del self._rows[key]
+                # residency: eviction reclaims the row's slot bytes for
+                # the incoming program (the table buffers themselves
+                # stay resident at fixed size)
+                from ..lib.metrics import default_registry
+
+                reg = default_registry()
+                reg.inc("hbm.table_evictions")
+                reg.inc("hbm.table_reclaimed_bytes", self._row_bytes())
                 return row
         return None
 
@@ -234,6 +250,15 @@ class DeviceProgramTable:
         self._free = []
         self._next_row = 0
         self._pending.clear()
+        if self._ti is not None:
+            # generation flush drops the device tables wholesale; count
+            # the reclaimed bytes (the ledger bookings release with the
+            # buffers themselves)
+            from ..lib.metrics import default_registry
+
+            default_registry().inc(
+                "hbm.table_flush_bytes",
+                self._ti.nbytes + self._tf.nbytes + self._tu.nbytes)
         self._ti = self._tf = self._tu = None
         self._widths = None
         self.flushes += 1
@@ -287,6 +312,18 @@ class DeviceProgramTable:
                                     jnp.asarray(ri[s]), jnp.asarray(rf[s]),
                                     jnp.asarray(ru[s]))
                     self._ti, self._tf, self._tu = bufs
+            # residency: the per-dtype-class tables are the fixed HBM
+            # cost of the device-resident transport. Tracking is
+            # idempotent for unchanged handles; an insert pass replaced
+            # them (non-donating kernel), so the new buffers book here
+            # and the old ones release once outstanding gathers drop
+            # their references.
+            from ..lib.hbm import default_hbm
+
+            hbm = default_hbm()
+            hbm.track("program_table.i32", self._ti)
+            hbm.track("program_table.f32", self._tf)
+            hbm.track("program_table.u8", self._tu)
             return self._ti, self._tf, self._tu, nb, count
 
     def stats(self) -> Dict[str, int]:

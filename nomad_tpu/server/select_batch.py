@@ -121,11 +121,22 @@ class _BatchOut:
         self._np = None
         self._lock = threading.Lock()
         self._on_first = on_first_resolve
+        # residency ledger: the lazy device outputs are live HBM from
+        # launch until the first resolver materializes them — book each
+        # leaf so in-flight dispatch state is visible (and a holder
+        # nobody ever resolves reads as a leak, not silence)
+        from ..lib.hbm import default_hbm
+
+        hbm = default_hbm()
+        for leaf in dev:
+            hbm.track("select_batch.batch_out", leaf)
 
     def resolve(self) -> Tuple:
         with self._lock:
             if self._np is None:
                 self._np = tuple(np.asarray(x) for x in self._dev)
+                # dropping the device refs frees the kernel outputs'
+                # HBM; the residency bookings release with them
                 self._dev = None
                 if self._on_first is not None:
                     cb, self._on_first = self._on_first, None
@@ -535,6 +546,14 @@ class SelectCoordinator:
         # The token (already leased at resolve) also rides the waiters'
         # results onto their plans (carry_token): a commit window
         # covers the carry only when it came from THIS dispatch.
+        # Residency: the carry arrays are held HBM until the next
+        # refresh adopts (re-sites them into the view) or rejects
+        # (drops them — the booking releases with the buffers).
+        from ..lib.hbm import default_hbm
+
+        hbm = default_hbm()
+        hbm.track("select_batch.carry", carry[0])
+        hbm.track("select_batch.carry", carry[1])
         evals = [self.trace_ids.get(r.order) for r in reqs]
         stop_rows = set()
         for r in reqs:

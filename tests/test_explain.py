@@ -561,7 +561,8 @@ class TestCliRobustness:
         # one-line error, not an OSError traceback
         for argv in (("eval", "trace", "x"),
                      ("eval", "placement", "x"),
-                     ("operator", "timeline")):
+                     ("operator", "timeline"),
+                     ("operator", "hbm")):
             rc, out, err = self._run("127.0.0.1:1", *argv)
             assert rc == 1, argv
             assert err.startswith("Error:"), argv

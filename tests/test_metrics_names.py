@@ -62,6 +62,12 @@ REQUIRED = {
     "nomad_scheduler_filter_constraint",
     "nomad_scheduler_exhausted_cpu",
     "nomad_scheduler_blocked_cpu",
+    # HBM residency ledger (ISSUE 11): labeled per-(site, shard) gauges
+    # plus the registry mirror totals + lease instruments
+    "nomad_hbm_live_bytes", "nomad_hbm_buffers", "nomad_hbm_peak_bytes",
+    "nomad_hbm_live_bytes_total", "nomad_hbm_buffers_total",
+    "nomad_hbm_peak_bytes_total", "nomad_hbm_leases",
+    "nomad_hbm_allocs", "nomad_hbm_releases",
 }
 
 #: every family a series may legally belong to; a new prefix here is a
@@ -79,10 +85,11 @@ ALLOWED_PREFIXES = (
     "nomad_scheduler_blocked_",
     "nomad_rpc_",             # rpc.client.* transport latencies
     "nomad_loop_errors_",     # ErrorStreak sinks
+    "nomad_hbm_",             # residency ledger (labeled + mirrors)
 )
 
 #: the only label names any exposed series may carry
-ALLOWED_LABELS = {"site", "quantile"}
+ALLOWED_LABELS = {"site", "quantile", "shard"}
 
 #: the transfer ledger's site vocabulary (the `site` label values) —
 #: renames here break `top_sites` dashboards exactly like metric renames
@@ -92,6 +99,13 @@ ALLOWED_SITES = {
     "select_batch.pack_buffers", "select_batch.fetch",
     "select_batch.table_insert", "select_batch.dyn_rows",
     "mesh.shard_cluster",
+    # HBM residency sites (lib/hbm.py; README residency-site table) —
+    # the `site` label is shared with the transfer families, so both
+    # vocabularies pin here
+    "stack.view_static", "stack.view_hot", "stack.view_ports",
+    "select_batch.batch_out", "select_batch.carry",
+    "program_table.i32", "program_table.f32", "program_table.u8",
+    "mesh.cluster",
 }
 
 
@@ -210,6 +224,11 @@ class TestSeriesNameStability:
         assert "select_batch.fetch" in sites
         assert "select_batch.table_insert" in sites
         assert "select_batch.dyn_rows" in sites
+        # ...and the residency ledger must have booked the loop's
+        # long-lived buffers (view slots, program table, carry)
+        assert "stack.view_hot" in sites
+        assert "program_table.i32" in sites
+        assert "select_batch.carry" in sites
 
     def test_batched_flow_populated_pipeline(self, loaded_agent):
         """Guard the fixture itself: if the batched path silently stops
