@@ -607,12 +607,20 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
                 tags.append("distinct-hosts")
             if i % 4 == 0:
                 tags.append("devices")
+            if i % 2 == 1:
+                tags.append("pinned-dc")
             return "+".join(tags) or "binpack"
 
+        # half the feed pins each job to ONE datacenter (r07+): pinned
+        # jobs in different dcs have disjoint node footprints, so the
+        # drain's conflict partition yields multi-lane wave dispatches —
+        # without them the e2e_drain wave read would be vacuously zero
         jobs = [(synth_service_job(
             rng, count=count,
             with_affinity=(i % 2 == 0), with_spread=(i % 3 == 0),
-            distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0)),
+            distinct_hosts=(i % 5 == 0), with_devices=(i % 4 == 0),
+            datacenter=(f"dc{1 + (i // 2) % 3}" if i % 2 == 1
+                        else None)),
             _scenario(i))
             for i in range(n_evals + warm_n)]
         # warmup: pays the XLA compiles / persistent-cache loads for the
@@ -640,6 +648,7 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         view0 = default_registry().counters(prefix="view.")
         led0 = default_ledger().snapshot()
         pipe0 = _pipeline_totals(s.metrics)
+        drain0 = _drain_totals(s.metrics)
         t0 = time.time()
         evals = []
         for job, scen in jobs:
@@ -718,6 +727,22 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
             f"{hbm_tail['plan_100k']['projected_bytes']}B "
             + ("fits" if hbm_tail["plan_100k"]["fits"] else
                f"needs {hbm_tail['plan_100k']['shards_needed']} shards"))
+        # drain-cadence tail (ISSUE 12): fused-dispatch width, wave
+        # structure, and the amortized per-eval dispatch overhead —
+        # the BENCH_r07 steering read for the mega-batch path
+        drain_tail = _e2e_drain(s, drain0)
+        log(f"e2e: drain width {drain_tail['batch_width_mean']:.1f} mean"
+            f"/{drain_tail['batch_width_max_recent']:.0f} max "
+            f"({drain_tail['window_occupancy_pct']:.0f}% of eval_batch="
+            f"{s.workers[0].eval_batch if s.workers else s.config.eval_batch}), "
+            f"groups {drain_tail['conflict_groups_mean']:.1f}, "
+            f"window {drain_tail['window_ms']:.1f}ms "
+            f"({drain_tail['window_source']}); wave "
+            f"{drain_tail['wave']['dispatches']} dispatches x "
+            f"{drain_tail['wave']['lanes_mean']:.1f} lanes, "
+            f"{drain_tail['wave']['collisions']} collisions; "
+            f"overhead {drain_tail['dispatch_overhead_ms_per_eval']:.3f}"
+            f"ms/eval")
     finally:
         s.shutdown()
     rate = done / dt if dt else 0.0
@@ -755,6 +780,95 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # projection — BENCH_r06+ carries a memory trajectory alongside
         # the speed one (ROADMAP item 3's steering read)
         "e2e_hbm": hbm_tail,
+        # drain-cadence + wave structure (ISSUE 12): mega-batch width,
+        # occupancy, lanes, and amortized per-eval dispatch overhead.
+        # Sweep NOMAD_TPU_DRAIN_WINDOW_MS (worker hold window, ms; unset
+        # = adaptive from pipeline.host_ms; 0 = never hold) to find the
+        # BENCH_r07 cadence frontier
+        "e2e_drain": drain_tail,
+    }
+
+
+def _drain_totals(reg) -> dict:
+    """Snapshot of the drain/wave/pipeline instruments the `e2e_drain`
+    tail windows over (lifetime counts/sums — deltas isolate the
+    measured window from warmup)."""
+    snap = reg.snapshot()
+    hist = snap.get("histograms") or {}
+    ctr = snap.get("counters") or {}
+    out = {"counters": {k: ctr.get(k, 0) for k in (
+        "drain.drains", "wave.dispatches", "wave.programs",
+        "wave.collisions", "pipeline.dispatches", "pipeline.programs")}}
+    for name in ("drain.batch_width", "drain.groups", "drain.hold_ms",
+                 "wave.lanes", "pipeline.host_ms"):
+        h = hist.get(name) or {}
+        out[name] = {"count": h.get("count", 0), "sum": h.get("sum", 0.0)}
+    return out
+
+
+def _e2e_drain(s, d0: dict) -> dict:
+    """bench tail `e2e_drain` (ISSUE 12): is the drain cadence doing its
+    job — fused-dispatch width (the mega-batch), window occupancy, wave
+    lane structure, and the amortized per-eval dispatch overhead the
+    mega-batch exists to shrink. Steer BENCH_r07 by it: width stuck at
+    ~1 with a deep queue means the cadence controller is the bottleneck
+    (sweep NOMAD_TPU_DRAIN_WINDOW_MS, threaded straight through to the
+    workers); width high but amortized overhead flat means the residual
+    cost is per-PROGRAM, i.e. the kernel — stop tuning the drain."""
+    d1 = _drain_totals(s.metrics)
+    snap = s.metrics.snapshot()
+    gauges = snap.get("gauges") or {}
+
+    def wmean(name):
+        c = d1[name]["count"] - d0[name]["count"]
+        return round((d1[name]["sum"] - d0[name]["sum"]) / c, 3) \
+            if c else 0.0
+
+    def wcount(name):
+        return d1["counters"][name] - d0["counters"][name]
+
+    programs = wcount("pipeline.programs")
+    dispatches = wcount("pipeline.dispatches")
+    host_ms = d1["pipeline.host_ms"]["sum"] - d0["pipeline.host_ms"]["sum"]
+    width_mean = wmean("drain.batch_width")
+    width_hist = snap.get("histograms", {}).get("drain.batch_width", {})
+    return {
+        "drains": wcount("drain.drains"),
+        # fused-dispatch width: the mega-batch acceptance read. The
+        # mean is an EXACT measured-window delta; the quantiles read
+        # the histogram's sliding sample window (last ≤1024 drains),
+        # which still contains warmup drains on short runs — hence the
+        # _recent suffix, so nobody steers by a warmup-polluted p50
+        "batch_width_mean": width_mean,
+        "batch_width_p50_recent": width_hist.get("p50", 0.0),
+        "batch_width_p95_recent": width_hist.get("p95", 0.0),
+        "batch_width_max_recent": width_hist.get("max", 0.0),
+        # share of the eval_batch ceiling each drain actually fills
+        # (the worker's EFFECTIVE cap — NOMAD_TPU_EVAL_BATCH outranks
+        # ServerConfig.eval_batch)
+        "window_occupancy_pct": round(
+            100.0 * width_mean / max(
+                (s.workers[0].eval_batch if s.workers
+                 else s.config.eval_batch), 1), 1),
+        "conflict_groups_mean": wmean("drain.groups"),
+        "hold_ms_mean": wmean("drain.hold_ms"),
+        "window_ms": gauges.get("drain.window_ms", 0.0),
+        "window_source": ("env" if os.environ.get(
+            "NOMAD_TPU_DRAIN_WINDOW_MS") is not None else "adaptive"),
+        "wave": {
+            "dispatches": wcount("wave.dispatches"),
+            "programs": wcount("wave.programs"),
+            "collisions": wcount("wave.collisions"),
+            "lanes_mean": wmean("wave.lanes"),
+        },
+        # the amortization itself: pre-kernel host overhead per eval —
+        # (dispatch_ms − kernel_ms) / evals in timeline terms. The
+        # ≥5× acceptance compares this against an eval_batch-capped run
+        # at the same feed (sweep the env knob).
+        "dispatch_overhead_ms_per_eval": round(
+            host_ms / programs, 4) if programs else 0.0,
+        "dispatch_overhead_ms_per_dispatch": round(
+            host_ms / dispatches, 3) if dispatches else 0.0,
     }
 
 

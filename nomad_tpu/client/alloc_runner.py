@@ -71,7 +71,14 @@ class AllocRunner:
                  recover_handles: Optional[Dict[str, dict]] = None,
                  driver_manager=None, csi_manager=None, conn=None,
                  network_manager=None, tls=None) -> None:
-        self.alloc = alloc
+        # the desired-state alloc reference is SWAPPED by server pushes
+        # (update(), client sync thread) while the alloc thread reads it
+        # everywhere — both sides go through the locked `alloc` property
+        # (NLT01). A dedicated lock: the getter runs inside `with
+        # self._lock` blocks (snapshot_alloc), so reusing _lock would
+        # self-deadlock.
+        self._alloc_lock = threading.Lock()
+        self._alloc = alloc
         self.node = node
         #: agent tls{} config — remote-migration HTTPS credentials
         self.tls = tls
@@ -98,8 +105,11 @@ class AllocRunner:
         self.services = ServiceHook(alloc, node, conn,
                                     exec_fn=self._exec_in_task)
         #: deployment health watcher (allochealth.py; reference
-        #: health_hook.go starts it only for deployment-tracked allocs)
-        self.health_tracker = None
+        #: health_hook.go starts it only for deployment-tracked allocs).
+        #: Created by the alloc thread mid-run, stopped by the client
+        #: thread (kill/shutdown/destroy) — locked property (NLT01).
+        self._ht_lock = threading.Lock()
+        self._health_tracker = None
         self._csi_mounted: List[Tuple[str, str]] = []  # (plugin, vol)
         self._base_dir = base_dir
         self.alloc_dir = AllocDir(base_dir, alloc.id)
@@ -119,6 +129,28 @@ class AllocRunner:
         self._destroyed = False
         self._shutting_down = False
         self.client_status = ALLOC_CLIENT_PENDING
+
+    @property
+    def alloc(self) -> Allocation:
+        """Current desired-state alloc (server pushes swap the whole
+        object — see update()); reads and the swap share one lock."""
+        with self._alloc_lock:
+            return self._alloc
+
+    @alloc.setter
+    def alloc(self, alloc: Allocation) -> None:
+        with self._alloc_lock:
+            self._alloc = alloc
+
+    @property
+    def health_tracker(self):
+        with self._ht_lock:
+            return self._health_tracker
+
+    @health_tracker.setter
+    def health_tracker(self, tracker) -> None:
+        with self._ht_lock:
+            self._health_tracker = tracker
 
     def _tasks(self):
         tg = self.alloc.job.lookup_task_group(self.alloc.task_group)

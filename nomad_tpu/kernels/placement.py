@@ -547,6 +547,12 @@ def place_task_group(cluster: ClusterArrays, p: TGParams, max_allocs: int,
             tk_oh = (tk_idx[:, None] == jnp.arange(n)[None, :]
                      ).astype(jnp.float32)                     # [K, N]
             tk_parts = jnp.einsum("kn,np->kp", tk_oh, parts)   # [K, 5]
+            # zero the parts of infeasible tail entries (score at the
+            # mask floor): the host drops them unread, and their raw
+            # values would otherwise depend on `used` rows OUTSIDE the
+            # program's footprint — breaking the wave dispatch's
+            # bit-parity contract for bytes nobody consumes
+            tk_parts = tk_parts * (tk_score > NEG_INF / 2)[:, None]
             ys = ys + (
                 jnp.sum(dh_fail.astype(jnp.int32)),
                 jnp.sum(dp_fail.astype(jnp.int32)),
@@ -642,6 +648,38 @@ def _pack_class(name: str):
     return "u", np.uint8
 
 
+#: field → (class, dtype), precomputed: _pack_class scans tuples, and
+#: the row-pack paths look this up per field per program per dispatch
+_PACK_CLASS = {name: _pack_class(name) for name in TGParams._fields}
+
+
+def pack_param_rows_batch(padded, fields):
+    """Pack a BATCH of same-shaped programs' `fields` into row-major
+    [B, L*] class buffers + the shared spec — the whole-batch form of
+    `pack_param_rows` (identical layout per row, pinned by
+    tests/test_drain.py). One vectorized stack per FIELD instead of
+    ~|fields| numpy ops per PROGRAM: at 256-program mega-batch waves the
+    per-program loop was the host-pack floor the drain cadence exists
+    to amortize."""
+    bufs = {"i": [], "f": [], "u": []}
+    offs = {"i": 0, "f": 0, "u": 0}
+    spec = []
+    b = len(padded)
+    for name in fields:
+        cls, dt = _PACK_CLASS[name]
+        stacked = np.stack([np.asarray(getattr(p, name))
+                            for p in padded])
+        flat = np.ascontiguousarray(stacked, dtype=dt).reshape(b, -1)
+        spec.append((name, cls, offs[cls], stacked.shape[1:]))
+        offs[cls] += flat.shape[1]
+        bufs[cls].append(flat)
+    cat = {c: (np.concatenate(v, axis=1) if v
+               else np.zeros((b, 0), dtype=d))
+           for (c, v), d in zip(bufs.items(),
+                                (np.int32, np.float32, np.uint8))}
+    return cat["i"], cat["f"], cat["u"], tuple(spec)
+
+
 def pack_params(batch: TGParams):
     """Flatten a (batched) TGParams into (i32, f32, u8) numpy buffers plus a
     static spec for the on-device unpack."""
@@ -667,16 +705,20 @@ def pack_param_rows(p: TGParams, fields):
     field-major across a whole batch): rows of programs packed at the
     same shapes are interchangeable table entries, and a batch of them
     stacks into [B, L] buffers whose on-device unpack slices static
-    column ranges."""
+    column ranges. Runs once per program per mega-batch dispatch, so the
+    offsets are tracked as running counters — re-summing the buffer list
+    per field was quadratic in field count and a measured ~40% of the
+    table-transport pack floor at 256-program waves."""
     bufs = {"i": [], "f": [], "u": []}
+    offs = {"i": 0, "f": 0, "u": 0}
     spec = []
     for name in fields:
         a = np.asarray(getattr(p, name))
         cls, dt = _pack_class(name)
         flat = np.ascontiguousarray(a, dtype=dt).reshape(-1)
-        off = sum(x.size for x in bufs[cls])
+        spec.append((name, cls, offs[cls], a.shape))
+        offs[cls] += flat.size
         bufs[cls].append(flat)
-        spec.append((name, cls, off, a.shape))
     cat = {c: (np.concatenate(v) if v else np.zeros(0, dtype=d))
            for (c, v), d in zip(bufs.items(),
                                 (np.int32, np.float32, np.uint8))}
@@ -774,6 +816,33 @@ def place_packed_chain(cluster: ClusterArrays, i32buf, f32buf, u8buf,
     return base
 
 
+def _assemble_table_batch(ti, tf, tu, rows, di, df, du, sspec, dspec
+                          ) -> TGParams:
+    """Gather static rows from the device program table and unpack a
+    batched TGParams: per-class whole-row `jnp.take` (embedding-style
+    DMA, not an element gather), then [B, L*] class buffers →
+    {field: [B, *shape]} via STATIC column slices (fuse to nothing
+    under jit — the `_unpack_params` contract with a leading batch
+    axis). Shared by the chain and wave table dispatches."""
+    gi = jnp.take(ti, rows, axis=0)
+    gf = jnp.take(tf, rows, axis=0)
+    gu = jnp.take(tu, rows, axis=0)
+    fields = {}
+    sbufs = {"i": gi, "f": gf, "u": gu}
+    for name, cls, off, shape in sspec:
+        size = int(np.prod(shape)) if shape else 1
+        seg = sbufs[cls][:, off:off + size]
+        a = seg.reshape((seg.shape[0],) + tuple(shape))
+        fields[name] = (a != 0) if cls == "u" else a
+    dbufs = {"i": di, "f": df, "u": du}
+    for name, cls, off, shape in dspec:
+        size = int(np.prod(shape)) if shape else 1
+        seg = dbufs[cls][:, off:off + size]
+        a = seg.reshape((seg.shape[0],) + tuple(shape))
+        fields[name] = (a != 0) if cls == "u" else a
+    return TGParams(**fields)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("sspec", "dspec", "max_allocs",
                                     "explain"))
@@ -793,34 +862,83 @@ def place_table_chain(cluster: ClusterArrays, ti, tf, tu, rows,
     leaves]) plus the final (used, dyn_free) carry as DEVICE arrays —
     the carry never rides the host fetch; it is handed to the view
     cache for the device-to-device plan-delta update."""
-    gi = jnp.take(ti, rows, axis=0)
-    gf = jnp.take(tf, rows, axis=0)
-    gu = jnp.take(tu, rows, axis=0)
-
-    # [B, L*] class buffers → {field: [B, *shape]} via STATIC column
-    # slices (fuse to nothing under jit — the `_unpack_params` contract
-    # with a leading batch axis). Inlined here so the loops run over the
-    # statically-named specs.
-    fields = {}
-    sbufs = {"i": gi, "f": gf, "u": gu}
-    for name, cls, off, shape in sspec:
-        size = int(np.prod(shape)) if shape else 1
-        seg = sbufs[cls][:, off:off + size]
-        a = seg.reshape((seg.shape[0],) + tuple(shape))
-        fields[name] = (a != 0) if cls == "u" else a
-    dbufs = {"i": di, "f": df, "u": du}
-    for name, cls, off, shape in dspec:
-        size = int(np.prod(shape)) if shape else 1
-        seg = dbufs[cls][:, off:off + size]
-        a = seg.reshape((seg.shape[0],) + tuple(shape))
-        fields[name] = (a != 0) if cls == "u" else a
-    batch = TGParams(**fields)
+    batch = _assemble_table_batch(ti, tf, tu, rows, di, df, du,
+                                  sspec, dspec)
     r, carry = _chain_with_carry(cluster, batch, max_allocs,
                                  explain=explain)
     base = (r.sel_idx, r.sel_score, r.nodes_feasible, r.nodes_fit)
     if explain:
         base = base + tuple(r.explain)
     return base, carry
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("sspec", "dspec", "max_allocs",
+                                    "explain"))
+def place_table_wave(cluster: ClusterArrays, ti, tf, tu, rows,
+                     di, df, du, sspec, dspec, max_allocs: int,
+                     explain: bool = False):
+    """Wave-partitioned device-resident placement (ISSUE 12): the
+    program axis arrives as LANES — `rows` i32[L, P] table indices and
+    [L, P, Ld*] dynamic rows, one lane per set of conflict groups whose
+    node footprints are DISJOINT from every other lane's (the broker's
+    `dequeue_batch` partition). Each lane runs the same sequential
+    conflict-aware chain as `place_table_chain` over its own programs;
+    lanes run vmapped in parallel, so the serial scan length is the
+    LONGEST LANE instead of the whole batch width — the chain no longer
+    grows linearly with mega-batch size.
+
+    Lane carries fold into ONE view carry by exact per-row lane
+    selection: a row's final (used, dyn_free) comes VERBATIM from the
+    single lane whose programs touched it (disjoint footprints ⇒ at most
+    one lane per row), untouched rows keep the input view. Because a
+    program only reads/writes rows inside its own footprint (its
+    feasibility mask confines selection; its plan-relative deltas land
+    on its own alloc rows), both the per-program outputs and the folded
+    carry are BIT-IDENTICAL to the sequential chain whenever the
+    footprint partition was truly disjoint (tests/test_drain.py pins
+    this).
+
+    Stale footprints (a node added between estimate and dispatch) can
+    make two lanes touch one row anyway: the fold counts those
+    CROSS-LANE COLLISION rows and returns the count as the LAST flat
+    output. The host rejects the carry for such dispatches (the rows'
+    true combined usage exists in no lane) and plan-apply per-node
+    verification resolves any over-commit — the reference's optimistic
+    worker race (plan_apply.go:437), never a silently wrong placement.
+
+    Returns (flat outputs [L·P, ...] in lane-major order + the
+    collision-count scalar, (used, dyn_free) device carry)."""
+    def lane(rows_l, di_l, df_l, du_l):
+        batch = _assemble_table_batch(ti, tf, tu, rows_l, di_l, df_l,
+                                      du_l, sspec, dspec)
+        return _chain_with_carry(cluster, batch, max_allocs,
+                                 explain=explain)
+
+    r, (used_l, dyn_l) = jax.vmap(lane)(rows, di, df, du)
+    used0, dyn0 = cluster.used, cluster.dyn_free
+    changed = jnp.any(used_l != used0[None], axis=-1) \
+        | (dyn_l != dyn0[None])                              # [L, N]
+    collisions = jnp.sum((jnp.sum(changed.astype(jnp.int32), axis=0)
+                          > 1).astype(jnp.int32))
+    used_f, dyn_f = used0, dyn0
+    for l in range(rows.shape[0]):
+        # static unroll of a where-select per lane: the chosen row is
+        # copied BITWISE from its owning lane (no arithmetic fold — a
+        # float re-accumulation would break carry == host-fold parity)
+        m = changed[l]
+        used_f = jnp.where(m[:, None], used_l[l], used_f)
+        dyn_f = jnp.where(m, dyn_l[l], dyn_f)
+    b = rows.shape[0] * rows.shape[1]
+
+    def flat(x):
+        return x.reshape((b,) + tuple(x.shape[2:]))
+
+    base = (flat(r.sel_idx), flat(r.sel_score),
+            flat(r.nodes_feasible), flat(r.nodes_fit))
+    if explain:
+        base = base + tuple(flat(leaf) for leaf in r.explain)
+    return base + (collisions,), (used_f, dyn_f)
 
 
 @functools.partial(jax.jit, static_argnames=("max_allocs", "explain"))

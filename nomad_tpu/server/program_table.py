@@ -54,7 +54,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..kernels.placement import (DYN_FIELDS, STATIC_FIELDS, TGParams,
-                                 pack_param_rows)
+                                 pack_param_rows_batch)
 from ..parallel.mesh import STATIC_DIMS, pad_params, param_dims
 
 #: per-dim ceilings for table residency: a program past any of these
@@ -174,18 +174,21 @@ class DeviceProgramTable:
                 self._flush_locked()
             padded, m = pad_params(params_list, dims=self.caps,
                                    need=need)
+            # whole-batch row-major pack (one vectorized op per field,
+            # not ~40 per program — the 256-wave host-pack floor); row
+            # i of each class buffer is byte-identical to the program's
+            # solo pack_param_rows output
+            si_b, sf_b, su_b, sspec = pack_param_rows_batch(
+                padded, STATIC_FIELDS)
             rows = np.empty(len(padded), dtype=np.int32)
-            sspec = None
-            for i, p in enumerate(padded):
-                si, sf, su, spec = pack_param_rows(p, STATIC_FIELDS)
-                if sspec is None:
-                    sspec = spec
-                if self._widths is None:
-                    self._widths = (si.size, sf.size, su.size)
+            if self._widths is None:
+                self._widths = (si_b.shape[1], sf_b.shape[1],
+                                su_b.shape[1])
+            for i in range(len(padded)):
                 h = hashlib.blake2b(digest_size=16)
-                h.update(si.tobytes())
-                h.update(sf.tobytes())
-                h.update(su.tobytes())
+                h.update(si_b[i].tobytes())
+                h.update(sf_b[i].tobytes())
+                h.update(su_b[i].tobytes())
                 key = h.digest()
                 row = self._rows.get(key)
                 if row is None:
@@ -193,24 +196,15 @@ class DeviceProgramTable:
                     if row is None:
                         return None  # capacity full of pending rows
                     self._rows[key] = row
-                    self._pending[row] = (si, sf, su)
+                    self._pending[row] = (si_b[i], sf_b[i], su_b[i])
                     self.inserts += 1
                 else:
                     self._rows.move_to_end(key)
                 rows[i] = row
-            dyn_i = []
-            dyn_f = []
-            dyn_u = []
-            dspec = None
-            for p in padded:
-                di, df, du, dsp = pack_param_rows(p, DYN_FIELDS)
-                if dspec is None:
-                    dspec = dsp
-                dyn_i.append(di)
-                dyn_f.append(df)
-                dyn_u.append(du)
-            return _Prep(self.gen, rows, np.stack(dyn_i), np.stack(dyn_f),
-                         np.stack(dyn_u), sspec, dspec, m)
+            dyn_i, dyn_f, dyn_u, dspec = pack_param_rows_batch(
+                padded, DYN_FIELDS)
+            return _Prep(self.gen, rows, dyn_i, dyn_f, dyn_u,
+                         sspec, dspec, m)
 
     def _row_bytes(self) -> int:
         """Device bytes one table row spans across the three class

@@ -57,6 +57,18 @@ the chain runs with `explain=True` and the PlacementExplain leaves
 top-K score breakdown) ride the SAME lazy `_BatchOut` fetch — one
 device→host transfer, ledger-accounted at `select_batch.fetch`,
 timeline-compatible, and guard-clean like the base outputs.
+
+Wave dispatch (ISSUE 12): the worker's broker drain arrives partitioned
+into CONFLICT GROUPS (disjoint node footprints — `coord.group_ids`,
+order → group id). Programs within one group still ride the sequential
+conflict-aware chain, but DISJOINT groups run as parallel lanes of the
+SAME fused dispatch (`place_table_wave`: vmap over lane chains, lane
+carries folded into one view carry by exact per-row lane selection) —
+the serial scan stops growing with mega-batch width. Bit-parity with
+the sequential chain is the contract whenever footprints are truly
+disjoint; a cross-lane row collision (stale footprint) is counted on
+device, the dispatch's carry is rejected, and plan-apply verification
+resolves the race like the reference's optimistic worker race.
 """
 from __future__ import annotations
 
@@ -147,8 +159,13 @@ class _BatchOut:
 class SelectCoordinator:
     """Fuses concurrent select dispatches from one eval batch."""
 
+    #: floor on wave width: fewer lanes than this and the dispatch just
+    #: rides the sequential chain (a 1-lane wave is the chain, minus a
+    #: shared compile)
+    _MIN_WAVE_LANES = 2
+
     def __init__(self, window_s: float = 0.004, tracer=None,
-                 timeline=None) -> None:
+                 timeline=None, registry=None) -> None:
         self._cv = threading.Condition()
         self._live = 0
         self._parked: List[_SelectReq] = []
@@ -169,6 +186,14 @@ class SelectCoordinator:
         #: fills trace_ids in start_batch) for per-eval pack/kernel spans
         self.tracer = tracer
         self.trace_ids: Dict[int, str] = {}
+        #: program-order → broker conflict-group id (worker fills in
+        #: start_batch from dequeue_batch's footprint partition); absent
+        #: orders conflict with everything — bare coordinators and
+        #: non-broker callers keep today's sequential chain
+        self.group_ids: Dict[int, int] = {}
+        #: server metrics registry for the wave.* instruments (None for
+        #: bare coordinators in tests — wave stats still land in .stats)
+        self.registry = registry
         #: dispatch-pipeline timeline (lib/transfer.DispatchTimeline,
         #: server-owned); None for bare coordinators in tests
         self.timeline = timeline
@@ -208,8 +233,14 @@ class SelectCoordinator:
         # a fused dispatch runs with explain when ANY program asked —
         # but a program that opted out must not receive attribution it
         # didn't request (its scheduler would record counters the
-        # caller explicitly disabled)
-        ex_leaves = out[4:] if explain else ()
+        # caller explicitly disabled). Slice the explain leaves by
+        # FIELD COUNT, not to the end: a wave dispatch appends its
+        # cross-lane collision scalar after them.
+        ex_leaves = ()
+        if explain and len(out) > 4:
+            from ..kernels.placement import PlacementExplain
+
+            ex_leaves = out[4:4 + len(PlacementExplain._fields)]
         ex = None
         if i is None:
             if ex_leaves:
@@ -310,7 +341,8 @@ class SelectCoordinator:
                 key = ("arrays", id(a.capacity))
                 resolved[key] = a
             groups.setdefault(key, []).append(r)
-        def _kernel_done(reqs, t_launch, seq, cluster=None, token=None):
+        def _kernel_done(reqs, t_launch, seq, cluster=None, token=None,
+                         idxs=None, wave=False):
             def cb(np_out):
                 t_end = time.perf_counter()
                 with self._stats_lock:
@@ -333,14 +365,24 @@ class SelectCoordinator:
                     # the next refresh may donate again
                     from ..scheduler import stack as stack_mod
 
+                    coll = int(np_out[-1]) if wave else 0
+                    if coll and self.registry is not None:
+                        self.registry.inc("wave.collisions", coll)
                     sel = np.asarray(np_out[0])
                     predicted: Dict[Optional[str], set] = {}
-                    for i, r in enumerate(reqs):
+                    for j, r in enumerate(reqs):
+                        i = idxs[j] if idxs is not None else j
                         eid = self.trace_ids.get(r.order)
                         rows = {int(x) for x in sel[i].reshape(-1)
                                 if x >= 0}
                         predicted[eid] = predicted.get(eid, set()) | rows
-                    stack_mod.carry_predicted(cluster, token, predicted)
+                    if not coll:
+                        # a cross-lane collision row's true combined
+                        # usage exists in no lane: leave the carry note
+                        # unpredicted — unadoptable, the next refresh
+                        # overlays from host (view.carry_rejects)
+                        stack_mod.carry_predicted(cluster, token,
+                                                  predicted)
                     stack_mod.release_view(cluster, token)
             return cb
 
@@ -465,12 +507,17 @@ class SelectCoordinator:
         """Dispatch one cluster group through the device program table.
         Returns False (nothing dispatched, no side effects on reqs) when
         the group can't ride the table — the caller then runs the legacy
-        transport."""
+        transport. Requests spanning ≥2 disjoint broker conflict groups
+        dispatch as a WAVE (parallel lanes) instead of one chain."""
         from ..kernels.placement import place_table_chain
         from ..lib.transfer import guard_scope
         from ..scheduler import stack as stack_mod
         from .program_table import table_for
 
+        lanes = self._wave_lanes(reqs)
+        if len(lanes) >= self._MIN_WAVE_LANES:
+            return self._dispatch_table_wave(lanes, cluster, want_ex,
+                                             led, _mono, _kernel_done)
         table = table_for(cluster)
         params_list = [r.params for r in reqs]
         # pad the program axis to a power of two with inert programs so
@@ -568,6 +615,158 @@ class SelectCoordinator:
             _kernel_done(reqs, tv, seq, cluster=cluster, token=token))
         for i, r in enumerate(reqs):
             r.out = (holder, i, token)
+            r.event.set()
+        return True
+
+    def _wave_lanes(self, reqs) -> List[list]:
+        """Partition a cluster group's requests into wave lanes from the
+        broker's conflict groups. Returns [reqs] (single lane — the
+        sequential chain) unless ≥2 disjoint groups exist and every
+        request has a known group: an order with no group id conflicts
+        with everything, so its whole dispatch stays sequential.
+
+        Groups pack into at most NOMAD_TPU_WAVE_LANES lanes (default 8)
+        longest-first onto the least-loaded lane (LPT): the vmapped
+        scan's length is the LONGEST lane, so balancing lanes is what
+        actually shortens the serial chain. Concatenating disjoint
+        groups inside one lane is always safe — a lane is sequential,
+        and sequential is correct for any footprint relation."""
+        if not self.group_ids:
+            return [reqs]
+        groups: Dict[int, list] = {}
+        for r in reqs:
+            gid = self.group_ids.get(r.order)
+            if gid is None:
+                return [reqs]
+            groups.setdefault(gid, []).append(r)
+        if len(groups) < self._MIN_WAVE_LANES:
+            return [reqs]
+        import os
+
+        try:
+            max_lanes = max(int(os.environ.get("NOMAD_TPU_WAVE_LANES",
+                                               "8")), 1)
+        except ValueError:
+            max_lanes = 8
+        n_lanes = min(len(groups), max_lanes)
+        if n_lanes < self._MIN_WAVE_LANES:
+            return [reqs]
+        lanes: List[list] = [[] for _ in range(n_lanes)]
+        for g in sorted(groups.values(), key=len, reverse=True):
+            min(lanes, key=len).extend(g)
+        return [l for l in lanes if l]
+
+    def _dispatch_table_wave(self, lanes, cluster, want_ex, led, _mono,
+                             _kernel_done) -> bool:
+        """Dispatch ≥2 disjoint-footprint lanes as ONE fused wave
+        through the device program table (`place_table_wave`). Same
+        transport, lease, carry-note, and guard discipline as the chain
+        path; the program axis is [L, P] (lanes × bucketed lane length,
+        inert-padded) instead of flat, and the kernel's carry is the
+        per-row fold of the lane carries. Returns False untouched on
+        any table-residency miss — the caller then runs the legacy
+        packed transport as one sequential chain."""
+        from ..kernels.placement import place_table_wave
+        from ..lib.transfer import guard_scope
+        from ..scheduler import stack as stack_mod
+        from .program_table import table_for
+
+        reqs = [r for lane in lanes for r in lane]
+        table = table_for(cluster)
+        t0 = time.perf_counter()
+        lane_len = _bucket(max(len(lane) for lane in lanes), lo=2)
+        n_lanes = _bucket(len(lanes), lo=2)
+        pad = _inert_program(lanes[0][0].params)
+        params_list: List = []
+        idxs: List[int] = []
+        for li, lane in enumerate(lanes):
+            for pi, r in enumerate(lane):
+                idxs.append(li * lane_len + pi)
+            params_list.extend([r.params for r in lane])
+            params_list.extend([pad] * (lane_len - len(lane)))
+        # fully-inert pad lanes (bucketed lane count shares compiles);
+        # they share the template's table row and fold as no-ops
+        params_list.extend([pad] * ((n_lanes - len(lanes)) * lane_len))
+        prep = table.prepare(params_list)
+        if prep is None:
+            return False
+        t1 = time.perf_counter()
+        with guard_scope():
+            import jax.numpy as jnp
+
+            com = table.commit(prep, led)
+            if com is None:
+                return False  # caps flush raced this prepare
+            ti, tf, tu, ins_nb, ins_count = com
+            self.stats["pack_ms"] += (t1 - t0) * 1e3
+            self._trace(reqs, "pack", _mono(t0), _mono(t1))
+            self.stats["batched"] += len(reqs)
+            rows2 = prep.rows.reshape(n_lanes, lane_len)
+            di3 = prep.dyn_i.reshape(n_lanes, lane_len,
+                                     prep.dyn_i.shape[1])
+            df3 = prep.dyn_f.reshape(n_lanes, lane_len,
+                                     prep.dyn_f.shape[1])
+            du3 = prep.dyn_u.reshape(n_lanes, lane_len,
+                                     prep.dyn_u.shape[1])
+            nb = (rows2.nbytes + di3.nbytes + df3.nbytes + du3.nbytes)
+            with led.timed("select_batch.dyn_rows", nb, count=4):
+                drows = jnp.asarray(rows2)
+                di = jnp.asarray(di3)
+                df = jnp.asarray(df3)
+                du = jnp.asarray(du3)
+            self.stats["pack_bytes"] += nb + ins_nb
+            t2 = time.perf_counter()
+            # view AFTER pack + atomic lease, exactly like the chain
+            # path (see _dispatch_table)
+            token = next(_DISPATCH_TOKENS)
+            try:
+                with led.scope() as moved:
+                    arrays = reqs[0].arrays_fn(lease_token=token)
+                tv = time.perf_counter()
+                self.stats["view_ms"] += (tv - t2) * 1e3
+                self._trace(reqs, "delta_apply", _mono(t2), _mono(tv))
+                out, carry = place_table_wave(
+                    arrays, ti, tf, tu, drows, di, df, du,
+                    prep.sspec, prep.dspec, prep.m, explain=want_ex)
+            except BaseException:
+                stack_mod.release_view(cluster, token)
+                raise
+        seq = 0
+        if self.timeline is not None:
+            seq = self.timeline.commit(
+                programs=len(reqs), batched=True,
+                pack=(_mono(t0), _mono(t1)),
+                upload=(_mono(t1), _mono(t2)),
+                view=(_mono(t2), _mono(tv)),
+                kernel_start=_mono(tv),
+                transfer_bytes=nb + ins_nb + moved[0],
+                transfer_count=4 + ins_count + moved[1])
+        if self.registry is not None:
+            self.registry.inc("wave.dispatches")
+            self.registry.inc("wave.programs", len(reqs))
+            self.registry.add_sample("wave.lanes", len(lanes))
+            self.registry.add_sample("wave.lane_len",
+                                     max(len(l) for l in lanes))
+        from ..lib.hbm import default_hbm
+
+        hbm = default_hbm()
+        hbm.track("select_batch.carry", carry[0])
+        hbm.track("select_batch.carry", carry[1])
+        evals = [self.trace_ids.get(r.order) for r in reqs]
+        stop_rows = set()
+        for r in reqs:
+            p = r.params
+            for arr in (p.delta_idx, p.pclr_idx, p.pset_idx):
+                a = np.asarray(arr).reshape(-1)
+                stop_rows.update(int(x) for x in a[a >= 0])
+        stack_mod.note_dispatch_carry(cluster, token, arrays, evals,
+                                      stop_rows, carry[0], carry[1])
+        holder = _BatchOut(
+            tuple(out),
+            _kernel_done(reqs, tv, seq, cluster=cluster, token=token,
+                         idxs=idxs, wave=True))
+        for j, r in enumerate(reqs):
+            r.out = (holder, idxs[j], token)
             r.event.set()
         return True
 

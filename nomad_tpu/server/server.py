@@ -126,7 +126,8 @@ class Server:
         # e2e_pipeline tail
         self.timeline = DispatchTimeline(self.metrics)
         self.broker = EvalBroker(nack_timeout=self.config.nack_timeout,
-                                 metrics=self.metrics, tracer=self.tracer)
+                                 metrics=self.metrics, tracer=self.tracer,
+                                 footprint_fn=self._eval_footprint)
         self.blocked = BlockedEvals(self.broker, registry=self.metrics)
         self.plan_queue = PlanQueue()
         self.planner = PlanApplier(self.state, self.plan_queue,
@@ -202,6 +203,82 @@ class Server:
             if not node.terminal_status():
                 self.heartbeater.reset(node.id)
         self._running = True
+
+    def _eval_footprint(self, ev: Evaluation):
+        """Cheap host-side node-footprint estimate for a ready eval (the
+        broker's `dequeue_batch` conflict-partition input, ISSUE 12):
+        a bool[n_cap] row mask over every node the eval's scheduling
+        could READ (candidate selection) or WRITE (placements, stops,
+        preemptions, plan-relative deltas). Returns None when nothing
+        cheap bounds it — None conflicts with everything, which is
+        always safe (the eval rides the sequential chain).
+
+        The mask is deliberately a SUPERSET built from pre-compile
+        facts only (no LUT build, no snapshot):
+
+          - datacenter pre-filter: rows whose `node.datacenter` token
+            is one of the job's datacenters (the first feasibility gate
+            `compile_constraints` bakes into the LUT — every selectable
+            node passes it);
+          - simple job-level equality constraints on already-tokenized
+            keys narrow it further (`${node.class} = x` and friends);
+          - ∪ rows of the job's CURRENT allocs — stops/preemptions/
+            migrations and their resource/port deltas land there;
+          - ∪ the eval's own node row (node-update/drain triggers).
+
+        Reads of the live cluster tensors are lock-free and racy by
+        design: a node added between estimate and dispatch can make two
+        "disjoint" evals collide — the wave kernel counts cross-lane
+        row collisions (carry rejected) and plan-apply verification
+        resolves the race; stale estimates cost a retry, never a wrong
+        placement."""
+        import numpy as np
+
+        if not ev.job_id:
+            return None
+        cl = self.state.cluster
+        attrs = cl.attrs  # one reference; concurrent growth swaps arrays
+        n = attrs.shape[0]
+        job = self.state.job_by_id(ev.namespace, ev.job_id)
+        if job is not None and job.datacenters:
+            k_dc = cl.vocab.lookup_key("node.datacenter")
+            if k_dc < 0 or k_dc >= attrs.shape[1]:
+                return None
+            kv = cl.vocab.key_vocabs[k_dc]
+            toks = [t for t in (kv.lookup(dc) for dc in job.datacenters)
+                    if t >= 0]
+            col = attrs[:, k_dc]
+            mask = np.isin(col, toks) if toks else np.zeros(n, dtype=bool)
+            from ..tensor.vocab import target_to_key
+
+            for c in job.constraints:
+                if c.operand != "=" or not c.rtarget \
+                        or "${" in str(c.rtarget):
+                    continue
+                key = target_to_key(c.ltarget)
+                if key is None or key == "__unresolvable__":
+                    continue
+                k = cl.vocab.lookup_key(key)
+                if k < 0 or k >= attrs.shape[1]:
+                    continue
+                tok = cl.vocab.key_vocabs[k].lookup(str(c.rtarget))
+                mask &= attrs[:, k] == tok
+        elif job is not None:
+            # no datacenter list = every node is a candidate; nothing
+            # cheap bounds the read set
+            return None
+        else:
+            # job gone (deregister/stop evals): only the current alloc
+            # rows can be touched
+            mask = np.zeros(n, dtype=bool)
+        for row, _tg in cl.job_allocs.get(ev.job_id, {}).values():
+            if 0 <= row < n:
+                mask[row] = True
+        if ev.node_id:
+            row = cl.row_of.get(ev.node_id)
+            if row is not None and row < n:
+                mask[row] = True
+        return mask
 
     def _restore_evals(self) -> None:
         """Re-enqueue non-terminal evals from state into the broker/blocked

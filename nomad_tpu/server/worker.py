@@ -29,6 +29,22 @@ SCHEDULER_TYPES = ("service", "batch", "system", "_core")
 #: per job, so a drained batch never holds two evals of one job)
 BATCHABLE_TYPES = ("service", "batch")
 
+#: drain-cadence knobs (ISSUE 12). The hold window is ADAPTIVE by
+#: default: the worker sizes it from the dispatch timeline's measured
+#: per-dispatch host overhead (`pipeline.host_ms` — pack + upload +
+#: view, i.e. dispatch_ms − kernel_ms), because waiting for more evals
+#: is break-even exactly when the wait costs what the merged dispatch
+#: saves. The env override pins it (ms) for BENCH cadence sweeps;
+#: 0 disables holding entirely.
+DRAIN_WINDOW_ENV = "NOMAD_TPU_DRAIN_WINDOW_MS"
+#: adaptive-window ceiling: never hold longer than this, however slow
+#: the measured dispatch path is (a wedged tunnel must not turn the
+#: drain loop into a 1 Hz scheduler)
+DRAIN_WINDOW_CAP_MS = 50.0
+#: re-read the measured overhead this often (the histogram summary
+#: sorts its sample window — not a per-drain cost)
+_DRAIN_WINDOW_REFRESH_S = 0.5
+
 
 class EvalContext:
     """Planner-protocol implementation for ONE evaluation (worker.go:277-438).
@@ -128,6 +144,22 @@ class Worker:
         #: the worker thread, read by shutdown() from the main thread.
         self._pool = None
         self._pool_lock = threading.Lock()
+        #: drain-cadence hold window (see DRAIN_WINDOW_ENV): a fixed
+        #: env-pinned value, or adaptive from the dispatch timeline's
+        #: measured per-dispatch host overhead (confined to the worker
+        #: thread — only _run/_drain touch the cache fields)
+        env = os.environ.get(DRAIN_WINDOW_ENV)
+        self._window_fixed: Optional[float] = None
+        if env is not None:
+            try:
+                self._window_fixed = max(float(env), 0.0) / 1e3
+            except ValueError:
+                self._window_fixed = None
+        self._window_cached = 0.0
+        self._window_next = 0.0
+        if self._window_fixed is not None:
+            self.metrics.set_gauge("drain.window_ms",
+                                   self._window_fixed * 1e3)
 
     @property
     def batch_stats(self) -> Dict[str, float]:
@@ -169,11 +201,16 @@ class Worker:
         inflight = None  # (coord, futs, items) started but not finished
         try:
             while not self._stop.is_set():
-                batch = self._drain(block=(inflight is None))
+                groups = self._drain(block=(inflight is None))
+                batch, group_of = [], []
+                for gi, g in enumerate(groups):
+                    for item in g:
+                        batch.append(item)
+                        group_of.append(gi)
                 started = None
                 if batch and (len(batch) > 1 or inflight is not None) \
                         and batch[0][0].type in BATCHABLE_TYPES:
-                    started = self.start_batch(batch)
+                    started = self.start_batch(batch, group_of=group_of)
                     batch = None
                 if inflight is not None:
                     self.finish_batch(*inflight)
@@ -192,24 +229,42 @@ class Worker:
             if inflight is not None:
                 self.finish_batch(*inflight)
 
-    def _drain(self, block: bool) -> List[Tuple[Evaluation, str]]:
-        eval, token = self.server.broker.dequeue(
-            SCHEDULER_TYPES, timeout=0.5 if block else 0.0
-        )
-        if eval is None:
-            return []
-        batch = [(eval, token)]
-        if self.eval_batch > 1 and eval.type in BATCHABLE_TYPES:
-            # opportunistic drain: whatever is ready NOW rides this
-            # batch; nothing waits for a batch to fill
-            while len(batch) < self.eval_batch:
-                ev2, tok2 = self.server.broker.dequeue(
-                    BATCHABLE_TYPES, timeout=0.0
-                )
-                if ev2 is None:
-                    break
-                batch.append((ev2, tok2))
-        return batch
+    def _drain(self, block: bool) -> List[List[Tuple[Evaluation, str]]]:
+        """Adaptive drain cadence (ISSUE 12): one broker call drains up
+        to `eval_batch` evals partitioned into conflict groups (disjoint
+        node footprints → parallel wave lanes in the fused dispatch).
+        A loaded queue holds the drain open for the adaptive window so
+        the dispatch carries as many evals as the window gathers; an
+        idle queue returns its single eval immediately — today's
+        latency. The hold window also runs while a predecessor batch is
+        in flight, where waiting is literally free (the drained batch's
+        host pack cannot dispatch before the in-flight kernel anyway)."""
+        hold = self._hold_window() if self.eval_batch > 1 else 0.0
+        return self.server.broker.dequeue_batch(
+            SCHEDULER_TYPES, self.eval_batch,
+            timeout=0.5 if block else 0.0,
+            hold_s=hold, batch_types=BATCHABLE_TYPES)
+
+    def _hold_window(self) -> float:
+        """Seconds the drain may hold a non-empty, non-full batch open.
+        Fixed by NOMAD_TPU_DRAIN_WINDOW_MS when set; otherwise the mean
+        measured per-dispatch host overhead (pipeline.host_ms — what an
+        extra dispatch would cost, so waiting that long to avoid one is
+        break-even), capped at DRAIN_WINDOW_CAP_MS. Zero until the
+        timeline has samples: an unmeasured path never adds latency."""
+        if self._window_fixed is not None:
+            return self._window_fixed
+        now = time.monotonic()
+        if now < self._window_next:
+            return self._window_cached
+        self._window_next = now + _DRAIN_WINDOW_REFRESH_S
+        summ = self.metrics.histogram("pipeline.host_ms").summary()
+        w = 0.0
+        if summ["count"]:
+            w = min(summ["mean"], DRAIN_WINDOW_CAP_MS) / 1e3
+        self._window_cached = w
+        self.metrics.set_gauge("drain.window_ms", w * 1e3)
+        return w
 
     # ---- one evaluation ----
 
@@ -269,11 +324,16 @@ class Worker:
         """Run a batch start-to-finish (non-pipelined callers/tests)."""
         self.finish_batch(*self.start_batch(items))
 
-    def start_batch(self, items: List[Tuple[Evaluation, str]]):
+    def start_batch(self, items: List[Tuple[Evaluation, str]],
+                    group_of: Optional[List[int]] = None):
         """Launch each eval's scheduler on the persistent pool. The
         schedulers reconcile+compile immediately but PARK at the
         coordinator — no placement happens until finish_batch() drives
-        the coordinator (the pipelining hook)."""
+        the coordinator (the pipelining hook). `group_of[i]` is item
+        i's broker conflict-group id (disjoint node footprints);
+        the coordinator runs disjoint groups as parallel wave lanes
+        inside one fused dispatch. None (tests, non-broker callers)
+        means unknown — everything rides one sequential chain."""
         from concurrent.futures import ThreadPoolExecutor
 
         from .select_batch import SelectCoordinator
@@ -301,10 +361,13 @@ class Worker:
                 self.tracer.record(ev.id, "snapshot", start=t0, end=t1)
         coord = SelectCoordinator(tracer=self.tracer,
                                   timeline=getattr(self.server,
-                                                   "timeline", None))
+                                                   "timeline", None),
+                                  registry=self.metrics)
         futs = []
         for order, (ev, tok) in enumerate(items):
             coord.trace_ids[order] = ev.id
+            if group_of is not None:
+                coord.group_ids[order] = group_of[order]
             coord.add_thread()
             try:
                 futs.append(pool.submit(

@@ -86,10 +86,13 @@ def synth_service_job(rng: random.Random, count: int = 8,
                       with_spread: bool = False,
                       distinct_hosts: bool = False,
                       with_devices: bool = False,
-                      distinct_property: bool = False) -> Job:
+                      distinct_property: bool = False,
+                      datacenter: Optional[str] = None) -> Job:
     """One service job: 1 task group, CPU+MiB bin-pack ask (BASELINE config 1),
     optionally the batch/spread/distinct_hosts/device/distinct_property
-    stanzas (configs 2-5)."""
+    stanzas (configs 2-5). `datacenter` pins the job to ONE dc — jobs
+    pinned to different dcs have disjoint node footprints, the shape the
+    wave-dispatch partition (ISSUE 12) parallelizes."""
     jid = f"svc-{uuid.uuid4().hex[:12]}"
     constraints = [Constraint(ltarget="${attr.kernel.name}", rtarget="linux",
                               operand="=")]
@@ -119,7 +122,7 @@ def synth_service_job(rng: random.Random, count: int = 8,
         name=jid,
         type=JOB_TYPE_SERVICE,
         priority=50,
-        datacenters=list(DATACENTERS),
+        datacenters=[datacenter] if datacenter else list(DATACENTERS),
         constraints=constraints,
         affinities=affinities,
         spreads=spreads,

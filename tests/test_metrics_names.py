@@ -68,6 +68,12 @@ REQUIRED = {
     "nomad_hbm_live_bytes_total", "nomad_hbm_buffers_total",
     "nomad_hbm_peak_bytes_total", "nomad_hbm_leases",
     "nomad_hbm_allocs", "nomad_hbm_releases",
+    # drain cadence (ISSUE 12): mega-batch width/grouping/hold window —
+    # the BENCH_r07 e2e_drain tail aggregates from these
+    "nomad_drain_drains", "nomad_drain_batch_width",
+    "nomad_drain_groups", "nomad_drain_hold_ms", "nomad_drain_window_ms",
+    # wave dispatch (ISSUE 12): lane structure of fused mega-batches
+    "nomad_wave_dispatches", "nomad_wave_programs", "nomad_wave_lanes",
 }
 
 #: every family a series may legally belong to; a new prefix here is a
@@ -86,6 +92,8 @@ ALLOWED_PREFIXES = (
     "nomad_rpc_",             # rpc.client.* transport latencies
     "nomad_loop_errors_",     # ErrorStreak sinks
     "nomad_hbm_",             # residency ledger (labeled + mirrors)
+    "nomad_drain_",           # drain-cadence mega-batching (ISSUE 12)
+    "nomad_wave_",            # wave-dispatch lane structure (ISSUE 12)
 )
 
 #: the only label names any exposed series may carry
@@ -142,10 +150,14 @@ def _strip_histo_suffix(name):
 @pytest.fixture()
 def loaded_agent(tmp_path, monkeypatch):
     """Dev agent driven through a BATCHED eval round (the fused
-    coordinator dispatch) plus a filtered failure and an exhausted
-    blocked eval — the flow that populates every promised family."""
-    # batch the worker BEFORE the server (Worker reads the env in init)
+    coordinator dispatch) plus a filtered failure, an exhausted blocked
+    eval, and a dc-pinned wave round (multi-lane wave dispatch) — the
+    flow that populates every promised family."""
+    # batch the worker BEFORE the server (Worker reads the env in init);
+    # the pinned hold window makes each parked wave drain as ONE batch,
+    # so the dc-pinned round reliably dispatches multi-lane
     monkeypatch.setenv("NOMAD_TPU_EVAL_BATCH", "4")
+    monkeypatch.setenv("NOMAD_TPU_DRAIN_WINDOW_MS", "300")
     from nomad_tpu.agent import Agent, AgentConfig
     from nomad_tpu.api import NomadClient
     from nomad_tpu.structs import Constraint
@@ -156,7 +168,7 @@ def loaded_agent(tmp_path, monkeypatch):
     api = NomadClient(a.http_addr[0], a.http_addr[1])
     assert _wait(lambda: len(api.nodes()) == 1)
 
-    def job(cpu=50, constraint=None):
+    def job(cpu=50, constraint=None, dc=None):
         j = mock.job()
         t = j.task_groups[0].tasks[0]
         t.driver = "mock_driver"
@@ -164,7 +176,16 @@ def loaded_agent(tmp_path, monkeypatch):
         t.resources.cpu = cpu
         if constraint is not None:
             j.constraints.append(constraint)
+        if dc is not None:
+            j.datacenters = [dc]
         return j
+
+    # clientless dc2/dc3 nodes: jobs pinned to different dcs have
+    # DISJOINT footprints, so the pinned wave below drains into one
+    # multi-lane wave dispatch (evals complete at plan apply; the
+    # allocs never start, which the metrics flow doesn't need)
+    for dc in ("dc2", "dc2", "dc3", "dc3"):
+        a.server.state.upsert_node(mock.node(datacenter=dc))
 
     # park registrations while the broker is disabled, then restore —
     # each wave's pending evals drain as ONE worker batch (fused
@@ -174,9 +195,15 @@ def loaded_agent(tmp_path, monkeypatch):
     # both promised families must be populated, not vacuously absent.
     s = a.server
     eval_ids = []
-    for wave in range(2):
+    for wave in range(3):
         s.broker.set_enabled(False)
-        wave_ids = [api.register_job(job()) for _ in range(4)]
+        if wave == 2:
+            # dc-pinned wave: two disjoint conflict groups in one drain
+            # → a multi-lane wave dispatch (wave.* series non-vacuous)
+            wave_ids = [api.register_job(job(dc=dc))
+                        for dc in ("dc2", "dc3", "dc2", "dc3")]
+        else:
+            wave_ids = [api.register_job(job()) for _ in range(4)]
         if wave == 1:
             wave_ids.append(
                 api.register_job(job(cpu=10**7)))  # exhausted → blocked
@@ -239,3 +266,7 @@ class TestSeriesNameStability:
         assert snap["counters"].get("pipeline.dispatches", 0) >= 1
         assert any(k.startswith("worker.0.batch.")
                    for k in snap["counters"])
+        # the dc-pinned wave actually dispatched multi-lane — without
+        # this the wave.* pins above would be testing absence
+        assert snap["counters"].get("wave.dispatches", 0) >= 1
+        assert snap["histograms"]["wave.lanes"]["max"] >= 2
