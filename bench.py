@@ -730,6 +730,22 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # drain-cadence tail (ISSUE 12): fused-dispatch width, wave
         # structure, and the amortized per-eval dispatch overhead —
         # the BENCH_r07 steering read for the mega-batch path
+        # control-plane tail (ISSUE 13): queue depth/age, plan-apply
+        # latency + partial rate, leadership stability, heartbeat/flight
+        # counts — ALWAYS emitted so BENCH_r07+ carries a control-plane
+        # trajectory next to the speed/memory ones (the 3-server soak
+        # and failover gates of ROADMAP item 4 read this section)
+        control_tail = _e2e_control(s)
+        log(f"e2e: control broker ready={control_tail['broker']['ready_total']} "
+            f"unacked={control_tail['broker']['unacked']} "
+            f"oldest={control_tail['broker']['oldest_eval_age_s']:.2f}s; "
+            f"plan apply p50/p99 "
+            f"{control_tail['plan_apply']['apply_ms']['p50']:.2f}/"
+            f"{control_tail['plan_apply']['apply_ms']['p99']:.2f}ms "
+            f"partial_rate={control_tail['plan_apply']['partial_rate']}; "
+            f"leadership gained={control_tail['leadership']['gained']} "
+            f"lost={control_tail['leadership']['lost']}; "
+            f"flight events={control_tail['flight_events']}")
         drain_tail = _e2e_drain(s, drain0)
         log(f"e2e: drain width {drain_tail['batch_width_mean']:.1f} mean"
             f"/{drain_tail['batch_width_max_recent']:.0f} max "
@@ -786,6 +802,12 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # = adaptive from pipeline.host_ms; 0 = never hold) to find the
         # BENCH_r07 cadence frontier
         "e2e_drain": drain_tail,
+        # control-plane health (ISSUE 13): broker queue depth/age,
+        # plan-apply queue/latency/partial-rate, leadership stability
+        # and flight-event counts — read next to e2e_drain (BASELINE.md
+        # round-7 addendum): depth/age climbing while drain width is
+        # flat means the broker, not the kernel, is the frontier
+        "e2e_control": control_tail,
     }
 
 
@@ -804,6 +826,48 @@ def _drain_totals(reg) -> dict:
         h = hist.get(name) or {}
         out[name] = {"count": h.get("count", 0), "sum": h.get("sum", 0.0)}
     return out
+
+
+def _e2e_control(s) -> dict:
+    """bench tail `e2e_control` (ISSUE 13): the control-plane health
+    read next to the speed/memory tails. Queue depth + oldest-eval age
+    are the broker backpressure signal; plan-apply latency + partial
+    rate the leader-serialization cost; leadership/flight counts the
+    stability read (zeros on a single-process bench, non-zero in the
+    ROADMAP item-4 3-server soak)."""
+    from nomad_tpu.lib.flight import default_flight
+
+    cs = s.control_plane_stats()
+    broker = cs["broker"]
+    plan = cs["plan_apply"]
+    counts = default_flight().counts()
+    return {
+        "broker": {
+            "ready_total": broker["ready_total"],
+            "unacked": broker["unacked"],
+            "pending_jobs": broker["pending_jobs"],
+            "blocked": broker["blocked"],
+            "oldest_eval_age_s": broker["oldest_eval_age_s"],
+            "nacked": int(s.broker.stats.get("nacked", 0)),
+            "requeued": int(s.broker.stats.get("requeued", 0)),
+            "failed": int(s.broker.stats.get("failed", 0)),
+        },
+        "plan_apply": {
+            "queue_depth": plan["queue_depth"],
+            "partial_rate": plan["partial_rate"],
+            "apply_ms": plan["apply_ms"],
+            "inline": plan.get("inline", 0),
+            "applied": plan.get("applied", 0),
+        },
+        "heartbeat_expired": cs["heartbeat_expired"],
+        "leadership": {
+            "gained": counts.get("leadership.gained", 0),
+            "lost": counts.get("leadership.lost", 0),
+            "terms": counts.get("raft.term", 0),
+        },
+        "flight_events": sum(counts.values()),
+        "flight_counts": dict(sorted(counts.items())),
+    }
 
 
 def _e2e_drain(s, d0: dict) -> dict:

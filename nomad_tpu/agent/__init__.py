@@ -372,6 +372,11 @@ class Agent:
                 # dispatch) — the quick answer to "is pipelining
                 # actually overlapping pack with the kernel?"
                 out["pipeline"] = timeline.summary()
+            # control-plane rollup (ISSUE 13): broker queue depths/ages,
+            # plan-apply queue/latency/partial-rate, heartbeat losses —
+            # also refreshes the broker/plan gauges so the registry
+            # snapshot above and this section agree on the next scrape
+            out["control"] = self.server.control_plane_stats()
         out["process"] = default_registry().snapshot()
         # per-call-site host↔device transfer attribution (the ledger):
         # process-global like the registry it mirrors into
@@ -403,9 +408,18 @@ class Agent:
 
         parts = []
         if self.server is not None:
+            # refresh the queue-state gauges (broker depths/ages, plan
+            # queue depth, blocked depth) so a bare Prometheus scrape
+            # reads current values without a prior /v1/metrics call
+            self.server.control_plane_stats()
             reg = getattr(self.server, "metrics", None)
             if reg is not None:
                 parts.append(reg.prometheus())
+        if self.cluster is not None:
+            # the raft node's own registry (it outlives the leadership-
+            # gated Server): nomad_raft_* series ride the same scrape
+            self.cluster.raft.status()  # refresh log-size gauges
+            parts.append(self.cluster.raft.metrics.prometheus())
         parts.append(default_registry().prometheus())
         parts.append(default_ledger().prometheus())
         parts.append(default_hbm().prometheus())

@@ -1470,7 +1470,123 @@ class HTTPApi:
                         400, f"plan needs integer nodes > 0 and "
                              f"allocs >= 0: {e}")
             return out
+        # /v1/operator/flight — the control-plane flight recorder
+        # (lib/flight.py): leadership changes, plan rejections, error
+        # streaks, stuck leases, wave-collision spikes, membership
+        # churn, heartbeat losses. Index long-poll exactly like
+        # /v1/event/stream; ?type= filters on the closed vocabulary.
+        if parts == ["operator", "flight"]:
+            require(acl.allow_operator_read())
+            from ..lib.flight import default_flight
+
+            fr = default_flight()
+            try:
+                index = int(query.get("index", 0) or 0)
+                wait = min(float(query.get("wait", 0) or 0), 60.0)
+            except ValueError as e:
+                raise HttpError(400, f"index/wait must be numeric: {e}")
+            types = [t for t in (query.get("type", "") or "").split(",")
+                     if t] or None
+            idx, events = fr.records_after(index, types=types,
+                                           timeout=wait)
+            return {"index": idx, "events": events,
+                    "counts": fr.counts()}
+        # /v1/operator/debug — one server's capture of EVERY diagnostic
+        # surface in a single response (command/operator_debug.go's
+        # per-agent capture half; the CLI aggregates this across the
+        # reachable servers into the bundle)
+        if parts == ["operator", "debug"]:
+            require(acl.allow_operator_read())
+            return self._operator_debug(server)
         raise HttpError(404, f"no handler for {method} {path}")
+
+    def _operator_debug(self, server) -> Dict[str, Any]:
+        """Assemble the per-server debug capture. Every key of
+        api.client.DEBUG_SECTIONS must be present — the CLI writes one
+        bundle file per section and the e2e capture test pins the set.
+        Tolerates facade agents (a bare ClusterServer behind HTTPApi in
+        tests) that lack the full Agent surface."""
+        import time as _time
+
+        from ..api.client import DEBUG_SECTIONS
+        from ..lib.flight import default_flight
+        from ..lib.hbm import default_hbm
+        from ..lib.transfer import default_ledger
+
+        agent = self.agent
+        cluster = getattr(agent, "cluster", None)
+        out: Dict[str, Any] = {"captured_unix": round(_time.time(), 3)}
+        out["server"] = {
+            "node_id": (cluster.config.node_id if cluster is not None
+                        else "self"),
+            "region": (cluster.config.region if cluster is not None
+                       else getattr(getattr(agent, "config", None),
+                                    "region", "global")),
+            "leader": (cluster.is_leader() if cluster is not None
+                       else True),
+            "state_index": server.state.index.value,
+        }
+        metrics_fn = getattr(agent, "metrics", None)
+        if callable(metrics_fn):
+            # Agent.metrics() already computes the control rollup —
+            # reuse it instead of re-scanning the broker queues (this
+            # endpoint is read precisely when the control plane is
+            # under pressure; don't triple the lock hold time)
+            out["metrics"] = metrics_fn()
+            out["control"] = (out["metrics"].get("control")
+                              or server.control_plane_stats())
+        else:
+            out["metrics"] = {"telemetry": server.metrics.snapshot()}
+            out["control"] = server.control_plane_stats()
+        prom_fn = getattr(agent, "metrics_prometheus", None)
+        out["prometheus"] = (prom_fn() if callable(prom_fn)
+                             else server.metrics.prometheus())
+        timeline = getattr(server, "timeline", None)
+        if timeline is not None:
+            _, recs = timeline.records_after(0)
+            out["timeline"] = {"summary": timeline.summary(),
+                               "dispatches": recs}
+        else:
+            out["timeline"] = {"summary": {}, "dispatches": []}
+        out["transfer_sites"] = default_ledger().snapshot()
+        hbm = default_hbm()
+        out["hbm"] = {"summary": hbm.summary(), "sites": hbm.snapshot()}
+        snap = server.metrics.snapshot()
+        out["drain"] = {
+            "counters": {k: v for k, v in
+                         (snap.get("counters") or {}).items()
+                         if k.startswith(("drain.", "wave."))},
+            "histograms": {k: v for k, v in
+                           (snap.get("histograms") or {}).items()
+                           if k.startswith(("drain.", "wave."))},
+        }
+        fr = default_flight()
+        out["flight"] = {"index": fr.last_index(),
+                         "events": fr.snapshot(limit=256),
+                         "counts": fr.counts()}
+        if cluster is not None:
+            out["raft"] = {"status": cluster.raft.status(),
+                           "metrics": cluster.raft.metrics.snapshot()}
+            out["wal"] = {"mode": "raft-journal",
+                          "log_bytes": out["raft"]["status"]["log_bytes"],
+                          "snapshot_index":
+                              out["raft"]["status"]["snapshot_index"]}
+        else:
+            out["raft"] = {"mode": "single-server"}
+            wal = getattr(server.state, "wal", None)
+            out["wal"] = (wal.status() if wal is not None
+                          else {"mode": "memory"})
+        tracer = getattr(server, "tracer", None)
+        traces: Dict[str, Any] = {}
+        if tracer is not None:
+            for tid in tracer.trace_ids()[-32:]:
+                tr = tracer.get(tid)
+                if tr is not None:
+                    traces[tid] = tr
+        out["eval_traces"] = traces
+        missing = [s for s in DEBUG_SECTIONS if s not in out]
+        assert not missing, f"debug sections missing: {missing}"
+        return out
 
     # ---- /v1/acl/* (acl_endpoint.go) ----
 

@@ -6,6 +6,6 @@ operator.go). Here one client class exposes the same noun-scoped surface;
 structs decode through the shared wire codec, so SDK users handle the
 same `nomad_tpu.structs` types the server does (the reference keeps a
 separate mirrored model; see SURVEY §2.5)."""
-from .client import ApiError, NomadClient
+from .client import DEBUG_SECTIONS, ApiError, NomadClient
 
-__all__ = ["ApiError", "NomadClient"]
+__all__ = ["ApiError", "DEBUG_SECTIONS", "NomadClient"]

@@ -16,6 +16,16 @@ class ApiError(Exception):
         self.code = code
 
 
+#: every section a `/v1/operator/debug` payload advertises — the CLI
+#: bundle writer and the end-to-end capture test iterate THIS tuple, so
+#: a section silently dropped from the endpoint fails loudly there
+DEBUG_SECTIONS = (
+    "server", "control", "metrics", "prometheus", "timeline",
+    "transfer_sites", "hbm", "drain", "flight", "raft", "wal",
+    "eval_traces",
+)
+
+
 class NomadClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 4646,
                  timeout: float = 70.0, token: Optional[str] = None,
@@ -493,6 +503,29 @@ class NomadClient:
                            "allocs": str(allocs)})
         return self._request("GET", "/v1/operator/hbm",
                              params=params or None)
+
+    def operator_flight(self, index: int = 0, wait: float = 0.0,
+                        types: Optional[List[str]] = None) -> dict:
+        """Control-plane flight events past `index` (GET
+        /v1/operator/flight): leadership changes, plan rejections,
+        heartbeat losses, error streaks, stuck leases, wave-collision
+        spikes, membership churn. `wait` long-polls like the event
+        stream; `types` filters to a comma-joined vocabulary subset."""
+        params: Dict[str, str] = {"index": str(index)}
+        if wait:
+            params["wait"] = str(wait)
+        if types:
+            params["type"] = ",".join(types)
+        return self._request("GET", "/v1/operator/flight", params=params)
+
+    def operator_debug(self) -> dict:
+        """One server's full debug capture (GET /v1/operator/debug):
+        every DEBUG_SECTIONS entry — metrics + Prometheus text,
+        dispatch timeline, transfer/HBM ledgers, drain stats, recent
+        flight events, raft/WAL status, recent eval traces. The
+        `operator debug` CLI aggregates this per reachable server into
+        the bundle."""
+        return self._request("GET", "/v1/operator/debug")
 
     def status_leader(self):
         return self._request("GET", "/v1/status/leader")

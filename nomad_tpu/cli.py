@@ -1376,15 +1376,52 @@ def cmd_operator_autopilot_health(args) -> int:
     return 0
 
 
+def _client_for_base(args, base: str):
+    """NomadClient for a scheme-qualified base URL (a gossip member's
+    `http_addr` tag), inheriting the invocation's token/TLS settings."""
+    import re as _re
+
+    m = _re.match(r"^(?P<scheme>https?)://(?P<host>\[[^\]]+\]|[^:/]+)"
+                  r":(?P<port>\d+)/?$", base)
+    if m is None:
+        raise ValueError(f"malformed http_addr {base!r}")
+    host = m.group("host").strip("[]")
+    https = m.group("scheme") == "https"
+    ca = (getattr(args, "ca_cert", None)
+          or os.environ.get("NOMAD_CACERT")) if https else None
+    if https and not ca:
+        raise ValueError(f"{base}: https member needs -ca-cert")
+    return NomadClient(
+        host, int(m.group("port")),
+        token=os.environ.get("NOMAD_TOKEN"), ca_cert=ca,
+        client_cert=(getattr(args, "client_cert", None)
+                     or os.environ.get("NOMAD_CLIENT_CERT")),
+        client_key=(getattr(args, "client_key", None)
+                    or os.environ.get("NOMAD_CLIENT_KEY")))
+
+
 def cmd_operator_debug(args) -> int:
     """`nomad-tpu operator debug` (command/operator_debug.go): capture a
-    support bundle — cluster state dumps + agent diagnostics — into a
-    tar.gz."""
+    support bundle into a tar.gz — cluster-wide state dumps from the
+    addressed agent, plus EVERY advertised debug section
+    (api.DEBUG_SECTIONS: metrics + Prometheus text, dispatch timeline,
+    transfer/HBM ledgers, drain stats, flight events, raft/WAL status,
+    eval traces) from EVERY reachable server, discovered through the
+    gossip members' `http_addr` tags."""
     import io
     import tarfile
     import time as _time
 
+    from .api import DEBUG_SECTIONS, ApiError
+
     api = _client(args)
+    try:
+        api.agent_self()  # reachability probe: one-line error + exit 1
+    except (ApiError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    # cluster-wide state from the addressed agent (the reference's
+    # one-shot API captures)
     captures = {
         "agent-self.json": lambda: api.agent_self(),
         "members.json": lambda: api._request("GET", "/v1/agent/members"),
@@ -1399,7 +1436,6 @@ def cmd_operator_debug(args) -> int:
             "GET", "/v1/evaluations", params={"namespace": "*"}),
         "deployments.json": lambda: api._request(
             "GET", "/v1/deployments", params={"namespace": "*"}),
-        "metrics.json": lambda: api.metrics(),
         "pprof-threads.json": lambda: api._request(
             "GET", "/v1/agent/pprof"),
         "raft-configuration.json": lambda: api.raft_configuration(),
@@ -1407,29 +1443,127 @@ def cmd_operator_debug(args) -> int:
         "monitor.json": lambda: api._request(
             "GET", "/v1/agent/monitor"),
     }
+    # per-server debug targets: every alive member advertising an
+    # http_addr, falling back to just the addressed agent
+    targets = {}
+    try:
+        members = api._request("GET", "/v1/agent/members") \
+            .get("members", [])
+    except (ApiError, OSError):
+        members = []
+    for m in members:
+        base = (m.get("tags") or {}).get("http_addr")
+        if not base or m.get("status") not in (None, "alive"):
+            continue
+        try:
+            # key by the FULL member name ("<node>.<region>"): bare node
+            # ids may collide across federated regions, and a collision
+            # here would silently drop a server's capture from the bundle
+            targets[m["name"]] = _client_for_base(args, base)
+        except ValueError as e:
+            print(f"  skipping member {m.get('name')}: {e}",
+                  file=sys.stderr)
+    if not targets:
+        targets = {"self": api}
     out_path = args.output or \
         f"nomad-debug-{_time.strftime('%Y%m%d-%H%M%S')}.tar.gz"
-    ok = 0
-    with tarfile.open(out_path, "w:gz") as tar:
+    ok = server_ok = 0
+    try:
+        tar_cm = tarfile.open(out_path, "w:gz")
+    except OSError as e:
+        print(f"Error: cannot write bundle {out_path!r}: {e}",
+              file=sys.stderr)
+        return 1
+    with tar_cm as tar:
+        def add(name: str, data: bytes) -> None:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(data))
+
         for name, fetch in captures.items():
             try:
                 data = json.dumps(fetch(), indent=2, default=str).encode()
                 ok += 1
                 print(f"  captured {name}")
             except Exception as e:  # noqa: BLE001 — partial bundle is
-                data = json.dumps({"error": str(e)}).encode()  # still useful
+                data = json.dumps({"error": str(e)}).encode()  # useful
                 print(f"  FAILED  {name}: {e}", file=sys.stderr)
-            info = tarfile.TarInfo(name)
-            info.size = len(data)
-            info.mtime = int(_time.time())
-            tar.addfile(info, io.BytesIO(data))
-    if ok == 0:
-        print(f"Error: every capture failed — is the agent reachable? "
-              f"(bundle of error stubs left at {out_path})",
+            add(name, data)
+        for sname, sapi in sorted(targets.items()):
+            try:
+                dbg = sapi.operator_debug()
+            except Exception as e:  # noqa: BLE001 — other servers still
+                add(f"server-{sname}/error.json",  # worth capturing
+                    json.dumps({"error": str(e)}).encode())
+                print(f"  FAILED  server {sname}: {e}", file=sys.stderr)
+                continue
+            for section in DEBUG_SECTIONS:
+                body = dbg.get(section)
+                if section == "prometheus":
+                    add(f"server-{sname}/prometheus.prom",
+                        str(body or "").encode())
+                else:
+                    add(f"server-{sname}/{section}.json",
+                        json.dumps(body, indent=2, default=str).encode())
+            server_ok += 1
+            print(f"  captured server {sname} "
+                  f"({len(DEBUG_SECTIONS)} sections)")
+    if server_ok == 0:
+        print(f"Error: every server capture failed — is the agent "
+              f"reachable? (bundle of error stubs left at {out_path})",
               file=sys.stderr)
         return 1
     print(f"Created debug bundle: {out_path} "
-          f"({ok}/{len(captures)} captures)")
+          f"({ok}/{len(captures)} captures, "
+          f"{server_ok}/{len(targets)} servers)")
+    return 0
+
+
+def cmd_operator_flight(args) -> int:
+    """`nomad-tpu operator flight` — the control-plane flight recorder
+    (/v1/operator/flight): leadership changes, plan rejections, error
+    streaks, stuck leases, wave-collision spikes, membership churn,
+    heartbeat losses, in arrival order with a long-poll cursor."""
+    from .api import ApiError
+
+    if args.wait < 0 or args.index < 0:
+        print("Error: -index and -wait must be >= 0", file=sys.stderr)
+        return 1
+    api = _client(args)
+    try:
+        out = api.operator_flight(
+            index=args.index, wait=args.wait,
+            types=args.type.split(",") if args.type else None)
+    except (ApiError, OSError) as e:
+        # unreachable agent or bad args: one-line error + exit 1,
+        # never a traceback (the eval trace / operator hbm convention)
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    print(f"Index  = {out.get('index', 0)}")
+    counts = out.get("counts") or {}
+    if counts:
+        print("Totals = " + ", ".join(f"{k}={v}"
+                                      for k, v in sorted(counts.items())))
+    events = out.get("events") or []
+    if not events:
+        print("\nNo flight events recorded")
+        return 0
+    rows = []
+    for e in events:
+        stamp = time.strftime("%H:%M:%S",
+                              time.localtime(e.get("time_unix", 0)))
+        detail = ", ".join(f"{k}={v}" for k, v in
+                           sorted((e.get("detail") or {}).items()))
+        rows.append([str(e.get("seq", "")), stamp, e.get("type", ""),
+                     e.get("severity", ""), e.get("source", "") or "-",
+                     (e.get("key", "") or "-")[:20], detail[:48]])
+    print()
+    print(_columns(rows, ["Seq", "Time", "Type", "Sev", "Source", "Key",
+                          "Detail"]))
     return 0
 
 
@@ -1908,6 +2042,16 @@ def build_parser() -> argparse.ArgumentParser:
                      help="block up to this many seconds for new records")
     otl.add_argument("-json", action="store_true")
     otl.set_defaults(fn=cmd_operator_timeline)
+    ofl = op.add_parser("flight",
+                        help="control-plane flight recorder events")
+    ofl.add_argument("-index", type=int, default=0,
+                     help="only events past this seq (long-poll cursor)")
+    ofl.add_argument("-wait", type=float, default=0.0,
+                     help="block up to this many seconds for new events")
+    ofl.add_argument("-type", default="",
+                     help="comma-separated event-type filter")
+    ofl.add_argument("-json", action="store_true")
+    ofl.set_defaults(fn=cmd_operator_flight)
     ohb = op.add_parser("hbm",
                         help="device-buffer residency + capacity planner")
     ohb.add_argument("-watermarks", action="store_true",

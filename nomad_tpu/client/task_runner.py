@@ -104,6 +104,13 @@ class TaskRunner:
         self._secret_env: Dict[str, str] = {}
         self.logmon: Optional[LogMon] = None
         self.handle = None
+        #: guards handle/logmon/_manual_restart: the run loop (re)binds
+        #: them across task relaunches while the external lifecycle API
+        #: (restart/signal/join, alloc HTTP endpoints) and the template
+        #: watcher read them (nomadlint NLT01 — the last three baselined
+        #: findings). Never held across a driver call: copy out, release,
+        #: then block (NLT02 discipline).
+        self._handle_lock = threading.Lock()
         self._kill = threading.Event()
         #: agent-shutdown detach flag: written by detach() (client
         #: shutdown thread), read by the run loop after _kill fires —
@@ -112,8 +119,12 @@ class TaskRunner:
         self._detach = False
         self._detach_lock = threading.Lock()
         #: user-requested restart in flight: the next task exit restarts
-        #: immediately without consuming restart-policy budget
+        #: immediately without consuming restart-policy budget. Bound to
+        #: the HANDLE restart() targeted — a flag armed against a handle
+        #: that already exited naturally must not convert a LATER
+        #: launch's successful exit into a relaunch.
         self._manual_restart = False
+        self._restart_handle = None
         #: rendered template content by dest path — the re-render
         #: baseline the watcher diffs against
         self._tmpl_content: Dict[str, str] = {}
@@ -176,10 +187,14 @@ class TaskRunner:
         while not self._kill.is_set():
             if recovered:
                 recovered = False  # only the first pass reattaches
+                with self._handle_lock:
+                    handle = self.handle
             else:
                 try:
                     cfg = self._task_config()
-                    self.handle = self.driver.start_task(cfg)
+                    handle = self.driver.start_task(cfg)
+                    with self._handle_lock:
+                        self.handle = handle
                     self._persist_handle()
                 except Exception as e:
                     self._event(EVENT_DRIVER_FAILURE, str(e))
@@ -190,7 +205,7 @@ class TaskRunner:
             self._set_state(TASK_STATE_RUNNING)
             result = None
             while result is None and not self._kill.is_set():
-                result = self.driver.wait_task(self.handle, timeout=0.1)
+                result = self.driver.wait_task(handle, timeout=0.1)
             if self._kill.is_set():
                 with self._detach_lock:
                     detach = self._detach
@@ -200,7 +215,7 @@ class TaskRunner:
                     return
                 if result is None:
                     self._event(EVENT_KILLING)
-                    self.driver.stop_task(self.handle,
+                    self.driver.stop_task(handle,
                                           self.task.kill_timeout_s)
                     self._event(EVENT_KILLED)
                 self._cleanup_handle()
@@ -211,10 +226,14 @@ class TaskRunner:
             self._event(EVENT_TERMINATED,
                         f"Exit Code: {result.exit_code}"
                         + (f", Err: {result.err}" if result.err else ""))
-            if self._manual_restart:
+            with self._handle_lock:
+                manual = (self._manual_restart
+                          and self._restart_handle is handle)
+                self._manual_restart = False
+                self._restart_handle = None
+            if manual:
                 # alloc restart (alloc_endpoint.go Restart → taskrunner
                 # Restart): always relaunch, no policy budget consumed
-                self._manual_restart = False
                 self.state.restarts += 1
                 self.state.last_restart = time.time()
                 self._event(EVENT_RESTART_SIGNALED,
@@ -240,23 +259,28 @@ class TaskRunner:
             return False
         if handle is None:
             return False
-        self.handle = handle
+        with self._handle_lock:
+            self.handle = handle
         self._event(EVENT_STARTED, "Task recovered after agent restart")
         return True
 
     def _persist_handle(self) -> None:
-        if self.on_handle is not None and self.handle is not None:
+        with self._handle_lock:
+            handle = self.handle
+        if self.on_handle is not None and handle is not None:
             self.on_handle(self.task.name, self.task.driver,
-                           self.handle.driver_state)
+                           handle.driver_state)
 
     def _cleanup_handle(self) -> None:
         """Release driver-side resources for a terminally-ended task
         (kills the per-task executor plugin; no-op for in-process
         drivers)."""
-        if self.handle is None:
+        with self._handle_lock:
+            handle = self.handle
+        if handle is None:
             return
         try:
-            self.driver.destroy_task(self.handle, force=True)
+            self.driver.destroy_task(handle, force=True)
         except Exception:
             pass
         if self.on_handle is not None:
@@ -285,11 +309,13 @@ class TaskRunner:
     def _prestart(self) -> None:
         self._event(EVENT_TASK_SETUP)
         # logmon hook (logmon_hook.go)
-        self.logmon = LogMon(
+        logmon = LogMon(
             self.logs_dir, self.task.name,
             max_files=self.task.log_config.max_files,
             max_file_size_mb=self.task.log_config.max_file_size_mb,
         )
+        with self._handle_lock:
+            self.logmon = logmon
         # artifacts hook (taskrunner/artifact_hook.go + getter/getter.go):
         # fetch each artifact into the task dir before the first start;
         # a fetch or checksum failure fails the task setup. Skipped when
@@ -623,9 +649,10 @@ class TaskRunner:
                         self._event(
                             EVENT_SIGNALING,
                             f"Template re-rendered; sending {sig}")
-                        if self.handle is not None \
-                                and self.handle.is_running():
-                            self.driver.signal_task(self.handle, sig)
+                        with self._handle_lock:
+                            handle = self.handle
+                        if handle is not None and handle.is_running():
+                            self.driver.signal_task(handle, sig)
                     except Exception as e:  # noqa: BLE001 — racing an
                         # exit
                         log.info("task %s: change_mode signal %s "
@@ -709,16 +736,18 @@ class TaskRunner:
                 env["NOMAD_CONNECT_TARGET_PORT"] = str(literal_port(lbl))
         raw = interpolate_config(dict(self.task.config), env, self.node)
         ip, ports = self.alloc.port_map(self.task.name)
+        with self._handle_lock:
+            logmon = self.logmon
         return TaskConfig(
             id=f"{self.alloc.id}/{self.task.name}",
             name=self.task.name,
             env=env,
             user=self.task.user,
             task_dir=self.task_dir,
-            stdout_path=self.logmon.stdout_path if self.logmon else "",
-            stderr_path=self.logmon.stderr_path if self.logmon else "",
-            stdout_sink=self.logmon.write_stdout if self.logmon else None,
-            stderr_sink=self.logmon.write_stderr if self.logmon else None,
+            stdout_path=logmon.stdout_path if logmon else "",
+            stderr_path=logmon.stderr_path if logmon else "",
+            stdout_sink=logmon.write_stdout if logmon else None,
+            stderr_sink=logmon.write_stderr if logmon else None,
             raw_config=raw,
             cpu_mhz=self.task.resources.cpu,
             memory_mb=self.task.resources.memory_mb,
@@ -733,27 +762,36 @@ class TaskRunner:
     def restart(self) -> None:
         """User-requested graceful restart (taskrunner lifecycle.go
         Restart): stop the current process; the run loop relaunches."""
-        handle = self.handle  # the run loop reassigns self.handle on
-        if handle is None or not handle.is_running():  # relaunch — wait
-            raise RuntimeError("task is not running")  # on OUR handle
-        self._manual_restart = True
+        with self._handle_lock:  # the run loop reassigns self.handle on
+            handle = self.handle  # relaunch — wait on OUR handle
+        if handle is None or not handle.is_running():
+            raise RuntimeError("task is not running")
+        with self._handle_lock:
+            self._manual_restart = True
+            self._restart_handle = handle
         try:
             self.driver.stop_task(handle, self.task.kill_timeout_s)
             # confirm the process actually exited: driver stop paths
             # swallow transport errors, and a stale armed flag would
             # later convert a natural successful exit into a relaunch
+            # (the handle binding above additionally scopes the flag to
+            # THIS launch, closing the exit-between-check-and-arm race)
             if handle.wait(self.task.kill_timeout_s + 7.0) is None:
                 raise RuntimeError("task did not stop for restart")
         except Exception:
-            self._manual_restart = False
+            with self._handle_lock:
+                self._manual_restart = False
+                self._restart_handle = None
             raise
 
     def signal(self, sig: str = "SIGHUP") -> bool:
         """Deliver a signal to the running task (lifecycle.go Signal)."""
-        if self.handle is None or not self.handle.is_running():
+        with self._handle_lock:
+            handle = self.handle
+        if handle is None or not handle.is_running():
             raise RuntimeError("task is not running")
         self._event(EVENT_SIGNALING, f"Signal {sig} sent to task")
-        return self.driver.signal_task(self.handle, sig)
+        return self.driver.signal_task(handle, sig)
 
     def kill(self) -> None:
         self._kill.set()
@@ -780,5 +818,7 @@ class TaskRunner:
     def join(self, timeout: float = 10.0) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
-        if self.logmon is not None:
-            self.logmon.close()
+        with self._handle_lock:
+            logmon = self.logmon
+        if logmon is not None:
+            logmon.close()

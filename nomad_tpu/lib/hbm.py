@@ -347,6 +347,20 @@ class HbmLedger:
                     now - lease.t0, wm)
             if not any_stuck:
                 self._stuck_streak = 0
+        if newly_stuck:
+            # flight events OUTSIDE the ledger lock (the recorder takes
+            # its own condition; no reason to nest them)
+            from .flight import default_flight
+
+            for lease in newly_stuck:
+                try:
+                    default_flight().record(
+                        "hbm.stuck_lease", key=str(lease.token),
+                        source=lease.site, severity="warn",
+                        detail={"age_s": round(now - lease.t0, 1),
+                                "watermark_s": wm})
+                except Exception:  # noqa: BLE001 — telemetry only
+                    pass
 
     # -- export --
 

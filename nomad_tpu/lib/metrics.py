@@ -331,6 +331,20 @@ class ErrorStreak:
         (self._log.warning if first else self._log.debug)(
             "%s: %s failed: %s: %s", self.name, what or "loop pass",
             type(exc).__name__, exc)
+        if first:
+            # first-of-streak → flight event: a wedged loop becomes part
+            # of the operator-debug narrative, not just a counter.
+            # Lazy import — flight.py imports this module for its
+            # registry mirror.
+            from .flight import default_flight
+
+            try:
+                default_flight().record(
+                    "error.streak", key=self.name, severity="warn",
+                    detail={"what": what or "loop pass",
+                            "error": f"{type(exc).__name__}: {exc}"})
+            except Exception:  # noqa: BLE001 — telemetry must not kill
+                pass
 
     def ok(self) -> None:
         with self._lock:

@@ -39,11 +39,16 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from ..lib.flight import default_flight
 from ..lib.journal import load_journal
+from ..lib.metrics import MetricsRegistry
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
+
+#: raft.state gauge encoding (dashboards key on the number)
+_STATE_CODE = {FOLLOWER: 0, CANDIDATE: 1, LEADER: 2}
 
 HEARTBEAT_INTERVAL = 0.05
 ELECTION_TIMEOUT = (0.15, 0.30)
@@ -174,6 +179,16 @@ class _Log:
         off = start - self.base_index - 1
         return self.entries[off: off + limit]
 
+    def disk_bytes(self) -> int:
+        """Current on-disk journal size (0 for memory-only logs) — the
+        bounded-log health read next to `compact_to`."""
+        if self._path is None or not os.path.exists(self._path):
+            return 0
+        try:
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
+
     def close(self) -> None:
         if self._fh is not None:
             self._fh.close()
@@ -199,6 +214,7 @@ class RaftNode:
                  snapshot_fn: Optional[Callable[[], Any]] = None,
                  restore_fn: Optional[Callable[[Any], None]] = None,
                  snapshot_threshold: int = 8192,
+                 metrics: Optional[MetricsRegistry] = None,
                  ) -> None:
         self.id = node_id
         self.peers = dict(peers)
@@ -214,6 +230,28 @@ class RaftNode:
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.snapshot_threshold = snapshot_threshold
+        #: per-node instrument registry (a node outlives the leadership-
+        #: gated Server and its registry). Instruments are created
+        #: EAGERLY so the exposed series set is deterministic — name
+        #: pinning (tests/test_metrics_names.py) never depends on which
+        #: code paths a test happened to drive.
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._m_commit_ms = self.metrics.histogram("raft.commit_ms")
+        self._m_apply_ms = self.metrics.histogram("raft.apply_ms")
+        self._m_append_ms = self.metrics.histogram("raft.append_ms")
+        self._ctr_elections = self.metrics.counter("raft.elections")
+        self._ctr_gained = self.metrics.counter("raft.leadership_gained")
+        self._ctr_lost = self.metrics.counter("raft.leadership_lost")
+        self._ctr_snapshots = self.metrics.counter("raft.snapshots")
+        self._ctr_installs = self.metrics.counter("raft.snapshot_installs")
+        self._g_term = self.metrics.gauge("raft.term")
+        self._g_state = self.metrics.gauge("raft.state")
+        self._g_commit = self.metrics.gauge("raft.commit_index")
+        self._g_applied = self.metrics.gauge("raft.last_applied")
+        self._g_log_last = self.metrics.gauge("raft.log_last_index")
+        self._g_log_base = self.metrics.gauge("raft.log_base_index")
+        self.metrics.gauge("raft.log_bytes")
+        self.metrics.gauge("raft.peers")
 
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
@@ -388,6 +426,8 @@ class RaftNode:
             self._persist_snapshot(snap)
             self._snapshot = snap
             self.log.compact_to(idx, term)
+            self._ctr_snapshots.inc()
+            self._g_log_base.set(self.log.base_index)
 
     def force_snapshot(self) -> int:
         """Take a snapshot now regardless of threshold (operator path /
@@ -420,6 +460,16 @@ class RaftNode:
     def _rand_timeout(self) -> float:
         return random.uniform(*self.election_timeout)
 
+    def _flight(self, type_: str, severity: str = "info",
+                **detail) -> None:
+        """Record a flight event attributed to this node. Consensus
+        correctness must never depend on telemetry — swallow."""
+        try:
+            default_flight().record(type_, key=self.id, source=self.id,
+                                    severity=severity, detail=detail)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+
     # ---- role transitions (hold lock) ----
 
     def _become_follower(self, term: int, leader: Optional[str]) -> None:
@@ -433,12 +483,17 @@ class RaftNode:
             self.leader_id = leader
         self._last_heard = time.monotonic()
         self._timeout = self._rand_timeout()
+        self._g_term.set(self.term)
+        self._g_state.set(_STATE_CODE[FOLLOWER])
         if was_leader:
             # Fail in-flight apply() futures — their entries may be
             # overwritten by the new leader; apply() re-checks term+commit.
             waiters, self._waiters = self._waiters, {}
             for ev in waiters.values():
                 ev.set()
+            self._ctr_lost.inc()
+            self._flight("leadership.lost", severity="warn",
+                         term=self.term, new_leader=leader or "")
             self._notify_leadership(False)
 
     def _become_leader(self) -> None:
@@ -447,6 +502,10 @@ class RaftNode:
         nxt = self.log.last_index() + 1
         self._next_index = {p: nxt for p in self.peers if p != self.id}
         self._match_index = {p: 0 for p in self.peers if p != self.id}
+        self._g_state.set(_STATE_CODE[LEADER])
+        self._ctr_gained.inc()
+        self._flight("leadership.gained", term=self.term,
+                     last_index=self.log.last_index())
         self._notify_leadership(True)
 
     def _notify_leadership(self, is_leader: bool) -> None:
@@ -505,14 +564,41 @@ class RaftNode:
                 return peers, dict(self._match_index)
             return peers
 
+    def status(self) -> Dict[str, Any]:
+        """One-shot consensus health view (the `operator debug` bundle's
+        raft section; refreshes the log-size gauges as a side effect so
+        a scrape right after stays consistent with the report)."""
+        with self._lock:
+            out = {
+                "id": self.id,
+                "state": self.state,
+                "term": self.term,
+                "leader": self.leader_id,
+                "commit_index": self.commit_index,
+                "last_applied": self.last_applied,
+                "log_base_index": self.log.base_index,
+                "log_last_index": self.log.last_index(),
+                "snapshot_index": (self._snapshot or {}).get("index", 0),
+                "peers": {p: list(a) for p, a in self.peers.items()},
+                "match_index": dict(self._match_index),
+            }
+        out["log_bytes"] = self.log.disk_bytes()
+        self.metrics.set_gauge("raft.log_bytes", out["log_bytes"])
+        self._g_log_last.set(out["log_last_index"])
+        self._g_log_base.set(out["log_base_index"])
+        self.metrics.set_gauge("raft.peers", len(out["peers"]))
+        return out
+
     def apply(self, data: Any, timeout: float = 10.0) -> int:
         """Leader-only: append, replicate, wait for commit. Returns the
         entry's log index (hashicorp/raft Apply future)."""
+        t0 = time.perf_counter()
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
             append_term = self.term
             idx = self.log.append(append_term, data)
+            self._g_log_last.set(self.log.last_index())
             ev = threading.Event()
             self._waiters[idx] = ev
             # single-voter clusters reach majority on append alone
@@ -522,6 +608,9 @@ class RaftNode:
             with self._lock:
                 self._waiters.pop(idx, None)
             raise TimeoutError("raft apply timed out (no quorum?)")
+        # append → woken: quorum replication + commit advancement (the
+        # leader-side serialization cost the plan pipeline rides on)
+        self._m_commit_ms.add_sample((time.perf_counter() - t0) * 1e3)
         with self._lock:
             ok = (self.commit_index >= idx
                   and self.log.last_index() >= idx)
@@ -617,6 +706,10 @@ class RaftNode:
             self._timeout = self._rand_timeout()
             last_idx = self.log.last_index()
             last_term = self.log.term_at(last_idx)
+            self._ctr_elections.inc()
+            self._g_term.set(term)
+            self._g_state.set(_STATE_CODE[CANDIDATE])
+        self._flight("raft.term", term=term)
         votes = {self.id}
         vote_lock = threading.Lock()
         with self._lock:
@@ -709,12 +802,14 @@ class RaftNode:
         if snap_to_send is not None:
             self._send_snapshot(peer_id, addr, term, snap_to_send)
             return
+        t0 = time.perf_counter()
         try:
             res = self.pool.call(addr, "Raft.AppendEntries", term, self.id,
                                  prev_idx, prev_term, entries, commit,
                                  timeout=2.0)
         except Exception:
             return
+        self._m_append_ms.add_sample((time.perf_counter() - t0) * 1e3)
         with self._lock:
             if res["term"] > self.term:
                 self._become_follower(res["term"], None)
@@ -727,6 +822,14 @@ class RaftNode:
                     self._match_index[peer_id] = match
                 self._next_index[peer_id] = match + 1
                 self._advance_commit()
+                # follower commit-index lag: how far behind this peer's
+                # replicated prefix is — the failover-risk gauge (a
+                # laggy majority stretches commit latency; a laggy
+                # minority is the InstallSnapshot candidate)
+                self.metrics.set_gauge(
+                    f"raft.lag.{peer_id}",
+                    max(self.commit_index
+                        - self._match_index.get(peer_id, 0), 0))
             else:
                 # back off (conflict hint if provided)
                 hint = res.get("conflict_index")
@@ -780,6 +883,7 @@ class RaftNode:
 
                 traceback.print_exc()
                 return {"term": self.term, "success": False}
+            self._ctr_installs.inc()
             return {"term": self.term, "success": True}
 
     def _advance_commit(self) -> None:
@@ -790,6 +894,7 @@ class RaftNode:
             count = 1 + sum(1 for m in self._match_index.values() if m >= n)
             if count >= len(self.peers) // 2 + 1:
                 self.commit_index = n
+                self._g_commit.set(n)
                 self._commit_cv.notify_all()
                 break
 
@@ -831,7 +936,9 @@ class RaftNode:
                 self.log.append(e["term"], e["data"])
             if leader_commit > self.commit_index:
                 self.commit_index = min(leader_commit, self.log.last_index())
+                self._g_commit.set(self.commit_index)
                 self._commit_cv.notify_all()
+            self._g_log_last.set(self.log.last_index())
             return {"term": self.term, "success": True}
 
     # ---- applier ----
@@ -862,6 +969,7 @@ class RaftNode:
                            if i in self._waiters]
                 self._applying = True  # FSM mutation outside the lock —
                 # InstallSnapshot/force_snapshot park on this flag
+            t0 = time.perf_counter()
             try:
                 for _, data in batch:
                     if isinstance(data, dict) \
@@ -881,6 +989,8 @@ class RaftNode:
                 with self._commit_cv:
                     self._applying = False
                     self._commit_cv.notify_all()
+            self._m_apply_ms.add_sample((time.perf_counter() - t0) * 1e3)
+            self._g_applied.set(end)
             for ev in waiters:
                 ev.set()
             self._maybe_take_snapshot()

@@ -82,6 +82,19 @@ class EvalBroker:
         self.tracer = tracer
         self._ctr = {k: self.metrics.counter(f"broker.{k}")
                      for k in _STAT_KEYS}
+        # queue-state gauges (ISSUE 13): created EAGERLY so the exposed
+        # series set is deterministic; refreshed by queue_stats() (the
+        # metrics scrape path) — depths mutate too often to gauge inline
+        self._g_ready = self.metrics.gauge("broker.ready_depth")
+        self._g_unacked = self.metrics.gauge("broker.unacked_depth")
+        self._g_pending = self.metrics.gauge("broker.pending_depth")
+        self._g_delayed = self.metrics.gauge("broker.delayed_depth")
+        self._g_oldest = self.metrics.gauge("broker.oldest_eval_age_s")
+        self._gauged_queues: set = set()
+        #: eval id → wall time it became waitable (ready or job-pending);
+        #: cleared on ack / final delivery — feeds the oldest-eval-age
+        #: gauges (a growing age under load = the backpressure signal)
+        self._enqueue_wall: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._enabled = False
@@ -118,6 +131,7 @@ class EvalBroker:
                 self._job_outstanding.clear()
                 self._job_pending.clear()
                 self._dequeues.clear()
+                self._enqueue_wall.clear()
                 self._delayed = DelayHeap()
             else:
                 if self._delay_thread is None:
@@ -176,6 +190,7 @@ class EvalBroker:
                 self._job_pending.setdefault(jk, []),
                 (-eval.priority, next(self._seq), eval),
             )
+            self._enqueue_wall[eval.id] = now
             return
         queue = FAILED_QUEUE if self._dequeues.get(eval.id, 0) >= self.delivery_limit \
             else eval.type
@@ -183,6 +198,7 @@ class EvalBroker:
             self._ready.setdefault(queue, []),
             (-eval.priority, next(self._seq), eval),
         )
+        self._enqueue_wall[eval.id] = now
         self._ctr["enqueued"].inc()
         self._cv.notify_all()
 
@@ -498,6 +514,7 @@ class EvalBroker:
                 un.timer.cancel()
             del self._unack[eval_id]
             self._dequeues.pop(eval_id, None)
+            self._enqueue_wall.pop(eval_id, None)
             jk = (un.eval.namespace, un.eval.job_id)
             if self._job_outstanding.get(jk) == eval_id:
                 del self._job_outstanding[jk]
@@ -525,12 +542,28 @@ class EvalBroker:
             if self._job_outstanding.get(jk) == eval_id:
                 del self._job_outstanding[jk]
             self._ctr["nacked"].inc()
-            if self._dequeues.get(eval_id, 0) >= self.delivery_limit:
+            dequeues = self._dequeues.get(eval_id, 0)
+            exhausted = dequeues >= self.delivery_limit
+            if exhausted:
                 self._ctr["failed"].inc()
             else:
                 self._ctr["requeued"].inc()
             self._enqueue_locked(un.eval, token="")
             self._cv.notify_all()
+        if exhausted:
+            # delivery budget exhausted → the eval now waits in the
+            # failed queue served last: silent progress loss without a
+            # flight event (the soak's "why did this job stall" read)
+            from ..lib.flight import default_flight
+
+            try:
+                default_flight().record(
+                    "broker.eval_failed", key=eval_id,
+                    source=un.eval.job_id, severity="warn",
+                    detail={"dequeues": dequeues,
+                            "type": un.eval.type})
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
 
     def _nack_timeout(self, eval_id: str, token: str) -> None:
         try:
@@ -573,3 +606,56 @@ class EvalBroker:
     def unacked_count(self) -> int:
         with self._lock:
             return len(self._unack)
+
+    def queue_stats(self) -> Dict[str, object]:
+        """Queue-state report + gauge refresh (ISSUE 13): per-scheduler
+        ready depth and oldest waiting-eval age, unacked/pending/delayed
+        depths. Called from the metrics scrape path (and `operator
+        debug`), so a Prometheus poll is enough to watch broker
+        backpressure build — depth climbing with age is a starved
+        worker pool; depth flat with age climbing is per-job
+        serialization head-of-line blocking."""
+        now = time.time()
+        with self._lock:
+            ready = {q: len(h) for q, h in self._ready.items() if h}
+            oldest_by_queue: Dict[str, float] = {}
+            for q, h in self._ready.items():
+                for item in h:
+                    t = self._enqueue_wall.get(item[2].id)
+                    if t is None:
+                        continue
+                    age = max(now - t, 0.0)
+                    if age > oldest_by_queue.get(q, 0.0):
+                        oldest_by_queue[q] = age
+            pending = sum(len(v) for v in self._job_pending.values())
+            unacked = len(self._unack)
+            delayed = len(self._delayed)
+            # _gauged_queues bookkeeping stays under the lock: scrapes
+            # run concurrently (ThreadingHTTPServer), and a bare set
+            # mutated mid-iteration raises
+            drained = self._gauged_queues - set(ready)
+            self._gauged_queues -= drained
+            self._gauged_queues |= set(ready)
+        oldest = max(oldest_by_queue.values(), default=0.0)
+        self._g_ready.set(sum(ready.values()))
+        self._g_unacked.set(unacked)
+        self._g_pending.set(pending)
+        self._g_delayed.set(delayed)
+        self._g_oldest.set(round(oldest, 3))
+        # per-scheduler depth gauges; queues that emptied are zeroed so
+        # a scrape never reads a stale depth for a drained scheduler
+        for q in drained:
+            self.metrics.set_gauge(f"broker.ready.{q}", 0)
+        for q, n in ready.items():
+            self.metrics.set_gauge(f"broker.ready.{q}", n)
+        return {
+            "ready": dict(sorted(ready.items())),
+            "ready_total": sum(ready.values()),
+            "unacked": unacked,
+            "pending_jobs": pending,
+            "delayed": delayed,
+            "oldest_eval_age_s": round(oldest, 3),
+            "oldest_by_queue": {q: round(a, 3)
+                                for q, a in sorted(
+                                    oldest_by_queue.items())},
+        }

@@ -266,7 +266,7 @@ class ClusterServer:
         self.membership = Membership(
             f"{config.node_id}.{config.region}", self.addr, self.pool,
             tags={"region": config.region},
-            on_change=self.autopilot.member_change)
+            on_change=self._member_change)
         self.rpc.register("Gossip.exchange", self.membership.exchange)
         # committed raft config changes shrink/grow the endpoint peer map
         # too (the reference's serf/raft reconciliation)
@@ -303,6 +303,24 @@ class ClusterServer:
         srv.server_addrs_fn = \
             lambda: self.region_servers(self.config.region)
         return srv
+
+    def _member_change(self, member) -> None:
+        """Gossip status transition → flight event (membership churn is
+        a first-class failover signal), then autopilot health."""
+        from ..lib.flight import default_flight
+        from .gossip import STATUS_ALIVE
+
+        try:
+            default_flight().record(
+                "membership.change", key=member.name,
+                source=self.config.node_id,
+                severity=("info" if member.status == STATUS_ALIVE
+                          else "warn"),
+                detail={"status": member.status,
+                        "incarnation": member.incarnation})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        self.autopilot.member_change(member)
 
     def _on_raft_conf_change(self, action: str, peer_id: str,
                              addr) -> None:

@@ -51,7 +51,7 @@ class _Future:
 class PlanQueue:
     """Priority queue of pending plans (reference plan_queue.go:29)."""
 
-    def __init__(self) -> None:
+    def __init__(self, metrics: Optional[MetricsRegistry] = None) -> None:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._heap: List[Tuple[int, int, Plan, _Future]] = []
@@ -63,6 +63,15 @@ class PlanQueue:
         # them or the inline fast path could commit ahead of an
         # already-dequeued higher-priority plan
         self._in_flight = 0
+        #: queued + in-flight plans awaiting the serialized leader apply
+        #: (ISSUE 13): the contention read on the commit-point mutex —
+        #: eagerly created so the series is always exposed
+        self._g_depth = (metrics.gauge("plan_apply.queue_depth")
+                         if metrics is not None else None)
+
+    def _gauge_locked(self) -> None:
+        if self._g_depth is not None:
+            self._g_depth.set(len(self._heap) + self._in_flight)
 
     def set_enabled(self, enabled: bool) -> None:
         with self._cv:
@@ -71,6 +80,7 @@ class PlanQueue:
                 for _, _, _, fut in self._heap:
                     fut.set(None, RuntimeError("plan queue disabled"))
                 self._heap.clear()
+            self._gauge_locked()
             self._cv.notify_all()
 
     def enqueue(self, plan: Plan) -> _Future:
@@ -82,6 +92,7 @@ class PlanQueue:
             heapq.heappush(
                 self._heap, (-plan.priority, next(self._seq), plan, fut)
             )
+            self._gauge_locked()
             self._cv.notify_all()
         return fut
 
@@ -97,6 +108,7 @@ class PlanQueue:
                 if self._heap:
                     _, _, plan, fut = heapq.heappop(self._heap)
                     self._in_flight += 1
+                    self._gauge_locked()
                     return plan, fut
                 remaining = 1.0
                 if deadline is not None:
@@ -109,6 +121,7 @@ class PlanQueue:
         """Applier thread: the plan returned by dequeue() is committed."""
         with self._cv:
             self._in_flight -= 1
+            self._gauge_locked()
 
     def idle(self) -> bool:
         """Enabled with nothing pending or in flight — the inline fast
@@ -123,6 +136,7 @@ class PlanQueue:
             for _, _, _, fut in self._heap:
                 fut.set(None, RuntimeError("plan queue shutdown"))
             self._heap.clear()
+            self._gauge_locked()
             self._cv.notify_all()
 
 
@@ -243,6 +257,11 @@ class PlanApplier:
         self._ctr = {k: self.metrics.counter(f"plan_apply.{k}")
                      for k in self.STAT_KEYS}
         self._apply_ms = self.metrics.histogram("plan_apply.apply_ms")
+        #: partial / applied — the server-side twin of the bench tail's
+        #: `e2e_plan_partial_rate` (optimistic-concurrency cost), always
+        #: exposed (ISSUE 13)
+        self._g_partial_rate = self.metrics.gauge(
+            "plan_apply.partial_rate")
 
     @property
     def stats(self) -> Dict[str, int]:
@@ -318,6 +337,7 @@ class PlanApplier:
             deployment_updates=list(plan.deployment_updates),
         )
         partial = False
+        rejected: List[str] = []
         touched = set(plan.node_allocation) | set(plan.node_preemptions)
         # verification holds the store's mutation lock: the tensor path
         # reads live used/alloc_usage counters, and a concurrent client
@@ -348,6 +368,7 @@ class PlanApplier:
                         )
                 else:
                     partial = True
+                    rejected.append(node_id)
                     self._ctr["rejected_nodes"].inc()
         if partial and plan.all_at_once:
             # all-at-once plans commit nothing on any failure — including the
@@ -386,5 +407,22 @@ class PlanApplier:
             result.refresh_index = self.state.index.value
             self._ctr["partial"].inc()
         self._ctr["applied"].inc()
+        self._g_partial_rate.set(
+            round(self._ctr["partial"].value
+                  / max(self._ctr["applied"].value, 1), 4))
         self._apply_ms.add_sample((time.perf_counter() - t0) * 1e3)
+        if partial:
+            # optimistic rejection → flight event: a failover or a
+            # wave-collision storm shows up as a plan.partial burst in
+            # the ring, keyed by eval for the trace join
+            from ..lib.flight import default_flight
+
+            try:
+                default_flight().record(
+                    "plan.partial", key=plan.eval_id, severity="warn",
+                    detail={"rejected_nodes": rejected[:8],
+                            "n_rejected": len(rejected),
+                            "all_at_once": bool(plan.all_at_once)})
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         return result

@@ -366,8 +366,23 @@ class SelectCoordinator:
                     from ..scheduler import stack as stack_mod
 
                     coll = int(np_out[-1]) if wave else 0
-                    if coll and self.registry is not None:
-                        self.registry.inc("wave.collisions", coll)
+                    if coll:
+                        if self.registry is not None:
+                            self.registry.inc("wave.collisions", coll)
+                        # stale-footprint spike → flight event: a burst
+                        # here is the drain partition losing against
+                        # cluster churn (plan-apply absorbs the race;
+                        # the recorder makes the episode visible)
+                        from ..lib.flight import default_flight
+
+                        try:
+                            default_flight().record(
+                                "wave.collisions", key=str(seq),
+                                severity="warn",
+                                detail={"collisions": coll,
+                                        "programs": len(reqs)})
+                        except Exception:  # noqa: BLE001 — telemetry
+                            pass
                     sel = np.asarray(np_out[0])
                     predicted: Dict[Optional[str], set] = {}
                     for j, r in enumerate(reqs):

@@ -97,6 +97,18 @@ class Server:
         #: node id → latest heartbeat-carried device stats (off-raft;
         #: devicemanager stats stream — see node_heartbeat)
         self._node_device_stats: Dict[str, dict] = {}
+        # Telemetry: one registry + eval-span tracer per server, threaded
+        # through broker / workers / plan applier / WAL (go-metrics setup
+        # in the reference; per-server so multi-server tests don't
+        # cross-count). Served on /v1/metrics + /v1/evaluation/:id/trace.
+        # Created BEFORE the state store so the WAL appends are
+        # registry-instrumented from the very first restore-time write.
+        from ..lib.metrics import MetricsRegistry
+        from ..lib.trace import EvalTracer
+        from ..lib.transfer import DispatchTimeline
+
+        self.metrics = MetricsRegistry()
+        self.tracer = EvalTracer(self.metrics)
         if state is not None:
             # Injected store (the cluster agent passes a RaftStateStore)
             self.state = state
@@ -104,22 +116,13 @@ class Server:
             from .wal import DurableStateStore, Wal
 
             self.state = DurableStateStore(
-                Wal(self.config.data_dir, fsync=self.config.fsync),
+                Wal(self.config.data_dir, fsync=self.config.fsync,
+                    metrics=self.metrics),
                 snapshot_threshold=self.config.snapshot_threshold,
             )
             self.state.restore()
         else:
             self.state = StateStore()
-        # Telemetry: one registry + eval-span tracer per server, threaded
-        # through broker / workers / plan applier (go-metrics setup in
-        # the reference; per-server so multi-server tests don't
-        # cross-count). Served on /v1/metrics + /v1/evaluation/:id/trace.
-        from ..lib.metrics import MetricsRegistry
-        from ..lib.trace import EvalTracer
-        from ..lib.transfer import DispatchTimeline
-
-        self.metrics = MetricsRegistry()
-        self.tracer = EvalTracer(self.metrics)
         # dispatch-pipeline timeline (pack/view/kernel overlap per fused
         # dispatch): fed by the workers' SelectCoordinators, served on
         # /v1/scheduler/timeline + `operator timeline` + bench's
@@ -129,10 +132,14 @@ class Server:
                                  metrics=self.metrics, tracer=self.tracer,
                                  footprint_fn=self._eval_footprint)
         self.blocked = BlockedEvals(self.broker, registry=self.metrics)
-        self.plan_queue = PlanQueue()
+        self.plan_queue = PlanQueue(metrics=self.metrics)
         self.planner = PlanApplier(self.state, self.plan_queue,
                                    broker=self.broker,
                                    metrics=self.metrics)
+        #: heartbeat TTL misses (ISSUE 13 satellite): silently-lost
+        #: clients were only a log line before — eagerly created so the
+        #: series is always exposed
+        self._ctr_hb_expired = self.metrics.counter("heartbeat.expired")
         self.workers: List[Worker] = [
             Worker(self, i) for i in range(self.config.num_schedulers)
         ]
@@ -295,6 +302,38 @@ class Server:
         save = getattr(self.state, "snapshot_save", None)
         if save is not None:
             save()
+
+    def control_plane_stats(self) -> Dict[str, object]:
+        """Control-plane health rollup + gauge refresh (ISSUE 13): the
+        broker's queue depths/ages, the plan pipeline's queue depth /
+        latency / optimistic-rejection rate, and heartbeat losses — the
+        section the metrics scrape, `operator debug`, and the bench
+        `e2e_control` tail all read, so they can never disagree."""
+        qs = self.broker.queue_stats()
+        blocked = self.blocked.blocked_count()
+        self.metrics.set_gauge("broker.blocked_depth", blocked)
+        qs["blocked"] = blocked
+        snap = self.metrics.snapshot()
+        hists = snap.get("histograms") or {}
+        apply_ms = hists.get("plan_apply.apply_ms") or {}
+        gauges = snap.get("gauges") or {}
+        plan = {
+            "queue_depth": int(gauges.get("plan_apply.queue_depth", 0)),
+            "partial_rate": gauges.get("plan_apply.partial_rate", 0.0),
+            "apply_ms": {k: apply_ms.get(k, 0)
+                         for k in ("count", "mean", "p50", "p95",
+                                   "p99", "max")},
+        }
+        plan.update(self.planner.stats)
+        wal = getattr(self.state, "wal", None)
+        out: Dict[str, object] = {
+            "broker": qs,
+            "plan_apply": plan,
+            "heartbeat_expired": int(self._ctr_hb_expired.value),
+        }
+        if wal is not None:
+            out["wal"] = wal.status()
+        return out
 
     def shutdown(self) -> None:
         self._running = False
@@ -564,7 +603,18 @@ class Server:
         self._node_device_stats.pop(node_id, None)
 
     def _heartbeat_expired(self, node_id: str) -> None:
-        """TTL missed → mark down + create evals (heartbeat.go:135)."""
+        """TTL missed → mark down + create evals (heartbeat.go:135).
+        Counted + flight-recorded (ISSUE 13 satellite): a soak losing
+        clients silently is exactly what the recorder exists to show."""
+        self._ctr_hb_expired.inc()
+        from ..lib.flight import default_flight
+
+        try:
+            default_flight().record(
+                "heartbeat.expired", key=node_id, severity="warn",
+                detail={"ttl_s": self.config.heartbeat_ttl})
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
         self.node_update_status(node_id, NODE_STATUS_DOWN,
                                 "heartbeat missed")
 

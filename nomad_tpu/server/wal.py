@@ -19,9 +19,12 @@ import os
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+import time
+
 import msgpack
 
 from ..lib.journal import load_journal
+from ..lib.metrics import MetricsRegistry, default_registry
 from ..structs.codec import to_wire
 from .fsm import ALLOWED_OPS, FSM, snapshot_state
 from .state import StateStore
@@ -32,7 +35,8 @@ DEFAULT_SNAPSHOT_THRESHOLD = 8192
 
 
 class Wal:
-    def __init__(self, data_dir: str, fsync: bool = False) -> None:
+    def __init__(self, data_dir: str, fsync: bool = False,
+                 metrics: Optional[MetricsRegistry] = None) -> None:
         self.data_dir = data_dir
         self.fsync = fsync
         os.makedirs(data_dir, exist_ok=True)
@@ -42,6 +46,25 @@ class Wal:
         self._fh = None
         self._packer = msgpack.Packer(use_bin_type=True)
         self.seq = 0
+        # durability instruments (ISSUE 13): append/fsync latency is the
+        # write-path floor every acknowledged mutation pays; snapshot
+        # duration + on-disk sizes are the compaction health read.
+        # Created EAGERLY so the exposed series set is deterministic.
+        self.metrics = metrics if metrics is not None \
+            else default_registry()
+        self._m_append_ms = self.metrics.histogram("wal.append_ms")
+        self._m_fsync_ms = self.metrics.histogram("wal.fsync_ms")
+        self._m_snapshot_ms = self.metrics.histogram("wal.snapshot_ms")
+        self._ctr_appends = self.metrics.counter("wal.appends")
+        self._ctr_snapshots = self.metrics.counter("wal.snapshots")
+        self._g_log_bytes = self.metrics.gauge("wal.log_bytes")
+        self._g_snap_bytes = self.metrics.gauge("wal.snapshot_bytes")
+        self._log_bytes = 0
+        if os.path.exists(self._path):
+            self._log_bytes = os.path.getsize(self._path)
+            self._g_log_bytes.set(self._log_bytes)
+        if os.path.exists(self._snap_path):
+            self._g_snap_bytes.set(os.path.getsize(self._snap_path))
 
     # ---- load (restore path) ----
 
@@ -74,6 +97,7 @@ class Wal:
         return self._fh
 
     def append(self, op: str, args: List[Any]) -> int:
+        t0 = time.perf_counter()
         with self._lock:
             self.seq += 1
             frame = self._packer.pack({"s": self.seq, "op": op, "args": args})
@@ -81,8 +105,16 @@ class Wal:
             fh.write(frame)
             fh.flush()
             if self.fsync:
+                tf = time.perf_counter()
                 os.fsync(fh.fileno())
-            return self.seq
+                self._m_fsync_ms.add_sample(
+                    (time.perf_counter() - tf) * 1e3)
+            self._log_bytes += len(frame)
+            seq = self.seq
+        self._ctr_appends.inc()
+        self._g_log_bytes.set(self._log_bytes)
+        self._m_append_ms.add_sample((time.perf_counter() - t0) * 1e3)
+        return seq
 
     # ---- snapshot rotation ----
 
@@ -90,6 +122,7 @@ class Wal:
         """Atomically persist a snapshot and truncate the log. Caller must
         guarantee no concurrent appends (the durable store holds its write
         lock across snapshot+rotate)."""
+        t0 = time.perf_counter()
         with self._lock:
             tree = dict(tree)
             tree["wal_seq"] = self.seq
@@ -102,6 +135,27 @@ class Wal:
             if self._fh is not None:
                 self._fh.close()
             self._fh = open(self._path, "wb")  # truncate
+            self._log_bytes = 0
+            snap_bytes = os.path.getsize(self._snap_path)
+        self._ctr_snapshots.inc()
+        self._g_log_bytes.set(0)
+        self._g_snap_bytes.set(snap_bytes)
+        # the whole rotation (serialize + fsync + truncate) counts: it
+        # runs under the store's write lock, so this IS the write stall
+        self._m_snapshot_ms.add_sample((time.perf_counter() - t0) * 1e3)
+
+    def status(self) -> Dict[str, Any]:
+        """Durability health view (the `operator debug` wal section)."""
+        with self._lock:
+            return {
+                "seq": self.seq,
+                "log_bytes": self._log_bytes,
+                "snapshot_bytes": int(self._g_snap_bytes.value),
+                "appends": int(self._ctr_appends.value),
+                "snapshots": int(self._ctr_snapshots.value),
+                "fsync": self.fsync,
+                "data_dir": self.data_dir,
+            }
 
     def close(self) -> None:
         with self._lock:

@@ -74,6 +74,18 @@ REQUIRED = {
     "nomad_drain_groups", "nomad_drain_hold_ms", "nomad_drain_window_ms",
     # wave dispatch (ISSUE 12): lane structure of fused mega-batches
     "nomad_wave_dispatches", "nomad_wave_programs", "nomad_wave_lanes",
+    # control-plane queue state (ISSUE 13): broker depths/ages + plan
+    # pipeline depth/rejection rate — the soak-backpressure dashboards
+    "nomad_broker_ready_depth", "nomad_broker_unacked_depth",
+    "nomad_broker_pending_depth", "nomad_broker_delayed_depth",
+    "nomad_broker_oldest_eval_age_s", "nomad_broker_blocked_depth",
+    "nomad_plan_apply_queue_depth", "nomad_plan_apply_partial_rate",
+    # heartbeat TTL misses (ISSUE 13 satellite)
+    "nomad_heartbeat_expired",
+    # WAL durability (ISSUE 13; present: the fixture agent is durable)
+    "nomad_wal_appends", "nomad_wal_snapshots", "nomad_wal_append_ms",
+    "nomad_wal_fsync_ms", "nomad_wal_snapshot_ms", "nomad_wal_log_bytes",
+    "nomad_wal_snapshot_bytes",
 }
 
 #: every family a series may legally belong to; a new prefix here is a
@@ -94,6 +106,11 @@ ALLOWED_PREFIXES = (
     "nomad_hbm_",             # residency ledger (labeled + mirrors)
     "nomad_drain_",           # drain-cadence mega-batching (ISSUE 12)
     "nomad_wave_",            # wave-dispatch lane structure (ISSUE 12)
+    "nomad_wal_",             # WAL durability (ISSUE 13)
+    "nomad_heartbeat_",       # node TTL misses (ISSUE 13)
+    "nomad_flight_",          # flight-recorder event counters (ISSUE 13)
+    "nomad_raft_",            # raft registries (cluster agents; pinned
+                              # non-vacuously in TestControlPlaneSeries)
 )
 
 #: the only label names any exposed series may carry
@@ -270,3 +287,68 @@ class TestSeriesNameStability:
         # this the wave.* pins above would be testing absence
         assert snap["counters"].get("wave.dispatches", 0) >= 1
         assert snap["histograms"]["wave.lanes"]["max"] >= 2
+
+
+#: the raft node's promised series (ISSUE 13) — exposed from the NODE's
+#: own registry (it outlives the leadership-gated Server), so pinned
+#: against a live ClusterServer instead of the dev-agent fixture
+RAFT_REQUIRED = {
+    "nomad_raft_term", "nomad_raft_state", "nomad_raft_commit_index",
+    "nomad_raft_last_applied", "nomad_raft_log_last_index",
+    "nomad_raft_log_base_index", "nomad_raft_log_bytes",
+    "nomad_raft_peers", "nomad_raft_elections",
+    "nomad_raft_leadership_gained", "nomad_raft_leadership_lost",
+    "nomad_raft_snapshots", "nomad_raft_snapshot_installs",
+    "nomad_raft_commit_ms", "nomad_raft_apply_ms", "nomad_raft_append_ms",
+}
+
+
+class TestControlPlaneSeries:
+    """nomad_raft_* pinning + the flight-event type vocabulary,
+    NON-vacuously: a 1-node ClusterServer drives a real leader
+    transition (election → leadership.gained) and a delivery-limited
+    nack drives broker.eval_failed — the ISSUE 13 fixture contract."""
+
+    def test_raft_series_and_flight_vocabulary(self):
+        from nomad_tpu.lib.flight import FLIGHT_TYPES, default_flight
+        from nomad_tpu.server.broker import EvalBroker
+        from nomad_tpu.server.cluster import (ClusterServer,
+                                              ClusterServerConfig)
+
+        idx0 = default_flight().last_index()
+        cs = ClusterServer(ClusterServerConfig(
+            node_id="mx0", heartbeat_ttl=60.0, gc_interval=3600.0))
+        cs.start()
+        try:
+            assert _wait(cs.is_leader, timeout=30.0)
+            cs.call("node_register", mock.node())  # commit traffic
+            names, labels, _ = _parse(cs.raft.metrics.prometheus())
+            missing = RAFT_REQUIRED - names
+            assert not missing, (
+                f"promised raft series missing/renamed: {sorted(missing)}")
+            stray = sorted(n for n in names
+                           if not _strip_histo_suffix(n)
+                           .startswith("nomad_raft_"))
+            assert not stray, stray
+            assert labels <= ALLOWED_LABELS
+            # the election IS a leadership transition — non-vacuous
+            assert cs.raft.metrics.counter(
+                "raft.leadership_gained").value >= 1
+            assert cs.raft.metrics.histogram("raft.commit_ms").count >= 1
+        finally:
+            cs.shutdown()
+        # nacked-to-exhaustion eval → broker.eval_failed flight event
+        b = EvalBroker(nack_timeout=0, delivery_limit=1)
+        b.set_enabled(True)
+        ev = mock.eval_()
+        b.enqueue(ev)
+        got, tok = b.dequeue([ev.type], timeout=1.0)
+        b.nack(got.id, tok)
+        b.shutdown()
+        _, evs = default_flight().records_after(idx0)
+        types = {e["type"] for e in evs}
+        assert types <= FLIGHT_TYPES, types - FLIGHT_TYPES
+        assert {"leadership.gained", "raft.term",
+                "broker.eval_failed"} <= types
+        # lifetime counts carry the same closed vocabulary
+        assert set(default_flight().counts()) <= FLIGHT_TYPES
