@@ -834,10 +834,18 @@ class HTTPApi:
                             "eval_create_index": state.index.value,
                             "job_modify_index": state.index.value}
         # /v1/nodes
+        def node_wire(n):
+            # the node identity secret authenticates node RPCs
+            # (connect_issue) — never serve it on the read API (the
+            # reference redacts structs.Node.SecretID the same way)
+            tree = to_wire(n)
+            tree.pop("secret_id", None)
+            return tree
+
         if parts == ["nodes"]:
             require(acl.allow_node_read())
             return blocking(lambda snap: (
-                snap.index_at, [to_wire(n) for n in snap.nodes()]))
+                snap.index_at, [node_wire(n) for n in snap.nodes()]))
         if parts and parts[0] == "node" and len(parts) >= 2:
             node_id = parts[1]
             sub = parts[2] if len(parts) > 2 else ""
@@ -846,7 +854,7 @@ class HTTPApi:
                 node = state.node_by_id(node_id)
                 if node is None:
                     raise HttpError(404, f"node {node_id!r} not found")
-                tree = to_wire(node)
+                tree = node_wire(node)
                 # live heartbeat-carried device stats (devicemanager
                 # stats stream; off-raft telemetry). Heartbeats land on
                 # the LEADER, so any non-leader (follower OR ex-leader

@@ -33,6 +33,12 @@ def dotted(node: ast.AST) -> str:
 _SUPPRESS_RE = re.compile(r"nomadlint:\s*disable=([A-Z0-9,\s]+)")
 #: whole-file opt-out (first 5 lines): `# nomadlint: disable-file`
 _SUPPRESS_FILE_RE = re.compile(r"nomadlint:\s*disable-file")
+#: reviewed waiver: `# nomadlint: ok <RULE> <mandatory reason>` — one
+#: rule per waiver so the reason stays attached to the decision. A
+#: waiver WITHOUT a reason is itself a finding (NLW00): the reason is
+#: the reviewable artifact, not the suppression. Waivers are counted
+#: in `--stats` so accumulated debt stays visible.
+_WAIVER_RE = re.compile(r"nomadlint:\s*ok\s+(NL[A-Z]\d\d)\b[ \t]*(.*)")
 
 
 @dataclass(frozen=True, order=True)
@@ -55,29 +61,89 @@ def baseline_key(f: Finding) -> str:
     return f"{f.path}::{f.rule}::{f.context}"
 
 
-def _suppressions(source: str) -> Tuple[bool, Dict[int, set]]:
-    """(file-wide opt-out, {line: {rules}}) from magic comments."""
+class Waiver:
+    """One `# nomadlint: ok RULE reason` comment."""
+
+    __slots__ = ("path", "line", "rule", "reason", "used")
+
+    def __init__(self, path: str, line: int, rule: str, reason: str):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.reason = reason
+        self.used = False
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "reason": self.reason, "used": self.used}
+
+
+def _suppressions(source: str, rel: str = ""
+                  ) -> Tuple[bool, Dict[int, set], List[Waiver]]:
+    """(file-wide opt-out, {line: {rules}}, waivers) from magic
+    comments. Waivers with an EMPTY reason still parse (so the finding
+    below can point at them) but suppress nothing."""
     lines = source.splitlines()
     whole = any(_SUPPRESS_FILE_RE.search(ln) for ln in lines[:5])
     per_line: Dict[int, set] = {}
+    waivers: List[Waiver] = []
     for i, ln in enumerate(lines, start=1):
         m = _SUPPRESS_RE.search(ln)
         if m:
             per_line[i] = {r.strip() for r in m.group(1).split(",")
                            if r.strip()}
-    return whole, per_line
+        w = _WAIVER_RE.search(ln)
+        if w:
+            waivers.append(Waiver(rel, i, w.group(1),
+                                  w.group(2).strip()))
+    return whole, per_line, waivers
+
+
+def apply_waivers(findings: List[Finding], waivers: List[Waiver],
+                  emit_missing_reason: bool = True) -> List[Finding]:
+    """Filter findings a reasoned waiver covers (same line + rule);
+    mark those waivers used, and emit an NLW00 finding for every
+    reason-less waiver — the reason IS the reviewable artifact.
+    `emit_missing_reason=False` for a second pass over the same waiver
+    objects (run_tree's whole-program findings)."""
+    by_key: Dict[Tuple[str, int, str], Waiver] = {}
+    out: List[Finding] = []
+    for w in waivers:
+        if w.reason:
+            by_key[(w.path, w.line, w.rule)] = w
+        elif emit_missing_reason:
+            out.append(Finding(
+                w.path, w.line, "NLW00",
+                f"waiver for {w.rule} has no reason — "
+                f"`# nomadlint: ok {w.rule} <why this is safe>`"))
+    for f in findings:
+        w = by_key.get((f.path, f.line, f.rule))
+        if w is not None:
+            w.used = True
+            continue
+        out.append(f)
+    return out
 
 
 def analyze_file(path: str, rel: str, jit_registry=None,
                  tree: Optional[ast.Module] = None,
                  source: Optional[str] = None,
-                 fns=None) -> List[Finding]:
+                 fns=None, interprocedural: bool = True,
+                 stats: Optional[dict] = None,
+                 suppressions: Optional[Tuple[bool, Dict[int, set],
+                                              List["Waiver"]]] = None
+                 ) -> List[Finding]:
     """All findings for one file. `rel` is the repo-relative path used in
     reports and baseline keys. Pass pre-read `source` / pre-parsed
     `tree` / a pre-marked `fns` map to skip re-work (run_tree's two
-    passes share them)."""
+    passes share them). `interprocedural=False` skips the whole-program
+    lock rules — run_tree runs those ONCE over the full tree instead of
+    per file (a lone file still gets them, as its own one-module
+    program). `stats` accumulates waiver bookkeeping for `--stats`."""
+    from .device_rules import analyze_device
     from .jax_rules import analyze_jax
     from .thread_rules import analyze_threads
+    from .vocab_rules import analyze_vocab
 
     if source is None:
         with open(path, encoding="utf-8") as f:
@@ -88,14 +154,28 @@ def analyze_file(path: str, rel: str, jit_registry=None,
         except SyntaxError as e:
             return [Finding(rel, e.lineno or 1, "NLP00",
                             f"syntax error: {e.msg}")]
-    whole, per_line = _suppressions(source)
+    if suppressions is None:
+        suppressions = _suppressions(source, rel)
+    whole, per_line, waivers = suppressions
     if whole:
         return []
     findings = analyze_jax(tree, rel, jit_registry=jit_registry,
                            enable_traced="jax" in source, fns=fns)
     findings += analyze_threads(tree, rel)
-    return [f for f in findings
-            if f.rule not in per_line.get(f.line, ())]
+    findings += analyze_device(tree, rel)
+    findings += analyze_vocab(tree, rel)
+    if interprocedural:
+        from .callgraph import Program
+        from .lock_rules import analyze_locks
+
+        findings += [f for f in analyze_locks(Program.build({rel: tree}))
+                     if f.path == rel]
+    findings = [f for f in findings
+                if f.rule not in per_line.get(f.line, ())]
+    findings = apply_waivers(findings, waivers)
+    if stats is not None:
+        stats.setdefault("waivers", []).extend(waivers)
+    return findings
 
 
 def _repo_rel(path: str, fallback_root: str) -> str:
@@ -127,14 +207,19 @@ def iter_python_files(root: str):
             yield p, _repo_rel(p, repo_root)
 
 
-def run_tree(root: str) -> List[Finding]:
+def run_tree(root: str, stats: Optional[dict] = None) -> List[Finding]:
     """Analyze every .py under `root` (a package dir or a single file).
 
-    Two passes: the first collects the cross-module registry of jitted
-    functions with static argnums/argnames (NLJ09 checks call sites in
-    OTHER modules against it), the second runs the rules.
+    Three passes: the first collects the cross-module registry of
+    jitted functions with static argnums/argnames (NLJ09 checks call
+    sites in OTHER modules against it), the second runs the per-file
+    rules, the third builds the whole-program model ONCE and runs the
+    interprocedural lock rules (NLT04–NLT06) over it — suppressions and
+    waivers from each file apply to those findings too.
     """
+    from .callgraph import Program
     from .jax_rules import collect_jit_registry
+    from .lock_rules import analyze_locks
 
     files = list(iter_python_files(root))
     registry: Dict[str, object] = {}
@@ -155,12 +240,42 @@ def run_tree(root: str) -> List[Finding]:
             if "jax" in source:  # cheap gate: registry needs jit decls
                 fns_cache[path] = collect_jit_registry(parsed[path][0],
                                                        registry)
+    if stats is None:
+        stats = {}
+    #: rel -> (whole, per_line, waivers), computed ONCE per file and
+    #: shared with the whole-program pass below
+    suppress: Dict[str, Tuple[bool, Dict[int, set], List[Waiver]]] = {}
     for path, rel in files:
         if path in parsed:
             tree, source = parsed[path]
+            suppress[rel] = _suppressions(source, rel)
             findings.extend(analyze_file(
                 path, rel, jit_registry=registry, tree=tree,
-                source=source, fns=fns_cache.get(path)))
+                source=source, fns=fns_cache.get(path),
+                interprocedural=False, stats=stats,
+                suppressions=suppress[rel]))
+    # whole-program pass (lock graph spans modules)
+    waivers_by_rel: Dict[str, List[Waiver]] = {}
+    for w in stats.get("waivers", []):
+        waivers_by_rel.setdefault(w.path, []).append(w)
+    prog = Program.build({rel: parsed[path][0]
+                          for path, rel in files if path in parsed})
+    lock_findings: List[Finding] = []
+    for f in analyze_locks(prog):
+        whole, per_line, _w = suppress.get(f.path, (False, {}, []))
+        if whole or f.rule in per_line.get(f.line, ()):
+            continue
+        lock_findings.append(f)
+    by_rel: Dict[str, List[Finding]] = {}
+    for f in lock_findings:
+        by_rel.setdefault(f.path, []).append(f)
+    for rel, fs in by_rel.items():
+        findings.extend(apply_waivers(fs, waivers_by_rel.get(rel, []),
+                                      emit_missing_reason=False))
+    stats["files"] = len(parsed)
+    #: absolute paths analyzed — the CLI unions these across its root
+    #: args so overlapping/duplicate paths don't double-count files
+    stats["file_paths"] = [os.path.abspath(p) for p in parsed]
     return sorted(findings)
 
 

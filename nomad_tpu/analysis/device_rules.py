@@ -1,0 +1,567 @@
+"""Device-discipline rules (NLD01–NLD04).
+
+The device-resident dispatch loop (PRs 3–8) has four standing contracts
+that only held by review until now:
+
+* **NLD01 — un-ledgered transfer.** Every host↔device transfer on the
+  fused dispatch path is EXPLICIT and ledger-accounted (lib/transfer.py
+  completeness contract). A `jnp.asarray`/`jax.device_put` upload, a
+  `np.asarray(<device array>)` fetch, or a `block_until_ready` sync
+  reachable from the dispatch path outside a `TransferLedger` scope
+  (`with led.timed(...)`/`led.scope()`) or `guard_scope()` region is an
+  unattributed round-trip — exactly the bytes BENCH_r05 could not
+  explain. Coverage is interprocedural within the module: a helper
+  whose every call site sits inside a covered region is covered
+  (`_apply_chunked`, the `up` upload lambda).
+
+* **NLD02 — donation-after-use.** A buffer passed at a donated
+  position of a `jax.jit(..., donate_argnums=...)` callable is DEAD on
+  return ("Array has been deleted", the PR 3 transient). Any later read
+  of that name on a path without rebinding is flagged.
+
+* **NLD03 — unbooked long-lived device allocation.** A device buffer
+  stored on `self` (outliving the function) must be booked in the HBM
+  residency ledger in the same function (`hbm.track`/`track_cluster`)
+  — otherwise the capacity planner's projection silently loses a term.
+
+* **NLD04 — non-bitwise carry fold.** Per-lane wave carries
+  (`jax.vmap` results) fold into one view carry by exact per-row lane
+  SELECTION (`jnp.where` on a changed-mask), never arithmetic: a float
+  re-accumulation (`+`, `jnp.sum`/`mean` over the lane axis) breaks
+  the carry == host-fold bit-parity the adoption proof relies on
+  (kernels/placement.py place_table_wave). Arithmetic combination of a
+  vmap-produced value is flagged; selection, comparison and reshaping
+  are not (a comparison result is a mask, no longer a carry).
+
+All rules are scoped to the device-path modules (see the *_SCOPE
+tuples) and are pure `ast` — no jax import.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, dotted as _dotted
+
+DEVICE_RULES = {
+    "NLD01": "host-device transfer outside a TransferLedger scope or "
+             "transfer-guard region",
+    "NLD02": "buffer referenced after being donated to a "
+             "donate_argnums jit",
+    "NLD03": "long-lived device allocation not booked in the HBM "
+             "residency ledger",
+    "NLD04": "arithmetic fold of per-lane carries (wave contract "
+             "requires bitwise per-row lane selection)",
+}
+
+_HINTS = {
+    "NLD01": "wrap the transfer in `with ledger.timed(site, nbytes)` "
+             "(or record() it) inside the guard scope",
+    "NLD02": "rebind the name from the kernel's output (donation "
+             "threads buffers through) or drop the donation",
+    "NLD03": "book it: hbm.track(site, buf) / track_cluster — the "
+             "site must be in the residency taxonomy",
+    "NLD04": "fold by selection: jnp.where(changed_mask, lane_value, "
+             "base) per lane, copied bitwise",
+}
+
+#: the fused dispatch path — modules whose transfers must be accounted
+TRANSFER_SCOPE = (
+    "nomad_tpu/scheduler/stack.py",
+    "nomad_tpu/server/select_batch.py",
+    "nomad_tpu/server/program_table.py",
+    "nomad_tpu/parallel/mesh.py",
+)
+#: where donating jits and device buffers live
+DONATE_SCOPE = TRANSFER_SCOPE + (
+    "nomad_tpu/kernels/",
+    "nomad_tpu/tensor/",
+)
+#: where per-lane (vmap) carries are produced and folded
+WAVE_SCOPE = (
+    "nomad_tpu/kernels/",
+    "nomad_tpu/parallel/",
+    "nomad_tpu/scheduler/stack.py",
+)
+
+_COVER_LEAVES = {"timed", "scope", "guard_scope"}
+_UPLOAD_LEAVES = {"asarray", "device_put"}
+_SYNC_LEAVES = {"block_until_ready", "device_get"}
+_FOLD_LEAVES = {"sum", "mean", "average"}
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv)
+
+
+def _in_scope(rel: str, scope) -> bool:
+    return any(rel.startswith(p) if p.endswith("/") else rel == p
+               for p in scope)
+
+
+def _leaf(node: ast.Call) -> str:
+    d = _dotted(node.func)
+    if d:
+        return d.split(".")[-1]
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _FnUnit:
+    """One function / assigned lambda: transfer calls + local coverage."""
+
+    __slots__ = ("name", "cls", "node", "transfers", "callsites",
+                 "covered")
+
+    def __init__(self, name: str, cls: Optional[str], node: ast.AST):
+        self.name = name
+        self.cls = cls            # owning class (direct methods only)
+        self.node = node
+        #: (line, api, lexically_covered)
+        self.transfers: List[Tuple[int, str, bool]] = []
+        #: call sites: (kind, name, covered), kind ∈ {bare, self} —
+        #: kept separate so coverage propagation never matches a
+        #: `self.m()` call against another class's same-named method
+        self.callsites: List[Tuple[str, str, bool]] = []
+        self.covered = False
+
+
+# ---- NLD01 -----------------------------------------------------------------
+
+
+class _TransferScan(ast.NodeVisitor):
+    """Scan one function unit: transfer calls with coverage + device
+    taint (for np.asarray fetch detection), local callsite coverage."""
+
+    def __init__(self, unit: _FnUnit, jnp_aliases: Set[str],
+                 np_aliases: Set[str]):
+        self.unit = unit
+        self.jnp = jnp_aliases
+        self.np = np_aliases
+        self.cover = 0
+        self.tainted: Set[str] = set()
+
+    def scan(self) -> None:
+        node = self.unit.node
+        body = node.body if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else [node.body]
+        for stmt in body:
+            self.visit(stmt)
+
+    # device taint: values produced by placement-kernel launches
+    def _device_producing(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            leaf = _leaf(expr)
+            if leaf.startswith("place_") or leaf == "resolve":
+                return True
+        r = _root_name(expr)
+        return r is not None and r in self.tainted
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        t = self._device_producing(node.value)
+        for tgt in node.targets:
+            self._bind(tgt, t)
+
+    def visit_With(self, node: ast.With):
+        covered = any(
+            isinstance(i.context_expr, ast.Call)
+            and _leaf(i.context_expr) in _COVER_LEAVES
+            for i in node.items)
+        for i in node.items:
+            self.visit(i.context_expr)
+        if covered:
+            self.cover += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if covered:
+            self.cover -= 1
+
+    def visit_FunctionDef(self, node):
+        return  # nested defs are their own units
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return  # assigned lambdas are their own units
+
+    def visit_comprehension(self, node: ast.comprehension):
+        # `np.asarray(x) for x in result.explain` — the generator
+        # target inherits the iterable's device taint
+        if self._device_producing(node.iter):
+            self._bind(node.target, True)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        for g in [g for sub in ast.walk(node)
+                  if isinstance(sub, (ast.GeneratorExp, ast.ListComp))
+                  for g in sub.generators]:
+            self.visit_comprehension(g)
+        d = _dotted(node.func)
+        leaf = _leaf(node)
+        root = d.split(".")[0] if d else ""
+        api = None
+        if leaf in _UPLOAD_LEAVES and (root in self.jnp
+                                       or root == "jax"
+                                       or d.startswith("jax.")):
+            api = d or leaf
+        elif leaf in _SYNC_LEAVES:
+            api = d or leaf
+        elif leaf == "asarray" and root in self.np and node.args \
+                and self._device_producing(node.args[0]):
+            api = f"{d}(<device array>)"
+        if api is not None:
+            self.unit.transfers.append((node.lineno, api,
+                                        self.cover > 0))
+        # local/module callsites for coverage propagation
+        if isinstance(node.func, ast.Name):
+            self.unit.callsites.append(("bare", node.func.id,
+                                        self.cover > 0))
+        elif isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            self.unit.callsites.append(("self", node.func.attr,
+                                        self.cover > 0))
+        self.generic_visit(node)
+
+
+def _collect_units(tree: ast.Module) -> List[_FnUnit]:
+    method_of: Dict[ast.AST, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if isinstance(stmt,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    method_of[stmt] = node.name
+    units: List[_FnUnit] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            units.append(_FnUnit(node.name, method_of.get(node), node))
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Lambda) \
+                and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            units.append(_FnUnit(node.targets[0].id, None, node.value))
+    return units
+
+
+def _aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    jnp, np_ = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in ("jax.numpy",):
+                    jnp.add(a.asname or "jax.numpy")
+                elif a.name == "numpy":
+                    np_.add(a.asname or "numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(a.name == "numpy"
+                                            for a in node.names):
+                for a in node.names:
+                    if a.name == "numpy":
+                        jnp.add(a.asname or "numpy")
+    return (jnp or {"jnp"}), (np_ or {"np", "numpy"})
+
+
+def _check_transfers(tree: ast.Module, rel: str,
+                     findings: List[Finding]) -> None:
+    if not _in_scope(rel, TRANSFER_SCOPE):
+        return
+    jnp_a, np_a = _aliases(tree)
+    units = _collect_units(tree)
+    for u in units:
+        _TransferScan(u, jnp_a, np_a).scan()
+    # coverage propagation: a unit is covered when its name has call
+    # sites and EVERY one is covered (lexically, or from a covered
+    # unit). `self.m()` sites match only the CALLER'S class's method;
+    # bare calls match only module-level units and assigned lambdas.
+    # Units sharing one (class, name) key — e.g. the two `up` upload
+    # lambdas in stack.py, one per mesh branch — are judged as a GROUP
+    # against the same site set: requiring every syntactic call site
+    # of the name to be covered is conservative for whichever unit a
+    # given site actually binds to.
+    groups: Dict[Tuple[Optional[str], str], List[_FnUnit]] = {}
+    for u in units:
+        groups.setdefault((u.cls, u.name), []).append(u)
+    changed = True
+    while changed:
+        changed = False
+        for (cls, name), members in groups.items():
+            if members[0].covered:
+                continue
+            sites = [cov or caller.covered
+                     for caller in units
+                     for kind, cname, cov in caller.callsites
+                     if cname == name
+                     and (cls is not None and caller.cls == cls
+                          if kind == "self" else cls is None)]
+            if sites and all(sites):
+                for m in members:
+                    m.covered = True
+                changed = True
+    for u in units:
+        if u.covered:
+            continue
+        qual = u.name
+        for line, api, covered in u.transfers:
+            if covered:
+                continue
+            findings.append(Finding(
+                rel, line, "NLD01",
+                DEVICE_RULES["NLD01"] + f": {api}",
+                _HINTS["NLD01"], context=qual))
+
+
+# ---- NLD02 -----------------------------------------------------------------
+
+
+def _donated_nums(call: ast.Call) -> Optional[Set[int]]:
+    """donate_argnums literal of a jax.jit(...) call, else None."""
+    if _leaf(call) != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            out: Set[int] = set()
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) \
+                            and isinstance(e.value, int):
+                        out.add(e.value)
+            return out or None
+    return None
+
+
+class _DonateScan(ast.NodeVisitor):
+    def __init__(self, rel: str, qual: str, findings: List[Finding]):
+        self.rel = rel
+        self.qual = qual
+        self.findings = findings
+        self.donating: Dict[str, Set[int]] = {}
+        #: name -> line it was donated at
+        self.dead: Dict[str, int] = {}
+
+    def visit_FunctionDef(self, node):
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        if isinstance(node.value, ast.Call):
+            nums = _donated_nums(node.value)
+            if nums and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                self.donating[node.targets[0].id] = nums
+                return
+        for t in node.targets:
+            self._revive(t)
+
+    def _revive(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.dead.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._revive(e)
+        elif isinstance(target, ast.Starred):
+            self._revive(target.value)
+
+    def visit_Call(self, node: ast.Call):
+        nums: Optional[Set[int]] = None
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self.donating:
+            nums = self.donating[node.func.id]
+        elif isinstance(node.func, ast.Call):
+            nums = _donated_nums(node.func)
+        self.generic_visit(node)
+        if nums:
+            for i, arg in enumerate(node.args):
+                if i in nums and isinstance(arg, ast.Name):
+                    self.dead[arg.id] = node.lineno
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load) and node.id in self.dead \
+                and node.lineno > self.dead[node.id]:
+            line = self.dead.pop(node.id)
+            self.findings.append(Finding(
+                self.rel, node.lineno, "NLD02",
+                DEVICE_RULES["NLD02"]
+                + f": {node.id} was donated at line {line}",
+                _HINTS["NLD02"], context=self.qual))
+
+
+def _check_donation(tree: ast.Module, rel: str,
+                    findings: List[Finding]) -> None:
+    if not _in_scope(rel, DONATE_SCOPE):
+        return
+    # module-level donating names are visible in every function
+    mod_donating: Dict[str, Set[int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            nums = _donated_nums(node.value)
+            if nums and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                mod_donating[node.targets[0].id] = nums
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan = _DonateScan(rel, node.name, findings)
+            scan.donating.update(mod_donating)
+            for stmt in node.body:
+                scan.visit(stmt)
+
+
+# ---- NLD03 -----------------------------------------------------------------
+
+
+def _check_residency(tree: ast.Module, rel: str,
+                     findings: List[Finding]) -> None:
+    if not _in_scope(rel, TRANSFER_SCOPE):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        books = any(
+            isinstance(sub, ast.Call)
+            and _leaf(sub) in ("track", "track_cluster")
+            for sub in ast.walk(fn))
+        if books:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Assign) \
+                    or not isinstance(sub.value, ast.Call):
+                continue
+            d = _dotted(sub.value.func)
+            root = d.split(".")[0] if d else ""
+            leaf = _leaf(sub.value)
+            device_alloc = (root in ("jnp", "jax")
+                            and leaf in ("zeros", "ones", "full",
+                                         "empty", "asarray",
+                                         "device_put"))
+            if not device_alloc:
+                continue
+            for t in sub.targets:
+                attr = None
+                tt = t
+                while isinstance(tt, (ast.Tuple, ast.List)):
+                    tt = tt.elts[0]
+                if isinstance(tt, ast.Attribute) \
+                        and isinstance(tt.value, ast.Name) \
+                        and tt.value.id == "self":
+                    attr = tt.attr
+                if attr is not None:
+                    findings.append(Finding(
+                        rel, sub.lineno, "NLD03",
+                        DEVICE_RULES["NLD03"]
+                        + f": self.{attr} = {d or leaf}(...) with no "
+                          f"hbm.track in {fn.name}()",
+                        _HINTS["NLD03"], context=fn.name))
+
+
+# ---- NLD04 -----------------------------------------------------------------
+
+
+class _WaveScan(ast.NodeVisitor):
+    def __init__(self, rel: str, qual: str, findings: List[Finding]):
+        self.rel = rel
+        self.qual = qual
+        self.findings = findings
+        self.lanes: Set[str] = set()
+
+    def visit_FunctionDef(self, node):
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _lane_value(self, expr: ast.AST) -> bool:
+        """Per-lane taint: vmap results, through subscript/attr; a
+        comparison kills it (a mask is no longer a carry)."""
+        if isinstance(expr, (ast.Compare, ast.BoolOp)):
+            return False
+        if isinstance(expr, ast.Call):
+            # jax.vmap(f)(args) — the producing form
+            if isinstance(expr.func, ast.Call) \
+                    and _leaf(expr.func) == "vmap":
+                return True
+            return False
+        r = _root_name(expr)
+        return r is not None and r in self.lanes
+
+    def _bind(self, target: ast.AST, value: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            if self._lane_value(value):
+                self.lanes.add(target.id)
+            else:
+                self.lanes.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)) \
+                and self._lane_value(value):
+            # destructured vmap result: every component is per-lane
+            for name in {n.id for n in ast.walk(target)
+                         if isinstance(n, ast.Name)}:
+                self.lanes.add(name)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        for t in node.targets:
+            self._bind(t, node.value)
+
+    def visit_BinOp(self, node: ast.BinOp):
+        if isinstance(node.op, _ARITH_OPS) and (
+                self._lane_value(node.left)
+                or self._lane_value(node.right)):
+            self.findings.append(Finding(
+                self.rel, node.lineno, "NLD04",
+                DEVICE_RULES["NLD04"]
+                + ": arithmetic on a vmap-produced per-lane value",
+                _HINTS["NLD04"], context=self.qual))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        leaf = _leaf(node)
+        root = _dotted(node.func).split(".")[0]
+        if leaf in _FOLD_LEAVES and root in ("jnp", "jax", "np") \
+                and node.args and self._lane_value(node.args[0]):
+            self.findings.append(Finding(
+                self.rel, node.lineno, "NLD04",
+                DEVICE_RULES["NLD04"]
+                + f": {root}.{leaf}() reduces per-lane values",
+                _HINTS["NLD04"], context=self.qual))
+        self.generic_visit(node)
+
+
+def _check_wave_fold(tree: ast.Module, rel: str,
+                     findings: List[Finding]) -> None:
+    if not _in_scope(rel, WAVE_SCOPE):
+        return
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan = _WaveScan(rel, fn.name, findings)
+            for stmt in fn.body:
+                scan.visit(stmt)
+
+
+def analyze_device(tree: ast.Module, rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    _check_transfers(tree, rel, findings)
+    _check_donation(tree, rel, findings)
+    _check_residency(tree, rel, findings)
+    _check_wave_fold(tree, rel, findings)
+    return findings

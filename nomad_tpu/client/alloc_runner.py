@@ -709,8 +709,12 @@ class AllocRunner:
             if self.on_update is not None and not shutting:
                 # Fires on every task-state transition (not just status
                 # flips): the server needs restart counts and events too;
-                # the client sync loop coalesces bursts.
-                self.on_update(self.snapshot_alloc())
+                # the client sync loop coalesces bursts. Publishing
+                # under _status_lock IS the ordering contract (see the
+                # docstring above); the callee (Client._alloc_updated)
+                # only persists + queues — it never re-enters this
+                # runner.
+                self.on_update(self.snapshot_alloc())  # nomadlint: ok NLT05 publish-under-lock is the ordering contract; callee only queues, never re-enters
 
     def snapshot_alloc(self) -> Allocation:
         """Client-side view for allocSync (client.go:1898)."""

@@ -90,8 +90,9 @@ class InProcConn:
     def services_lookup(self, namespace, name):
         return self.server.services_lookup(namespace, name)
 
-    def connect_issue(self, service_name):
-        return self.server.connect_issue(service_name)
+    def connect_issue(self, service_name, node_id="", secret_id=""):
+        return self.server.connect_issue(service_name, node_id,
+                                         secret_id)
 
     def node_get(self, node_id):
         return self.server.node_get(node_id)
@@ -185,8 +186,9 @@ class RpcConn:
     def services_lookup(self, namespace, name):
         return self._call("services_lookup", namespace, name)
 
-    def connect_issue(self, service_name):
-        return self._call("connect_issue", service_name)
+    def connect_issue(self, service_name, node_id="", secret_id=""):
+        return self._call("connect_issue", service_name, node_id,
+                          secret_id)
 
     def node_get(self, node_id):
         return self._call("node_get", node_id)
@@ -227,9 +229,24 @@ class Client:
         self.alloc_dir_base = os.path.join(self.data_dir, "allocs")
         self.state_db = (ClientStateDB(self.data_dir) if self.config.persist
                          else MemClientStateDB())
-        self.node = self.config.node or Node(id=str(uuid.uuid4()))
+        self.node = self.config.node or Node(id="")
+        # node identity (structs.Node.{id,secret_id}): the server binds
+        # the secret WRITE-ONCE at first registration (TOFU), so both
+        # halves persist in the state DB — a restarted client that
+        # minted a fresh secret would be locked out of node_register
+        # (and connect_issue) forever, with no way to recover the bound
+        # one through the redacted node surfaces
+        saved_id, _saved_secret = self.state_db.node_identity()
         if not self.node.id:
-            self.node.id = str(uuid.uuid4())
+            self.node.id = saved_id or str(uuid.uuid4())
+        if not self.node.secret_id:
+            # restore the secret bound to THIS id — an explicit
+            # config.node with a different id must mint its own, not
+            # inherit (or clobber) another node's binding
+            self.node.secret_id = (self.state_db.node_secret(self.node.id)
+                                   or str(uuid.uuid4()))
+        self.state_db.put_node_identity(self.node.id,
+                                        self.node.secret_id)
         from .devicemanager import DeviceManager
         from .pluginmanager import DriverManager
 

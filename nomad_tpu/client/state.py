@@ -33,6 +33,50 @@ class ClientStateDB:
             except Exception:
                 self._data = {}
 
+    #: reserved record key for the node identity — never carries an
+    #: "alloc" entry, so allocs()/delete_alloc skip it structurally
+    _IDENTITY_KEY = "_node_identity"
+
+    def put_node_identity(self, node_id: str, secret_id: str) -> None:
+        """Persist the node's id + identity secret (reference: the
+        client stores NodeID/SecretID in client state). The server
+        binds the secret WRITE-ONCE at first registration, so a
+        restarted client must present the same one or be locked out
+        of node_register/connect_issue. `id`/`secret_id` track the
+        LAST identity (restored when a start names no node); the
+        `secrets` map keeps every id's bound secret — FIRST write wins
+        per id, mirroring the server's write-once rule, so a start
+        handed a wrong secret for an already-bound id (or an explicit
+        DIFFERENT node id) cannot destroy the only recoverable copy
+        (the server redacts it everywhere)."""
+        with self._lock:
+            rec = self._data.setdefault(self._IDENTITY_KEY, {})
+            secrets = rec.setdefault("secrets", {})
+            if rec.get("id") and rec.get("secret_id"):
+                # migrate a pre-`secrets`-map record before binding
+                secrets.setdefault(rec["id"], rec["secret_id"])
+            bound = secrets.setdefault(node_id, secret_id)
+            rec["id"] = node_id
+            rec["secret_id"] = bound
+            self._flush()
+
+    def node_identity(self) -> "tuple[str, str]":
+        with self._lock:
+            rec = self._data.get(self._IDENTITY_KEY) or {}
+            return rec.get("id") or "", rec.get("secret_id") or ""
+
+    def node_secret(self, node_id: str) -> str:
+        """The write-once secret bound to `node_id`, "" when unknown."""
+        with self._lock:
+            rec = self._data.get(self._IDENTITY_KEY) or {}
+            sec = (rec.get("secrets") or {}).get(node_id)
+            if sec:
+                return sec
+            # pre-`secrets`-map record shape
+            if rec.get("id") == node_id:
+                return rec.get("secret_id") or ""
+            return ""
+
     def put_alloc(self, alloc) -> None:
         # task_states ride inside the alloc record itself
         with self._lock:

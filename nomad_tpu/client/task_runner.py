@@ -404,8 +404,14 @@ class TaskRunner:
                  for k in ("ca", "cert", "key")}
         if all(os.path.exists(p) for p in paths.values()):
             return
+        # issuance is an authenticated node RPC (ADVICE r5): present
+        # this node's identity secret so the server can verify the
+        # requester is the registered node, not any fabric peer
         pems = self.conn.connect_issue(
-            self.task.env["NOMAD_CONNECT_SERVICE"])
+            self.task.env["NOMAD_CONNECT_SERVICE"],
+            self.node.id if self.node is not None else "",
+            getattr(self.node, "secret_id", "")
+            if self.node is not None else "")
         for k, p in paths.items():
             fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
             with os.fdopen(fd, "w") as f:

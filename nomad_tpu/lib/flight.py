@@ -30,27 +30,14 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.vocab import FLIGHT_TYPES
 from .metrics import MetricsRegistry, default_registry
 
-#: the closed event-type vocabulary. Adding a type here is a conscious
-#: taxonomy extension (update the pinning test in the same PR).
-FLIGHT_TYPES = frozenset({
-    # raft / leadership (raft/raft.py)
-    "leadership.gained",   # this node won an election
-    "leadership.lost",     # this node stepped down from leader
-    "raft.term",           # this node started an election (term bump)
-    # leader plan pipeline (server/plan_apply.py)
-    "plan.partial",        # optimistic verification rejected node(s)
-    # broker (server/broker.py)
-    "broker.eval_failed",  # delivery limit exhausted → failed queue
-    # liveness (server/server.py, lib/metrics.py, lib/hbm.py,
-    # server/select_batch.py, server/cluster.py)
-    "heartbeat.expired",   # node TTL missed → marked down
-    "error.streak",        # an ErrorStreak sink started a failure streak
-    "hbm.stuck_lease",     # view lease older than the age watermark
-    "wave.collisions",     # cross-lane row collision in a wave dispatch
-    "membership.change",   # gossip member status transition
-})
+#: the closed event-type vocabulary now lives in analysis/vocab.py (ONE
+#: source of truth shared by this recorder, the exposition pins in
+#: tests/test_metrics_names.py, and the NLV01 static vocabulary
+#: ratchet). Adding a type there is a conscious taxonomy extension.
+__all__ = ["FLIGHT_TYPES", "FlightRecorder", "default_flight"]
 
 
 class FlightRecorder:

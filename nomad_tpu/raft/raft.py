@@ -444,7 +444,7 @@ class RaftNode:
             peers = {p: list(a) for p, a in self.peers.items()}
             # snapshot under the lock: the applier can't start a new
             # batch (needs the lock) so the FSM stays at exactly idx
-            state = self.snapshot_fn()
+            state = self.snapshot_fn()  # nomadlint: ok NLT05 lock pins the FSM at idx by design; snapshot_fn reads FSM state only, never re-enters raft
         snap = {"index": idx, "term": term, "peers": peers,
                 "state": state}
         with self._lock:

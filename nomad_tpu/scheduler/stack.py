@@ -1266,13 +1266,17 @@ class TPUStack:
             else:
                 result = place_task_group(arrays, _to_device(params), m,
                                           explain=want_ex)
-            sel = np.asarray(result.sel_idx)
-            scores = np.asarray(result.sel_score)
+            # the solo fetch below is deliberately unledgered, like the
+            # upload side (_to_device): the batched coordinator path is
+            # the accounted + guard-clean one; this fallback serves
+            # coordinator-less callers (oracle parity, unit tests)
+            sel = np.asarray(result.sel_idx)  # nomadlint: ok NLD01 solo fallback, outside ledger/guard by design (_to_device)
+            scores = np.asarray(result.sel_score)  # nomadlint: ok NLD01 solo fallback, outside ledger/guard by design (_to_device)
             n_feas = int(result.nodes_feasible)
-            n_fit = np.asarray(result.nodes_fit)
+            n_fit = np.asarray(result.nodes_fit)  # nomadlint: ok NLD01 solo fallback, outside ledger/guard by design (_to_device)
             if result.explain is not None:
                 ex_np = PlacementExplain(
-                    *(np.asarray(x) for x in result.explain))
+                    *(np.asarray(x) for x in result.explain))  # nomadlint: ok NLD01 solo fallback, outside ledger/guard by design (_to_device)
         snap_rows = self.cluster.node_of_row
         node_ids: List[Optional[str]] = []
         out_scores: List[float] = []

@@ -159,6 +159,7 @@ class CoreScheduler:
                 continue
             state.delete_node(node.id)
             self.server._drop_node_device_stats(node.id)
+            self.server._drop_node_identity_lock(node.id)
             n += 1
         return n
 

@@ -94,6 +94,16 @@ class Server:
         self._admission_lock = threading.RLock()
         # serializes lazy connect-CA creation (connect_issue)
         self._connect_ca_lock = threading.Lock()
+        # Serializes node_register's write-once identity check against
+        # its upsert PER NODE ID: node_by_id and upsert_node lock the
+        # store SEPARATELY, so two concurrent first registrations for
+        # one node id could otherwise both see no bound secret and
+        # last-writer-wins would hand the TOFU binding to the loser.
+        # Striped by id — on a clustered server upsert_node blocks on
+        # a raft quorum commit, and one global mutex would serialize
+        # every registration in the region behind it.
+        self._node_identity_locks: Dict[str, threading.Lock] = {}
+        self._node_identity_locks_mu = threading.Lock()
         #: node id → latest heartbeat-carried device stats (off-raft;
         #: devicemanager stats stream — see node_heartbeat)
         self._node_device_stats: Dict[str, dict] = {}
@@ -559,8 +569,34 @@ class Server:
     def node_register(self, node: Node) -> None:
         if not node.computed_class:
             node.compute_class()
-        was = self.state.node_by_id(node.id)
-        self.state.upsert_node(node)
+        # the identity secret is WRITE-ONCE (reference
+        # node_endpoint.go:TOFU — Register rejects a SecretID change):
+        # registration is itself an unauthenticated forwarded RPC, so a
+        # mutable secret would let any peer overwrite a live node's
+        # credential (hijack the connect_issue identity, or deny the
+        # real node its next issuance). First registration binds it;
+        # re-registering must present the bound secret. Check and
+        # upsert are ONE atom under this id's identity lock — otherwise
+        # two racing first registrations both pass the check and the
+        # binding goes to whichever loses the upsert race.
+        import hmac
+
+        with self._node_identity_locks_mu:
+            id_lock = self._node_identity_locks.setdefault(
+                node.id, threading.Lock())
+        with id_lock:
+            was = self.state.node_by_id(node.id)
+            if was is not None and was.secret_id:
+                # bytes, not str: compare_digest on str raises on
+                # non-ASCII — a deny must never become a 500
+                if not hmac.compare_digest(
+                        was.secret_id.encode(),
+                        (node.secret_id or "").encode()):
+                    self.metrics.inc("node.register_denied")
+                    raise PermissionError(
+                        f"node_register denied for {node.id!r}: identity "
+                        f"secret does not match the registered one")
+            self.state.upsert_node(node)
         self._publish("Node", "NodeRegistered", node.id)
         self.heartbeater.reset(node.id)
         if node.status == NODE_STATUS_READY:
@@ -654,9 +690,17 @@ class Server:
         # the node gone (missing ⇒ tainted/lost), or it no-ops while the
         # node still looks ready and the allocs are stranded forever
         self.state.delete_node(node_id)
+        self._drop_node_identity_lock(node_id)
         evals = self._create_node_evals(node_id)
         self._publish("Node", "NodeDeregistered", node_id)
         return evals
+
+    def _drop_node_identity_lock(self, node_id: str) -> None:
+        """Release a deleted node's registration-identity stripe — the
+        stripe dict otherwise grows with every lifetime-distinct node
+        id (ephemeral clients mint fresh uuids)."""
+        with self._node_identity_locks_mu:
+            self._node_identity_locks.pop(node_id, None)
 
     def node_update_drain(self, node_id: str, drain) -> List[Evaluation]:
         import copy
@@ -908,8 +952,19 @@ class Server:
         """Node lookup for clients (remote ephemeral-disk migration
         resolves the previous node's advertised HTTP address; the
         reference ships Node info to clients the same way for
-        allocwatcher migration)."""
-        return self.state.node_by_id(node_id)
+        allocwatcher migration).
+
+        The returned view REDACTS the node identity secret: node_get is
+        a forwarded fabric RPC (cluster.FORWARDED), and serving
+        `secret_id` here would hand any peer exactly the credential
+        `connect_issue` verifies — the HTTP node surface redacts it for
+        the same reason (agent/http.py node_wire)."""
+        import dataclasses
+
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return None
+        return dataclasses.replace(node, secret_id="")
 
     def services_lookup(self, namespace: str, name: str):
         """Catalog lookup for client-side template rendering (the
@@ -974,11 +1029,22 @@ class Server:
     #: read from the TASK's namespace)
     CONNECT_NS = "nomad/connect"
 
-    def connect_issue(self, service_name: str) -> dict:
+    def connect_issue(self, service_name: str, node_id: str = "",
+                      secret_id: str = "") -> dict:
         """Issue a leaf certificate for one sidecar proxy, signed by the
         cluster's connect CA (lazily created, stored in the replicated
         secrets table so every server signs with the same root —
         Consul's Connect CA model). Returns PEM strings.
+
+        Issuance verifies the REQUESTING NODE'S identity first (ADVICE
+        r5: this used to be an unauthenticated forwarded RPC — any
+        fabric peer could mint a leaf for an arbitrary service CN and
+        walk through intention deny rules). The caller presents its
+        node id + identity secret (structs.Node.secret_id, generated
+        client-side, registered with the node); an unknown node or a
+        secret mismatch rejects with PermissionError and counts
+        `connect.issue_denied` — the reference ties issuance to the
+        allocation via SI tokens/ACLs, this is the node-identity half.
 
         Reference analog: Envoy sidecars receive leaf certs from
         Consul's CA (`plugins`/SI-token flow); here the server IS the
@@ -986,6 +1052,22 @@ class Server:
         dir (client/task_runner.py connect hook)."""
         import os
         import tempfile
+
+        import hmac
+
+        node = self.state.node_by_id(node_id) if node_id else None
+        # a node with NO registered secret must deny (an empty==empty
+        # match would let any peer mint from a public node id, e.g. a
+        # row restored from pre-upgrade state); constant-time compare
+        if node is None or not node.secret_id \
+                or not hmac.compare_digest(
+                    node.secret_id.encode(),
+                    (secret_id or "").encode()):
+            self.metrics.inc("connect.issue_denied")
+            raise PermissionError(
+                f"connect_issue denied for service {service_name!r}: "
+                f"node identity not verified (unknown node or secret "
+                f"mismatch for {node_id!r})")
 
         from ..lib import tlsutil
         from ..structs.secrets import SecretEntry

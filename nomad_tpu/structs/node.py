@@ -47,6 +47,12 @@ class Node:
     """
 
     id: str = ""
+    #: node identity secret (reference structs.Node.SecretID,
+    #: structs.go:1718): generated client-side at first start and
+    #: presented on authenticated node RPCs — `connect_issue` verifies
+    #: it against the registered node before minting a mesh leaf cert
+    #: (ADVICE r5: issuance was an unauthenticated forwarded RPC)
+    secret_id: str = ""
     name: str = ""
     datacenter: str = "dc1"
     node_class: str = ""

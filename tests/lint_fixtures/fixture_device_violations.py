@@ -1,0 +1,51 @@
+"""Device-discipline violation fixtures (NLD01–NLD04).
+
+Analyzed under the repo-relative path of a fused-dispatch module
+(scheduler/stack.py — inside TRANSFER/DONATE/WAVE scope) and asserted
+against the trailing `# NLDxx` markers with exact lines.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def unledgered_upload(buf):
+    dev = jnp.asarray(buf)  # NLD01
+    return dev
+
+
+def unledgered_fetch():
+    result = place_fake_kernel()
+    host = np.asarray(result.sel_idx)  # NLD01
+    return host
+
+
+def place_fake_kernel():
+    """Device-producing by naming convention (place_*)."""
+
+
+def _impl(x):
+    return x * 1
+
+
+def donated_after_use(x):
+    g = jax.jit(_impl, donate_argnums=(0,))
+    y = g(x)
+    return x + y  # NLD02
+
+
+class TableCache:
+    def alloc_unbooked(self):
+        self._ti = jnp.zeros((4, 4), dtype=jnp.int32)  # NLD03
+        return self._ti
+
+
+def arithmetic_lane_fold(rows):
+    used_l, dyn_l = jax.vmap(_lane)(rows)
+    bad = jnp.sum(used_l, axis=0)  # NLD04
+    worse = dyn_l[0] + dyn_l[1]  # NLD04
+    return bad, worse
+
+
+def _lane(row):
+    return row, row

@@ -1,0 +1,225 @@
+"""Near-miss fixtures the lock rules must stay SILENT on (NLT04–06).
+
+Each class is the violation fixture's shape with the discipline
+applied — the analyzer proving it can tell the fix from the bug.
+"""
+import threading
+import time
+from logging import shutdown
+
+
+class ConsistentOrder:
+    """Same three locks as ThreeLockCycle, but every path acquires in
+    one global order (la, lb, lc) — no cycle."""
+
+    def __init__(self):
+        self.la = threading.Lock()
+        self.lb = threading.Lock()
+        self.lc = threading.Lock()
+
+    def ab(self):
+        with self.la:
+            with self.lb:
+                pass
+
+    def abc(self):
+        with self.la:
+            with self.lb:
+                with self.lc:
+                    pass
+
+    def bc(self):
+        with self.lb:
+            with self.lc:
+                pass
+
+
+class CopyThenCall:
+    """The PR 8 broker discipline: snapshot under the lock, release,
+    THEN invoke the stored callback — no NLT05."""
+
+    def __init__(self, estimator):
+        self.estimator = estimator
+        self._lk = threading.Lock()
+        self._items = []
+
+    def estimate(self):
+        with self._lk:
+            snapshot = list(self._items)
+        return self.estimator(snapshot)
+
+    def helper_not_reentrant(self):
+        with self._lk:
+            self._compute()  # callee takes NO lock: fine
+
+    def _compute(self):
+        return len(self._items)
+
+
+class RLockReentry:
+    """Re-entrant acquisition of an RLock is sanctioned (that is what
+    RLock is for) — NLT05 must not fire."""
+
+    def __init__(self):
+        self._lk = threading.RLock()
+
+    def outer(self):
+        with self._lk:
+            self.inner()
+
+    def inner(self):
+        with self._lk:
+            pass
+
+
+class LeaseDiscipline:
+    """Release the lease at kernel end, then block — no NLT06."""
+
+    def __init__(self):
+        self.cluster = None
+
+    def device_arrays(self, lease_token=None):
+        return object()
+
+    def launch_then_block(self, tok):
+        arrays = self.device_arrays(lease_token=tok)
+        release_view(self.cluster, tok)
+        time.sleep(0.01)  # after release: fine
+        return arrays
+
+    def block_without_lease(self, out):
+        arrays = self.device_arrays()
+        out.block_until_ready()  # no lease taken: fine
+        return arrays
+
+    def release_via_helper_then_block(self, tok):
+        # release_view refactored into a helper: the NET-RELEASING
+        # call closes the interval (transitively), so the later sleep
+        # is clean — not an open-ended lease to EOF
+        arrays = self.device_arrays(lease_token=tok)
+        self._finish(tok)
+        time.sleep(0.01)  # after the real (helper) release: fine
+        return arrays
+
+    def _finish(self, tok):
+        release_view(self.cluster, tok)
+
+    def balanced_helper_then_block(self, tok, out):
+        # a helper with its OWN balanced lease/release pair is NOT a
+        # net releaser — but no lease is open here, so still clean
+        self._scoped_probe(tok)
+        out.block_until_ready()
+
+    def _scoped_probe(self, tok):
+        arrays = self.device_arrays(lease_token=tok)
+        release_view(self.cluster, tok)
+        return arrays
+
+
+class NestedLockOwner:
+    """A NESTED class's `self._wlk = Lock()` belongs to the inner
+    class ONLY: the outer pass-1 scan stopping at the class boundary
+    means the outer's same-NAMED `self._wlk` (a plain guard object)
+    never becomes a phantom `NestedLockOwner._wlk` lock — pre-fix,
+    the two guard withs below read as an ABBA cycle against NG."""
+
+    class Worker:
+        def __init__(self):
+            self._wlk = threading.Lock()
+
+        def lock_then_g(self):
+            with self._wlk:
+                with NG:
+                    pass
+
+    def __init__(self):
+        self._wlk = _EnterExitGuard()  # same name, NOT a lock
+
+    def guard_then_g(self):
+        with self._wlk:
+            with NG:
+                pass
+
+    def g_then_guard(self):
+        with NG:
+            with self._wlk:  # a guard re-enter, not a lock inversion
+                pass
+
+
+NG = threading.Lock()
+
+
+class _EnterExitGuard:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class DefaultCondReentry:
+    """threading.Condition() with no wrapped lock defaults to an
+    RLock — re-entry through the call tree is legal at runtime, so
+    NLT05 must stay silent (the explicit-Lock-wrapped twin is
+    fixture_lock_violations.CondOverLock)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def outer(self):
+        with self._cv:
+            self._inner()
+
+    def _inner(self):
+        with self._cv:
+            pass
+
+
+#: module-level bare Condition: same RLock-by-default rule
+_BARE_CV = threading.Condition()
+
+
+def cond_outer():
+    with _BARE_CV:
+        _cond_inner()
+
+
+def _cond_inner():
+    with _BARE_CV:
+        pass
+
+
+class MethodShadow:
+    """A bare call resolves through module scope (here: an import) —
+    NEVER to a same-named METHOD of the class. `shutdown()` does not
+    dispatch to self.shutdown at runtime, so re-entry through that
+    method's lock effects would be a fabricated edge."""
+
+    def __init__(self):
+        self._lk = threading.Lock()
+
+    def shutdown(self):
+        with self._lk:
+            pass
+
+    def run(self):
+        with self._lk:
+            shutdown()
+
+
+def local_class_shadow(helper):
+    """A function-LOCAL class is scanned as a class, never absorbed as
+    nested defs of this function: the bare `helper()` below is the
+    caller-passed callable, not _Inner.helper — absorbing the class
+    would fabricate an NG re-entry edge here."""
+    class _Inner:
+        def helper(self):
+            with NG:
+                pass
+    with NG:
+        helper()
+    return _Inner
+
+
+def release_view(cluster, token):
+    """Stand-in for scheduler.stack.release_view (leaf-name match)."""

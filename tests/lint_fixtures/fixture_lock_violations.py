@@ -1,0 +1,178 @@
+"""Lock-discipline violation fixtures (NLT04–NLT06).
+
+Analyzed by tests/test_lint.py under a repo-relative path OUTSIDE the
+NLT01–03 thread scope (so only the interprocedural family fires) and
+asserted against the trailing `# NLTxx` markers with exact lines.
+"""
+import threading
+import time
+
+
+class ThreeLockCycle:
+    """Seeded three-lock cycle: la→lb, lb→lc, lc→la. The NLT04 report
+    must carry the FULL cycle path (all three locks) with per-edge
+    witnesses."""
+
+    def __init__(self):
+        self.la = threading.Lock()
+        self.lb = threading.Lock()
+        self.lc = threading.Lock()
+
+    def ab(self):
+        with self.la:
+            with self.lb:  # NLT04 first witness: la→lb while holding la
+                pass
+
+    def bc(self):
+        with self.lb:
+            with self.lc:
+                pass
+
+    def ca(self):
+        with self.lc:
+            with self.la:
+                pass
+
+
+# a second, CALL-MEDIATED cycle between module-level locks: neither
+# function acquires both locks lexically — only the resolved call tree
+# sees the inversion
+M_A = threading.Lock()
+M_B = threading.Lock()
+
+
+def hold_a_then_b():
+    with M_A:
+        _grab_b()  # NLT04
+
+
+def _grab_b():
+    with M_B:
+        pass
+
+
+def hold_b_then_a():
+    with M_B:
+        _grab_a()
+
+
+def _grab_a():
+    with M_A:
+        pass
+
+
+class MultiItemInversion:
+    """ABBA where the forward direction is the ONE-LINE `with a, b:`
+    form: multi-item withs enter left-to-right, so this must produce
+    the same ma→mb edge as the nested form (review-hardening pin —
+    the scan once recorded both items with the pre-statement held
+    set and missed the whole cycle)."""
+
+    def __init__(self):
+        self.ma = threading.Lock()
+        self.mb = threading.Lock()
+
+    def fwd(self):
+        with self.ma, self.mb:  # NLT04
+            pass
+
+    def rev(self):
+        with self.mb:
+            with self.ma:
+                pass
+
+
+class Reenter:
+    """NLT05 both shapes: same-lock re-acquisition through the call
+    tree, and a stored callback invoked under the owner's lock (the
+    pre-PR-8 broker-footprint-estimator hazard, verbatim)."""
+
+    def __init__(self, estimator):
+        self.estimator = estimator
+        self._lk = threading.Lock()
+        self._items = []
+
+    def outer(self):
+        with self._lk:
+            self.mutate()  # NLT05
+
+    def mutate(self):
+        with self._lk:
+            self._items.append(1)
+
+    def estimate_under_lock(self):
+        # the broker hazard: the estimator reads state whose mutators
+        # re-enter a locked entry point (enqueue) of this same object
+        with self._lk:
+            return self.estimator(self._items)  # NLT05
+
+
+class LeaseHolder:
+    """NLT06: blocking / device-sync between taking a view lease and
+    releasing it."""
+
+    def __init__(self):
+        self.cluster = None
+
+    def device_arrays(self, lease_token=None):
+        return object()
+
+    def blocking_under_lease(self, tok):
+        arrays = self.device_arrays(lease_token=tok)
+        time.sleep(0.01)  # NLT06
+        release_view(self.cluster, tok)
+        return arrays
+
+    def sync_under_lease(self, tok, out):
+        arrays = self.device_arrays(lease_token=tok)
+        out.block_until_ready()  # NLT06
+        release_view(self.cluster, tok)
+        return arrays
+
+    def blocking_before_helper_release(self, tok):
+        # the helper IS the release (net-releasing callee) — but the
+        # sleep lands before it, still under the lease
+        arrays = self.device_arrays(lease_token=tok)
+        time.sleep(0.01)  # NLT06
+        self._finish(tok)
+        return arrays
+
+    def _finish(self, tok):
+        release_view(self.cluster, tok)
+
+
+class CondOverLock:
+    """Condition wrapping an EXPLICIT non-reentrant Lock: acquiring
+    the condition acquires that lock, so re-entry through the call
+    tree deadlocks. (The no-arg Condition() default wraps an RLock —
+    fixture_lock_clean.DefaultCondReentry pins that side silent.)"""
+
+    def __init__(self):
+        self._cv = threading.Condition(threading.Lock())
+
+    def outer(self):
+        with self._cv:
+            self._inner()  # NLT05
+
+    def _inner(self):
+        with self._cv:
+            pass
+
+
+class NestedDefReentry:
+    """A def nested in the calling function IS resolvable from its
+    bare call — re-entering the held lock through it deadlocks."""
+
+    def __init__(self):
+        self.nl = threading.Lock()
+
+    def run(self):
+        def grab():
+            with self.nl:
+                pass
+        with self.nl:
+            grab()  # NLT05
+
+
+def release_view(cluster, token):
+    """Stand-in for scheduler.stack.release_view (leaf-name match)."""

@@ -1,0 +1,36 @@
+"""Near-miss fixtures the vocabulary ratchet must stay SILENT on.
+
+Pinned names used correctly, plus the documented skip: DYNAMIC names
+(f-strings / variables) are runtime-pinned by the exposition tests,
+not statically — the ratchet covers what is statically knowable.
+"""
+
+
+def pinned_metric(reg):
+    reg.inc("broker.enqueued")
+    reg.add_sample("drain.hold_ms", 1.0)
+    reg.set_gauge("plan_apply.queue_depth", 0)
+
+
+def pinned_flight(default_flight):
+    default_flight().record("plan.partial", key="ev1")
+
+
+def pinned_transfer_site(led):
+    with led.timed("select_batch.fetch", 64):
+        pass
+    led.record("stack.hot_delta", 32)
+
+
+def pinned_residency(hbm, buf, tok):
+    hbm.track("mesh.cluster", buf)
+    hbm.track_cluster("stack.view", buf, 4)
+    hbm.lease(tok, "stack.view")
+
+
+def dynamic_names_are_runtime_pinned(reg, q):
+    # per-instance families: statically unknowable, pinned by the
+    # loaded-agent exposition tests instead
+    reg.set_gauge(f"broker.ready.{q}", 1)
+    name = "wave.lanes"
+    reg.add_sample(name, 2)

@@ -1,0 +1,32 @@
+"""Vocabulary-ratchet violation fixtures (NLV01).
+
+Every literal below names a series/type/site OUTSIDE the pinned
+vocabularies in nomad_tpu/analysis/vocab.py — each is exactly the
+rename-or-unpinned-new-series mistake the ratchet exists to catch
+before the exposition tests (or a dashboard) notice.
+"""
+
+
+def unpinned_metric_family(reg):
+    reg.inc("totally.new_family")  # NLV01
+
+
+def unpinned_gauge(metrics):
+    metrics.set_gauge("sideband.depth", 3)  # NLV01
+
+
+def unknown_flight_type(default_flight):
+    default_flight().record("not.a.type", key="x")  # NLV01
+
+
+def unknown_transfer_site(led):
+    with led.timed("stack.sideways", 8):  # NLV01
+        pass
+
+
+def unknown_residency_site(hbm, buf):
+    hbm.track("heap.mystery", buf)  # NLV01
+
+
+def unknown_lease_site(hbm, tok):
+    hbm.lease(tok, "slab.view")  # NLV01

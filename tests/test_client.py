@@ -327,3 +327,87 @@ class TestClientRestore:
             c2.shutdown()
         finally:
             server.shutdown()
+
+    def test_client_restart_reuses_node_identity(self, tmp_path):
+        """The node id + WRITE-ONCE identity secret persist in the
+        client state DB: a restarted client handed only its data_dir
+        (the remote-RpcConn reality — node_get and the HTTP node
+        surfaces REDACT the secret, so it cannot be recovered from the
+        server) re-registers as the SAME node instead of minting a
+        fresh secret and being locked out by the server's
+        registration check."""
+        server = Server(ServerConfig(num_schedulers=1,
+                                     heartbeat_ttl=60.0))
+        server.start()
+        cdir = str(tmp_path / "client")
+        try:
+            c1 = Client(InProcConn(server), ClientConfig(data_dir=cdir))
+            c1.start()
+            nid, secret = c1.node.id, c1.node.secret_id
+            assert secret
+            _wait(lambda: server.state.node_by_id(nid) is not None)
+            c1.shutdown()
+
+            c2 = Client(InProcConn(server), ClientConfig(data_dir=cdir))
+            assert (c2.node.id, c2.node.secret_id) == (nid, secret)
+            c2.start()  # re-register passes the write-once check
+            _wait(lambda: server.state.node_by_id(nid) is not None)
+            assert server.state.node_by_id(nid).secret_id == secret
+            assert server.metrics.snapshot()["counters"].get(
+                "node.register_denied", 0) == 0
+            c2.shutdown()
+        finally:
+            server.shutdown()
+
+    def test_state_db_identity_secret_is_first_write_wins(self, tmp_path):
+        """The per-id secret map mirrors the server's WRITE-ONCE rule:
+        a later put with a wrong secret for an already-bound id (e.g.
+        an explicit config.node carrying a typo) must not destroy the
+        only recoverable copy."""
+        db = ClientStateDB(str(tmp_path))
+        db.put_node_identity("n1", "s1")
+        db.put_node_identity("n1", "typo")  # cannot clobber the binding
+        assert db.node_secret("n1") == "s1"
+        assert db.node_identity() == ("n1", "s1")
+        db.put_node_identity("n2", "s2")    # a different id binds fresh
+        assert db.node_identity() == ("n2", "s2")
+        assert db.node_secret("n1") == "s1"
+        # the binding survives a reload from disk
+        assert ClientStateDB(str(tmp_path)).node_secret("n1") == "s1"
+
+    def test_explicit_other_node_preserves_saved_identity(self, tmp_path):
+        """An explicit config.node with a DIFFERENT id must neither
+        inherit the saved node's write-once secret nor destroy it: the
+        state DB keys secrets by node id, so a later start naming the
+        original id recovers its binding and still passes the server's
+        registration check."""
+        server = Server(ServerConfig(num_schedulers=1,
+                                     heartbeat_ttl=60.0))
+        server.start()
+        cdir = str(tmp_path / "client")
+        try:
+            c1 = Client(InProcConn(server), ClientConfig(data_dir=cdir))
+            c1.start()
+            nid, secret = c1.node.id, c1.node.secret_id
+            _wait(lambda: server.state.node_by_id(nid) is not None)
+            c1.shutdown()
+
+            c2 = Client(InProcConn(server), ClientConfig(
+                data_dir=cdir, node=Node(id="other-node")))
+            assert c2.node.secret_id and c2.node.secret_id != secret
+            c2.start()
+            _wait(lambda: server.state.node_by_id("other-node")
+                  is not None)
+            c2.shutdown()
+
+            c3 = Client(InProcConn(server), ClientConfig(
+                data_dir=cdir, node=Node(id=nid)))
+            assert c3.node.secret_id == secret
+            c3.start()  # same binding → write-once check passes
+            _wait(lambda: server.state.node_by_id(nid) is not None)
+            assert server.state.node_by_id(nid).secret_id == secret
+            assert server.metrics.snapshot()["counters"].get(
+                "node.register_denied", 0) == 0
+            c3.shutdown()
+        finally:
+            server.shutdown()

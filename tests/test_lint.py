@@ -25,7 +25,7 @@ BASELINE = os.path.join(REPO, "lint_baseline.json")
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "lint_fixtures")
 
-_MARKER = re.compile(r"#\s*(NL[JT]\d\d)\b")
+_MARKER = re.compile(r"#\s*(NL[A-Z]\d\d)\b")
 
 _TREE_CACHE = []
 
@@ -85,6 +85,111 @@ def test_clean_fixtures_have_zero_findings():
                             _scope_rel("kernels", "fixture_clean.py")) == []
     assert _analyze_fixture("fixture_thread_clean.py",
                             _scope_rel("server", "fixture_clean.py")) == []
+
+
+# ---- ISSUE 14 families: lock discipline, device discipline, vocab ----
+# Each violation fixture is pinned EXACTLY (rule ids + line numbers via
+# trailing markers); each clean fixture is the same shape with the
+# discipline applied and must be silent. Scope mapping: the lock
+# fixtures sit OUTSIDE the NLT01-03 thread scope (raft/) so only the
+# interprocedural family fires; the device fixtures impersonate the
+# fused-dispatch module (scheduler/stack.py) to be in TRANSFER/DONATE/
+# WAVE scope.
+
+def test_lock_fixture_findings_exact():
+    found = _analyze_fixture("fixture_lock_violations.py",
+                             _scope_rel("raft", "fixture.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_lock_violations.py"))
+
+
+def test_lock_cycle_reports_full_path():
+    """The seeded three-lock cycle must render the WHOLE cycle (all
+    three locks, back to the start) plus a per-edge witness call site —
+    the 'reading a lock-order finding' contract in README."""
+    found = _analyze_fixture("fixture_lock_violations.py",
+                             _scope_rel("raft", "fixture.py"))
+    cycles = [f for f in found if f.rule == "NLT04"
+              and "ThreeLockCycle" in f.message]
+    assert len(cycles) == 1
+    msg = cycles[0].message
+    assert ("ThreeLockCycle.la -> ThreeLockCycle.lb -> "
+            "ThreeLockCycle.lc -> ThreeLockCycle.la") in msg
+    # each hop carries its witness (function + file:line)
+    for hop in ("ThreeLockCycle.ab", "ThreeLockCycle.bc",
+                "ThreeLockCycle.ca"):
+        assert hop in msg
+    # the call-mediated module-lock cycle is a separate finding whose
+    # edges only exist through the resolved call tree
+    mod = [f for f in found if f.rule == "NLT04" and "M_A" in f.message]
+    assert len(mod) == 1
+    assert "via _grab_b()" in mod[0].message
+
+
+def test_device_fixture_findings_exact():
+    found = _analyze_fixture("fixture_device_violations.py",
+                             _scope_rel("scheduler", "stack.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_device_violations.py"))
+
+
+def test_vocab_fixture_findings_exact():
+    found = _analyze_fixture("fixture_vocab_violations.py",
+                             _scope_rel("lib", "fixture.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_vocab_violations.py"))
+
+
+def test_new_family_clean_fixtures_are_silent():
+    assert _analyze_fixture("fixture_lock_clean.py",
+                            _scope_rel("raft", "fixture_clean.py")) == []
+    assert _analyze_fixture("fixture_device_clean.py",
+                            _scope_rel("scheduler", "stack.py")) == []
+    assert _analyze_fixture("fixture_vocab_clean.py",
+                            _scope_rel("lib", "fixture_clean.py")) == []
+
+
+# ---- waivers ----
+
+def test_waiver_with_reason_suppresses_and_is_counted(tmp_path):
+    from nomad_tpu.analysis.core import _suppressions
+
+    src = ("import threading\n"
+           "import time\n"
+           "class C:\n"
+           "    def __init__(self, cb):\n"
+           "        self.cb = cb\n"
+           "        self._lk = threading.Lock()\n"
+           "    def m(self):\n"
+           "        with self._lk:\n"
+           "            self.cb()  # nomadlint: ok NLT05 cb is a pure "
+           "read, documented\n")
+    p = tmp_path / "waived.py"
+    p.write_text(src)
+    stats = {}
+    found = analyze_file(str(p), _scope_rel("raft", "waived.py"),
+                         stats=stats)
+    assert found == []
+    waivers = stats["waivers"]
+    assert len(waivers) == 1 and waivers[0].rule == "NLT05"
+    assert waivers[0].used and waivers[0].reason.startswith("cb is")
+
+
+def test_waiver_without_reason_is_a_finding(tmp_path):
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self, cb):\n"
+           "        self.cb = cb\n"
+           "        self._lk = threading.Lock()\n"
+           "    def m(self):\n"
+           "        with self._lk:\n"
+           "            self.cb()  # nomadlint: ok NLT05\n")
+    p = tmp_path / "bad_waiver.py"
+    p.write_text(src)
+    found = analyze_file(str(p), _scope_rel("raft", "bad_waiver.py"))
+    rules = sorted(f.rule for f in found)
+    # the reason-less waiver suppresses NOTHING and is itself flagged
+    assert rules == ["NLT05", "NLW00"]
 
 
 def test_inline_suppression(tmp_path):
@@ -158,7 +263,123 @@ def test_cli_fail_on_new_clean_then_dirty(tmp_path, capsys):
     assert "NLJ05" in out
 
 
+def test_cli_explain_prints_rationale_and_fixture_example(capsys):
+    assert lint_main(["--explain", "NLT04"]) == 0
+    out = capsys.readouterr().out
+    assert "lock-order inversion" in out
+    assert "fix:" in out
+    # the fixture suite provides the worked example
+    assert "fixture_lock_violations.py" in out
+    assert lint_main(["--explain", "NLX99"]) == 1
+
+
+def test_cli_format_json_machine_readable(tmp_path, capsys):
+    import json as _json
+
+    src = ("import threading\nimport time\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lk = threading.Lock()\n"
+           "    def m(self):\n"
+           "        with self._lk:\n"
+           "            self.m()\n")
+    pkg = tmp_path / "nomad_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    assert lint_main([str(pkg), "--format", "json"]) == 0
+    payload = _json.loads(capsys.readouterr().out)
+    (f,) = payload["findings"]
+    assert f["rule"] == "NLT05"
+    assert f["file"].endswith("mod.py")
+    assert f["line"] == 8
+    assert f["context"] == "C.m"
+    # --json stays as the legacy alias
+    assert lint_main([str(pkg), "--json"]) == 0
+    assert _json.loads(capsys.readouterr().out)["findings"]
+
+
+def test_cli_duplicate_roots_do_not_double_count(tmp_path, capsys):
+    """Passing overlapping/duplicate path args dedups findings AND the
+    stats side: the waiver ledger merges by site and `files` counts
+    each analyzed file once."""
+    import json as _json
+
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self, cb):\n"
+           "        self.cb = cb\n"
+           "        self._lk = threading.Lock()\n"
+           "    def m(self):\n"
+           "        with self._lk:\n"
+           "            self.cb()  # nomadlint: ok NLT05 pure read, "
+           "documented\n")
+    pkg = tmp_path / "nomad_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    assert lint_main([str(pkg), str(pkg), "--format", "json",
+                      "--stats"]) == 0
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["findings"] == []
+    assert payload["stats"]["files"] == 1
+    assert payload["stats"]["by_rule"] == {}  # waived → nothing counted
+    (w,) = payload["stats"]["waivers"]
+    assert w["rule"] == "NLT05" and w["used"]
+
+
+def test_cli_stats_lists_waiver_ledger(capsys):
+    """--stats prints per-rule counts plus every waiver with its
+    reason and active/stale state (the shipped tree carries the ISSUE
+    14 burn-down waivers — they must all be ACTIVE)."""
+    assert lint_main([PKG, "--stats"]) == 0
+    out = capsys.readouterr().out
+    assert "findings by rule: clean" in out
+    assert "waivers:" in out
+    assert "0 stale" in out
+    assert "NO REASON" not in out
+
+
+def test_analyzer_wall_clock_budget():
+    """The whole analyzer (per-file rules + whole-program lock graph)
+    must stay under 10s on the full tree — it gates bench preflight and
+    pre-commit (ISSUE 14 acceptance)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    run_tree(PKG)
+    assert _time.monotonic() - t0 < 10.0
+
+
 # ---- regression: the findings this PR burned down stay fixed ----
+
+
+def test_broker_estimator_discipline_holds():
+    """PR 8's documented hazard, now a rule: the broker footprint
+    estimator must never be invoked under the broker lock (its reads
+    re-enter enqueue). The shipped _group_picks runs OUTSIDE the lock —
+    NLT05 must be silent on broker.py — while the fixture pins that the
+    pre-fix shape (callback under the owner's lock) is still caught."""
+    found = [f for f in _tree_findings()
+             if f.rule == "NLT05"
+             and f.path == "nomad_tpu/server/broker.py"]
+    assert found == [], [f.render() for f in found]
+    fixture = _analyze_fixture("fixture_lock_violations.py",
+                               _scope_rel("raft", "fixture.py"))
+    assert any(f.rule == "NLT05"
+               and f.context == "Reenter.estimate_under_lock"
+               for f in fixture)
+
+
+def test_wave_fold_stays_bitwise():
+    """place_table_wave's lane-carry fold is the NLD04 contract: the
+    shipped kernel folds by jnp.where selection (silent), and the rule
+    catches the arithmetic fold in the fixture."""
+    found = _tree_findings()
+    assert not any(f.rule == "NLD04"
+                   and f.path == "nomad_tpu/kernels/placement.py"
+                   for f in found)
+    fixture = _analyze_fixture("fixture_device_violations.py",
+                               _scope_rel("scheduler", "stack.py"))
+    assert any(f.rule == "NLD04" for f in fixture)
 
 def test_task_runner_template_state_is_lock_guarded():
     """ADVICE.md r5 / satellite: _tmpl_content, _secret_data and

@@ -17,6 +17,16 @@ import time
 import pytest
 
 from nomad_tpu import mock
+# the frozen vocabularies live in analysis/vocab.py (ISSUE 14): one
+# source of truth shared by this exposition test, lib/flight.py's
+# recorder, and the NLV01 static vocabulary-ratchet lint rule. This
+# module only drives the loaded-agent flow and pins the exposition
+# against the shared sets.
+from nomad_tpu.analysis.vocab import (ALLOWED_LABELS, ALLOWED_PREFIXES,
+                                      ALLOWED_SITES, PROM_REQUIRED,
+                                      RAFT_REQUIRED)
+
+REQUIRED = PROM_REQUIRED
 
 
 def _wait(cond, timeout=20.0, every=0.05):
@@ -28,110 +38,8 @@ def _wait(cond, timeout=20.0, every=0.05):
     return cond()
 
 
-#: every series name the repo PROMISES (post-mangle, nomad_ prefix).
-#: Renaming any of these must be a deliberate, reviewed act.
-REQUIRED = {
-    # broker (eval_broker.go stats)
-    "nomad_broker_enqueued", "nomad_broker_dequeued", "nomad_broker_acked",
-    "nomad_broker_nacked", "nomad_broker_failed", "nomad_broker_requeued",
-    # plan applier
-    "nomad_plan_apply_applied", "nomad_plan_apply_partial",
-    "nomad_plan_apply_rejected_nodes", "nomad_plan_apply_stale_token",
-    "nomad_plan_apply_inline", "nomad_plan_apply_apply_ms",
-    # eval-lifecycle phase histograms (lib/trace.py taxonomy)
-    "nomad_eval_phase_schedule_ms", "nomad_eval_phase_plan_apply_ms",
-    # device-view delta refresh (scheduler/stack.py)
-    "nomad_view_upload_bytes", "nomad_view_full_uploads",
-    "nomad_view_hot_log_len", "nomad_view_ports_log_len",
-    # device-to-device plan deltas (ISSUE 10: dispatch-carry adoption)
-    "nomad_view_carry_adopts", "nomad_view_carry_rows",
-    # transfer ledger mirrors + labeled per-site exposition
-    "nomad_transfer_bytes", "nomad_transfer_count", "nomad_transfer_ms",
-    "nomad_transfer_bytes_total", "nomad_transfer_count_total",
-    "nomad_transfer_ms_total",
-    # dispatch pipeline (lib/transfer.DispatchTimeline)
-    "nomad_pipeline_dispatches", "nomad_pipeline_programs",
-    "nomad_pipeline_transfer_bytes", "nomad_pipeline_transfer_count",
-    # pipeline phase + overlap/bubble histograms — the r06 acceptance
-    # read (overlap_pct) aggregates from these; renames break it
-    "nomad_pipeline_pack_ms", "nomad_pipeline_upload_ms",
-    "nomad_pipeline_view_ms", "nomad_pipeline_host_ms",
-    "nomad_pipeline_kernel_ms", "nomad_pipeline_overlap_ms",
-    "nomad_pipeline_bubble_ms",
-    # scheduler explainability counters (ISSUE 8)
-    "nomad_scheduler_filter_constraint",
-    "nomad_scheduler_exhausted_cpu",
-    "nomad_scheduler_blocked_cpu",
-    # HBM residency ledger (ISSUE 11): labeled per-(site, shard) gauges
-    # plus the registry mirror totals + lease instruments
-    "nomad_hbm_live_bytes", "nomad_hbm_buffers", "nomad_hbm_peak_bytes",
-    "nomad_hbm_live_bytes_total", "nomad_hbm_buffers_total",
-    "nomad_hbm_peak_bytes_total", "nomad_hbm_leases",
-    "nomad_hbm_allocs", "nomad_hbm_releases",
-    # drain cadence (ISSUE 12): mega-batch width/grouping/hold window —
-    # the BENCH_r07 e2e_drain tail aggregates from these
-    "nomad_drain_drains", "nomad_drain_batch_width",
-    "nomad_drain_groups", "nomad_drain_hold_ms", "nomad_drain_window_ms",
-    # wave dispatch (ISSUE 12): lane structure of fused mega-batches
-    "nomad_wave_dispatches", "nomad_wave_programs", "nomad_wave_lanes",
-    # control-plane queue state (ISSUE 13): broker depths/ages + plan
-    # pipeline depth/rejection rate — the soak-backpressure dashboards
-    "nomad_broker_ready_depth", "nomad_broker_unacked_depth",
-    "nomad_broker_pending_depth", "nomad_broker_delayed_depth",
-    "nomad_broker_oldest_eval_age_s", "nomad_broker_blocked_depth",
-    "nomad_plan_apply_queue_depth", "nomad_plan_apply_partial_rate",
-    # heartbeat TTL misses (ISSUE 13 satellite)
-    "nomad_heartbeat_expired",
-    # WAL durability (ISSUE 13; present: the fixture agent is durable)
-    "nomad_wal_appends", "nomad_wal_snapshots", "nomad_wal_append_ms",
-    "nomad_wal_fsync_ms", "nomad_wal_snapshot_ms", "nomad_wal_log_bytes",
-    "nomad_wal_snapshot_bytes",
-}
 
-#: every family a series may legally belong to; a new prefix here is a
-#: conscious taxonomy extension
-ALLOWED_PREFIXES = (
-    "nomad_broker_",
-    "nomad_plan_apply_",
-    "nomad_eval_phase_",
-    "nomad_worker_",          # worker.<id>.batch.* coordinator stats
-    "nomad_pipeline_",
-    "nomad_view_",
-    "nomad_transfer_",
-    "nomad_scheduler_filter_",
-    "nomad_scheduler_exhausted_",
-    "nomad_scheduler_blocked_",
-    "nomad_rpc_",             # rpc.client.* transport latencies
-    "nomad_loop_errors_",     # ErrorStreak sinks
-    "nomad_hbm_",             # residency ledger (labeled + mirrors)
-    "nomad_drain_",           # drain-cadence mega-batching (ISSUE 12)
-    "nomad_wave_",            # wave-dispatch lane structure (ISSUE 12)
-    "nomad_wal_",             # WAL durability (ISSUE 13)
-    "nomad_heartbeat_",       # node TTL misses (ISSUE 13)
-    "nomad_flight_",          # flight-recorder event counters (ISSUE 13)
-    "nomad_raft_",            # raft registries (cluster agents; pinned
-                              # non-vacuously in TestControlPlaneSeries)
-)
 
-#: the only label names any exposed series may carry
-ALLOWED_LABELS = {"site", "quantile", "shard"}
-
-#: the transfer ledger's site vocabulary (the `site` label values) —
-#: renames here break `top_sites` dashboards exactly like metric renames
-ALLOWED_SITES = {
-    "stack.static_full", "stack.hot_full", "stack.hot_delta",
-    "stack.ports_full", "stack.ports_delta", "stack.ports_word_delta",
-    "select_batch.pack_buffers", "select_batch.fetch",
-    "select_batch.table_insert", "select_batch.dyn_rows",
-    "mesh.shard_cluster",
-    # HBM residency sites (lib/hbm.py; README residency-site table) —
-    # the `site` label is shared with the transfer families, so both
-    # vocabularies pin here
-    "stack.view_static", "stack.view_hot", "stack.view_ports",
-    "select_batch.batch_out", "select_batch.carry",
-    "program_table.i32", "program_table.f32", "program_table.u8",
-    "mesh.cluster",
-}
 
 
 def _parse(text):
@@ -262,6 +170,11 @@ class TestSeriesNameStability:
         _, labels, sites = _parse(api.metrics_prometheus())
         assert labels <= ALLOWED_LABELS, labels - ALLOWED_LABELS
         assert sites <= ALLOWED_SITES, sites - ALLOWED_SITES
+        # lint-side booking prefixes (hbm.track_cluster/lease) are NOT
+        # legal label values — a bare prefix leaking into the
+        # exposition must keep failing here
+        from nomad_tpu.analysis.vocab import BOOKING_PREFIXES
+        assert not (ALLOWED_SITES & BOOKING_PREFIXES)
         # the fused-dispatch sites must actually be present (the flow
         # above ran batched coordinator rounds on the device-resident
         # program-table transport)
@@ -289,18 +202,6 @@ class TestSeriesNameStability:
         assert snap["histograms"]["wave.lanes"]["max"] >= 2
 
 
-#: the raft node's promised series (ISSUE 13) — exposed from the NODE's
-#: own registry (it outlives the leadership-gated Server), so pinned
-#: against a live ClusterServer instead of the dev-agent fixture
-RAFT_REQUIRED = {
-    "nomad_raft_term", "nomad_raft_state", "nomad_raft_commit_index",
-    "nomad_raft_last_applied", "nomad_raft_log_last_index",
-    "nomad_raft_log_base_index", "nomad_raft_log_bytes",
-    "nomad_raft_peers", "nomad_raft_elections",
-    "nomad_raft_leadership_gained", "nomad_raft_leadership_lost",
-    "nomad_raft_snapshots", "nomad_raft_snapshot_installs",
-    "nomad_raft_commit_ms", "nomad_raft_apply_ms", "nomad_raft_append_ms",
-}
 
 
 class TestControlPlaneSeries:
