@@ -649,6 +649,7 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         led0 = default_ledger().snapshot()
         pipe0 = _pipeline_totals(s.metrics)
         drain0 = _drain_totals(s.metrics)
+        spec0 = s.metrics.counters(prefix="spec.")
         t0 = time.time()
         evals = []
         for job, scen in jobs:
@@ -746,6 +747,19 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
             f"leadership gained={control_tail['leadership']['gained']} "
             f"lost={control_tail['leadership']['lost']}; "
             f"flight events={control_tail['flight_events']}")
+        # speculative-dispatch tail (ISSUE 15): launch/certify/rollback
+        # outcomes of the measured window, the wasted-kernel cost of
+        # mispredictions, and a short bubble-trajectory A/B against
+        # NOMAD_TPU_SPECULATE=0 — did taking plan-apply latency off the
+        # dispatch path actually close the bubble on THIS host?
+        spec_tail = _e2e_spec(s, spec0, rng, count)
+        log(f"e2e: spec launches={spec_tail['launches']} "
+            f"certified={spec_tail['certified']} "
+            f"rolled_back={spec_tail['rolled_back']} "
+            f"redispatch={spec_tail['redispatch_programs']} "
+            f"wasted {spec_tail['wasted_kernel_ms']:.1f}ms; A/B bubble "
+            f"on={spec_tail['ab']['on']['bubble_ms_mean']} "
+            f"off={spec_tail['ab']['off']['bubble_ms_mean']}")
         drain_tail = _e2e_drain(s, drain0)
         log(f"e2e: drain width {drain_tail['batch_width_mean']:.1f} mean"
             f"/{drain_tail['batch_width_max_recent']:.0f} max "
@@ -808,7 +822,100 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # round-7 addendum): depth/age climbing while drain width is
         # flat means the broker, not the kernel, is the frontier
         "e2e_control": control_tail,
+        # speculative dispatch (ISSUE 15): certification outcomes,
+        # wasted-kernel cost, and the bubble A/B vs
+        # NOMAD_TPU_SPECULATE=0 — `bubble_ms` should approach 0 with
+        # speculation on while `wave.collisions` and
+        # `e2e_plan_partial_rate` stay flat (BASELINE.md round-8
+        # addendum explains the acceptance read)
+        "e2e_spec": spec_tail,
     }
+
+
+def _e2e_spec(s, spec0: dict, rng, count: int) -> dict:
+    """bench tail `e2e_spec` (ISSUE 15): speculative-dispatch outcomes
+    over the measured window (launch/certify/rollback counts, exact
+    re-dispatched program count, wasted kernel ms) plus a short
+    bubble-trajectory A/B — the same dc-pinned feed run once with
+    speculation on and once with NOMAD_TPU_SPECULATE=0, bubble_ms
+    measured per-arm from the dispatch timeline records (rolled-back
+    kernels excluded: wasted device time must not read as overlap)."""
+    import os
+
+    from nomad_tpu.server.select_batch import SPECULATE_ENV
+    from nomad_tpu.synth import synth_service_job
+
+    c1 = s.metrics.counters(prefix="spec.")
+
+    def delta(k: str) -> float:
+        # counters(prefix=) returns keys with the prefix STRIPPED
+        return round(c1.get(k, 0) - spec0.get(k, 0), 3)
+
+    out = {
+        "launches": int(delta("launches")),
+        "certified": int(delta("certified")),
+        "rolled_back": int(delta("rolled_back")),
+        "redispatch_programs": int(delta("redispatch_programs")),
+        "wasted_kernel_ms": delta("wasted_kernel_ms"),
+    }
+
+    def arm(enabled: bool, n: int = 48) -> dict:
+        from nomad_tpu.server.select_batch import SPEC_PARK_ENV
+
+        prev = os.environ.get(SPECULATE_ENV)
+        prev_park = os.environ.get(SPEC_PARK_ENV)
+        os.environ[SPECULATE_ENV] = "1" if enabled else "0"
+        # a loaded bench host parks slower than the 30ms default; the
+        # A/B instrument should measure speculation's EFFECT, not
+        # whether the rendezvous won a scheduling race
+        os.environ[SPEC_PARK_ENV] = "200"
+        try:
+            idx0 = s.timeline.last_index()
+            t0 = time.time()
+            evs = []
+            for i in range(n):
+                ev = s.job_register(synth_service_job(
+                    rng, count=count, datacenter=f"dc{1 + i % 3}"))
+                if ev is not None:
+                    evs.append(ev.id)
+            done = 0
+            for eid in evs:
+                got = s.wait_for_eval(
+                    eid, statuses=("complete", "failed", "blocked",
+                                   "cancelled"), timeout=120.0)
+                if got is not None:
+                    done += 1
+            dt = time.time() - t0
+            _idx, recs = s.timeline.records_after(idx0, timeout=0.0)
+            bub = [r["bubble_ms"] for r in recs
+                   if r["bubble_ms"] is not None
+                   and r.get("spec_outcome") != "rolled_back"]
+            return {
+                "evals": done,
+                "evals_per_sec": round(done / dt, 2) if dt else 0.0,
+                "dispatches": len(recs),
+                "speculative": sum(1 for r in recs
+                                   if r.get("speculative")),
+                "bubble_ms_mean": round(sum(bub) / len(bub), 3)
+                if bub else None,
+            }
+        finally:
+            if prev is None:
+                os.environ.pop(SPECULATE_ENV, None)
+            else:
+                os.environ[SPECULATE_ENV] = prev
+            if prev_park is None:
+                os.environ.pop(SPEC_PARK_ENV, None)
+            else:
+                os.environ[SPEC_PARK_ENV] = prev_park
+
+    # shared warmup (discarded), SAME width as the arms: the program
+    # shapes AND the batch-width chain bucket compile here, so neither
+    # arm pays cold XLA compiles — the A/B compares speculation, not
+    # compile order
+    arm(True)
+    out["ab"] = {"on": arm(True), "off": arm(False)}
+    return out
 
 
 def _drain_totals(reg) -> dict:

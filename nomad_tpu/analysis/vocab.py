@@ -40,6 +40,9 @@ FLIGHT_TYPES = frozenset({
     "hbm.stuck_lease",     # view lease older than the age watermark
     "wave.collisions",     # cross-lane row collision in a wave dispatch
     "membership.change",   # gossip member status transition
+    # speculative dispatch (ISSUE 15, server/select_batch.py)
+    "spec.rollback",       # certification rolled back speculative
+                           # program slices (conflicting commit)
 })
 
 # ---- Prometheus series names (tests/test_metrics_names.py) -----------------
@@ -90,6 +93,12 @@ PROM_REQUIRED = frozenset({
     "nomad_drain_groups", "nomad_drain_hold_ms", "nomad_drain_window_ms",
     # wave dispatch (ISSUE 12): lane structure of fused mega-batches
     "nomad_wave_dispatches", "nomad_wave_programs", "nomad_wave_lanes",
+    # speculative wave dispatch (ISSUE 15): launch/certify/rollback
+    # outcomes, exact re-dispatch counts, wasted device time — the
+    # BENCH_r08 e2e_spec tail and the adaptive gate read these
+    "nomad_spec_launches", "nomad_spec_certified",
+    "nomad_spec_rolled_back", "nomad_spec_redispatch_programs",
+    "nomad_spec_wasted_kernel_ms",
     # control-plane queue state (ISSUE 13): broker depths/ages + plan
     # pipeline depth/rejection rate — the soak-backpressure dashboards
     "nomad_broker_ready_depth", "nomad_broker_unacked_depth",
@@ -134,6 +143,7 @@ ALLOWED_PREFIXES = (
     "nomad_hbm_",             # residency ledger (labeled + mirrors)
     "nomad_drain_",           # drain-cadence mega-batching (ISSUE 12)
     "nomad_wave_",            # wave-dispatch lane structure (ISSUE 12)
+    "nomad_spec_",            # speculative dispatch outcomes (ISSUE 15)
     "nomad_wal_",             # WAL durability (ISSUE 13)
     "nomad_heartbeat_",       # node TTL misses (ISSUE 13)
     "nomad_flight_",          # flight-recorder event counters (ISSUE 13)

@@ -271,13 +271,19 @@ class DispatchTimeline:
                pack: Tuple[float, float], view: Tuple[float, float],
                kernel_start: float, transfer_bytes: int,
                transfer_count: int,
-               upload: Optional[Tuple[float, float]] = None) -> int:
+               upload: Optional[Tuple[float, float]] = None,
+               speculative: bool = False) -> int:
         """Append a dispatch record at kernel launch; returns its seq.
         `pack`/`upload`/`view` are monotonic (start, end) intervals —
         `upload` is the explicit packed-buffer host→device transfer
         between pack and view (zero-length when absent), kept as its
         own phase so the tunnel-RTT cost ISSUE 6 chases lands in a
-        named bucket instead of leaking into bubble_ms."""
+        named bucket instead of leaking into bubble_ms.
+
+        `speculative` marks a dispatch launched against the predicted
+        post-commit view (ISSUE 15); its outcome arrives later via
+        `spec_resolve` and a rolled-back kernel is accounted as WASTED
+        device time, never as useful overlap."""
         if upload is None:
             upload = (pack[1], pack[1])
         reg = self.registry
@@ -294,6 +300,8 @@ class DispatchTimeline:
                 "transfer_bytes": int(transfer_bytes),
                 "transfer_count": int(transfer_count),
                 "overlap_ms": None, "bubble_ms": None,
+                "speculative": bool(speculative),
+                "spec_outcome": None,
             }
             self._ring.append(rec)
             self._finalize_locked(seq)
@@ -341,6 +349,41 @@ class DispatchTimeline:
                 reg.inc("pipeline.transfer_bytes", fetch_bytes)
                 reg.inc("pipeline.transfer_count", fetch_count)
 
+    def spec_resolve(self, seq: int, outcome: str,
+                     wasted_frac: Optional[float] = None) -> None:
+        """Certification verdict for a speculative dispatch record:
+        "certified" (results adopted — the overlap it bought is real)
+        or "rolled_back" with `wasted_frac` = the rolled-back share of
+        its programs (1.0 when omitted). The wasted share of the kernel
+        is summed into the summary's `spec.wasted_kernel_ms`; a FULLY
+        rolled-back record leaves the overlap/bubble aggregates (its
+        kernel hid nothing useful), a partial one stays — its certified
+        slices made the kernel's overlap real work. Resolution happens
+        BEFORE any successor record commits (the coordinator certifies
+        before it offers the next launch), so successor finalization
+        sees the verdict. No-op for evicted records."""
+        reg = self.registry
+        with self._cv:
+            rec = self._find_locked(seq)
+            if rec is None:
+                return
+            rec["spec_outcome"] = outcome
+            frac = 0.0
+            if outcome == "rolled_back":
+                frac = 1.0 if wasted_frac is None else \
+                    min(max(float(wasted_frac), 0.0), 1.0)
+            rec["spec_wasted_frac"] = frac
+            if frac >= 1.0 and rec["overlap_ms"] is not None:
+                # its own host-side prep hid under the predecessor's
+                # kernel, but it produced nothing adopted — that hiding
+                # bought nothing
+                rec["overlap_ms"] = 0.0
+            self._cv.notify_all()
+        if reg is not None:
+            reg.inc("pipeline.spec_certified"
+                    if outcome == "certified"
+                    else "pipeline.spec_rolled_back")
+
     def _find_locked(self, seq: int) -> Optional[dict]:
         # recent seqs live at the right end; scan backwards
         for rec in reversed(self._ring):
@@ -370,6 +413,11 @@ class DispatchTimeline:
             return
         overlap = (min(rec["view_end"], prev["kernel_end"])
                    - max(rec["pack_start"], prev["kernel_start"]))
+        if prev.get("spec_outcome") == "rolled_back" \
+                and prev.get("spec_wasted_frac", 1.0) >= 1.0:
+            # host work hidden under a FULLY wasted kernel is not a
+            # pipelining win — the attribution stays honest
+            overlap = 0.0
         rec["overlap_ms"] = round(max(overlap, 0.0) * 1e3, 3)
         rec["bubble_ms"] = round(max(
             rec["kernel_start"] - prev["kernel_end"], 0.0) * 1e3, 3)
@@ -403,6 +451,9 @@ class DispatchTimeline:
             "kernel_ms": ms(rec["kernel_start"], rec["kernel_end"]),
             "overlap_ms": rec["overlap_ms"],
             "bubble_ms": rec["bubble_ms"],
+            "speculative": rec.get("speculative", False),
+            "spec_outcome": rec.get("spec_outcome"),
+            "spec_wasted_frac": rec.get("spec_wasted_frac"),
             "transfer_bytes": rec["transfer_bytes"],
             "transfer_count": rec["transfer_count"],
             # pre-kernel host side total; with kernel_ms and bubble_ms
@@ -440,15 +491,37 @@ class DispatchTimeline:
             recs = [self._export(r) for r in self._ring]
             seq = self._seq
         n = len(recs)
-        paired = [r for r in recs if r["overlap_ms"] is not None]
+        # rolled-back speculative work is wasted device time: each
+        # record's kernel contributes its ROLLED SHARE to the wasted
+        # sum, and only FULLY rolled-back records leave the
+        # overlap/bubble aggregates (a partially certified dispatch's
+        # kernel did real work)
+        def _frac(r):
+            f = r["spec_wasted_frac"]
+            return 1.0 if f is None else f
+
+        rolled = [r for r in recs if r["spec_outcome"] == "rolled_back"]
+        paired = [r for r in recs if r["overlap_ms"] is not None
+                  and not (r["spec_outcome"] == "rolled_back"
+                           and _frac(r) >= 1.0)]
         pack_ms = sum(r["host_ms"] or 0.0 for r in paired)
         overlap = sum(r["overlap_ms"] for r in paired)
         bubble = sum(r["bubble_ms"] for r in paired)
         kernel = [r["kernel_ms"] for r in recs
                   if r["kernel_ms"] is not None]
+        spec = {
+            "launched": sum(1 for r in recs if r["speculative"]),
+            "certified": sum(1 for r in recs
+                             if r["spec_outcome"] == "certified"),
+            "rolled_back": len(rolled),
+            "wasted_kernel_ms": round(
+                sum((r["kernel_ms"] or 0.0) * _frac(r)
+                    for r in rolled), 3),
+        }
         return {
             "last_seq": seq,
             "dispatches": n,
+            "spec": spec,
             "overlap_pct": round(100.0 * overlap / pack_ms, 2)
             if pack_ms else 0.0,
             "overlap_ms_total": round(overlap, 3),

@@ -265,12 +265,325 @@ def carry_predicted(cluster, token, predicted: Dict[str, set]) -> None:
     """Second half of the carry note, filled when the dispatch's outputs
     land host-side (the first _BatchOut resolver): per-eval node rows
     the kernel actually selected. Until this arrives the carry is not
-    adoptable — an unresolved dispatch has unprovable placements."""
+    adoptable — an unresolved dispatch has unprovable placements.
+
+    The speculative-dispatch chain (below) holds its own carry records
+    keyed by the same tokens; the fill reaches whichever bookkeeping
+    still knows the token — a refresh may have popped the cache note
+    while the chain still needs the prediction for certification."""
     with _DEV_CACHE_LOCK:
         ent = _DEV_CACHE.get(cluster)
         c = ent.get("carry") if ent is not None else None
         if c is not None and c["token"] == token:
             c["predicted"] = predicted
+        with _SPEC_LOCK:
+            chain = _SPEC_CHAINS.get(cluster)
+            if chain is not None:
+                rec = chain["expect"].get(token)
+                if rec is None and chain["head"] is not None \
+                        and chain["head"]["token"] == token:
+                    rec = chain["head"]
+                if rec is not None:
+                    rec["predicted"] = predicted
+
+
+# ---- speculative dispatch chain (ISSUE 15) ---------------------------------
+# The SelectCoordinator can launch dispatch k+1 against the PREDICTED
+# post-commit view while dispatch k's plans are still committing: the
+# predicted view is the base view with (used, dyn_free) swapped for the
+# predecessor's device-resident chain carry — a pure buffer recombination,
+# zero transfer, and on device the data dependency makes XLA queue kernel
+# k+1 right behind kernel k (bubble_ms → 0). The chain records, per
+# cluster, WHAT the speculative view assumed (which dispatch tokens'
+# carries it folded in, their per-eval predicted placement rows, their
+# stop rows) and accumulates a STALE-ROW set: every row where the chained
+# view may diverge from the committed host truth. Certification
+# (select_batch.SelectCoordinator._certify_spec) then keeps a program's
+# speculative result only when its node footprint avoids every stale row
+# — which makes the result bit-identical to what a sequential dispatch
+# against the committed view would have produced (the same superset
+# argument the wave-lane partition rests on).
+#
+# Lock order: _DEV_CACHE_LOCK → _SPEC_LOCK. _SPEC_LOCK is otherwise a
+# leaf (the plan-window observer takes it under the store's mutation
+# lock and calls nothing further).
+
+#: cluster → chain state dict; weak so dead clusters free their carries
+_SPEC_CHAINS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_SPEC_LOCK = threading.Lock()
+
+
+def _spec_carry_rec(token, evals, stop_rows, used, dyn_free,
+                    predicted=None) -> dict:
+    return {"token": token, "evals": set(evals),
+            "stops": {int(r) for r in stop_rows},
+            "used": used, "dyn_free": dyn_free, "predicted": predicted}
+
+
+def spec_chain_view(cluster, lease_token) -> Optional[ClusterArrays]:
+    """Predicted post-commit view for a speculative dispatch, or None
+    when nothing is predictable (no carry note, an interleaved refresh,
+    a node-set change). The view is the chain head's (used, dyn_free)
+    carry over the chain base's static/ports buffers — the 'third
+    buffer slot' next to the double-buffered real views. `lease_token`
+    is registered on the cached entry ATOMICALLY with the read, so a
+    concurrent refresh copies into a fresh slot instead of donating the
+    base buffers out from under the speculative kernel.
+
+    Does NOT advance the chain: a caller that aborts after this (table
+    residency miss, caps flush race) only has to release the lease."""
+    from ..lib.hbm import default_hbm
+
+    with _DEV_CACHE_LOCK:
+        ent = _DEV_CACHE.get(cluster)
+        if ent is None:
+            return None
+        arrays = ent["arrays"]
+        with _SPEC_LOCK:
+            chain = _SPEC_CHAINS.get(cluster)
+            if chain is not None and (
+                    chain["base_arrays"] is not arrays
+                    or chain["static_key"] != ent["static_key"]
+                    or chain["node_version"] != cluster.node_version):
+                # a real refresh (or node churn) interleaved: the chain's
+                # base is gone — certification could no longer prove
+                # anything against it
+                _spec_reset_locked(cluster, chain)
+                chain = None
+            if chain is None:
+                # seed from the live carry note of the last REAL
+                # dispatch (note_dispatch_carry guarantees base
+                # identity at write; any refresh since rebuilt arrays
+                # and was caught above)
+                c = ent.get("carry")
+                if c is None or c["base_arrays"] is not arrays:
+                    return None
+                chain = {
+                    "base_arrays": arrays,
+                    "static_key": ent["static_key"],
+                    "node_version": cluster.node_version,
+                    "checked_version": ent["version"],
+                    "checked_ports": ent["ports_version"],
+                    "stale": set(),
+                    "expect": {},
+                    "windows": [],
+                    "last_rejected": set(),
+                    "head": _spec_carry_rec(
+                        c["token"], c["evals"], c["stop_rows"],
+                        c["used"], c["dyn_free"],
+                        predicted=c["predicted"]),
+                }
+                _SPEC_CHAINS[cluster] = chain
+                _install_window_observer(cluster)
+            head = chain["head"]
+            if head is None:
+                return None
+        ent.setdefault("leases", set()).add(lease_token)
+        default_hbm().lease(lease_token, "stack.view")
+        return ClusterArrays(
+            capacity=arrays.capacity,
+            used=head["used"],
+            node_ok=arrays.node_ok,
+            attrs=arrays.attrs,
+            ports_used=arrays.ports_used,
+            dyn_free=head["dyn_free"],
+        )
+
+
+def spec_chain_advance(cluster, token, evals, stop_rows, used,
+                       dyn_free) -> None:
+    """A speculative dispatch launched successfully against the chain
+    view: fold the previous head into the EXPECTED set (its plans are
+    now committing — certification will match their commit windows) and
+    install the new dispatch's carry as the head. The folded head's
+    stop rows go stale immediately: the chain view bakes their
+    plan-relative delta subtraction into `used` but deliberately does
+    not model their port credits (the same reason adoption always
+    overlays them)."""
+    with _SPEC_LOCK:
+        chain = _SPEC_CHAINS.get(cluster)
+        if chain is None:
+            return
+        head = chain["head"]
+        if head is not None:
+            chain["expect"][head["token"]] = head
+            chain["stale"].update(head["stops"])
+        chain["head"] = _spec_carry_rec(token, evals, stop_rows, used,
+                                        dyn_free)
+
+
+def spec_chain_certify(cluster) -> Optional[frozenset]:
+    """Fold every commit since the last certification into the chain's
+    stale-row set and return it (cumulative). Returns None when the
+    chain cannot prove anything — an interleaved refresh, node churn,
+    a delta-log window miss, or an expected dispatch whose outputs
+    never resolved — in which case the caller must roll back every
+    speculative result and reset the chain.
+
+    Soundness: stale is a SUPERSET of the rows where the chain view may
+    diverge from the committed host state. A row change is non-stale
+    only when it happened inside a clean+exact plan window of an
+    EXPECTED dispatch token, for an eval that dispatch chained, on a
+    row that dispatch predicted (its kernel placement) — exactly the
+    changes whose post-commit values the chain carry already holds
+    bit-identically (structs.Plan.carry_exact). Everything else —
+    foreign mutations, partial commits, retry plans under other
+    tokens, phantom placements of uncommitted evals, any port-bitmap
+    mutation (never modeled by the carry) — goes stale and stays
+    stale for the life of the chain."""
+    cl = cluster
+    with _DEV_CACHE_LOCK:
+        ent = _DEV_CACHE.get(cl)
+        arrays = ent["arrays"] if ent is not None else None
+        static_key = ent["static_key"] if ent is not None else None
+        with _SPEC_LOCK:
+            chain = _SPEC_CHAINS.get(cl)
+            if chain is None:
+                return None
+            if (arrays is not chain["base_arrays"]
+                    or static_key != chain["static_key"]
+                    or cl.node_version != chain["node_version"]):
+                return None
+            # version-chain discipline (the device_arrays contract):
+            # capture the version BEFORE reading the logs and advance
+            # checked_* only to the CAPTURED values. Mutators append
+            # their log entry before bumping, so every entry describing
+            # a version ≤ the capture is in the copy below; a mutation
+            # landing mid-certify has ver > v_now and is examined next
+            # time — never silently skipped.
+            v_now = cl.version
+            p_now = cl.ports_version
+            hot = cl.hot_entries_since(chain["checked_version"], cl.n_cap)
+            if hot is None:
+                return None
+            hot = [(ver, rows) for ver, rows in hot if ver <= v_now]
+            ports = cl.port_words_since(chain["checked_ports"], cl.n_cap)
+            if ports is None:
+                return None
+            # windows: observer-captured ∪ ring — the observer survives
+            # ring wrap, the ring covers windows marked before the
+            # observer was installed
+            seen = set()
+            windows = []
+            for w in (chain["windows"]
+                      + cl.plan_windows_since(chain["checked_version"])):
+                k = (w[0], w[1], w[2], w[4])
+                if k not in seen:
+                    seen.add(k)
+                    windows.append(w)
+            chain["windows"] = []
+            expect = chain["expect"]
+            stale = chain["stale"]
+            # optimistic-rejection diagnostics: the rows whose
+            # placements verification dropped this interval — surfaced
+            # in the spec.rollback flight detail (their staleness is
+            # already covered by the predicted-uncovered rule)
+            chain["last_rejected"] = {
+                int(r) for w in windows if w[5] for r in w[5]}
+            covered = set()   # (eval_id, token) committed clean+exact
+            for _lo, _hi, eid, ok, tok, _rej in windows:
+                if ok and tok in expect and eid in expect[tok]["evals"]:
+                    covered.add((eid, tok))
+            allowed_rows: Dict[int, set] = {}
+            for tok, rec in expect.items():
+                pred = rec["predicted"]
+                if pred is None:
+                    # expected dispatch never resolved its outputs: its
+                    # placements are unprovable
+                    return None
+                rows_ok = set(rec["stops"])
+                for eid, rows in pred.items():
+                    if rows and (eid, tok) not in covered:
+                        # phantom placements: the carry baked them in,
+                        # no clean+exact commit vouches for them
+                        stale.update(rows)
+                    else:
+                        rows_ok.update(rows)
+                allowed_rows[tok] = rows_ok
+            for ver, rows in hot:
+                w = None
+                for v_lo, v_hi, eid, ok, tok, _rej in windows:
+                    if v_lo < ver <= v_hi:
+                        w = (eid, ok, tok)
+                        break
+                if w is None:
+                    stale.update(rows)      # foreign mutation
+                    continue
+                eid, ok, tok = w
+                if not (ok and tok in expect and (eid, tok) in covered):
+                    stale.update(rows)      # partial/inexact/other-token
+                    continue
+                stale.update(r for r in rows
+                             if r not in allowed_rows[tok])
+            # the carry never models the port bitmap: every touched
+            # port row diverges from the chain view's base ports
+            # (entries past the p_now capture are examined again next
+            # certify — stale is a set, re-adding is idempotent)
+            stale.update(int(r) for r in ports)
+            chain["checked_version"] = v_now
+            chain["checked_ports"] = p_now
+            # expected tokens are single-shot: their plans all committed
+            # before this certification ran (the worker finishes batch k
+            # before it certifies batch k+1), so their windows were in
+            # THIS interval and must not be re-judged against the next
+            chain["expect"] = {}
+            return frozenset(stale)
+
+
+def spec_chain_reset(cluster) -> None:
+    """Drop the chain (rollback, refresh, shutdown): carries are
+    released with their last reference, the window observer detaches."""
+    with _SPEC_LOCK:
+        chain = _SPEC_CHAINS.get(cluster)
+        if chain is not None:
+            _spec_reset_locked(cluster, chain)
+
+
+def spec_chain_head_token(cluster) -> Optional[int]:
+    """Token of the chain's current head carry (None when no chain) —
+    test/introspection surface."""
+    with _SPEC_LOCK:
+        chain = _SPEC_CHAINS.get(cluster)
+        head = chain["head"] if chain is not None else None
+        return head["token"] if head is not None else None
+
+
+def spec_chain_last_rejected(cluster) -> frozenset:
+    """Node rows whose placements optimistic verification dropped in
+    the last certified interval (plan_apply's rejected_rows) — the
+    rollback flight detail names the rows that caused the conflict."""
+    with _SPEC_LOCK:
+        chain = _SPEC_CHAINS.get(cluster)
+        if chain is None:
+            return frozenset()
+        return frozenset(chain.get("last_rejected") or ())
+
+
+def _spec_reset_locked(cluster, chain) -> None:
+    chain["head"] = None
+    chain["expect"] = {}
+    chain["windows"] = []
+    _SPEC_CHAINS.pop(cluster, None)
+    if getattr(cluster, "plan_window_observer", None) is not None:
+        cluster.plan_window_observer = None
+
+
+def _install_window_observer(cluster) -> None:
+    """Commit-window → certification callback (tensor/cluster.py):
+    windows reach the chain as they are marked, under the commit lock,
+    so certification never depends on the bounded ring retaining them."""
+    ref = weakref.ref(cluster)
+
+    def _obs(rec):
+        cl = ref()
+        if cl is None:
+            return
+        with _SPEC_LOCK:
+            chain = _SPEC_CHAINS.get(cl)
+            if chain is not None:
+                chain["windows"].append(rec)
+
+    cluster.plan_window_observer = _obs
 
 
 class TPUStack:
@@ -607,7 +920,7 @@ class TPUStack:
         uncovered_rows: set = set()
         for ver, rs in hot_entries:
             cov = False
-            for v_lo, v_hi, eid, ok, w_tok in windows:
+            for v_lo, v_hi, eid, ok, w_tok, _rej in windows:
                 if v_lo < ver <= v_hi:
                     cov = (ok and w_tok == token
                            and eid in covered_evals)

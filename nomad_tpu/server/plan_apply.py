@@ -393,13 +393,19 @@ class PlanApplier:
         cl = getattr(self.state, "cluster", None)
         if (cl is not None and getattr(self.state, "raft", None) is None
                 and hasattr(self.state, "mutation_lock")):
+            # rejected node ids → rows: the certification observer
+            # (speculative dispatch, ISSUE 15) attributes a rollback to
+            # the rows whose placements verification dropped
+            rej_rows = [r for r in (cl.row_of.get(nid) for nid in rejected)
+                        if r is not None] if rejected else None
             with self.state.mutation_lock():
                 v_lo = cl.version
                 self.state.upsert_plan_results(plan, result)
                 cl.mark_plan_window(
                     plan.eval_id, v_lo, cl.version, clean=not partial,
                     exact=bool(getattr(plan, "carry_exact", False)),
-                    token=getattr(plan, "carry_token", None))
+                    token=getattr(plan, "carry_token", None),
+                    rejected_rows=rej_rows)
         else:
             self.state.upsert_plan_results(plan, result)
         result.alloc_index = self.state.index.value

@@ -68,6 +68,62 @@ class ServerConfig:
         self.mesh = mesh
 
 
+#: constraint operands the footprint estimator can evaluate statically
+#: per distinct vocab value (cheap, no regex/version parsing per node)
+_FOOTPRINT_OPS = frozenset({
+    "=", "==", "is", "!=", "not", "set_contains", "set_contains_all",
+    "set_contains_any", "is_set", "is_not_set",
+})
+
+
+def _constraint_mask(cl, attrs, constraints, n):
+    """Superset row mask for a list of constraints: every row a program
+    compiled from `constraints` could ever select passes the mask
+    (`Server._eval_footprint`'s widened narrowing step). Evaluates each
+    simple constraint per DISTINCT vocab value with the scalar oracle
+    the LUT compile itself uses (`check_constraint`) — so `!=`
+    missing-ness, `set_contains` over comma-lists, and `is_set` all
+    match LUT semantics instead of re-deriving them — then gathers the
+    verdicts through the tokenized attrs column. Rows whose token
+    post-dates the vocab snapshot (concurrent growth) always pass:
+    a footprint may only ever be too wide, never too narrow."""
+    import numpy as np
+
+    from ..tensor.constraints import check_constraint
+    from ..tensor.vocab import MISSING, target_to_key
+
+    mask = np.ones(n, dtype=bool)
+    for c in constraints:
+        if c.operand not in _FOOTPRINT_OPS:
+            continue
+        r = str(c.rtarget) if c.rtarget is not None else ""
+        if "${" in r:
+            continue  # interpolated target: not statically evaluable
+        key = target_to_key(c.ltarget)
+        if key is None or key == "__unresolvable__":
+            continue
+        k = cl.vocab.lookup_key(key)
+        if k < 0 or k >= attrs.shape[1]:
+            # key never tokenized: every node reads as missing
+            if not check_constraint(c.operand, None, r, False, True):
+                mask &= False
+            continue
+        vals = list(cl.vocab.key_vocabs[k].values)
+        ok_toks = np.fromiter(
+            (check_constraint(c.operand, v, r, True, True)
+             for v in vals), dtype=bool, count=len(vals))
+        missing_ok = check_constraint(c.operand, None, r, False, True)
+        col = attrs[:, k]
+        cm = np.zeros(n, dtype=bool)
+        known = (col >= 0) & (col < len(vals))
+        cm[known] = ok_toks[col[known]]
+        cm |= col >= len(vals)          # token newer than the snapshot
+        if missing_ok:
+            cm |= col == MISSING
+        mask &= cm
+    return mask
+
+
 class Server:
     def __init__(self, config: Optional[ServerConfig] = None,
                  state: Optional[StateStore] = None) -> None:
@@ -237,8 +293,19 @@ class Server:
             is one of the job's datacenters (the first feasibility gate
             `compile_constraints` bakes into the LUT — every selectable
             node passes it);
-          - simple job-level equality constraints on already-tokenized
-            keys narrow it further (`${node.class} = x` and friends);
+          - simple value constraints on already-tokenized keys narrow
+            it further — `=`/`!=`/`set_contains[_any|_all]`/`is_set`
+            over static targets (`${node.class} = x` and friends),
+            evaluated per DISTINCT vocab value with the same scalar
+            oracle the LUT compile uses, so multi-valued attrs and
+            missing-ness semantics match exactly. Both job-level and
+            task-group/task-level constraints take part: the eval's
+            read set is the UNION over its task groups of each group's
+            narrowed mask (a node only one group could select is still
+            in the eval's footprint — a node no group could select is
+            not). A job with no datacenter list but a narrowing
+            node-class (or any simple) constraint now gets a real
+            footprint instead of conflicting with everything;
           - ∪ rows of the job's CURRENT allocs — stops/preemptions/
             migrations and their resource/port deltas land there;
           - ∪ the eval's own node row (node-update/drain triggers).
@@ -257,33 +324,34 @@ class Server:
         attrs = cl.attrs  # one reference; concurrent growth swaps arrays
         n = attrs.shape[0]
         job = self.state.job_by_id(ev.namespace, ev.job_id)
-        if job is not None and job.datacenters:
-            k_dc = cl.vocab.lookup_key("node.datacenter")
-            if k_dc < 0 or k_dc >= attrs.shape[1]:
+        if job is not None:
+            if job.datacenters:
+                k_dc = cl.vocab.lookup_key("node.datacenter")
+                if k_dc < 0 or k_dc >= attrs.shape[1]:
+                    return None
+                kv = cl.vocab.key_vocabs[k_dc]
+                toks = [t for t in (kv.lookup(dc)
+                                    for dc in job.datacenters)
+                        if t >= 0]
+                col = attrs[:, k_dc]
+                mask = (np.isin(col, toks) if toks
+                        else np.zeros(n, dtype=bool))
+            else:
+                mask = np.ones(n, dtype=bool)
+            mask &= _constraint_mask(cl, attrs, job.constraints, n)
+            tg_union = None
+            for tg in job.task_groups:
+                cons = list(tg.constraints)
+                for t in tg.tasks:
+                    cons.extend(t.constraints)
+                m = _constraint_mask(cl, attrs, cons, n)
+                tg_union = m if tg_union is None else (tg_union | m)
+            if tg_union is not None:
+                mask &= tg_union
+            if not job.datacenters and bool(mask.all()):
+                # no datacenter list and nothing narrowed = every node
+                # is a candidate; nothing cheap bounds the read set
                 return None
-            kv = cl.vocab.key_vocabs[k_dc]
-            toks = [t for t in (kv.lookup(dc) for dc in job.datacenters)
-                    if t >= 0]
-            col = attrs[:, k_dc]
-            mask = np.isin(col, toks) if toks else np.zeros(n, dtype=bool)
-            from ..tensor.vocab import target_to_key
-
-            for c in job.constraints:
-                if c.operand != "=" or not c.rtarget \
-                        or "${" in str(c.rtarget):
-                    continue
-                key = target_to_key(c.ltarget)
-                if key is None or key == "__unresolvable__":
-                    continue
-                k = cl.vocab.lookup_key(key)
-                if k < 0 or k >= attrs.shape[1]:
-                    continue
-                tok = cl.vocab.key_vocabs[k].lookup(str(c.rtarget))
-                mask &= attrs[:, k] == tok
-        elif job is not None:
-            # no datacenter list = every node is a candidate; nothing
-            # cheap bounds the read set
-            return None
         else:
             # job gone (deregister/stop evals): only the current alloc
             # rows can be touched

@@ -213,6 +213,16 @@ class Worker:
                     started = self.start_batch(batch, group_of=group_of)
                     batch = None
                 if inflight is not None:
+                    if started is not None:
+                        # speculative dispatch (ISSUE 15): when batch
+                        # k's fused dispatch launches inside
+                        # finish_batch below, it offers batch k+1 a
+                        # speculative launch against its predicted
+                        # carry — k+1's kernel queues behind k's on
+                        # device while k's plans commit; k+1's
+                        # coordinator certifies at the top of its own
+                        # finish_batch, after every k plan committed
+                        inflight[0].successor = started[0]
                     self.finish_batch(*inflight)
                     inflight = None
                 if started is not None:
@@ -363,11 +373,30 @@ class Worker:
                                   timeline=getattr(self.server,
                                                    "timeline", None),
                                   registry=self.metrics)
+        # per-program footprint masks for speculative certification
+        # (select_batch._certify_spec): the same estimator the broker
+        # partitions with, re-read at batch start so the mask reflects
+        # this batch's state. None (no estimator / nothing cheap bounds
+        # the eval) conflicts with every stale row — sound, never fast.
+        # Skipped entirely when speculation can never run (hard opt-out
+        # or an active mesh): masks nobody reads are pure batch-start
+        # latency.
+        from ..parallel.mesh import get_active_mesh
+        from .select_batch import spec_enabled
+
+        fp_fn = (getattr(self.server, "_eval_footprint", None)
+                 if spec_enabled() and get_active_mesh() is None
+                 else None)
         futs = []
         for order, (ev, tok) in enumerate(items):
             coord.trace_ids[order] = ev.id
             if group_of is not None:
                 coord.group_ids[order] = group_of[order]
+            if fp_fn is not None:
+                try:
+                    coord.footprints[order] = fp_fn(ev)
+                except Exception:  # noqa: BLE001 — estimate only
+                    coord.footprints[order] = None
             coord.add_thread()
             try:
                 futs.append(pool.submit(

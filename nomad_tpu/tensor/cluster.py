@@ -153,7 +153,18 @@ class ClusterTensors:
         # whose earlier dispatch never committed can't whitewash that
         # dispatch's phantom placements into an adoption.
         self._plan_windows: Deque[Tuple[int, int, str, bool,
-                                        Optional[int]]] = deque()
+                                        Optional[int],
+                                        Optional[frozenset]]] = deque()
+        #: commit-window → certification callback (speculative dispatch,
+        #: ISSUE 15): when set, every mark_plan_window call ALSO hands
+        #: the full window record to this observer, synchronously and
+        #: under the same commit lock. The speculative-dispatch chain
+        #: (scheduler/stack.py spec_chain_*) installs it so commit
+        #: verdicts reach certification even after the bounded ring
+        #: wraps — the ring is a telemetry window, the observer is the
+        #: certification feed. Must be cheap and non-blocking (it runs
+        #: inside the store's mutation lock).
+        self.plan_window_observer = None
 
     # ---- plan-commit windows ----
 
@@ -161,20 +172,33 @@ class ClusterTensors:
 
     def mark_plan_window(self, eval_id: str, v_lo: int, v_hi: int,
                         clean: bool, exact: bool,
-                        token: Optional[int] = None) -> None:
+                        token: Optional[int] = None,
+                        rejected_rows=None) -> None:
         """Record that versions (v_lo, v_hi] were one plan's commit.
         MUST be called under the same lock as the commit itself — a
         foreign mutation interleaving into the window would be
-        mis-attributed as kernel-committed."""
+        mis-attributed as kernel-committed. `rejected_rows` names the
+        node rows whose placements the optimistic verification dropped
+        (partial commits): certification reports them in the rollback
+        flight detail, so a speculation storm is attributable to the
+        rows that caused it."""
+        rej = (frozenset(rejected_rows) if rejected_rows else None)
+        rec = (v_lo, v_hi, eval_id, bool(clean and exact), token, rej)
         log = self._plan_windows
         if len(log) >= self.PLAN_WINDOW_LEN:
             log.popleft()
-        log.append((v_lo, v_hi, eval_id, bool(clean and exact), token))
+        log.append(rec)
+        obs = self.plan_window_observer
+        if obs is not None:
+            try:
+                obs(rec)
+            except Exception:  # noqa: BLE001 — certification bookkeeping
+                pass           # must never fail a plan commit
 
     def plan_windows_since(self, v0: int):
-        """[(v_lo, v_hi, eval_id, covered, token)] for windows
-        overlapping (v0, version]. `covered` folds clean+exact: True
-        means every row change inside the window matches what the
+        """[(v_lo, v_hi, eval_id, covered, token, rejected_rows)] for
+        windows overlapping (v0, version]. `covered` folds clean+exact:
+        True means every row change inside the window matches what the
         committing eval's kernel dispatch predicted; `token` names that
         dispatch."""
         return [w for w in list(self._plan_windows) if w[1] > v0]

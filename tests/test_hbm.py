@@ -329,6 +329,80 @@ class TestLeakGate:
             base["select_batch.batch_out"]["allocs"]
         assert led.lease_high_water >= 1
 
+    def test_rolled_back_speculation_leaks_nothing(self, monkeypatch):
+        """ISSUE 15 extension of the leak gate: a speculative dispatch
+        that certification ROLLS BACK in full (foreign conflict, no
+        usable footprints) must leave zero outstanding leases, zero
+        unfreed carries or lazy outputs, and per-site live-bytes back
+        at the pre-speculation baseline — the rollback path frees
+        everything the launch booked, under transfer-guard disallow."""
+        import tests.test_spec as tsp
+        from nomad_tpu.scheduler import stack as stack_mod
+        from nomad_tpu.server.select_batch import SelectCoordinator
+
+        led = _fresh_global_ledger(monkeypatch)
+        monkeypatch.setenv("NOMAD_TPU_SPEC_ROLLBACK_MAX", "1.0")
+        cl = tsp._dc_cluster()
+        reg = MetricsRegistry()
+        # round 0: warm compiles, fully committed + consumed — the
+        # QUIESCED baseline (no in-flight carry) the end state must
+        # return to
+        _c0, res0 = tpt._run_round(
+            cl, [tsp._dc_job("dc1"), tsp._dc_job("dc2")],
+            eval_ids=["w1", "w2"])
+        tpt._commit_round(cl, res0, ["w1", "w2"])
+        _view_stack(cl).device_arrays()
+        res0 = None
+        gc.collect()
+        base = led.snapshot()
+        base_live = led.totals()[0]
+        assert led.outstanding_leases() == 0
+        # round 1: leaves the carry note the speculation chain seeds on
+        _c1, res1 = tpt._run_round(
+            cl, [tsp._dc_job("dc1"), tsp._dc_job("dc2")],
+            eval_ids=["a", "b"])
+
+        monkeypatch.setenv("NOMAD_TPU_TRANSFER_GUARD", "disallow")
+        coord2 = SelectCoordinator(registry=reg)
+        coord2.trace_ids = {0: "c", 1: "d"}
+        coord2.group_ids = {0: 0, 1: 1}
+        # NO footprints: every program conflicts with any stale row —
+        # the foreign commit below forces a FULL rollback
+        threads, res2 = tsp._start_parked(
+            cl, [tsp._dc_job("dc1", cpu=250),
+                 tsp._dc_job("dc2", cpu=250)], coord2)
+        assert coord2.try_spec_launch(cl)
+        tpt._commit_round(cl, res1, ["a", "b"])
+        dc1_node = next(nid for nid in cl.row_of
+                        if cl.nodes[nid].datacenter == "dc1")
+        cl.upsert_alloc(tsp._foreign_alloc(dc1_node))
+        coord2.run()
+        for t in threads:
+            t.join(30.0)
+        monkeypatch.delenv("NOMAD_TPU_TRANSFER_GUARD")
+        assert reg.counters().get("spec.rolled_back") == 1
+        assert reg.counters().get("spec.redispatch_programs") == 2
+        assert all(res2[i][0][0] is not None for i in res2)
+
+        # commit the re-dispatched placements and consume the
+        # re-dispatch's in-flight carry, then drop transients
+        tpt._commit_round(cl, {i: (r[0], r[2], r[3])
+                               for i, r in res2.items()}, ["c", "d"])
+        _view_stack(cl).device_arrays()
+        res1 = res2 = None
+        gc.collect()
+        assert led.outstanding_leases() == 0, "leaked spec view lease"
+        snap = led.snapshot()
+        assert snap.get("select_batch.carry", {}).get(
+            "live_bytes", 0) == 0, "unfreed speculative carry"
+        assert snap.get("select_batch.batch_out", {}).get(
+            "live_bytes", 0) == 0, "unresolved speculative outputs"
+        for site, row in sorted(snap.items()):
+            assert row["live_bytes"] == base.get(site, {}).get(
+                "live_bytes", 0), f"residency grew at {site}"
+        assert led.totals()[0] == base_live
+        assert stack_mod.spec_chain_head_token(cl) is None
+
     def test_unreleased_lease_is_visible(self, monkeypatch):
         """A dispatch that takes a view lease and never releases it
         must show up as outstanding (and, past the watermark, stuck) —

@@ -140,6 +140,42 @@ def loaded_agent(tmp_path, monkeypatch):
             ev = api.wait_for_eval(eid, timeout=30.0)
             assert ev is not None and ev.status == "complete"
         eval_ids.extend(wave_ids)
+
+    # speculative-dispatch families (ISSUE 15), NON-vacuously: one
+    # CERTIFIED and one ROLLED-BACK speculative dispatch, driven
+    # deterministically at the coordinator level against a side
+    # cluster with the agent server's registry — the exposition source
+    # — so nomad_spec_* pins test real launch/certify/rollback flows,
+    # not eagerly-created zeros.
+    import tests.test_program_table as tpt
+    import tests.test_spec as tsp
+    from nomad_tpu.scheduler import stack as stack_mod
+    from nomad_tpu.server.select_batch import SelectCoordinator
+
+    monkeypatch.setenv("NOMAD_TPU_SPEC_ROLLBACK_MAX", "1.0")
+    for conflict in (False, True):
+        cl = tsp._dc_cluster()
+        _c1, res1 = tpt._run_round(
+            cl, [tsp._dc_job("dc1"), tsp._dc_job("dc2")],
+            eval_ids=["m1", "m2"])
+        coord2 = SelectCoordinator(registry=s.metrics)
+        coord2.trace_ids = {0: "m3", 1: "m4"}
+        coord2.group_ids = {0: 0, 1: 1}
+        coord2.footprints = {0: tsp._dc_mask(cl, "dc1"),
+                             1: tsp._dc_mask(cl, "dc2")}
+        threads, _res2 = tsp._start_parked(
+            cl, [tsp._dc_job("dc1", cpu=250),
+                 tsp._dc_job("dc2", cpu=250)], coord2)
+        assert coord2.try_spec_launch(cl)
+        tpt._commit_round(cl, res1, ["m1", "m2"])
+        if conflict:
+            dc1_node = next(nid for nid in cl.row_of
+                            if cl.nodes[nid].datacenter == "dc1")
+            cl.upsert_alloc(tsp._foreign_alloc(dc1_node))
+        coord2.run()
+        for t in threads:
+            t.join(30.0)
+        stack_mod.spec_chain_reset(cl)
     yield a, api
     a.shutdown()
 
@@ -200,6 +236,13 @@ class TestSeriesNameStability:
         # this the wave.* pins above would be testing absence
         assert snap["counters"].get("wave.dispatches", 0) >= 1
         assert snap["histograms"]["wave.lanes"]["max"] >= 2
+        # the speculative rounds drove one certified AND one
+        # rolled-back dispatch — the nomad_spec_* pins are live flows
+        assert snap["counters"].get("spec.launches", 0) >= 2
+        assert snap["counters"].get("spec.certified", 0) >= 1
+        assert snap["counters"].get("spec.rolled_back", 0) >= 1
+        assert snap["counters"].get("spec.redispatch_programs", 0) >= 1
+        assert snap["counters"].get("spec.wasted_kernel_ms", 0) > 0
 
 
 
