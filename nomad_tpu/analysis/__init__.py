@@ -44,7 +44,8 @@ _CORE = frozenset({
 })
 _TABLES = frozenset({
     "ALL_RULES", "DEVICE_RULES", "JAX_RULES", "LOCK_RULES",
-    "RULE_HINTS", "THREAD_RULES", "VOCAB_RULES",
+    "REPLICA_RULES", "RULE_HINTS", "SECRET_RULES", "THREAD_RULES",
+    "VOCAB_RULES",
 })
 
 __all__ = sorted(_CORE | _TABLES)
@@ -57,6 +58,10 @@ def _load_tables() -> None:
     from .jax_rules import _HINTS as _JAX_HINTS
     from .lock_rules import LOCK_RULES
     from .lock_rules import _HINTS as _LOCK_HINTS
+    from .replica_rules import REPLICA_RULES
+    from .replica_rules import _HINTS as _REPLICA_HINTS
+    from .secrets import SECRET_RULES
+    from .secrets import _HINTS as _SECRET_HINTS
     from .thread_rules import THREAD_RULES
     from .thread_rules import _HINTS as _THREAD_HINTS
     from .vocab_rules import VOCAB_RULES, _HINT as _VOCAB_HINT
@@ -64,10 +69,11 @@ def _load_tables() -> None:
     globals().update(
         JAX_RULES=JAX_RULES, THREAD_RULES=THREAD_RULES,
         LOCK_RULES=LOCK_RULES, DEVICE_RULES=DEVICE_RULES,
-        VOCAB_RULES=VOCAB_RULES,
+        VOCAB_RULES=VOCAB_RULES, REPLICA_RULES=REPLICA_RULES,
+        SECRET_RULES=SECRET_RULES,
         ALL_RULES={
             **JAX_RULES, **THREAD_RULES, **LOCK_RULES, **DEVICE_RULES,
-            **VOCAB_RULES,
+            **VOCAB_RULES, **REPLICA_RULES, **SECRET_RULES,
             "NLW00": "waiver without a reason (the reason is the "
                      "reviewable artifact)",
             "NLP00": "file does not parse",
@@ -75,7 +81,7 @@ def _load_tables() -> None:
         # fix hints per rule (the --explain feed)
         RULE_HINTS={
             **_JAX_HINTS, **_THREAD_HINTS, **_LOCK_HINTS,
-            **_DEVICE_HINTS,
+            **_DEVICE_HINTS, **_REPLICA_HINTS, **_SECRET_HINTS,
             "NLV01": _VOCAB_HINT,
             "NLW00": "add the reason: `# nomadlint: ok RULE <why this "
                      "is safe>`",

@@ -32,8 +32,55 @@ from .core import (Finding, compare_to_baseline, default_baseline_path,
                    default_root, load_baseline, run_tree, write_baseline)
 
 
+def _sarif(findings: List[Finding]) -> dict:
+    """SARIF 2.1.0 — one run, one result per finding; the NLR/NLS
+    call-path hops ride as relatedLocations so CI annotators render
+    the full apply-path, the way the text format does."""
+    def loc(path: str, line: int, text: str = "") -> dict:
+        out = {
+            "physicalLocation": {
+                "artifactLocation": {"uri": path},
+                "region": {"startLine": max(int(line), 1)},
+            },
+        }
+        if text:
+            out["message"] = {"text": text}
+        return out
+
+    rules = [{"id": rid,
+              "shortDescription": {"text": ALL_RULES[rid]},
+              **({"help": {"text": RULE_HINTS[rid]}}
+                 if RULE_HINTS.get(rid) else {})}
+             for rid in sorted(ALL_RULES)]
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message
+                        + (f" (fix: {f.hint})" if f.hint else "")},
+            "locations": [loc(f.path, f.line, f.context)],
+        }
+        if f.related:
+            res["relatedLocations"] = [loc(p, ln, txt)
+                                       for p, ln, txt in f.related]
+        results.append(res)
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "nomadlint",
+                                "rules": rules}},
+            "results": results,
+        }],
+    }
+
+
 def _emit(findings: List[Finding], fmt: str,
           stats: dict = None) -> None:
+    if fmt == "sarif":
+        print(json.dumps(_sarif(findings), indent=1))
+        return
     if fmt == "json":
         payload = {
             "findings": [{
@@ -121,9 +168,10 @@ def main(argv=None) -> int:
                     help="exit 2 when findings exceed the baseline")
     ap.add_argument("--write-baseline", action="store_true",
                     help="freeze current findings into the baseline")
-    ap.add_argument("--format", choices=("text", "json"),
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
                     default="text", dest="fmt",
-                    help="findings output format")
+                    help="findings output format (sarif: SARIF 2.1.0 "
+                         "with call paths as relatedLocations)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="legacy alias for --format json")
     ap.add_argument("--stats", action="store_true",
@@ -199,9 +247,9 @@ def main(argv=None) -> int:
         baseline = load_baseline(baseline_path)
         new = compare_to_baseline(findings, baseline)
         _emit(new, fmt, stats=json_stats)
-        if args.stats and fmt != "json":
+        if args.stats and fmt == "text":
             _print_stats(findings, stats)
-        if new and fmt != "json":
+        if new and fmt == "text":
             print(f"\n{len(new)} NEW finding(s) over baseline "
                   f"({len(findings)} total). Fix them, or if "
                   f"legitimately unavoidable, regenerate the baseline "
@@ -209,7 +257,7 @@ def main(argv=None) -> int:
         return 2 if new else 0
 
     _emit(findings, fmt, stats=json_stats)
-    if fmt != "json":
+    if fmt == "text":
         if args.stats:
             _print_stats(findings, stats)
         else:

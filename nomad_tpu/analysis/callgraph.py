@@ -111,7 +111,8 @@ class CallSite:
 class FuncInfo:
     __slots__ = ("qual", "rel", "cls", "node", "acquisitions", "calls",
                  "attr_calls", "blocking", "lease_events", "effects",
-                 "may_block", "resolved", "nested")
+                 "may_block", "resolved", "nested", "raw_calls",
+                 "returns")
 
     def __init__(self, qual: str, rel: str, cls: Optional["ClassInfo"],
                  node: ast.AST):
@@ -136,6 +137,13 @@ class FuncInfo:
         #: defs nested directly in this body, by bare name — the ONLY
         #: scope a bare call may resolve them from
         self.nested: Dict[str, "FuncInfo"] = {}
+        #: EVERY call in the body as (line, dotted-name, ast.Call) —
+        #: including the unresolvable ones _classify drops. The NLR/NLS
+        #: taint passes need stdlib leaves (time.time, random.Random,
+        #: log.info) that never resolve to in-tree FuncInfos.
+        self.raw_calls: List[Tuple[int, str, ast.Call]] = []
+        #: every `return` statement in the body (secret-taint egress)
+        self.returns: List[ast.Return] = []
 
 
 class ClassInfo:
@@ -597,10 +605,15 @@ class _FnScan(ast.NodeVisitor):
                 return d or leaf
         return None
 
+    def visit_Return(self, node: ast.Return):
+        self.fi.returns.append(node)
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call):
         held = tuple(self.held)
         target = self._classify(node)
         d = _dotted(node.func)
+        self.fi.raw_calls.append((node.lineno, d, node))
         leaf = d.split(".")[-1] if d else (
             node.func.attr if isinstance(node.func, ast.Attribute) else "")
         # direct lock-method acquisition: self._lock.acquire()

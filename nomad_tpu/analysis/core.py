@@ -49,6 +49,10 @@ class Finding:
     message: str = field(compare=False)
     hint: str = field(compare=False, default="")
     context: str = field(compare=False, default="")  # Class.method / func
+    #: call-path hops as (path, line, text) — NLR/NLS findings carry
+    #: the rendered apply-path here so --format sarif can emit them as
+    #: relatedLocations; compare=False keeps baseline keys stable
+    related: tuple = field(compare=False, default=())
 
     def render(self) -> str:
         ctx = f" [{self.context}]" if self.context else ""
@@ -167,9 +171,13 @@ def analyze_file(path: str, rel: str, jit_registry=None,
     if interprocedural:
         from .callgraph import Program
         from .lock_rules import analyze_locks
+        from .replica_rules import analyze_replica
+        from .secrets import analyze_secrets
 
-        findings += [f for f in analyze_locks(Program.build({rel: tree}))
-                     if f.path == rel]
+        prog = Program.build({rel: tree})
+        for analyze in (analyze_locks, analyze_replica,
+                        analyze_secrets):
+            findings += [f for f in analyze(prog) if f.path == rel]
     findings = [f for f in findings
                 if f.rule not in per_line.get(f.line, ())]
     findings = apply_waivers(findings, waivers)
@@ -220,6 +228,8 @@ def run_tree(root: str, stats: Optional[dict] = None) -> List[Finding]:
     from .callgraph import Program
     from .jax_rules import collect_jit_registry
     from .lock_rules import analyze_locks
+    from .replica_rules import analyze_replica
+    from .secrets import analyze_secrets
 
     files = list(iter_python_files(root))
     registry: Dict[str, object] = {}
@@ -254,18 +264,20 @@ def run_tree(root: str, stats: Optional[dict] = None) -> List[Finding]:
                 source=source, fns=fns_cache.get(path),
                 interprocedural=False, stats=stats,
                 suppressions=suppress[rel]))
-    # whole-program pass (lock graph spans modules)
+    # whole-program pass (lock graph and the NLR/NLS taint scopes span
+    # modules)
     waivers_by_rel: Dict[str, List[Waiver]] = {}
     for w in stats.get("waivers", []):
         waivers_by_rel.setdefault(w.path, []).append(w)
     prog = Program.build({rel: parsed[path][0]
                           for path, rel in files if path in parsed})
     lock_findings: List[Finding] = []
-    for f in analyze_locks(prog):
-        whole, per_line, _w = suppress.get(f.path, (False, {}, []))
-        if whole or f.rule in per_line.get(f.line, ()):
-            continue
-        lock_findings.append(f)
+    for analyze in (analyze_locks, analyze_replica, analyze_secrets):
+        for f in analyze(prog):
+            whole, per_line, _w = suppress.get(f.path, (False, {}, []))
+            if whole or f.rule in per_line.get(f.line, ()):
+                continue
+            lock_findings.append(f)
     by_rel: Dict[str, List[Finding]] = {}
     for f in lock_findings:
         by_rel.setdefault(f.path, []).append(f)

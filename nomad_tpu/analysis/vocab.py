@@ -111,6 +111,13 @@ PROM_REQUIRED = frozenset({
     "nomad_wal_appends", "nomad_wal_snapshots", "nomad_wal_append_ms",
     "nomad_wal_fsync_ms", "nomad_wal_snapshot_ms", "nomad_wal_log_bytes",
     "nomad_wal_snapshot_bytes",
+    # mesh-CA issuance outcomes (ISSUE 14 + 16): total denials plus a
+    # distinct series per deny reason — identity (unknown node / secret
+    # mismatch) vs missing allocation binding (verified node, but no
+    # live alloc of the named service)
+    "nomad_connect_issue_denied",
+    "nomad_connect_issue_denied_identity",
+    "nomad_connect_issue_denied_no_alloc",
 })
 
 #: the raft node's promised series (ISSUE 13) — exposed from the NODE's
@@ -123,6 +130,14 @@ RAFT_REQUIRED = frozenset({
     "nomad_raft_leadership_gained", "nomad_raft_leadership_lost",
     "nomad_raft_snapshots", "nomad_raft_snapshot_installs",
     "nomad_raft_commit_ms", "nomad_raft_apply_ms", "nomad_raft_append_ms",
+})
+
+#: the FSM's promised series (ISSUE 16) — registered on the raft node's
+#: registry (cluster.py binds them right after the RaftNode boots), so
+#: they ride the same scrape surface as RAFT_REQUIRED
+FSM_REQUIRED = frozenset({
+    "nomad_fsm_applied",        # entries applied to the state store
+    "nomad_fsm_apply_skipped",  # bad entries skipped by apply_resilient
 })
 
 #: every family a series may legally belong to; a new prefix here is a
@@ -149,6 +164,8 @@ ALLOWED_PREFIXES = (
     "nomad_flight_",          # flight-recorder event counters (ISSUE 13)
     "nomad_raft_",            # raft registries (cluster agents; pinned
                               # non-vacuously in TestControlPlaneSeries)
+    "nomad_fsm_",             # FSM apply outcomes (ISSUE 16; bound to
+                              # the raft registry by server/cluster.py)
     "nomad_connect_",         # mesh-CA issuance outcomes (ISSUE 14:
                               # connect.issue_denied identity rejections)
     "nomad_node_",            # node-identity registration outcomes

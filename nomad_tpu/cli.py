@@ -325,7 +325,7 @@ def cmd_acl(args) -> int:
     if args.sub == "bootstrap":
         tok = api.acl_bootstrap()
         print(f"Accessor ID  = {tok.accessor_id}")
-        print(f"Secret ID    = {tok.secret_id}")
+        print(f"Secret ID    = {tok.secret_id}")  # nomadlint: ok NLS01 bootstrap hands the fresh token to the invoking operator's own terminal — this IS the credential delivery channel (command/acl_bootstrap.go)
         print(f"Type         = {tok.type}")
         return 0
     if args.sub == "policy-apply":
@@ -350,7 +350,7 @@ def cmd_acl(args) -> int:
             name=args.name or "", type=args.type,
             policies=args.policy or [])
         print(f"Accessor ID  = {tok.accessor_id}")
-        print(f"Secret ID    = {tok.secret_id}")
+        print(f"Secret ID    = {tok.secret_id}")  # nomadlint: ok NLS01 token-create prints the new secret once, to the creating operator's terminal — the delivery channel
         print(f"Policies     = {', '.join(tok.policies) or '<none>'}")
         return 0
     if args.sub == "token-list":

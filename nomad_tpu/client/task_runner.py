@@ -174,6 +174,27 @@ class TaskRunner:
 
     def run(self) -> None:
         """The MAIN restart loop (task_runner.go:494)."""
+        try:
+            self._run()
+        finally:
+            # a task that completes or dies on its own is never join()ed
+            # by anyone — the logmon (two CircBufWriter flusher threads)
+            # must close HERE or it leaks per finished task. The detach
+            # path keeps it open: the still-running task's driver pump
+            # holds the sink, and the recovering agent mints a fresh one.
+            with self._detach_lock:
+                detach = self._detach
+            if not detach:
+                with self._handle_lock:
+                    logmon, self.logmon = self.logmon, None
+                if logmon is not None:
+                    try:
+                        logmon.close()
+                    except Exception:
+                        log.warning("task %s: logmon close failed",
+                                    self.task.name, exc_info=True)
+
+    def _run(self) -> None:
         self._event(EVENT_RECEIVED)
         try:
             self._prestart()

@@ -136,8 +136,13 @@ class GenericScheduler:
         self.planner.update_eval(updated)
 
     def _create_blocked_eval(self, plan_failure: bool = False) -> None:
-        """Reference createBlockedEval (generic_sched.go:192)."""
-        self.blocked = self.eval.create_blocked_eval({}, True, "")
+        """Reference createBlockedEval (generic_sched.go:192).
+
+        The timestamp is minted HERE — scheduler workers run leader-side
+        only — and rides into the replicated eval, so FSM apply stays a
+        pure function of the entry (the NLR01 invariant)."""
+        self.blocked = self.eval.create_blocked_eval({}, True, "",
+                                                     now=time.time())
         if plan_failure:
             self.blocked.triggered_by = TRIGGER_MAX_PLANS
             self.blocked.status_description = BLOCKED_EVAL_MAX_PLAN_DESC

@@ -247,6 +247,11 @@ class ClusterServer:
             restore_fn=state.fsm_restore,
             snapshot_threshold=config.snapshot_threshold,
         )
+        # FSM apply counters live in the raft registry (one scrape
+        # surface per server; tests/test_metrics_names.py pins the
+        # names): fsm.applied ticks per committed entry,
+        # fsm.apply_skipped per entry apply_resilient dropped
+        fsm.bind_metrics(self.raft.metrics)
         state.raft = self.raft
         self._srv_cfg = srv_cfg
         self._register_endpoints()

@@ -63,10 +63,27 @@ def validate_op(state, op: str, args) -> None:
 
 
 class FSM:
-    """Applies decoded log entries to a StateStore (fsm.go Apply :180)."""
+    """Applies decoded log entries to a StateStore (fsm.go Apply :180).
 
-    def __init__(self, state) -> None:
+    Apply is a PURE FUNCTION of the entry (the nomad/fsm.go contract):
+    no clock, no RNG, no iteration-order dependence — nomadlint's NLR
+    family ratchets this statically, and tests/test_control_plane.py's
+    cross-replica fingerprint gate checks it end to end. Timestamps and
+    port-draw seeds are minted leader-side and ride IN the entry."""
+
+    def __init__(self, state, metrics=None) -> None:
         self.state = state
+        self._ctr_applied = None
+        self._ctr_skipped = None
+        if metrics is not None:
+            self.bind_metrics(metrics)
+
+    def bind_metrics(self, metrics) -> None:
+        """Counters registered eagerly so the series exist at value 0
+        from startup (the closed-vocabulary contract: a scrape must
+        never see a family appear mid-run)."""
+        self._ctr_applied = metrics.counter("fsm.applied")
+        self._ctr_skipped = metrics.counter("fsm.apply_skipped")
 
     def apply(self, entry: Dict[str, Any]) -> None:
         op = entry["op"]
@@ -74,6 +91,8 @@ class FSM:
             raise ValueError(f"unknown FSM op {op!r}")
         args = [from_wire(a) for a in entry["args"]]
         getattr(self.state, op)(*args)
+        if self._ctr_applied is not None:
+            self._ctr_applied.inc()
 
     def apply_resilient(self, entry: Dict[str, Any]) -> None:
         """Replay/replication path: a bad entry is logged and skipped —
@@ -84,6 +103,8 @@ class FSM:
             import traceback
 
             traceback.print_exc()
+            if self._ctr_skipped is not None:
+                self._ctr_skipped.inc()
 
 
 # ---- snapshot (fsm.go Snapshot :1242 / Restore :1256) ----
@@ -116,6 +137,46 @@ def snapshot_state(state) -> Dict[str, Any]:
             "tokens": [to_wire(t) for t in state.acl.tokens()],
         },
     }
+
+
+def _canon(obj):
+    """Canonical JSON-able form: dict keys sorted, floats via repr
+    (bit-exact — 0.1+0.2 != 0.3 must NOT hash equal), bytes hexed.
+    Nested list order is PRESERVED: an NLR03-class divergence (set
+    order escaping into a stored list) must change the fingerprint."""
+    if isinstance(obj, dict):
+        return {str(k): _canon(obj[k])
+                for k in sorted(obj, key=str)}
+    if isinstance(obj, (list, tuple)):
+        return [_canon(v) for v in obj]
+    if isinstance(obj, float):
+        return f"f:{obj!r}"
+    if isinstance(obj, (bytes, bytearray)):
+        return f"b:{bytes(obj).hex()}"
+    return obj
+
+
+def state_fingerprint(state) -> str:
+    """sha256 over the canonicalized snapshot tree — the cross-replica
+    equality check (tests/test_control_plane.py): identical raft logs
+    MUST produce identical fingerprints on every replica and across a
+    snapshot/restore round-trip.
+
+    Top-level collections are sorted by their serialized elements so a
+    restore that repopulates stores in a different ROW order (the
+    mutators key by id; insertion order is not part of the state) still
+    fingerprints equal, while any VALUE divergence — a replica-local
+    timestamp, port draw, or uuid — changes the hash."""
+    import hashlib
+    import json
+
+    snap = _canon(snapshot_state(state))
+    for key, val in snap.items():
+        if isinstance(val, list):
+            snap[key] = sorted(
+                val, key=lambda v: json.dumps(v, sort_keys=True))
+    blob = json.dumps(snap, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
 
 
 def _upsert_preserving_indexes(mutator, obj) -> None:

@@ -390,6 +390,17 @@ class PlanApplier:
         # stores commit on the FSM applier thread where this bracketing
         # is meaningless; their mutations stay on the host re-upload
         # path (the windows simply never cover them).
+        # Alloc create/modify times are minted HERE, on the leader,
+        # before the commit enters the store: the raft path journals the
+        # already-stamped allocs, so every follower's FSM applies
+        # identical values (the NLR01 invariant — apply is a pure
+        # function of the entry; reference structs.Allocation
+        # CreateTime/ModifyTime are also set plan-side).
+        now = time.time()
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                a.create_time = a.create_time or now
+                a.modify_time = now
         cl = getattr(self.state, "cluster", None)
         if (cl is not None and getattr(self.state, "raft", None) is None
                 and hasattr(self.state, "mutation_lock")):

@@ -1097,6 +1097,29 @@ class Server:
     #: read from the TASK's namespace)
     CONNECT_NS = "nomad/connect"
 
+    def _node_runs_service(self, node_id: str, service_name: str) -> bool:
+        """True iff `node_id` has a live (non-terminal) SERVER-PLACED
+        allocation whose job spec declares `service_name`. Deliberately
+        reads the job spec embedded in/behind the alloc — NOT the
+        client-pushed service-registration rows, which any node agent
+        can write for any name (unauthenticated fabric)."""
+        for a in self.state.allocs_by_node(node_id):
+            if a.terminal_status():
+                continue
+            job = a.job or self.state.job_by_id(a.namespace, a.job_id)
+            if job is None:
+                continue
+            for tg in job.task_groups:
+                if a.task_group and tg.name != a.task_group:
+                    continue
+                if any(s.name == service_name for s in tg.services):
+                    return True
+                for task in tg.tasks:
+                    if any(s.name == service_name
+                           for s in task.services):
+                        return True
+        return False
+
     def connect_issue(self, service_name: str, node_id: str = "",
                       secret_id: str = "") -> dict:
         """Issue a leaf certificate for one sidecar proxy, signed by the
@@ -1132,10 +1155,24 @@ class Server:
                     node.secret_id.encode(),
                     (secret_id or "").encode()):
             self.metrics.inc("connect.issue_denied")
+            self.metrics.inc("connect.issue_denied_identity")
             raise PermissionError(
                 f"connect_issue denied for service {service_name!r}: "
                 f"node identity not verified (unknown node or secret "
                 f"mismatch for {node_id!r})")
+
+        # Allocation binding (the SI-token half of the reference model):
+        # a verified node may only mint leaves for services its OWN live,
+        # server-placed allocations declare. Without this, any registered
+        # client could mint a cert for an arbitrary service CN and walk
+        # through intention deny rules from a foothold on one node.
+        if not self._node_runs_service(node_id, service_name):
+            self.metrics.inc("connect.issue_denied")
+            self.metrics.inc("connect.issue_denied_no_alloc")
+            raise PermissionError(
+                f"connect_issue denied for service {service_name!r}: "
+                f"node {node_id!r} runs no live allocation whose job "
+                f"declares that service")
 
         from ..lib import tlsutil
         from ..structs.secrets import SecretEntry

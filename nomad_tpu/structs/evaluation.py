@@ -1,7 +1,6 @@
 """Evaluation model (reference `structs.Evaluation`, nomad/structs/structs.go:9500)."""
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -95,8 +94,13 @@ class Evaluation:
         )
 
     def create_blocked_eval(self, class_eligibility: Dict[str, bool], escaped: bool,
-                            quota_reached: str) -> "Evaluation":
-        """Reference `Evaluation.CreateBlockedEval` (structs.go:9652)."""
+                            quota_reached: str, now: float = 0.0) -> "Evaluation":
+        """Reference `Evaluation.CreateBlockedEval` (structs.go:9652).
+
+        `now` is CALLER-minted (leader-side, scheduler/generic.py) and
+        rides the raft entry with the eval: stamping `time.time()` here
+        would make apply non-deterministic — each replica would store
+        its own clock (NLR01)."""
         return Evaluation(
             id=new_id(),
             namespace=self.namespace,
@@ -110,12 +114,16 @@ class Evaluation:
             class_eligibility=class_eligibility,
             escaped_computed_class=escaped,
             quota_limit_reached=quota_reached,
-            create_time=time.time(),
-            modify_time=time.time(),
+            create_time=now,
+            modify_time=now,
         )
 
-    def create_failed_follow_up_eval(self, wait_s: float) -> "Evaluation":
-        """Reference `Evaluation.CreateFailedFollowUpEval` (structs.go:9679)."""
+    def create_failed_follow_up_eval(self, wait_s: float,
+                                     now: float = 0.0) -> "Evaluation":
+        """Reference `Evaluation.CreateFailedFollowUpEval` (structs.go:9679).
+
+        `now` is caller-minted for the same replica-determinism reason
+        as create_blocked_eval."""
         return Evaluation(
             id=new_id(),
             namespace=self.namespace,
@@ -125,8 +133,8 @@ class Evaluation:
             job_id=self.job_id,
             job_modify_index=self.job_modify_index,
             status=EVAL_STATUS_PENDING,
-            wait_until=time.time() + wait_s,
+            wait_until=now + wait_s,
             previous_eval=self.id,
-            create_time=time.time(),
-            modify_time=time.time(),
+            create_time=now,
+            modify_time=now,
         )

@@ -196,8 +196,18 @@ class NetworkIndex:
             if deterministic:
                 dyn, perr = self._dynamic_ports_precise(used, reserved_vals, n_dyn)
             else:
+                # the rng is the CALLER's obligation: minted leader-side
+                # (seeded from the plan/submit context) so a follower
+                # replaying the same raft entry draws the same ports —
+                # a fresh `random.Random()` here seeds from OS entropy
+                # and diverges per replica (NLR02)
+                if rng is None:
+                    raise ValueError(
+                        "assign_network(deterministic=False) requires a "
+                        "caller-seeded rng — port draws must be "
+                        "reproducible across replicas")
                 dyn, perr = self._dynamic_ports_stochastic(
-                    used, reserved_vals, n_dyn, rng or random.Random()
+                    used, reserved_vals, n_dyn, rng
                 )
                 if perr:
                     dyn, perr = self._dynamic_ports_precise(used, reserved_vals, n_dyn)

@@ -1,0 +1,77 @@
+"""Near-miss twin of fixture_replica_violations.py with the
+determinism discipline applied — every NLR rule must stay SILENT:
+
+* timestamps are caller-minted (`now` parameter riding the entry), not
+  read from the applying replica's clock;
+* port draws come from a caller-SEEDED rng carried in the entry;
+* set iteration goes through `sorted(...)`, and order-insensitive
+  folds (`len`) stay exempt;
+* delta-log readers capture cluster versions BEFORE reading and
+  advance `checked_*` cursors only to the captured values.
+"""
+import random
+
+ALLOWED_OPS = frozenset({"upsert_eval", "upsert_alloc"})
+
+
+def make_blocked_eval(prev, now):
+    # leader-minted `now` rides the raft entry: apply is pure
+    return {"previous": prev, "create_time": now}
+
+
+def assign_ports(used, rng):
+    # caller-seeded rng: every replica replays the same draws
+    while True:
+        p = rng.randrange(20000, 32000)
+        if p not in used:
+            return p
+
+
+class Store:
+    def __init__(self):
+        self.evals = {}
+        self.allocs = {}
+
+    def upsert_eval(self, e):
+        self.evals[e["id"]] = make_blocked_eval(e, e["now"])
+        return e
+
+    def upsert_alloc(self, a):
+        a["port"] = assign_ports(set(self.allocs),
+                                 random.Random(a["port_seed"]))
+        self.allocs[a["id"]] = a
+        return a
+
+
+def validate_op(state, op, args):
+    if op not in ALLOWED_OPS:
+        raise ValueError(op)
+
+
+def snapshot_state(state):
+    keys = set(state.evals)
+    return {"evals": sorted(keys), "n": len(keys)}
+
+
+class Fsm:
+    def __init__(self, state):
+        self.state = state
+
+    def apply(self, entry):
+        getattr(self.state, entry["op"])(*entry["args"])
+
+    def restore(self, snap):
+        rows = {r for r in snap["evals"]}
+        out = []
+        for r in sorted(rows):
+            out.append(r)
+        return out
+
+
+def scan_certified(cl, chain):
+    # the scheduler/stack.py certify discipline: capture, read, then
+    # advance only to the captured value
+    v_now = cl.version
+    rows = cl.hot_rows_since(chain["checked_version"], 64)
+    chain["checked_version"] = v_now
+    return rows

@@ -1,0 +1,94 @@
+"""Seeded NLR01-NLR04 violations — exact (rule, line) pins for
+tests/test_lint.py (trailing `# NLRxx` markers name the rule expected
+on that line).
+
+The shapes replay the REAL findings ISSUE 16 burned down, so the
+burn-down regression tests can assert "silent on the tree, still
+caught here": the eval-timestamp mint (structs/evaluation.py pre-fix
+stamped `time.time()` inside the replicated value) and the zero-arg
+port RNG (structs/network.py pre-fix seeded each replica's draws from
+OS entropy). Scope is self-contained: the module carries its own
+`ALLOWED_OPS` literal, an `Fsm` class (apply/restore roots), a `Store`
+defining two op mutators (the state-store duck type), and the
+snapshot/validate module functions next to the Fsm.
+"""
+import datetime
+import random
+import time
+import uuid
+
+ALLOWED_OPS = frozenset({"upsert_eval", "upsert_alloc"})
+
+
+def make_blocked_eval(prev):
+    # the pre-fix structs/evaluation.py shape: the replicated value
+    # carries the APPLYING replica's clock
+    return {"previous": prev, "create_time": time.time()}  # NLR01
+
+
+def assign_ports(used):
+    # the pre-fix structs/network.py shape: each replica seeds its own
+    # draws from OS entropy
+    rng = random.Random()  # NLR02
+    while True:
+        p = rng.randrange(20000, 32000)
+        if p not in used:
+            return p
+
+
+class Store:
+    def __init__(self):
+        self.evals = {}
+        self.allocs = {}
+
+    def upsert_eval(self, e):
+        e["id"] = uuid.uuid4().hex  # NLR02
+        self.evals[e["id"]] = make_blocked_eval(e)
+        return e
+
+    def upsert_alloc(self, a):
+        a["port"] = assign_ports(set(self.allocs))
+        self.allocs[a["id"]] = a
+        return a
+
+
+def validate_op(state, op, args):
+    if op not in ALLOWED_OPS:
+        raise ValueError(op)
+    args.append(random.randrange(1 << 30))  # NLR02
+
+
+def snapshot_state(state):
+    snap = {"at": datetime.datetime.now().timestamp()}  # NLR01
+    keys = set(state.evals)
+    snap["evals"] = list(keys)  # NLR03
+    return snap
+
+
+class Fsm:
+    def __init__(self, state):
+        self.state = state
+
+    def apply(self, entry):
+        getattr(self.state, entry["op"])(*entry["args"])
+
+    def restore(self, snap):
+        rows = {r for r in snap["evals"]}
+        out = []
+        for r in rows:  # NLR03
+            out.append(r)
+        return out
+
+
+def scan_live_cursor(cl, chain):
+    # PR 11's review bug shape: the cursor jumps to a LIVE version read
+    rows = cl.hot_rows_since(chain["checked_version"], 64)
+    chain["checked_version"] = cl.version  # NLR04
+    return rows
+
+
+def scan_late_capture(cl, chain):
+    ents = cl.hot_entries_since(chain["checked_version"], 64)
+    v_now = cl.version
+    chain["checked_version"] = v_now  # NLR04
+    return ents

@@ -1,0 +1,45 @@
+"""Near-miss twin of fixture_secret_violations.py with every redaction
+idiom the manifest recognizes applied — NLS01 must stay SILENT:
+
+* `dataclasses.replace(node, secret_id="")` for returned objects
+  (server.py node_get ships this shape);
+* `tree.pop("secret_id", None)`, `del tree["secret_id"]`, and a
+  subscript overwrite for wire trees (agent/http.py node_wire ships
+  the pop);
+* telemetry mentions NON-secret fields only.
+"""
+import dataclasses
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class Server:
+    def __init__(self, state):
+        self.state = state
+
+    def node_get(self, node_id):
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            return None
+        return dataclasses.replace(node, secret_id="")
+
+    def node_tree(self, node_id):
+        node = self.state.node_by_id(node_id)
+        tree = to_wire(node)
+        tree.pop("secret_id", None)
+        return tree
+
+    def node_tree_del(self, node_id):
+        tree = to_wire(self.state.node_by_id(node_id))
+        del tree["secret_id"]
+        return tree
+
+    def node_tree_blank(self, node_id):
+        tree = to_wire(self.state.node_by_id(node_id))
+        tree["secret_id"] = ""
+        return tree
+
+    def debug_node(self, node):
+        log.info("node %s registered (%s)", node.id, node.status)
+        print("registered", node.id)

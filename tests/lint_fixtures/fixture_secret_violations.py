@@ -1,0 +1,43 @@
+"""Seeded NLS01 violations — exact (rule, line) pins for
+tests/test_lint.py.
+
+The shapes replay the PR 10 review bug (node_get serving
+`structs.Node.secret_id` to any fabric peer) plus the telemetry
+leaks the manifest guards against: a secret attribute reaching a log
+call, `print`, or the flight recorder. The class is named `Server`, so
+every method is an RPC reply surface per the analysis/secrets.py
+manifest.
+"""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class _Flight:
+    def record(self, kind, **fields):
+        pass
+
+
+def default_flight():
+    return _Flight()
+
+
+class Server:
+    def __init__(self, state):
+        self.state = state
+
+    def node_get(self, node_id):
+        # the PR 10 bug: the bearer object returns un-redacted
+        return self.state.node_by_id(node_id)  # NLS01
+
+    def node_tree(self, node_id):
+        node = self.state.node_by_id(node_id)
+        tree = to_wire(node)
+        return tree  # NLS01
+
+    def debug_node(self, node):
+        log.info("node %s secret %s", node.id, node.secret_id)  # NLS01
+        print("registered", node.secret_id)  # NLS01
+        default_flight().record(  # NLS01 (a LEGAL flight event type:
+            "membership.change",  # the leak is the secret field, the
+            sec=node.secret_id)   # vocab rule must not co-fire here)

@@ -251,19 +251,42 @@ def agent(tmp_path, monkeypatch):
     a.shutdown()
 
 
+def _run_service_alloc(server, node_id, *services):
+    """Place a live server-side alloc on `node_id` whose job declares
+    `services` — the binding `connect_issue` now requires (ISSUE 16:
+    a verified node may only mint leaves for services its own live
+    allocations run)."""
+    from nomad_tpu.structs.job import Service
+
+    j = mock.job()
+    j.task_groups[0].services = [Service(name=s) for s in services]
+    # the agent's client WILL pull this alloc and run it — keep the
+    # task a harmless long-lived mock so it doesn't flap to terminal
+    # (a failed task would retract the binding mid-test)
+    for t in j.task_groups[0].tasks:
+        t.driver = "mock_driver"
+        t.config = {"run_for": 300}
+    a = mock.alloc(job=j, node_id=node_id)
+    a.client_status = "running"
+    server.state.upsert_job(j)
+    server.state.upsert_alloc(a)
+    return a
+
+
 class TestConnectIssueIdentity:
     """ISSUE 14 satellite / ADVICE r5: `connect_issue` verifies the
     requesting node's identity secret against state BEFORE minting —
     a peer can no longer mint as an EXISTING node without its secret.
-    Known gap (ROADMAP): registration is open TOFU, so a fabric peer
-    can still self-register a fresh node id and mint from it; closing
-    that needs service→alloc→node binding at issuance."""
+    ISSUE 16 closes the ROADMAP gap: a fabric peer that self-registers
+    a fresh node id still can't mint, because issuance now also
+    requires a live allocation of the named service on that node."""
 
     def test_wrong_secret_is_denied_and_counted(self, agent):
         a, api = agent
         n = a.client.node
-        before = a.server.metrics.snapshot()["counters"].get(
-            "connect.issue_denied", 0)
+        c0 = a.server.metrics.snapshot()["counters"]
+        before = c0.get("connect.issue_denied", 0)
+        before_id = c0.get("connect.issue_denied_identity", 0)
         with pytest.raises(PermissionError):
             a.server.connect_issue("svc-a", n.id, "not-the-secret")
         # non-ASCII presented secret: still a clean deny (str-mode
@@ -277,10 +300,48 @@ class TestConnectIssueIdentity:
         # no identity at all (the pre-fix caller shape): rejected
         with pytest.raises(PermissionError):
             a.server.connect_issue("svc-a")
-        after = a.server.metrics.snapshot()["counters"][
-            "connect.issue_denied"]
-        assert after == before + 4
+        counters = a.server.metrics.snapshot()["counters"]
+        assert counters["connect.issue_denied"] == before + 4
+        # every one of these is an IDENTITY deny — the distinct reason
+        # series lets a dashboard tell credential probing apart from
+        # mis-scheduled sidecars (no-alloc denials)
+        assert counters["connect.issue_denied_identity"] == before_id + 4
+        assert counters.get("connect.issue_denied_no_alloc", 0) == 0
         # denial happens BEFORE any CA/cert work — no mesh CA appears
+        assert a.server.state.secret_get("nomad/connect", "ca") is None
+
+    def test_no_alloc_binding_is_denied_and_counted(self, agent):
+        """A node with a VALID identity but no live allocation of the
+        named service must be denied with the distinct no_alloc reason
+        (a self-registered fabric peer passes the identity check for
+        its own fresh node id — the alloc binding is what stops it)."""
+        a, api = agent
+        n = a.client.node
+        c0 = a.server.metrics.snapshot()["counters"]
+        with pytest.raises(PermissionError) as ei:
+            a.server.connect_issue("not-scheduled-here", n.id,
+                                   n.secret_id)
+        assert "no live allocation" in str(ei.value)
+        # a TERMINAL alloc of the service must not satisfy the binding
+        dead = _run_service_alloc(a.server, n.id, "not-scheduled-here")
+        dead.client_status = "failed"
+        a.server.state.upsert_alloc(dead)
+        with pytest.raises(PermissionError):
+            a.server.connect_issue("not-scheduled-here", n.id,
+                                   n.secret_id)
+        # a live alloc on a DIFFERENT node doesn't bind this one
+        _run_service_alloc(a.server, "some-other-node",
+                           "not-scheduled-here")
+        with pytest.raises(PermissionError):
+            a.server.connect_issue("not-scheduled-here", n.id,
+                                   n.secret_id)
+        c1 = a.server.metrics.snapshot()["counters"]
+        assert c1["connect.issue_denied"] \
+            == c0.get("connect.issue_denied", 0) + 3
+        assert c1["connect.issue_denied_no_alloc"] \
+            == c0.get("connect.issue_denied_no_alloc", 0) + 3
+        assert c1.get("connect.issue_denied_identity", 0) \
+            == c0.get("connect.issue_denied_identity", 0)
         assert a.server.state.secret_get("nomad/connect", "ca") is None
 
     def test_empty_stored_secret_is_denied(self, agent):
@@ -313,6 +374,7 @@ class TestConnectIssueIdentity:
         assert n.secret_id  # client generated one at start
         # the registered node's view in state carries the same secret
         assert a.server.state.node_by_id(n.id).secret_id == n.secret_id
+        _run_service_alloc(a.server, n.id, "svc-id")  # alloc binding
         pems = a.server.connect_issue("svc-id", n.id, n.secret_id)
         assert "BEGIN CERTIFICATE" in pems["cert"]
 
@@ -432,6 +494,7 @@ class TestMeshCA:
 
         a, api = agent
         n = a.client.node
+        _run_service_alloc(a.server, n.id, "svc-a", "svc-b")
         pems = a.server.connect_issue("svc-a", n.id, n.secret_id)
         assert "BEGIN CERTIFICATE" in pems["cert"]
         # a second issue signs with the SAME root
@@ -747,6 +810,7 @@ class TestValidation:
 
         a, api = agent
         n = a.client.node
+        _run_service_alloc(a.server, n.id, "seed")  # alloc binding
         a.server.connect_issue("seed", n.id, n.secret_id)  # CA exists
         import urllib.error
         import urllib.request

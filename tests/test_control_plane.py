@@ -244,6 +244,147 @@ class TestHeartbeatExpiry:
             s.shutdown()
 
 
+# ---- replica determinism (ISSUE 16): apply is a pure function ----
+
+
+class TestReplicaDeterminism:
+    """FSM.apply must be a pure function of the raft entry: identical
+    logs produce identical canonical state fingerprints on every
+    replica regardless of local clock/RNG state, and across a
+    snapshot/restore round-trip. The divergence tests pin that the
+    fingerprint gate CATCHES the pre-fix behaviors (apply-path
+    `time.time()` / unseeded `random.Random()`) if reintroduced —
+    nomadlint's NLR family ratchets the same invariant statically."""
+
+    def _log(self):
+        """An entry log exercising the burned-down paths: nodes, a
+        job, a placed alloc, and blocked/follow-up evals whose
+        timestamps were minted leader-side (`now` rides the entry)."""
+        from nomad_tpu.structs.codec import to_wire
+
+        node_a, node_b = mock.node(), mock.node()
+        job = mock.job()
+        alloc = mock.alloc(job=job, node_id=node_a.id)
+        ev = mock.eval_(job_id=job.id)
+        blocked = ev.create_blocked_eval({}, True, "", now=1723.5)
+        follow = ev.create_failed_follow_up_eval(30.0, now=1723.5)
+        entries = [
+            ("upsert_node", [node_a]), ("upsert_node", [node_b]),
+            ("upsert_job", [job]), ("upsert_eval", [ev]),
+            ("upsert_alloc", [alloc]), ("upsert_eval", [blocked]),
+            ("upsert_eval", [follow]), ("delete_node", [node_b.id]),
+        ]
+        return [{"op": op, "args": [to_wire(a) for a in args]}
+                for op, args in entries]
+
+    def _replay(self, log, clock, seed, store_cls=None):
+        """Apply `log` on a fresh store under a SKEWED local clock and
+        RNG — a deterministic FSM must not notice either."""
+        import random as _random
+        from unittest import mock as um
+
+        from nomad_tpu.server.fsm import FSM, state_fingerprint
+        from nomad_tpu.server.state import StateStore
+
+        state = (store_cls or StateStore)()
+        fsm = FSM(state)
+        _random.seed(seed)
+        with um.patch("time.time", lambda: clock):
+            for entry in log:
+                fsm.apply(entry)
+        return state, state_fingerprint(state)
+
+    def test_three_replicas_fingerprint_identical(self):
+        log = self._log()
+        fps = [self._replay(log, clock, seed)[1]
+               for clock, seed in ((1.0e9, 1), (2.0e9, 2), (3.0e9, 3))]
+        assert fps[0] == fps[1] == fps[2]
+
+    def test_snapshot_restore_round_trip_fingerprints_equal(self):
+        from nomad_tpu.server.fsm import (restore_state, snapshot_state,
+                                          state_fingerprint)
+        from nomad_tpu.server.state import StateStore
+
+        state, fp = self._replay(self._log(), 5.0e9, 7)
+        fresh = StateStore()
+        restore_state(fresh, snapshot_state(state))
+        assert state_fingerprint(fresh) == fp
+
+    def test_gate_catches_replica_local_clock(self):
+        """Reintroducing the pre-fix eval-timestamp shape (apply-path
+        time.time()) MUST diverge the fingerprints — this is the test
+        that fails if someone undoes the leader-side mint."""
+        import time as _time
+
+        from nomad_tpu.server.state import StateStore
+
+        class PreFixClockStore(StateStore):
+            def upsert_eval(self, e):
+                e.create_time = _time.time()  # the pre-fix shape
+                super().upsert_eval(e)
+
+        log = self._log()
+        _, fp1 = self._replay(log, 1.0e9, 1, store_cls=PreFixClockStore)
+        _, fp2 = self._replay(log, 2.0e9, 1, store_cls=PreFixClockStore)
+        assert fp1 != fp2, \
+            "fingerprint gate is blind to apply-path wall-clock reads"
+
+    def test_gate_catches_unseeded_rng(self):
+        """Reintroducing per-replica entropy (the pre-fix port-RNG
+        shape: zero-arg random.Random() on the apply path) MUST
+        diverge the fingerprints."""
+        import random as _random
+
+        from nomad_tpu.server.state import StateStore
+
+        class PreFixRngStore(StateStore):
+            def upsert_alloc(self, a):
+                a.client_description = str(
+                    _random.Random().random())  # OS-entropy seeded
+                super().upsert_alloc(a)
+
+        log = self._log()
+        _, fp1 = self._replay(log, 1.0e9, 1, store_cls=PreFixRngStore)
+        _, fp2 = self._replay(log, 1.0e9, 1, store_cls=PreFixRngStore)
+        assert fp1 != fp2, \
+            "fingerprint gate is blind to apply-path entropy"
+
+    def test_blocked_eval_timestamps_ride_the_entry(self):
+        ev = mock.eval_()
+        blocked = ev.create_blocked_eval({}, False, "", now=123.25)
+        assert blocked.create_time == blocked.modify_time == 123.25
+        follow = ev.create_failed_follow_up_eval(10.0, now=123.25)
+        assert follow.wait_until == 133.25
+        assert follow.create_time == follow.modify_time == 123.25
+
+    def test_stochastic_ports_require_caller_seeded_rng(self):
+        """assign_network(deterministic=False) without an rng is the
+        pre-fix divergence shape — it must refuse; with the SAME seed
+        two replicas draw the SAME ports."""
+        import random as _random
+
+        from nomad_tpu.structs.network import NetworkIndex
+        from nomad_tpu.structs.resources import NetworkResource, Port
+
+        ask = NetworkResource(mbits=10,
+                              dynamic_ports=[Port(label="http"),
+                                             Port(label="rpc")])
+
+        def draw(rng):
+            idx = NetworkIndex()
+            idx.set_node(mock.node())
+            offer, err = idx.assign_network(ask, deterministic=False,
+                                            rng=rng)
+            assert err == ""
+            return [p.value for p in offer.dynamic_ports]
+
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        with pytest.raises(ValueError):
+            idx.assign_network(ask, deterministic=False)
+        assert draw(_random.Random(42)) == draw(_random.Random(42))
+
+
 # ---- operator surfaces on a dev agent ----
 
 

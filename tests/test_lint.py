@@ -149,6 +149,52 @@ def test_new_family_clean_fixtures_are_silent():
                             _scope_rel("lib", "fixture_clean.py")) == []
 
 
+# ---- ISSUE 16 families: replica determinism (NLR) + secret taint ----
+# Scope mapping: raft/ keeps the fixtures outside the NLT01-03 thread
+# scope, so only the new families (plus the lock family, silent here)
+# run. The NLR scope is self-computed from each fixture's own
+# ALLOWED_OPS literal / Fsm class, not from the path.
+
+def test_replica_fixture_findings_exact():
+    found = _analyze_fixture("fixture_replica_violations.py",
+                             _scope_rel("raft", "fixture_replica.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_replica_violations.py"))
+
+
+def test_secret_fixture_findings_exact():
+    found = _analyze_fixture("fixture_secret_violations.py",
+                             _scope_rel("raft", "fixture_secret.py"))
+    assert {(f.rule, f.line) for f in found} == _expected_markers(
+        os.path.join(FIXTURES, "fixture_secret_violations.py"))
+
+
+def test_replica_and_secret_clean_fixtures_are_silent():
+    assert _analyze_fixture(
+        "fixture_replica_clean.py",
+        _scope_rel("raft", "fixture_replica_clean.py")) == []
+    assert _analyze_fixture(
+        "fixture_secret_clean.py",
+        _scope_rel("raft", "fixture_secret_clean.py")) == []
+
+
+def test_replica_finding_renders_full_apply_path():
+    """An NLR01/02 report names the whole call path from the apply
+    root to the entropy read (the 'reading a determinism finding'
+    contract in README), and carries the hops as related locations
+    for the SARIF emitter."""
+    found = _analyze_fixture("fixture_replica_violations.py",
+                             _scope_rel("raft", "fixture_replica.py"))
+    leaf = next(f for f in found if f.rule == "NLR01"
+                and "time.time" in f.message)
+    assert "Store.upsert_eval [ALLOWED_OPS mutator on Store]" \
+        in leaf.message
+    assert "-> make_blocked_eval" in leaf.message
+    assert leaf.related, "related locations feed SARIF"
+    assert any("make_blocked_eval" in text
+               for _rel, _line, text in leaf.related)
+
+
 # ---- waivers ----
 
 def test_waiver_with_reason_suppresses_and_is_counted(tmp_path):
@@ -298,6 +344,69 @@ def test_cli_format_json_machine_readable(tmp_path, capsys):
     assert _json.loads(capsys.readouterr().out)["findings"]
 
 
+def test_cli_format_sarif(tmp_path, capsys):
+    """`--format sarif` emits a valid SARIF 2.1.0 run: driver rules
+    from ALL_RULES, one result per finding with ruleId/level/location,
+    and the NLR call path as relatedLocations."""
+    import json as _json
+    import shutil as _shutil
+
+    src = os.path.join(FIXTURES, "fixture_replica_violations.py")
+    pkg = tmp_path / "nomad_tpu" / "raft"
+    pkg.mkdir(parents=True)
+    _shutil.copy(src, pkg / "fixture_replica.py")
+    assert lint_main([str(tmp_path / "nomad_tpu"),
+                      "--format", "sarif"]) == 0
+    out = capsys.readouterr().out
+    doc = _json.loads(out)
+    assert doc["version"] == "2.1.0"
+    assert "sarif-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "nomadlint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {"NLR01", "NLR02", "NLR03", "NLR04", "NLS01"} <= rule_ids
+    results = run["results"]
+    assert results and all(r["level"] == "error" for r in results)
+    expected = {(rule, line) for rule, line in _expected_markers(src)}
+    got = {(r["ruleId"],
+            r["locations"][0]["physicalLocation"]["region"]["startLine"])
+           for r in results}
+    assert got == expected
+    # an interprocedural NLR finding carries its call path
+    nlr01 = next(r for r in results if r["ruleId"] == "NLR01"
+                 and "time.time" in r["message"]["text"])
+    rel_locs = nlr01["relatedLocations"]
+    assert rel_locs and all(
+        rl["physicalLocation"]["artifactLocation"]["uri"]
+        for rl in rel_locs)
+    assert any("make_blocked_eval" in rl["message"]["text"]
+               for rl in rel_locs)
+    # no trailing human-readable summary pollutes the JSON document
+    assert out.strip().endswith("}")
+
+
+def test_cli_format_json_pins_unchanged_schema(tmp_path, capsys):
+    """--format json output for the new families keeps the pinned
+    shape (rule/file/line/context keys) — downstream tooling parses
+    it; `related` stays SARIF-only."""
+    import json as _json
+    import shutil as _shutil
+
+    src = os.path.join(FIXTURES, "fixture_secret_violations.py")
+    pkg = tmp_path / "nomad_tpu" / "raft"
+    pkg.mkdir(parents=True)
+    _shutil.copy(src, pkg / "fixture_secret.py")
+    assert lint_main([str(tmp_path / "nomad_tpu"),
+                      "--format", "json"]) == 0
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["findings"]
+    for f in payload["findings"]:
+        assert f["rule"] == "NLS01"
+        assert set(f) >= {"rule", "file", "line", "context", "message"}
+        assert "related" not in f
+
+
 def test_cli_duplicate_roots_do_not_double_count(tmp_path, capsys):
     """Passing overlapping/duplicate path args dedups findings AND the
     stats side: the waiver ledger merges by site and `files` counts
@@ -412,6 +521,57 @@ def test_preemption_kernel_is_scatter_and_gather_free():
     path = os.path.join(PKG, "kernels", "preemption.py")
     found = analyze_file(path, "nomad_tpu/kernels/preemption.py")
     assert not any(f.rule in ("NLJ06", "NLJ07") for f in found)
+
+
+def test_eval_timestamps_stay_leader_minted():
+    """ISSUE 16 burn-down: structs/evaluation.py no longer stamps
+    `time.time()` inside replicated values (the `now` parameter rides
+    the raft entry) — NLR01 must be silent on the tree while the
+    fixture pins that the pre-fix shape is still caught."""
+    found = [f for f in _tree_findings() if f.rule == "NLR01"]
+    assert found == [], [f.render() for f in found]
+    fixture = _analyze_fixture("fixture_replica_violations.py",
+                               _scope_rel("raft", "fixture_replica.py"))
+    assert any(f.rule == "NLR01" and f.context == "make_blocked_eval"
+               for f in fixture)
+
+
+def test_port_draws_stay_caller_seeded():
+    """ISSUE 16 burn-down: structs/network.py requires a caller-seeded
+    rng for stochastic port draws (zero-arg random.Random() raised
+    NLR02 pre-fix) — silent on the tree, caught in the fixture."""
+    found = [f for f in _tree_findings() if f.rule == "NLR02"]
+    assert found == [], [f.render() for f in found]
+    fixture = _analyze_fixture("fixture_replica_violations.py",
+                               _scope_rel("raft", "fixture_replica.py"))
+    assert any(f.rule == "NLR02" and f.context == "assign_ports"
+               for f in fixture)
+
+
+def test_secret_egress_stays_redacted():
+    """The PR 10 node_get leak, now a rule: NLS01 silent on the tree
+    (the two cli.py bootstrap prints carry reviewed waivers — the
+    operator terminal IS the credential delivery channel), still
+    caught in the fixture."""
+    found = [f for f in _tree_findings() if f.rule == "NLS01"]
+    assert found == [], [f.render() for f in found]
+    fixture = _analyze_fixture("fixture_secret_violations.py",
+                               _scope_rel("raft", "fixture_secret.py"))
+    contexts = {f.context for f in fixture if f.rule == "NLS01"}
+    assert {"Server.node_get", "Server.node_tree",
+            "Server.debug_node"} <= contexts
+
+
+def test_cursor_discipline_holds_on_stack():
+    """scheduler/stack.py's certify path captures cluster versions
+    before reading the delta logs — NLR04 silent on the tree, both
+    pre-fix shapes (live read, late capture) caught in the fixture."""
+    found = [f for f in _tree_findings() if f.rule == "NLR04"]
+    assert found == [], [f.render() for f in found]
+    fixture = _analyze_fixture("fixture_replica_violations.py",
+                               _scope_rel("raft", "fixture_replica.py"))
+    ctxs = {f.context for f in fixture if f.rule == "NLR04"}
+    assert ctxs == {"scan_live_cursor", "scan_late_capture"}
 
 
 def test_analyzer_needs_no_jax_import():

@@ -23,8 +23,8 @@ from nomad_tpu import mock
 # module only drives the loaded-agent flow and pins the exposition
 # against the shared sets.
 from nomad_tpu.analysis.vocab import (ALLOWED_LABELS, ALLOWED_PREFIXES,
-                                      ALLOWED_SITES, PROM_REQUIRED,
-                                      RAFT_REQUIRED)
+                                      ALLOWED_SITES, FSM_REQUIRED,
+                                      PROM_REQUIRED, RAFT_REQUIRED)
 
 REQUIRED = PROM_REQUIRED
 
@@ -176,6 +176,16 @@ def loaded_agent(tmp_path, monkeypatch):
         for t in threads:
             t.join(30.0)
         stack_mod.spec_chain_reset(cl)
+
+    # mesh-CA denial outcomes (ISSUE 14 + 16), NON-vacuously: one
+    # identity rejection (unknown node) and one allocation-binding
+    # rejection (verified node identity, but no live alloc of the
+    # named service) — the nomad_connect_* pins are real deny flows
+    with pytest.raises(PermissionError):
+        s.connect_issue("svc-x", "no-such-node", "not-a-secret")
+    n = a.client.node
+    with pytest.raises(PermissionError):
+        s.connect_issue("svc-never-scheduled", n.id, n.secret_id)
     yield a, api
     a.shutdown()
 
@@ -243,6 +253,13 @@ class TestSeriesNameStability:
         assert snap["counters"].get("spec.rolled_back", 0) >= 1
         assert snap["counters"].get("spec.redispatch_programs", 0) >= 1
         assert snap["counters"].get("spec.wasted_kernel_ms", 0) > 0
+        # the connect denial series are live deny flows with DISTINCT
+        # per-reason counters (ISSUE 16), not eagerly-created zeros
+        assert snap["counters"].get("connect.issue_denied", 0) >= 2
+        assert snap["counters"].get(
+            "connect.issue_denied_identity", 0) >= 1
+        assert snap["counters"].get(
+            "connect.issue_denied_no_alloc", 0) >= 1
 
 
 
@@ -266,19 +283,29 @@ class TestControlPlaneSeries:
         try:
             assert _wait(cs.is_leader, timeout=30.0)
             cs.call("node_register", mock.node())  # commit traffic
+            # a malformed entry exercises apply_resilient's skip path
+            # (ISSUE 16): committed on every replica, dropped by the
+            # FSM identically — fsm.apply_skipped must tick
+            cs.raft.apply({"op": "bogus_op", "args": []})
             names, labels, _ = _parse(cs.raft.metrics.prometheus())
-            missing = RAFT_REQUIRED - names
+            missing = (RAFT_REQUIRED | FSM_REQUIRED) - names
             assert not missing, (
-                f"promised raft series missing/renamed: {sorted(missing)}")
+                f"promised raft/fsm series missing/renamed: "
+                f"{sorted(missing)}")
             stray = sorted(n for n in names
                            if not _strip_histo_suffix(n)
-                           .startswith("nomad_raft_"))
+                           .startswith(("nomad_raft_", "nomad_fsm_")))
             assert not stray, stray
             assert labels <= ALLOWED_LABELS
             # the election IS a leadership transition — non-vacuous
             assert cs.raft.metrics.counter(
                 "raft.leadership_gained").value >= 1
             assert cs.raft.metrics.histogram("raft.commit_ms").count >= 1
+            # FSM outcome counters are live flows: node_register was
+            # applied, the bogus op was skipped (never fatal)
+            assert cs.raft.metrics.counter("fsm.applied").value >= 1
+            assert _wait(lambda: cs.raft.metrics.counter(
+                "fsm.apply_skipped").value >= 1, timeout=10.0)
         finally:
             cs.shutdown()
         # nacked-to-exhaustion eval → broker.eval_failed flight event

@@ -308,6 +308,11 @@ class TestServerBatchedPath:
         # stray NOMAD_TPU_EVAL_BATCH=1 would green-light the test on the
         # single-eval path without ever touching the rendezvous
         monkeypatch.delenv("NOMAD_TPU_EVAL_BATCH", raising=False)
+        # pin the drain hold window: the adaptive window (capped at
+        # 50ms) can close before the restore loop finishes enqueuing on
+        # a loaded machine, draining the 8 evals as singles — then the
+        # batched>0 assertion below tests a rendezvous that never formed
+        monkeypatch.setenv("NOMAD_TPU_DRAIN_WINDOW_MS", "300")
         rng = random.Random(11)
         s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
                                 eval_batch=8))
@@ -340,7 +345,16 @@ class TestServerBatchedPath:
             assert s.broker._dequeues.get(evs[3].id, 0) >= 2
             got = s.state.eval_by_id(evs[3].id)
             assert got is None or got.status != "complete"
-            # the batch path actually engaged (fused programs ran)
+            # the batch path actually engaged (fused programs ran).
+            # Polled: evals flip to complete inside sched.process,
+            # BEFORE finish_batch collects the futures and writes the
+            # worker.*.batch.* counters — an immediate read here races
+            # that write by a few milliseconds on a loaded machine
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if s.workers[0].batch_stats.get("batched", 0) > 0:
+                    break
+                time.sleep(0.05)
             assert s.workers[0].batch_stats.get("batched", 0) > 0
         finally:
             s.shutdown()
