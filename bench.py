@@ -773,6 +773,24 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
             f"{drain_tail['wave']['collisions']} collisions; "
             f"overhead {drain_tail['dispatch_overhead_ms_per_eval']:.3f}"
             f"ms/eval")
+        # scheduling-SLO tail (ISSUE 17): per-band latency/attainment/
+        # budget over the measured window, ALWAYS emitted
+        slo_tail = _e2e_slo(s, evals)
+        log("e2e: slo " + "; ".join(
+            f"{b}: n={v['total']} att={v['attainment']} "
+            f"budget={v['budget_remaining']}"
+            for b, v in slo_tail["bands"].items() if v["total"])
+            + f"; burn events={len(slo_tail['burn_events'])}")
+        # distributed-trace tail (ISSUE 17): span completeness per
+        # placement + the tracing-overhead A/B
+        trace_tail = _e2e_trace(s, rng, count)
+        log(f"e2e: trace stitch {trace_tail['stitched']}/"
+            f"{trace_tail['traces']} "
+            f"(rate={trace_tail['stitch_rate']}) "
+            f"spans/placement={trace_tail['spans_per_placement_mean']}; "
+            f"A/B evals/s on={trace_tail['ab']['on']['evals_per_sec']} "
+            f"off={trace_tail['ab']['off']['evals_per_sec']} "
+            f"overhead={trace_tail['overhead_pct']}%")
     finally:
         s.shutdown()
     rate = done / dt if dt else 0.0
@@ -829,6 +847,17 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # `e2e_plan_partial_rate` stay flat (BASELINE.md round-8
         # addendum explains the acceptance read)
         "e2e_spec": spec_tail,
+        # scheduling SLOs (ISSUE 17): per-priority-band latency
+        # histograms, attainment, error-budget remaining, and any burn
+        # events over the measured window — read next to e2e_control
+        # (BASELINE.md round-9 addendum): budget draining while broker
+        # depth/age is flat means the regression is downstream of the
+        # queue
+        "e2e_slo": slo_tail,
+        # distributed tracing (ISSUE 17): spans per placement, trace
+        # stitch rate (target >= 0.99), and the tracing-overhead A/B
+        # vs NOMAD_TPU_TRACE=0
+        "e2e_trace": trace_tail,
     }
 
 
@@ -916,6 +945,130 @@ def _e2e_spec(s, spec0: dict, rng, count: int) -> dict:
     arm(True)
     out["ab"] = {"on": arm(True), "off": arm(False)}
     return out
+
+
+def _e2e_slo(s, evals) -> dict:
+    """bench tail `e2e_slo` (ISSUE 17): per-priority-band scheduling-SLO
+    state over the measured window. The bench harness runs no clients,
+    so the observed latency is submit→eval-complete (plan committed) —
+    the control-plane share of the production submit→alloc-start SLO.
+    Objectives/targets come from the same NOMAD_TPU_SLO_* knobs the
+    server tracker reads, so a sweep tunes both at once."""
+    from nomad_tpu.lib.metrics import MetricsRegistry
+    from nomad_tpu.lib.tracectx import SLO_BANDS, SloTracker
+
+    reg = MetricsRegistry()
+    trk = SloTracker(reg, flight=None, source="bench")
+    burns = []
+    for eid, _scen, _ns, _jid in evals:
+        ev = s.state.eval_by_id(eid)
+        if ev is None or ev.status != "complete":
+            continue
+        if not ev.create_time or not ev.modify_time:
+            continue
+        latency_ms = max(ev.modify_time - ev.create_time, 0.0) * 1e3
+        res = trk.observe(ev.priority, latency_ms, now=ev.modify_time)
+        for b in res["fired"]:
+            burns.append({"band": res["band"], **b})
+    hist = reg.snapshot().get("histograms") or {}
+    latency = {}
+    for b in SLO_BANDS:
+        h = hist.get(f"slo.latency.{b}_ms") or {}
+        if h.get("count"):
+            latency[b] = {k: h[k] for k in ("count", "mean", "p50",
+                                            "p95", "p99")}
+    return {
+        "latency_source": "submit_to_eval_complete",
+        "objective": trk.objective,
+        "target_ms": dict(trk.target_ms),
+        "bands": trk.snapshot(),
+        "latency_ms": latency,
+        "burn_events": burns,
+    }
+
+
+def _e2e_trace(s, rng, count: int) -> dict:
+    """bench tail `e2e_trace` (ISSUE 17): a short traced arm — every
+    submit minted under its own root context, the resulting span trees
+    read back from the SpanStore — reporting spans-per-placement and
+    the stitch rate (a trace counts as stitched when its eval span is
+    present and every span's parent resolves inside the tree; target
+    >= 0.99), plus a throughput A/B against NOMAD_TPU_TRACE=0 pricing
+    the instrumentation itself."""
+    import os
+
+    from nomad_tpu.lib import tracectx
+    from nomad_tpu.synth import synth_service_job
+
+    def arm(enabled: bool, n: int = 32) -> dict:
+        prev = os.environ.get("NOMAD_TPU_TRACE")
+        os.environ["NOMAD_TPU_TRACE"] = "1" if enabled else "0"
+        try:
+            roots = []
+            t0 = time.time()
+            for i in range(n):
+                root = tracectx.mint()
+                with tracectx.use(root):
+                    ev = s.job_register(synth_service_job(
+                        rng, count=count, datacenter=f"dc{1 + i % 3}"))
+                if ev is not None:
+                    roots.append((root, ev.id))
+            done = 0
+            for _root, eid in roots:
+                got = s.wait_for_eval(
+                    eid, statuses=("complete", "failed", "blocked",
+                                   "cancelled"), timeout=120.0)
+                if got is not None:
+                    done += 1
+            dt = time.time() - t0
+            return {"roots": roots, "evals": done,
+                    "evals_per_sec": round(done / dt, 2) if dt else 0.0}
+        finally:
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_TRACE", None)
+            else:
+                os.environ["NOMAD_TPU_TRACE"] = prev
+
+    on = arm(True)
+    off = arm(False)
+    # late spans (ack-side eval emit, plan.apply) land asynchronously
+    # with the eval-status read — give the store a beat before stitching
+    time.sleep(0.25)
+    store = tracectx.default_spans()
+    stitched = 0
+    with_plan = 0
+    span_counts = []
+    for root, _eid in on["roots"]:
+        spans = store.for_trace(root.trace_id)
+        span_counts.append(len(spans))
+        ids = {sp["span_id"] for sp in spans}
+        names = {sp["name"] for sp in spans}
+        orphans = [sp for sp in spans
+                   if sp["parent_span_id"]
+                   and sp["parent_span_id"] != root.span_id
+                   and sp["parent_span_id"] not in ids]
+        if spans and "eval" in names and not orphans:
+            stitched += 1
+        if "plan.apply" in names:
+            with_plan += 1
+    n = len(on["roots"])
+    over = None
+    if on["evals_per_sec"] and off["evals_per_sec"]:
+        over = round((off["evals_per_sec"] / on["evals_per_sec"] - 1.0)
+                     * 100.0, 2)
+    return {
+        "traces": n,
+        "stitched": stitched,
+        "stitch_rate": round(stitched / n, 4) if n else None,
+        "with_plan_apply": with_plan,
+        "spans_per_placement_mean": round(
+            sum(span_counts) / len(span_counts), 2) if span_counts else 0.0,
+        "ab": {
+            "on": {k: on[k] for k in ("evals", "evals_per_sec")},
+            "off": {k: off[k] for k in ("evals", "evals_per_sec")},
+        },
+        "overhead_pct": over,
+    }
 
 
 def _drain_totals(reg) -> dict:

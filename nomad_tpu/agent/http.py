@@ -98,7 +98,9 @@ class HTTPApi:
                     token = self.headers.get("X-Nomad-Token") \
                         or query.get("token")
                     out = api.route(method, parsed.path, query, body,
-                                    token=token)
+                                    token=token,
+                                    traceparent=self.headers.get(
+                                        "traceparent"))
                     self._respond(200, out)
                 except HttpError as e:
                     self._respond(e.code, {"error": str(e)})
@@ -172,6 +174,48 @@ class HTTPApi:
                 if t not in g["tags"]:
                     g["tags"].append(t)
         return [grouped[k] for k in sorted(grouped)]
+
+    def _trace_source(self) -> str:
+        cluster = getattr(self.agent, "cluster", None)
+        if cluster is not None:
+            return f"{cluster.config.node_id}.{cluster.config.region}"
+        return "self"
+
+    def _traced_submit(self, op: Callable[[], Any],
+                       traceparent: Optional[str] = None) -> Tuple[Any, str]:
+        """The INGRESS edge of a distributed trace (ISSUE 17): mint the
+        trace context — honoring a well-formed inbound W3C `traceparent`
+        from the SDK, else a fresh root — bind it to this thread for the
+        dynamic extent of the submit (RPC forwarding and the leader's
+        `_create_eval` pick it up from the thread-local), and record the
+        `http.submit` span. Returns (result, trace_id)."""
+        from ..lib import tracectx
+
+        if not tracectx.trace_enabled():
+            return op(), ""
+        ctx = tracectx.mint(tracectx.parse_traceparent(traceparent))
+        t0 = time.time()
+        try:
+            with tracectx.use(ctx):
+                return op(), ctx.trace_id
+        finally:
+            tracectx.default_spans().record(
+                "http.submit", trace_id=ctx.trace_id,
+                span_id=ctx.span_id, parent_span_id=ctx.parent_span_id,
+                start_unix=t0, end_unix=time.time(),
+                source=self._trace_source())
+
+    def _submit_fn(self, server, method: str, *args) -> Callable[[], Any]:
+        """Submit callable for the traced ingress endpoints: a clustered
+        agent dispatches through `cluster.call`, which invokes locally
+        on the leader and leader-forwards over the RPC fabric on a
+        follower — the forwarding hop re-injects the trace context from
+        the thread-local (rpc/transport.py `ctx` slot). A dev agent
+        calls its in-process server directly."""
+        cluster = getattr(self.agent, "cluster", None)
+        if cluster is not None:
+            return lambda: cluster.call(method, *args)
+        return lambda: getattr(server, method)(*args)
 
     def _maybe_multiregion_register(self, server, job, local_region: str,
                                     token: Optional[str]) -> Optional[Any]:
@@ -482,7 +526,8 @@ class HTTPApi:
     # ---- routing (http.go:253 registerHandlers) ----
 
     def route(self, method: str, path: str, query: Dict[str, str],
-              body: Any, token: Optional[str] = None) -> Any:
+              body: Any, token: Optional[str] = None,
+              traceparent: Optional[str] = None) -> Any:
         parts0 = [p for p in path.split("/") if p]
         if not parts0 or parts0[0] != "v1":
             raise HttpError(404, f"no handler for {path}")
@@ -660,11 +705,14 @@ class HTTPApi:
                 if mr_out is not None:
                     return mr_out
                 try:
-                    ev = server.job_register(job)
+                    ev, trace_id = self._traced_submit(
+                        self._submit_fn(server, "job_register", job),
+                        traceparent)
                 except ValueError as e:
                     raise HttpError(400, str(e))
                 return {"eval_id": ev.id if ev else "",
-                        "job_modify_index": job.job_modify_index}
+                        "job_modify_index": job.job_modify_index,
+                        "trace_id": trace_id}
         # /v1/jobs/parse — server-side HCL parse (command/agent/
         # job_endpoint.go JobsParseRequest; capability-gated like the
         # reference post-1.2.4 — parsing arbitrary bodies is server CPU)
@@ -720,18 +768,24 @@ class HTTPApi:
                     if mr_out is not None:
                         return mr_out
                     try:
-                        ev = server.job_register(job)
+                        ev, trace_id = self._traced_submit(
+                            self._submit_fn(server, "job_register", job),
+                            traceparent)
                     except ValueError as e:
                         raise HttpError(400, str(e))
-                    return {"eval_id": ev.id if ev else ""}
+                    return {"eval_id": ev.id if ev else "",
+                            "trace_id": trace_id}
             if sub == "evaluate" and method in ("PUT", "POST"):
                 # Job.Evaluate (job_endpoint.go:710) — `nomad job eval`
                 require(acl.allow_namespace_operation(ns, "read-job"))
                 try:
-                    ev = server.job_evaluate(ns, job_id)
+                    ev, trace_id = self._traced_submit(
+                        self._submit_fn(server, "job_evaluate", ns,
+                                        job_id),
+                        traceparent)
                 except ValueError as e:
                     raise HttpError(400, str(e))
-                return {"eval_id": ev.id}
+                return {"eval_id": ev.id, "trace_id": trace_id}
             if sub == "allocations":
                 require(acl.allow_namespace_operation(ns, "read-job"))
                 return blocking(lambda snap: (
@@ -1478,6 +1532,26 @@ class HTTPApi:
                         400, f"plan needs integer nodes > 0 and "
                              f"allocs >= 0: {e}")
             return out
+        # /v1/trace/<trace_id> — THIS process's retained spans of one
+        # distributed trace (lib/tracectx.py SpanStore). Index long-poll
+        # exactly like /v1/operator/flight; a single server only holds
+        # its own hops — `nomad trace` stitches the full causal tree by
+        # asking every gossip-discovered server.
+        if parts and parts[0] == "trace":
+            require(acl.allow_operator_read())
+            if len(parts) != 2 or not parts[1]:
+                raise HttpError(404, "trace id required")
+            from ..lib.tracectx import default_spans
+
+            spans = default_spans()
+            try:
+                index = int(query.get("index", 0) or 0)
+                wait = min(float(query.get("wait", 0) or 0), 60.0)
+            except ValueError as e:
+                raise HttpError(400, f"index/wait must be numeric: {e}")
+            idx, recs = spans.spans_after(index, trace_id=parts[1],
+                                          timeout=wait)
+            return {"trace_id": parts[1], "index": idx, "spans": recs}
         # /v1/operator/flight — the control-plane flight recorder
         # (lib/flight.py): leadership changes, plan rejections, error
         # streaks, stuck leases, wave-collision spikes, membership
@@ -1592,6 +1666,20 @@ class HTTPApi:
                 if tr is not None:
                     traces[tid] = tr
         out["eval_traces"] = traces
+        # distributed-trace + SLO capture (ISSUE 17): this process's
+        # span ring (flight-recorder shape) and the per-band SLO state,
+        # so a bundle taken during an incident carries the causal
+        # waterfalls AND the budget picture without a live cluster
+        from ..lib.tracectx import default_spans
+
+        sp = default_spans()
+        slo = getattr(server, "slo", None)
+        out["trace"] = {
+            "index": sp.last_index(),
+            "spans": sp.snapshot(limit=256),
+            "counts": sp.counts(),
+            "slo": (slo.snapshot() if slo is not None else {}),
+        }
         missing = [s for s in DEBUG_SECTIONS if s not in out]
         assert not missing, f"debug sections missing: {missing}"
         return out

@@ -43,6 +43,9 @@ FLIGHT_TYPES = frozenset({
     # speculative dispatch (ISSUE 15, server/select_batch.py)
     "spec.rollback",       # certification rolled back speculative
                            # program slices (conflicting commit)
+    # scheduling SLOs (ISSUE 17, lib/tracectx.py SloTracker)
+    "slo.burn",            # error-budget burn rate crossed a fast- or
+                           # slow-window alerting threshold
 })
 
 # ---- Prometheus series names (tests/test_metrics_names.py) -----------------
@@ -118,6 +121,20 @@ PROM_REQUIRED = frozenset({
     "nomad_connect_issue_denied",
     "nomad_connect_issue_denied_identity",
     "nomad_connect_issue_denied_no_alloc",
+    # distributed tracing (ISSUE 17): SpanStore recording mirror on the
+    # process registry — span RATES without reading the ring
+    "nomad_trace_spans",
+    # per-priority scheduling SLOs (ISSUE 17): attainment + error-budget
+    # gauges and submit→alloc-start latency summaries per band, all
+    # pre-created at SloTracker construction so the pins hold on an
+    # agent that never placed an alloc
+    "nomad_slo_observations",
+    "nomad_slo_attainment_high", "nomad_slo_attainment_normal",
+    "nomad_slo_attainment_low",
+    "nomad_slo_budget_remaining_high", "nomad_slo_budget_remaining_normal",
+    "nomad_slo_budget_remaining_low",
+    "nomad_slo_latency_high_ms", "nomad_slo_latency_normal_ms",
+    "nomad_slo_latency_low_ms",
 })
 
 #: the raft node's promised series (ISSUE 13) — exposed from the NODE's
@@ -171,6 +188,9 @@ ALLOWED_PREFIXES = (
     "nomad_node_",            # node-identity registration outcomes
                               # (ISSUE 14: node.register_denied —
                               # write-once secret mismatch rejections)
+    "nomad_trace_",           # distributed-tracing SpanStore mirrors
+                              # (ISSUE 17)
+    "nomad_slo_",             # per-priority scheduling SLOs (ISSUE 17)
 )
 
 #: the only label names any exposed series may carry
@@ -208,3 +228,37 @@ BOOKING_PREFIXES = frozenset({"stack.view"})
 
 #: union the `site` label may carry in any exposition
 ALLOWED_SITES = frozenset(TRANSFER_SITES | RESIDENCY_SITES)
+
+# ---- distributed-trace span taxonomy (lib/tracectx.py SpanStore) -----------
+
+#: the closed span-name vocabulary for the ninth telemetry layer
+#: (ISSUE 17). `nomad trace` waterfalls and the debug-bundle stitcher
+#: key on these names; SpanStore.record raises on anything else, so a
+#: new span name is a conscious taxonomy act exactly like a new flight
+#: type. Parentage rules (enforced by the zero-orphan gate in
+#: tests/test_trace_distributed.py, documented in the README table):
+#:
+#:   http.submit   root (or child of the SDK's inbound `traceparent`)
+#:   rpc.forward   child of the caller's current span (submit hop:
+#:                 http.submit on the follower)
+#:   eval          child of the span current at broker enqueue
+#:                 (rpc.forward when forwarded, http.submit when local)
+#:   eval.<phase>  child of `eval` — one per lib/trace.py PHASES entry,
+#:                 mirrored off the EvalTracer's monotonic spans
+#:   plan.apply    child of `eval` — span id LEADER-MINTED in
+#:                 plan_apply.apply (like `now=`) and stamped onto the
+#:                 plan's allocs before the raft entry is journaled
+#:   alloc.start   child of `plan.apply` via the alloc's riding
+#:                 trace_span_id (client-side)
+#:   alloc.health  child of `alloc.start` (client-side health verdict)
+SPAN_NAMES = frozenset({
+    "http.submit",
+    "rpc.forward",
+    "eval",
+    "eval.queue_wait", "eval.claim", "eval.snapshot", "eval.schedule",
+    "eval.pack", "eval.delta_apply", "eval.kernel", "eval.plan_apply",
+    "eval.ack",
+    "plan.apply",
+    "alloc.start",
+    "alloc.health",
+})

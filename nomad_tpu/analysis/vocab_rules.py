@@ -17,6 +17,8 @@ Extracted call shapes (first literal-string argument unless noted):
   name).
 * flight events — `default_flight().record("type")` /
   `self._flight("type")` wrappers: the type must be in FLIGHT_TYPES.
+* trace spans — `default_spans().record("name")` / `<spans>.record`:
+  the span name must be in SPAN_NAMES.
 * transfer sites — `<ledger>.timed/record("site", ...)`: the site must
   be in TRANSFER_SITES.
 * residency sites — `<hbm>.track("site", ...)`: the site must be in
@@ -36,7 +38,7 @@ from typing import List, Optional
 from .core import Finding, dotted as _dotted
 from .vocab import (ALLOWED_PREFIXES, BOOKING_PREFIXES, FLIGHT_TYPES,
                     PROM_REQUIRED, RAFT_REQUIRED, RESIDENCY_SITES,
-                    TRANSFER_SITES)
+                    SPAN_NAMES, TRANSFER_SITES)
 
 VOCAB_RULES = {
     "NLV01": "name outside the pinned observability vocabulary",
@@ -93,6 +95,11 @@ def analyze_vocab(tree: ast.Module, rel: str) -> List[Finding]:
             if arg0 is not None and arg0 not in FLIGHT_TYPES:
                 flag(node, f"flight event type {arg0!r} is not in "
                            f"FLIGHT_TYPES")
+            continue
+        # distributed-trace span names (lib/tracectx.py SpanStore)
+        if leaf == "record" and "span" in recv:
+            if arg0 is not None and arg0 not in SPAN_NAMES:
+                flag(node, f"span name {arg0!r} is not in SPAN_NAMES")
             continue
         # transfer-ledger sites
         if leaf in ("timed", "record") and (

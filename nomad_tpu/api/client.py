@@ -22,7 +22,7 @@ class ApiError(Exception):
 DEBUG_SECTIONS = (
     "server", "control", "metrics", "prometheus", "timeline",
     "transfer_sites", "hbm", "drain", "flight", "raft", "wal",
-    "eval_traces",
+    "eval_traces", "trace",
 )
 
 
@@ -64,7 +64,8 @@ class NomadClient:
 
     def _request(self, method: str, path: str,
                  params: Optional[Dict[str, Any]] = None,
-                 body: Any = None) -> Any:
+                 body: Any = None,
+                 headers: Optional[Dict[str, str]] = None) -> Any:
         conn = self._connect()
         try:
             if self.region and not (params or {}).get("region"):
@@ -72,7 +73,8 @@ class NomadClient:
             qs = f"?{urlencode(params)}" if params else ""
             payload = json.dumps(to_json_tree(body)) \
                 if body is not None else None
-            headers = {"Content-Type": "application/json"}
+            headers = dict(headers or {})
+            headers["Content-Type"] = "application/json"
             if self.token:
                 headers["X-Nomad-Token"] = self.token
             conn.request(method, f"{path}{qs}", body=payload,
@@ -100,9 +102,21 @@ class NomadClient:
             "GET", "/v1/jobs", params={"prefix": prefix} if prefix else None))
         return [from_wire(j) for j in data]
 
-    def register_job(self, job) -> str:
-        out = self._request("PUT", "/v1/jobs", body={"job": to_wire(job)})
+    def register_job(self, job, traceparent: Optional[str] = None) -> str:
+        """Submit a job; an optional W3C `traceparent` makes the
+        server's http.submit span a child of the caller's trace
+        (lib/tracectx.py) instead of a fresh root."""
+        out = self.register_job_traced(job, traceparent=traceparent)
         return out.get("eval_id", "")
+
+    def register_job_traced(self, job,
+                            traceparent: Optional[str] = None) -> dict:
+        """register_job, returning the full response envelope —
+        `eval_id`, `job_modify_index` and the ingress-minted
+        `trace_id` (empty when tracing is disabled server-side)."""
+        hdrs = {"traceparent": traceparent} if traceparent else None
+        return self._request("PUT", "/v1/jobs",
+                             body={"job": to_wire(job)}, headers=hdrs)
 
     def job(self, job_id: str, namespace: str = "default"):
         return from_wire(self._request(
@@ -517,6 +531,19 @@ class NomadClient:
         if types:
             params["type"] = ",".join(types)
         return self._request("GET", "/v1/operator/flight", params=params)
+
+    def trace(self, trace_id: str, index: int = 0,
+              wait: float = 0.0) -> dict:
+        """This process's spans for one distributed trace (GET
+        /v1/trace/:trace_id). Long-polls like the event stream when
+        `wait` is set; returns {trace_id, index, spans}. One server
+        only holds the spans IT emitted — the `nomad trace` CLI
+        stitches the full tree across gossip-discovered servers."""
+        params: Dict[str, str] = {"index": str(index)}
+        if wait:
+            params["wait"] = str(wait)
+        return self._request("GET", f"/v1/trace/{trace_id}",
+                             params=params)
 
     def operator_debug(self) -> dict:
         """One server's full debug capture (GET /v1/operator/debug):

@@ -1567,6 +1567,108 @@ def cmd_operator_flight(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`nomad-tpu trace <trace-id>` — stitch one distributed trace back
+    together from every gossip-discovered server (each process only
+    holds the spans IT emitted) and render the span tree as a
+    waterfall. Unreachable servers degrade to a `missing-server`
+    annotation under the partial stitch instead of failing the
+    command; no spans anywhere is the error case (one line, exit 1)."""
+    from .api import ApiError
+
+    if not args.trace_id.strip():
+        print("Error: trace id required", file=sys.stderr)
+        return 1
+    api = _client(args)
+    try:
+        api.agent_self()  # reachability probe: one-line error + exit 1
+    except (ApiError, OSError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    # per-server targets through the gossip members' http_addr tags —
+    # the operator-debug discovery idiom
+    targets = {}
+    try:
+        members = api._request("GET", "/v1/agent/members") \
+            .get("members", [])
+    except (ApiError, OSError):
+        members = []
+    for m in members:
+        base = (m.get("tags") or {}).get("http_addr")
+        if not base or m.get("status") not in (None, "alive"):
+            continue
+        try:
+            targets[m["name"]] = _client_for_base(args, base)
+        except ValueError as e:
+            print(f"  skipping member {m.get('name')}: {e}",
+                  file=sys.stderr)
+    if not targets:
+        targets = {"self": api}
+    spans, missing = {}, []
+    for sname, sapi in sorted(targets.items()):
+        try:
+            out = sapi.trace(args.trace_id)
+        except Exception as e:  # noqa: BLE001 — partial stitch renders
+            missing.append((sname, str(e)))
+            continue
+        for s in out.get("spans", []):
+            # dedup by span id: in-process multi-server tests share one
+            # store, and a member can be reachable via two addresses
+            spans.setdefault(s.get("span_id", ""), s)
+    spans.pop("", None)
+    if not spans:
+        msg = f"Error: no spans found for trace {args.trace_id!r}"
+        if missing:
+            msg += f" ({len(missing)} server(s) unreachable)"
+        print(msg, file=sys.stderr)
+        return 1
+    recs = sorted(spans.values(),
+                  key=lambda s: (s.get("start_unix", 0.0),
+                                 s.get("span_id", "")))
+    if args.json:
+        print(json.dumps({"trace_id": args.trace_id, "spans": recs,
+                          "missing_servers": [m for m, _ in missing]},
+                         indent=2, default=str))
+        return 0
+    t0 = min(s.get("start_unix", 0.0) for s in recs)
+    t1 = max(s.get("start_unix", 0.0) + s.get("duration_ms", 0.0) / 1e3
+             for s in recs)
+    total_ms = max((t1 - t0) * 1e3, 1e-6)
+    ids = set(spans)
+    kids, roots = {}, []
+    for s in recs:
+        p = s.get("parent_span_id") or ""
+        if p and p in ids:
+            kids.setdefault(p, []).append(s)
+        else:
+            roots.append(s)  # root or remote parent (SDK traceparent)
+    print(f"Trace {args.trace_id} — {len(recs)} spans, "
+          f"{len(targets) - len(missing)}/{len(targets)} servers, "
+          f"{total_ms:.1f}ms")
+    width = 32
+    rows = []
+
+    def walk(s, depth):
+        off = (s.get("start_unix", 0.0) - t0) * 1e3
+        dur = s.get("duration_ms", 0.0)
+        lo = min(int(off / total_ms * width), width - 1)
+        ln = max(min(int(round(dur / total_ms * width)), width - lo), 1)
+        bar = " " * lo + "#" * ln
+        rows.append(["  " * depth + s.get("name", "?"),
+                     s.get("source") or "-", f"[{bar:<{width}}]",
+                     f"+{off:.1f}ms", f"{dur:.2f}ms"])
+        for c in kids.get(s.get("span_id", ""), []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    print(_columns(rows, ["Span", "Source", "Waterfall", "Start",
+                          "Duration"]))
+    for sname, err in missing:
+        print(f"  missing-server: {sname} ({err})")
+    return 0
+
+
 def cmd_operator_scheduler_get(args) -> int:
     api = _client(args)
     cfg = api.scheduler_config()
@@ -1997,6 +2099,11 @@ def build_parser() -> argparse.ArgumentParser:
     sci.add_argument("policy_id")
     sci.set_defaults(fn=cmd_scaling)
 
+    tr = sub.add_parser("trace", help="stitch one distributed trace "
+                                      "across all servers")
+    tr.add_argument("trace_id")
+    tr.add_argument("-json", action="store_true")
+    tr.set_defaults(fn=cmd_trace)
     op = sub.add_parser("operator", help="operator commands").add_subparsers(
         dest="sub", required=True)
     osn = op.add_parser("snapshot")

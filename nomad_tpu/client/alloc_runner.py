@@ -129,6 +129,18 @@ class AllocRunner:
         self._destroyed = False
         self._shutting_down = False
         self.client_status = ALLOC_CLIENT_PENDING
+        # distributed tracing (lib/tracectx.py): alloc.start covers
+        # run() entry → first running status and is emitted once; its
+        # minted span id parents the alloc.health verdict span. The
+        # trace identity itself rides the alloc struct (leader-stamped
+        # in plan_apply, structs/alloc.py). _trace_lock is a LEAF lock
+        # guarding _trace_t0/_alloc_span_id across the alloc thread,
+        # the status publisher and the health tracker — nothing else
+        # is acquired while it is held.
+        self._trace_lock = threading.Lock()
+        with self._trace_lock:
+            self._trace_t0 = time.time()
+            self._alloc_span_id = ""
 
     @property
     def alloc(self) -> Allocation:
@@ -164,6 +176,8 @@ class AllocRunner:
         self._thread.start()
 
     def _run(self) -> None:
+        with self._trace_lock:
+            self._trace_t0 = time.time()
         tasks = self._tasks()
         # allocDir hook (alloc_runner_hooks.go allocDirHook)
         self.alloc_dir.build([t.name for t in tasks])
@@ -287,6 +301,66 @@ class AllocRunner:
         self.network_handle = self.network_manager.create(
             self.alloc.id, port_maps)
 
+    def _trace_source(self) -> str:
+        n = self.node
+        if n is None:
+            return ""
+        return getattr(n, "name", "") or getattr(n, "id", "")
+
+    def _emit_alloc_start_span(self) -> None:
+        """alloc.start: run() entry → first running status, parented
+        under the leader-minted plan.apply span the alloc carries.
+        Emitted at most once (the span-id mint is the latch).
+        Telemetry only — never allowed to fail the alloc."""
+        alloc = self.alloc
+        if not alloc.trace_id:
+            return
+        try:
+            from ..lib import tracectx
+
+            if not tracectx.trace_enabled():
+                return
+            with self._trace_lock:
+                if self._alloc_span_id:
+                    return
+                self._alloc_span_id = span_id = tracectx.new_span_id()
+                t0 = self._trace_t0
+            tracectx.default_spans().record(
+                "alloc.start",
+                trace_id=alloc.trace_id,
+                span_id=span_id,
+                parent_span_id=alloc.trace_span_id,
+                start_unix=t0, end_unix=time.time(),
+                source=self._trace_source(),
+                detail={"alloc_id": alloc.id})
+        except Exception:  # noqa: BLE001 — telemetry must not bite
+            pass
+
+    def _emit_health_span(self, t0: float, healthy: bool) -> None:
+        """alloc.health: health-tracking start → verdict, child of the
+        alloc.start span (falls back to the plan.apply parent when the
+        alloc went running before tracing saw it)."""
+        alloc = self.alloc
+        if not alloc.trace_id:
+            return
+        try:
+            from ..lib import tracectx
+
+            if not tracectx.trace_enabled():
+                return
+            with self._trace_lock:
+                parent = self._alloc_span_id or alloc.trace_span_id
+            tracectx.default_spans().record(
+                "alloc.health",
+                trace_id=alloc.trace_id,
+                span_id=tracectx.new_span_id(),
+                parent_span_id=parent,
+                start_unix=t0, end_unix=time.time(),
+                source=self._trace_source(),
+                detail={"alloc_id": alloc.id, "healthy": bool(healthy)})
+        except Exception:  # noqa: BLE001 — telemetry must not bite
+            pass
+
     def _start_health_tracker(self) -> None:
         """Deployment-tracked allocs watch their own health and report
         the verdict to the servers (health_hook.go; tracker.go:95).
@@ -309,12 +383,17 @@ class AllocRunner:
             with self._lock:
                 return dict(self.task_states)
 
+        health_t0 = time.time()
+
+        def report_fn(healthy: bool) -> None:
+            self.conn.update_alloc_health(self.alloc.id, healthy)
+            self._emit_health_span(health_t0, healthy)
+
         self.health_tracker = HealthTracker(
             self.alloc,
             task_states_fn=task_states_fn,
             checks_fn=self.services.checks_status,
-            report_fn=lambda healthy: self.conn.update_alloc_health(
-                self.alloc.id, healthy),
+            report_fn=report_fn,
         )
         self.health_tracker.start()
         if self._halted():  # destroy/shutdown raced the creation
@@ -704,6 +783,8 @@ class AllocRunner:
             else:
                 status = ALLOC_CLIENT_PENDING
             self.client_status = status
+            if status == ALLOC_CLIENT_RUNNING:
+                self._emit_alloc_start_span()  # once — latched inside
             if status in (ALLOC_CLIENT_COMPLETE, ALLOC_CLIENT_FAILED):
                 self.services.stop()
             if self.on_update is not None and not shutting:

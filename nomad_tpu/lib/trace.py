@@ -39,6 +39,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from .metrics import MetricsRegistry
+from .tracectx import SpanStore, TraceContext, new_span_id
 
 #: canonical span order for display/aggregation
 PHASES = ("queue_wait", "claim", "snapshot", "schedule", "pack",
@@ -46,22 +47,32 @@ PHASES = ("queue_wait", "claim", "snapshot", "schedule", "pack",
 
 
 class _Trace:
-    __slots__ = ("spans", "marks", "wall_anchor", "mono_anchor")
+    __slots__ = ("spans", "marks", "wall_anchor", "mono_anchor", "ctx")
 
     def __init__(self) -> None:
         self.spans: List[Dict] = []
         self.marks: Dict[str, float] = {}
         self.wall_anchor = time.time()
         self.mono_anchor = time.monotonic()
+        #: distributed-trace binding (ISSUE 17): the eval's own span
+        #: context, bound once at broker enqueue from the ingress-
+        #: minted ids riding the Evaluation struct. When set, every
+        #: phase span this tracer records is mirrored into the process
+        #: SpanStore as `eval.<phase>`, parented under the eval span.
+        self.ctx: "TraceContext | None" = None
 
 
 class EvalTracer:
     """Bounded, thread-safe per-eval span store + phase histograms."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 capacity: int = 512) -> None:
+                 capacity: int = 512,
+                 spans: Optional[SpanStore] = None,
+                 source: str = "") -> None:
         self.registry = registry
         self.capacity = max(int(capacity), 1)
+        self.spans = spans
+        self.source = source
         self._lock = threading.Lock()
         self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
 
@@ -78,6 +89,38 @@ class EvalTracer:
             else:
                 self._traces.move_to_end(trace_id)
             tr.marks["enqueue"] = time.monotonic()
+
+    def bind(self, trace_id: str, ctx: Optional[TraceContext]) -> None:
+        """Attach the eval's distributed span context (first bind wins
+        — nack redeliveries must not re-parent an in-flight trace;
+        no-op for unknown ids or a None ctx)."""
+        if ctx is None:
+            return
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            if tr is not None and tr.ctx is None:
+                tr.ctx = ctx
+
+    def binding(self, trace_id: str) -> Optional[TraceContext]:
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            return tr.ctx if tr is not None else None
+
+    def emit_root(self, trace_id: str) -> None:
+        """Record the eval's ROOT span (enqueue anchor → now) into the
+        SpanStore — called once at the terminal ack/fail point, after
+        the final phase span mirrored."""
+        with self._lock:
+            tr = self._traces.get(trace_id)
+            if tr is None or tr.ctx is None:
+                return
+            ctx, wall0 = tr.ctx, tr.wall_anchor
+        if self.spans is not None:
+            self.spans.record(
+                "eval", trace_id=ctx.trace_id, span_id=ctx.span_id,
+                parent_span_id=ctx.parent_span_id, start_unix=wall0,
+                end_unix=time.time(), source=self.source,
+                detail={"eval_id": trace_id})
 
     def mark(self, trace_id: str, name: str) -> None:
         """Store a named monotonic timestamp (no-op for unknown ids)."""
@@ -102,6 +145,17 @@ class EvalTracer:
             if tr is None:
                 return
             tr.spans.append({"phase": phase, "start": start, "end": end})
+            ctx = tr.ctx
+            # monotonic → wall against the trace's anchors, so the
+            # mirrored span lines up with spans from other processes
+            wall0 = tr.wall_anchor + (start - tr.mono_anchor)
+            wall1 = tr.wall_anchor + (end - tr.mono_anchor)
+        if ctx is not None and self.spans is not None:
+            self.spans.record(
+                "eval." + phase, trace_id=ctx.trace_id,
+                span_id=new_span_id(), parent_span_id=ctx.span_id,
+                start_unix=wall0, end_unix=wall1, source=self.source,
+                detail={"eval_id": trace_id})
 
     def span_from_mark(self, trace_id: str, mark: str, phase: str) -> None:
         """Record `phase` spanning the stored mark → now (no-op when the

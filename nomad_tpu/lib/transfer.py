@@ -272,7 +272,8 @@ class DispatchTimeline:
                kernel_start: float, transfer_bytes: int,
                transfer_count: int,
                upload: Optional[Tuple[float, float]] = None,
-               speculative: bool = False) -> int:
+               speculative: bool = False,
+               traces: Optional[List[str]] = None) -> int:
         """Append a dispatch record at kernel launch; returns its seq.
         `pack`/`upload`/`view` are monotonic (start, end) intervals —
         `upload` is the explicit packed-buffer host→device transfer
@@ -302,6 +303,10 @@ class DispatchTimeline:
                 "overlap_ms": None, "bubble_ms": None,
                 "speculative": bool(speculative),
                 "spec_outcome": None,
+                # distributed trace ids of the evals whose programs ride
+                # this dispatch — ties the timeline record into the
+                # cross-process trace tree (lib/tracectx.py).
+                "traces": [t for t in (traces or []) if t],
             }
             self._ring.append(rec)
             self._finalize_locked(seq)

@@ -4,8 +4,16 @@ Behavioral reference: `nomad/rpc.go` (listener/dispatch :104,253),
 `helper/pool/pool.go` (msgpack codecs :23-28, conn pool :130). Frames are
 `uint32 big-endian length + msgpack body`:
 
-  request : {"t": "req", "seq": N, "method": "Job.Register", "args": [...]}
+  request : {"t": "req", "seq": N, "method": "Job.Register", "args": [...],
+             "ctx": {"t": trace_id, "s": span_id, "p": parent}?}
   response: {"t": "res", "seq": N, "ok": bool, "result": ..., "error": str}
+
+The optional `ctx` slot is distributed-trace context (lib/tracectx.py):
+`RpcClient.call` injects a CHILD of the caller thread's current context
+(recording the hop as an `rpc.forward` span), `RpcServer._handle_one`
+restores it onto the handler thread, so a forwarded call re-injects it
+on the next hop automatically. Peers without the slot interoperate —
+absent or malformed context is simply no trace, never an error.
 
 Handlers are registered by dotted method name exactly like the reference's
 `<Endpoint>.<Method>` msgpack-RPC convention. The server answers requests
@@ -24,6 +32,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import msgpack
 
 from ..lib.metrics import MetricsRegistry, default_registry
+from ..lib.tracectx import (TraceContext, current as trace_current,
+                            default_spans, trace_enabled, use as trace_use)
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 * 1024 * 1024
@@ -163,11 +173,17 @@ class RpcServer:
     def _handle_one(self, conn, wlock, msg) -> None:
         res = {"t": "res", "seq": msg.get("seq")}
         handler = self._handlers.get(msg.get("method", ""))
+        # restore the caller's trace context onto this handler thread:
+        # a forwarding handler's own pool.call then re-injects it on
+        # the next hop with no per-endpoint plumbing
+        ctx = TraceContext.from_wire(msg.get("ctx"))
         try:
             if handler is None:
                 raise RpcError(f"unknown method {msg.get('method')!r}")
+            with trace_use(ctx):
+                result = handler(*msg.get("args", []))
             res["ok"] = True
-            res["result"] = handler(*msg.get("args", []))
+            res["result"] = result
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             res["ok"] = False
             res["error"] = f"{type(e).__name__}: {e}"
@@ -251,32 +267,59 @@ class RpcClient:
 
     def _call(self, method: str, *args: Any,
               timeout: Optional[float] = 10.0) -> Any:
-        if self._closed:
-            raise ConnectionError("client closed")
+        # the closed check lives under _plock WITH the registration:
+        # checked outside, a teardown between check and register left a
+        # _Pending nobody would ever fail — the caller then hung out
+        # its full timeout (forever with timeout=None) on a connection
+        # already known dead
         with self._plock:
+            if self._closed:
+                raise ConnectionError("client closed")
             self._seq += 1
             seq = self._seq
             p = _Pending()
             self._pending[seq] = p
+        caller = trace_current()
+        hop = None
+        req = {"t": "req", "seq": seq, "method": method,
+               "args": list(args)}
+        if caller is not None and trace_enabled():
+            hop = caller.child()
+            req["ctx"] = hop.to_wire()
+            hop_start = time.time()
         try:
-            write_frame(self._sock,
-                        {"t": "req", "seq": seq, "method": method,
-                         "args": list(args)}, self._wlock)
+            write_frame(self._sock, req, self._wlock)
         except (ConnectionError, OSError):
             self._fail_all()
             raise ConnectionError("send failed")
-        if not p.event.wait(timeout):
-            with self._plock:
-                self._pending.pop(seq, None)
-            raise TimeoutError(f"rpc {method} timed out")
-        if p.msg is None:
-            raise ConnectionError("connection lost")
-        if not p.msg.get("ok"):
-            raise RpcError(p.msg.get("error", "unknown remote error"))
-        return p.msg.get("result")
+        try:
+            if not p.event.wait(timeout):
+                with self._plock:
+                    self._pending.pop(seq, None)
+                raise TimeoutError(f"rpc {method} timed out")
+            if p.msg is None:
+                raise ConnectionError("connection lost")
+            if not p.msg.get("ok"):
+                raise RpcError(p.msg.get("error", "unknown remote error"))
+            return p.msg.get("result")
+        finally:
+            if hop is not None:
+                # the hop span is the CLIENT's view of the forward
+                # (request→response, queue + remote handler inclusive)
+                default_spans().record(
+                    "rpc.forward", trace_id=hop.trace_id,
+                    span_id=hop.span_id,
+                    parent_span_id=hop.parent_span_id,
+                    start_unix=hop_start, end_unix=time.time(),
+                    detail={"method": method,
+                            "peer": f"{self.addr[0]}:{self.addr[1]}"})
 
     def close(self) -> None:
-        self._closed = True
+        # fail in-flight waiters DIRECTLY: relying on the reader thread
+        # to notice the socket close and run _fail_all left a window
+        # where a waiter slept out its timeout against a socket this
+        # process itself had already discarded
+        self._fail_all()
         try:
             self._sock.close()
         except OSError:

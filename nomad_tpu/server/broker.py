@@ -37,6 +37,7 @@ import numpy as np
 from ..utils import fast_uuid
 from ..lib import DelayHeap
 from ..lib.metrics import MetricsRegistry
+from ..lib.tracectx import TraceContext
 from ..structs import Evaluation
 
 FAILED_QUEUE = "_failed"
@@ -177,6 +178,14 @@ class EvalBroker:
             # the eval id IS the trace id; (re-)enqueue re-anchors the
             # queue_wait span (nack redeliveries measure their own wait)
             self.tracer.begin(eval.id)
+            # distributed binding (ISSUE 17): the ingress-minted span
+            # context rides the Evaluation struct; binding it here
+            # parents every phase span this eval records under the
+            # submit trace (first bind wins across redeliveries)
+            if eval.trace_id and eval.trace_span_id:
+                self.tracer.bind(eval.id, TraceContext(
+                    eval.trace_id, eval.trace_span_id,
+                    eval.trace_parent_span_id))
         now = time.time()
         if eval.wait_until and eval.wait_until > now:
             if not self._delayed.push(eval.id, eval.wait_until, eval):
@@ -529,6 +538,10 @@ class EvalBroker:
                     del self._job_pending[jk]
                 self._enqueue_locked(nxt, token="")
             self._cv.notify_all()
+        if self.tracer is not None:
+            # close the eval's ROOT span (enqueue → ack) outside the
+            # broker lock — it lands in the process SpanStore
+            self.tracer.emit_root(eval_id)
 
     def nack(self, eval_id: str, token: str) -> None:
         with self._cv:

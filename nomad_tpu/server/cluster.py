@@ -191,7 +191,8 @@ class ClusterServerConfig(ServerConfig):
 #: forward()). node_update_allocs — not the raw state merge — is the
 #: status-push route so reschedule evals and unblocking fire.
 FORWARDED = (
-    "job_register", "job_deregister", "node_register", "node_update_status",
+    "job_register", "job_deregister", "job_evaluate",
+    "node_register", "node_update_status",
     "node_update_drain", "node_update_eligibility", "node_heartbeat",
     "node_update_allocs", "node_get_client_allocs", "alloc_get",
     "node_get", "run_gc",
@@ -307,6 +308,12 @@ class ClusterServer:
         # clients keep their failover list current (NodeServerInfo)
         srv.server_addrs_fn = \
             lambda: self.region_servers(self.config.region)
+        # spans land in the PROCESS-global store; the serf-style member
+        # name keeps co-hosted servers tellable apart in a stitched
+        # trace (in-process cluster tests, `nomad trace` rendering)
+        member = f"{self.config.node_id}.{self.config.region}"
+        srv.tracer.source = member
+        srv.slo.source = member
         return srv
 
     def _member_change(self, member) -> None:

@@ -323,6 +323,7 @@ class PlanApplier:
         # validation, nomad/plan_endpoint.go:31). A nack-timeout redelivery
         # must not let two workers commit plans for the same eval.
         t0 = time.perf_counter()
+        wall0 = time.time()
         if self.broker is not None and plan.eval_token:
             if not self.broker.outstanding(plan.eval_id, plan.eval_token):
                 self._ctr["stale_token"].inc()
@@ -397,10 +398,23 @@ class PlanApplier:
         # function of the entry; reference structs.Allocation
         # CreateTime/ModifyTime are also set plan-side).
         now = time.time()
+        # The plan-apply SPAN ID is minted here too, leader-side like
+        # `now` (ISSUE 17): stamped onto the committed allocs so the
+        # raft entry carries it — every replica applies identical trace
+        # ids (replica-determinism gate in test_trace_distributed.py) —
+        # and the client's alloc.start span parents under it for free.
+        from ..lib.tracectx import new_span_id, trace_enabled
+
+        plan_span_id = ""
+        if plan.trace_id and trace_enabled():
+            plan_span_id = new_span_id()
         for allocs in result.node_allocation.values():
             for a in allocs:
                 a.create_time = a.create_time or now
                 a.modify_time = now
+                if plan_span_id:
+                    a.trace_id = plan.trace_id
+                    a.trace_span_id = plan_span_id
         cl = getattr(self.state, "cluster", None)
         if (cl is not None and getattr(self.state, "raft", None) is None
                 and hasattr(self.state, "mutation_lock")):
@@ -428,6 +442,23 @@ class PlanApplier:
             round(self._ctr["partial"].value
                   / max(self._ctr["applied"].value, 1), 4))
         self._apply_ms.add_sample((time.perf_counter() - t0) * 1e3)
+        if plan_span_id:
+            # the leader's view of verify+commit, parented under the
+            # eval span the plan inherited from its evaluation
+            from ..lib.tracectx import default_spans
+
+            try:
+                n_placed = sum(len(v) for v in
+                               result.node_allocation.values())
+                default_spans().record(
+                    "plan.apply", trace_id=plan.trace_id,
+                    span_id=plan_span_id,
+                    parent_span_id=plan.trace_span_id,
+                    start_unix=wall0, end_unix=time.time(),
+                    detail={"eval_id": plan.eval_id,
+                            "placed": n_placed, "partial": bool(partial)})
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
         if partial:
             # optimistic rejection → flight event: a failover or a
             # wave-collision storm shows up as a plan.partial burst in

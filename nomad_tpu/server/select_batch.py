@@ -526,7 +526,8 @@ class SelectCoordinator:
                         pack=(_mono(tv), _mono(tv)),
                         view=(_mono(tv), _mono(tk)),
                         kernel_start=_mono(tk),
-                        transfer_bytes=moved[0], transfer_count=moved[1])
+                        transfer_bytes=moved[0], transfer_count=moved[1],
+                        traces=self._dist_traces([r]))
                 dev = (res.sel_idx, res.sel_score,
                        res.nodes_feasible, res.nodes_fit)
                 if res.explain is not None:
@@ -589,7 +590,8 @@ class SelectCoordinator:
                     view=(_mono(t2), _mono(tv)),
                     kernel_start=_mono(tv),
                     transfer_bytes=nb + moved[0],
-                    transfer_count=3 + moved[1])
+                    transfer_count=3 + moved[1],
+                    traces=self._dist_traces(reqs))
             out = _BatchOut(dev_out, _kernel_done(reqs, tv, seq))
             # release waiters at LAUNCH: each materializes the shared
             # output as the chain lands and rolls straight into its plan
@@ -1207,6 +1209,21 @@ class SelectCoordinator:
             tid = self.trace_ids.get(r.order)
             if tid is not None:
                 self.tracer.record(tid, phase, start=start, end=end)
+
+    def _dist_traces(self, reqs: List[_SelectReq]) -> List[str]:
+        """Distributed trace ids (lib/tracectx.py) of the evals riding a
+        dispatch, deduped in batch order — stamped onto the
+        DispatchTimeline record so the per-process pipeline view ties
+        back into the cross-process trace tree."""
+        if self.tracer is None:
+            return []
+        out: List[str] = []
+        for r in reqs:
+            tid = self.trace_ids.get(r.order)
+            ctx = self.tracer.binding(tid) if tid is not None else None
+            if ctx is not None and ctx.trace_id not in out:
+                out.append(ctx.trace_id)
+        return out
 
 
 def _inert_program(p):

@@ -169,12 +169,24 @@ class Server:
         # cross-count). Served on /v1/metrics + /v1/evaluation/:id/trace.
         # Created BEFORE the state store so the WAL appends are
         # registry-instrumented from the very first restore-time write.
+        from ..lib.flight import default_flight
         from ..lib.metrics import MetricsRegistry
         from ..lib.trace import EvalTracer
+        from ..lib.tracectx import SloTracker, default_spans
         from ..lib.transfer import DispatchTimeline
 
         self.metrics = MetricsRegistry()
-        self.tracer = EvalTracer(self.metrics)
+        # eval phase spans mirror into the process-global SpanStore
+        # (ISSUE 17): distributed traces are stitched ACROSS servers, so
+        # the ring is per process like the flight recorder, with spans
+        # carrying a per-server `source` (set by the cluster agent)
+        self.tracer = EvalTracer(self.metrics, spans=default_spans(),
+                                 source="self")
+        # per-priority scheduling SLOs (ISSUE 17): submit→alloc-start
+        # attainment/budget/burn, observed leader-side on the first
+        # client_status=running report (node_update_allocs)
+        self.slo = SloTracker(self.metrics, flight=default_flight(),
+                              source="self")
         if state is not None:
             # Injected store (the cluster agent passes a RaftStateStore)
             self.state = state
@@ -491,6 +503,12 @@ class Server:
                                   index=self.state.index.value))
 
     def apply_eval_update(self, eval: Evaluation, reblock: bool = False) -> None:
+        # leader-minted modify stamp, BEFORE the journaled upsert: it
+        # rides the `upsert_eval` log entry (like `now=` in
+        # `_create_eval`), so replay stays deterministic while
+        # submit→complete latency is readable from the struct (the
+        # bench `e2e_slo` tail reads modify_time − create_time)
+        eval.modify_time = time.time()
         self.state.upsert_eval(eval)
         self._publish("Eval", "EvalUpdated", eval.id, eval.namespace)
         if reblock or eval.should_block():
@@ -505,6 +523,20 @@ class Server:
     def _create_eval(self, **kwargs) -> Evaluation:
         eval = Evaluation(**kwargs)
         eval.create_time = eval.modify_time = time.time()
+        # distributed-trace binding (ISSUE 17): when this eval is being
+        # created under an ingress trace (HTTP submit / forwarded RPC —
+        # the transport restored the context onto this thread), mint the
+        # eval's OWN span as a child and stamp it on the struct BEFORE
+        # the raft write — leader-minted like the timestamps above, so
+        # apply stays a pure function of the log (NLR01).
+        from ..lib import tracectx
+
+        caller = tracectx.current()
+        if caller is not None and tracectx.trace_enabled():
+            child = caller.child()
+            eval.trace_id = child.trace_id
+            eval.trace_span_id = child.span_id
+            eval.trace_parent_span_id = child.parent_span_id
         self.apply_eval_update(eval)
         return eval
 
@@ -1219,9 +1251,18 @@ class Server:
         trigger reschedule evals."""
         jobs_to_eval: Dict[Tuple[str, str], Job] = {}
         for up in updates:
+            # SLO observe point (ISSUE 17): the FIRST transition to
+            # client_status=running closes the submit→alloc-start
+            # latency window. Read the pre-merge status here, leader-
+            # side — never inside update_alloc_from_client, which is an
+            # apply-path ALLOWED_OPS method (NLR01).
+            prev = self.state.alloc_by_id(up.id)
             merged = self.state.update_alloc_from_client(up)
             if merged is None:
                 continue
+            if merged.client_status == "running" and (
+                    prev is None or prev.client_status != "running"):
+                self._observe_slo_start(merged)
             self._publish("Alloc", "AllocUpdated", merged.id,
                           merged.namespace)
             if merged.terminal_status():
@@ -1242,6 +1283,20 @@ class Server:
                 job_id=job_id,
                 status=EVAL_STATUS_PENDING,
             )
+
+    def _observe_slo_start(self, alloc: Allocation) -> None:
+        """Feed one alloc's submit→start latency into the SLO tracker:
+        latency is now − the creating eval's create_time (the ingress
+        stamp), band from the eval's priority. Telemetry only — any
+        miss (evicted eval, restored state) is a silent skip."""
+        try:
+            ev = self.state.eval_by_id(alloc.eval_id)
+            if ev is None or not ev.create_time:
+                return
+            latency_ms = max(time.time() - ev.create_time, 0.0) * 1e3
+            self.slo.observe(ev.priority, latency_ms)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
 
     # ---- Deployment endpoint (nomad/deployment_endpoint.go) ----
 

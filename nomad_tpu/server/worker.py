@@ -64,6 +64,12 @@ class EvalContext:
         plan.eval_token = self.token
         plan.snapshot_index = (self.snapshot.index_at
                                if self.snapshot is not None else 0)
+        # belt: plans built via Evaluation.make_plan already carry the
+        # eval's trace context; backfill hand-built plans so plan_apply
+        # can parent its span and stamp allocs (lib/tracectx.py)
+        if not plan.trace_id and self.eval.trace_id:
+            plan.trace_id = self.eval.trace_id
+            plan.trace_span_id = self.eval.trace_span_id
         tracer = getattr(self.server, "tracer", None)
         t0 = time.monotonic()
         try:

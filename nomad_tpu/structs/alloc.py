@@ -202,6 +202,13 @@ class Allocation:
     alloc_modify_index: int = 0
     create_time: float = 0.0
     modify_time: float = 0.0
+    # distributed-trace binding (ISSUE 17): LEADER-stamped in
+    # plan_apply.apply next to the `now=` mint and riding the raft
+    # entry, so replicas store identical ids (NLR01) and the client's
+    # alloc_runner parents its alloc.start span under the leader's
+    # plan.apply span (trace_span_id) with no extra RPC.
+    trace_id: str = ""
+    trace_span_id: str = ""
 
     def server_terminal_status(self) -> bool:
         """Reference `Allocation.ServerTerminalStatus` (structs.go:8831)."""

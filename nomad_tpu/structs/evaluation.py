@@ -68,6 +68,15 @@ class Evaluation:
     modify_index: int = 0
     create_time: float = 0.0
     modify_time: float = 0.0
+    # distributed-trace binding (ISSUE 17): INGRESS-minted by the
+    # leader's _create_eval (never apply-side — NLR01) and riding the
+    # raft entry like create_time, so every replica stores the same
+    # ids. trace_span_id is this eval's OWN span; trace_parent_span_id
+    # the ingress/forward span it parents under. Empty on evals that
+    # predate the tracer or were minted by internal triggers.
+    trace_id: str = ""
+    trace_span_id: str = ""
+    trace_parent_span_id: str = ""
 
     def terminal_status(self) -> bool:
         return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED)
@@ -91,6 +100,10 @@ class Evaluation:
             priority=priority,
             job=job,
             all_at_once=job.all_at_once if job is not None else False,
+            # the plan inherits the eval's trace binding so the leader's
+            # plan_apply span parents under the eval span (ISSUE 17)
+            trace_id=self.trace_id,
+            trace_span_id=self.trace_span_id,
         )
 
     def create_blocked_eval(self, class_eligibility: Dict[str, bool], escaped: bool,

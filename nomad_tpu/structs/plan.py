@@ -72,6 +72,14 @@ class Plan:
     #: plan of the same eval can never vouch for an earlier dispatch's
     #: uncommitted placements
     carry_token: Optional[int] = None
+    #: distributed-trace binding inherited from the eval (ISSUE 17):
+    #: trace_span_id is the EVAL span the leader's plan-apply span
+    #: parents under. The plan-apply span id itself is leader-minted in
+    #: plan_apply.apply (like `now=`) and stamped onto the result
+    #: allocs before the raft entry is journaled — never here, never
+    #: apply-side.
+    trace_id: str = ""
+    trace_span_id: str = ""
 
     def append_stopped_alloc(self, alloc: Allocation, desired_desc: str,
                              client_status: str = "") -> None:

@@ -43,7 +43,7 @@ class TestVocabulary:
             "leadership.gained", "leadership.lost", "raft.term",
             "plan.partial", "broker.eval_failed", "heartbeat.expired",
             "error.streak", "hbm.stuck_lease", "wave.collisions",
-            "membership.change", "spec.rollback",
+            "membership.change", "spec.rollback", "slo.burn",
         }
 
 

@@ -261,6 +261,32 @@ class TestSeriesNameStability:
         assert snap["counters"].get(
             "connect.issue_denied_no_alloc", 0) >= 1
 
+    def test_trace_and_slo_series_are_live(self, loaded_agent):
+        """The ninth-layer families (ISSUE 17) must be fed by real
+        flows, not just pre-created at tracker init: every HTTP submit
+        above minted an ingress span, and each placed alloc's
+        pending→running flip recorded an SLO observation."""
+        from nomad_tpu.lib.tracectx import SLO_BANDS, default_spans
+
+        a, api = loaded_agent
+        # ingress spans were recorded for the submits the fixture drove
+        assert default_spans().counts().get("http.submit", 0) >= 3
+        # eval spans were bound at broker enqueue and emitted at ack
+        assert default_spans().counts().get("eval", 0) >= 1
+        # alloc start-latency observations land asynchronously as
+        # client allocs flip to running
+        assert _wait(lambda: a.server.metrics.snapshot()["counters"]
+                     .get("slo.observations", 0) >= 1)
+        names, _, _ = _parse(api.metrics_prometheus())
+        assert "nomad_trace_spans" in names
+        assert "nomad_slo_observations" in names
+        # per-band attainment/budget gauges exist from first exposition
+        # (dashboards need the full band matrix, not lazily-appearing
+        # rows)
+        for band in SLO_BANDS:
+            assert f"nomad_slo_attainment_{band}" in names
+            assert f"nomad_slo_budget_remaining_{band}" in names
+
 
 
 

@@ -1,0 +1,244 @@
+"""RPC transport error paths + trace-context envelope (ISSUE 17).
+
+The teardown bugs pinned here were real: (1) `close()` relied on the
+reader thread noticing the dead socket, so an in-flight caller could
+sleep out its FULL timeout (forever with `timeout=None`) against a
+connection this process had already discarded; (2) the closed check ran
+OUTSIDE the pending-registration lock, so a teardown racing a call left
+a `_Pending` nobody would ever fail. Both now fail promptly, the pool
+evicts dead clients and redials, and the optional `ctx` envelope slot
+restores the caller's trace context handler-side while tolerating
+garbage from old or hostile peers.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.lib.tracectx import (current as trace_current,
+                                    default_spans, mint, use)
+from nomad_tpu.rpc.transport import (ConnPool, RpcClient, RpcError,
+                                     RpcServer, read_frame, write_frame)
+
+
+@pytest.fixture()
+def server():
+    srv = RpcServer()
+    gate = threading.Event()
+    seen = {}
+
+    def echo(*args):
+        seen["ctx"] = trace_current()
+        return list(args)
+
+    def block():
+        gate.wait(10.0)
+        return "unblocked"
+
+    def boom():
+        raise ValueError("kaput")
+
+    srv.register("Test.echo", echo)
+    srv.register("Test.block", block)
+    srv.register("Test.boom", boom)
+    srv.start()
+    yield srv, gate, seen
+    gate.set()
+    srv.shutdown()
+
+
+class TestTeardownPromptness:
+    def test_inflight_call_fails_promptly_on_close(self, server):
+        """The headline bug: an in-flight call with timeout=None must
+        raise as soon as close() runs, not hang forever."""
+        srv, gate, _ = server
+        c = RpcClient(*srv.addr)
+        errs, done = [], threading.Event()
+
+        def go():
+            try:
+                c.call("Test.block", timeout=None)
+            except Exception as e:  # noqa: BLE001 — the error IS the test
+                errs.append(e)
+            done.set()
+
+        threading.Thread(target=go, daemon=True).start()
+        time.sleep(0.2)  # let the request hit the wire
+        t0 = time.time()
+        c.close()
+        assert done.wait(3.0), "in-flight call hung past close()"
+        assert time.time() - t0 < 2.0
+        assert errs and isinstance(errs[0], ConnectionError)
+
+    def test_call_on_closed_client_raises_immediately(self, server):
+        srv, _, _ = server
+        c = RpcClient(*srv.addr)
+        c.close()
+        t0 = time.time()
+        with pytest.raises(ConnectionError):
+            c.call("Test.echo", 1, timeout=None)
+        assert time.time() - t0 < 1.0, \
+            "closed-client call slept instead of failing fast"
+
+    def test_close_racing_many_calls_hangs_nobody(self, server):
+        """Teardown concurrent with a burst of calls: every caller gets
+        an exception (never a hang), pending map drains to empty."""
+        srv, _, _ = server
+        c = RpcClient(*srv.addr)
+        results = []
+
+        def go():
+            try:
+                results.append(("ok", c.call("Test.block", timeout=None)))
+            except Exception as e:  # noqa: BLE001
+                results.append(("err", type(e).__name__))
+
+        threads = [threading.Thread(target=go, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        c.close()
+        for t in threads:
+            t.join(3.0)
+        assert not any(t.is_alive() for t in threads), "caller hung"
+        assert len(results) == 8
+        assert all(kind == "err" for kind, _ in results)
+        assert c._pending == {}
+
+    def test_peer_death_fails_waiters(self, server):
+        """The wire dying under us (peer crash, network cut) must fail
+        the in-flight call via the reader thread, not let it sleep out
+        its timeout."""
+        srv, _, _ = server
+        c = RpcClient(*srv.addr)
+        errs, done = [], threading.Event()
+
+        def go():
+            try:
+                c.call("Test.block", timeout=None)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+            done.set()
+
+        threading.Thread(target=go, daemon=True).start()
+        time.sleep(0.2)
+        c._sock.shutdown(socket.SHUT_RDWR)  # cut the wire
+        assert done.wait(3.0), "caller hung past peer death"
+        assert errs and isinstance(errs[0], ConnectionError)
+        c.close()
+
+
+class TestRemoteErrors:
+    def test_unknown_method_is_rpc_error(self, server):
+        srv, _, _ = server
+        c = RpcClient(*srv.addr)
+        with pytest.raises(RpcError, match="unknown method"):
+            c.call("Test.nope")
+        c.close()
+
+    def test_handler_exception_crosses_the_wire(self, server):
+        srv, _, _ = server
+        c = RpcClient(*srv.addr)
+        with pytest.raises(RpcError, match="ValueError: kaput"):
+            c.call("Test.boom")
+        # the connection survives a handler error (pipelined, not fatal)
+        assert c.call("Test.echo", "still-alive") == ["still-alive"]
+        c.close()
+
+
+class TestConnPool:
+    def test_evicts_dead_client_and_redials(self, server):
+        srv, _, _ = server
+        pool = ConnPool()
+        addr = tuple(srv.addr)
+        assert pool.call(addr, "Test.echo", 1) == [1]
+        first = pool._conns[addr]
+        first.close()  # simulate the peer connection dying
+        # next call must not be handed the corpse: evict + redial
+        assert pool.call(addr, "Test.echo", 2) == [2]
+        assert pool._conns[addr] is not first
+        pool.close()
+
+    def test_dead_server_single_redial_then_raises(self, server):
+        """When the peer is gone for good, the pool makes exactly one
+        reconnect attempt and then surfaces the error — it must not
+        hand the caller the dead cached client, and must not retry
+        forever either."""
+        srv, _, _ = server
+        pool = ConnPool()
+        addr = tuple(srv.addr)
+        assert pool.call(addr, "Test.echo", 1) == [1]
+        srv.shutdown()
+        pool._conns[addr].close()  # cached conn learns of the death
+        with pytest.raises((ConnectionError, OSError)):
+            pool.call(addr, "Test.echo", 2)
+        pool.close()
+        assert pool._conns == {}
+
+
+class TestCtxEnvelope:
+    def test_ctx_injected_and_restored_handler_side(self, server):
+        srv, _, seen = server
+        c = RpcClient(*srv.addr)
+        with use(mint()):
+            caller = trace_current()
+            idx0 = default_spans().last_index()
+            c.call("Test.echo", "x")
+        got = seen["ctx"]
+        assert got is not None
+        assert got.trace_id == caller.trace_id
+        # the handler runs under the HOP's context, a child of the
+        # caller's span — a forwarding handler's own pool.call then
+        # parents the next hop correctly with no extra plumbing
+        assert got.parent_span_id == caller.span_id
+        assert got.span_id != caller.span_id
+        # the client recorded the hop as an rpc.forward span
+        _, recs = default_spans().spans_after(idx0)
+        fwd = [s for s in recs if s["name"] == "rpc.forward"
+               and s["trace_id"] == caller.trace_id]
+        assert len(fwd) == 1
+        assert fwd[0]["span_id"] == got.span_id
+        assert fwd[0]["detail"]["method"] == "Test.echo"
+        assert fwd[0]["detail"]["peer"].endswith(str(srv.addr[1]))
+        c.close()
+
+    def test_no_ctx_outside_a_trace(self, server):
+        srv, _, seen = server
+        c = RpcClient(*srv.addr)
+        idx0 = default_spans().last_index()
+        c.call("Test.echo", "x")
+        assert seen["ctx"] is None
+        _, recs = default_spans().spans_after(idx0)
+        assert [s for s in recs if s["name"] == "rpc.forward"] == []
+        c.close()
+
+    def test_kill_switch_suppresses_injection(self, server, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_TRACE", "0")
+        srv, _, seen = server
+        c = RpcClient(*srv.addr)
+        idx0 = default_spans().last_index()
+        with use(mint()):
+            c.call("Test.echo", "x")
+        assert seen["ctx"] is None
+        _, recs = default_spans().spans_after(idx0)
+        assert [s for s in recs if s["name"] == "rpc.forward"] == []
+        c.close()
+
+    def test_malformed_ctx_from_peer_is_tolerated(self, server):
+        """A hand-rolled frame with a garbage ctx slot (old or hostile
+        peer) must neither kill the serve loop nor poison the handler —
+        it is simply no trace."""
+        srv, _, seen = server
+        for bad in ("garbage", 42, ["t"], {"t": 7, "s": None}, {}):
+            s = socket.create_connection(srv.addr, timeout=5.0)
+            try:
+                write_frame(s, {"t": "req", "seq": 1,
+                                "method": "Test.echo", "args": ["ping"],
+                                "ctx": bad})
+                res = read_frame(s)
+            finally:
+                s.close()
+            assert res["ok"] is True and res["result"] == ["ping"]
+            assert seen["ctx"] is None
