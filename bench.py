@@ -650,6 +650,7 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         pipe0 = _pipeline_totals(s.metrics)
         drain0 = _drain_totals(s.metrics)
         spec0 = s.metrics.counters(prefix="spec.")
+        events0 = s.metrics.counters(prefix="events.")
         t0 = time.time()
         evals = []
         for job, scen in jobs:
@@ -791,6 +792,24 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
             f"A/B evals/s on={trace_tail['ab']['on']['evals_per_sec']} "
             f"off={trace_tail['ab']['off']['evals_per_sec']} "
             f"overhead={trace_tail['overhead_pct']}%")
+        # event-stream tail (ISSUE 18): broker fan-out under 100+
+        # subscribers — delivery lag, the no-lost/no-dup ledger, and
+        # the publish-hook A/B vs NOMAD_TPU_EVENTS=0
+        events_tail = _e2e_events(s, events0, rng, count)
+        if events_tail.get("enabled", True):
+            log(f"e2e: events {events_tail['published']} published to "
+                f"{events_tail['subscribers']} subs "
+                f"({events_tail['deliveries']} deliveries) lag p50/p99 "
+                f"{events_tail['lag_ms']['p50']}/"
+                f"{events_tail['lag_ms']['p99']}ms "
+                f"lost={events_tail['lost_non_evicted']} "
+                f"dup={events_tail['dups']} "
+                f"evictions={events_tail['subscriber_evictions']}; "
+                f"A/B evals/s on={events_tail['ab']['on']['evals_per_sec']} "
+                f"off={events_tail['ab']['off']['evals_per_sec']} "
+                f"overhead={events_tail['publish_overhead_pct']}%")
+        else:
+            log("e2e: events disabled (NOMAD_TPU_EVENTS=0)")
     finally:
         s.shutdown()
     rate = done / dt if dt else 0.0
@@ -858,6 +877,12 @@ def bench_e2e(n_nodes: int, n_allocs: int, n_evals: int, count: int,
         # stitch rate (target >= 0.99), and the tracing-overhead A/B
         # vs NOMAD_TPU_TRACE=0
         "e2e_trace": trace_tail,
+        # FSM-sourced event stream (ISSUE 18): publish→deliver lag
+        # p50/p99 under 112 mixed-filter subscribers, the
+        # no-lost/no-dup ledger (identity tuples — a plan entry emits
+        # its whole batch at one apply index), and the publish-hook
+        # overhead A/B vs NOMAD_TPU_EVENTS=0 (target <= 2%)
+        "e2e_events": events_tail,
     }
 
 
@@ -1068,6 +1093,193 @@ def _e2e_trace(s, rng, count: int) -> dict:
             "off": {k: off[k] for k in ("evals", "evals_per_sec")},
         },
         "overhead_pct": over,
+    }
+
+
+def _e2e_events(s, events0: dict, rng, count: int) -> dict:
+    """bench tail `e2e_events` (ISSUE 18): the FSM-sourced event stream
+    under fan-out — 112 concurrent subscribers (mixed topic filters)
+    each draining in its own thread while a registration window drives
+    the apply path, reporting publish→deliver lag p50/p99, the
+    no-lost/no-dup ledger for non-evicted indexes (identity tuples —
+    one apply index carries a whole batch), and a throughput A/B
+    pricing the publish hook against NOMAD_TPU_EVENTS=0."""
+    import os
+    import threading
+
+    from nomad_tpu.server.event_broker import GAP_TYPE
+    from nomad_tpu.synth import synth_service_job
+
+    broker = s.events
+    if broker is None:
+        return {"enabled": False}
+    ev0 = s.metrics.counters(prefix="events.")
+
+    # -- fan-out window: 112 subscribers, publish-side perf_counter
+    # stamps via a bench-side wrap of broker.publish (the product hot
+    # path stays clock-free), delivery stamped in each drain thread
+    cycles = [None, ["Job"], ["Eval"], ["Alloc"], ["Node"],
+              ["Eval:*", "Alloc"], ["Deployment", "Plan"]]
+    n_subs = 112
+    pub_stamp = {}            # apply index -> perf_counter at publish
+    pub_tuples = []           # (index, topic, type, key) in pub order
+    pub_lock = threading.Lock()
+    real_publish = broker.publish
+
+    def stamped_publish(events):
+        now = time.perf_counter()
+        with pub_lock:
+            for e in events:
+                pub_stamp.setdefault(e.index, now)
+                pub_tuples.append((e.index, e.topic, e.type, e.key))
+        real_publish(events)
+
+    recs = []
+    stop = threading.Event()
+
+    def drain(sub, rec):
+        while True:
+            batch = sub.poll(timeout=0.05)
+            now = time.perf_counter()
+            if batch:
+                for e in batch:
+                    if e.type == GAP_TYPE:
+                        rec["lost_through"] = max(rec["lost_through"],
+                                                  e.index)
+                        continue
+                    key = (e.index, e.topic, e.type, e.key)
+                    if key in rec["seen"]:
+                        rec["dups"] += 1
+                    rec["seen"].add(key)
+                    t0 = pub_stamp.get(e.index)
+                    if t0 is not None:
+                        rec["lags"].append((now - t0) * 1000.0)
+            elif stop.is_set():
+                return
+
+    subs, threads = [], []
+    broker.publish = stamped_publish
+    try:
+        for i in range(n_subs):
+            topics = cycles[i % len(cycles)]
+            sub = broker.subscribe(topics)
+            rec = {"topics": topics, "seen": set(), "dups": 0,
+                   "lags": [], "lost_through": 0}
+            th = threading.Thread(target=drain, args=(sub, rec),
+                                  daemon=True)
+            th.start()
+            subs.append(sub)
+            recs.append(rec)
+            threads.append(th)
+        evs = []
+        for i in range(40):
+            ev = s.job_register(synth_service_job(
+                rng, count=count, datacenter=f"dc{1 + i % 3}"))
+            if ev is not None:
+                evs.append(ev.id)
+        for eid in evs:
+            s.wait_for_eval(eid, statuses=("complete", "failed",
+                                           "blocked", "cancelled"),
+                            timeout=120.0)
+        # account lost/dup only through the index the window reached —
+        # background applies landing after the drain stops would read
+        # as false losses otherwise
+        cut = broker.last_index()
+        time.sleep(0.5)
+    finally:
+        broker.publish = real_publish
+        stop.set()
+        for th in threads:
+            th.join(timeout=5.0)
+        for sub in subs:
+            sub.close()
+
+    lags = sorted(x for rec in recs for x in rec["lags"])
+
+    def _pctl(q: float) -> float:
+        if not lags:
+            return 0.0
+        return round(lags[min(int(q * len(lags)), len(lags) - 1)], 3)
+
+    lost = 0
+    dups = 0
+    gap_subs = 0
+    with pub_lock:
+        window = [t for t in pub_tuples if t[0] <= cut]
+    for rec in recs:
+        dups += rec["dups"]
+        if rec["lost_through"]:
+            gap_subs += 1
+        allowed = (None if rec["topics"] is None else
+                   {t.split(":")[0] for t in rec["topics"]})
+        for t in window:
+            if t[0] <= rec["lost_through"]:
+                continue  # evicted-and-gap-marked: not "lost"
+            if allowed is not None and t[1] not in allowed:
+                continue
+            if t not in rec["seen"]:
+                lost += 1
+
+    # -- publish-overhead A/B: the env gate NOMAD_TPU_EVENTS=0 leaves
+    # state.event_broker unset at construction; the live equivalent is
+    # detaching the broker from the store (the per-entry gate in
+    # state._emit_entry), restored after the arm
+    def arm(enabled: bool, n: int = 32) -> dict:
+        prev = os.environ.get("NOMAD_TPU_EVENTS")
+        os.environ["NOMAD_TPU_EVENTS"] = "1" if enabled else "0"
+        saved = s.state.event_broker
+        s.state.event_broker = broker if enabled else None
+        try:
+            ids = []
+            t0 = time.time()
+            for i in range(n):
+                ev = s.job_register(synth_service_job(
+                    rng, count=count, datacenter=f"dc{1 + i % 3}"))
+                if ev is not None:
+                    ids.append(ev.id)
+            done = 0
+            for eid in ids:
+                got = s.wait_for_eval(
+                    eid, statuses=("complete", "failed", "blocked",
+                                   "cancelled"), timeout=120.0)
+                if got is not None:
+                    done += 1
+            dt = time.time() - t0
+            return {"evals": done,
+                    "evals_per_sec": round(done / dt, 2) if dt else 0.0}
+        finally:
+            s.state.event_broker = saved
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_EVENTS", None)
+            else:
+                os.environ["NOMAD_TPU_EVENTS"] = prev
+
+    arm(True, n=16)  # shared warmup arm, discarded (the _e2e_spec
+    # precedent: the first arm otherwise pays cache/queue warmup and
+    # the A/B reads as publish overhead it isn't)
+    on = arm(True)
+    off = arm(False)
+    over = None
+    if on["evals_per_sec"] and off["evals_per_sec"]:
+        over = round((off["evals_per_sec"] / on["evals_per_sec"] - 1.0)
+                     * 100.0, 2)
+    ev1 = s.metrics.counters(prefix="events.")
+    return {
+        "subscribers": n_subs,
+        "published": len(window),
+        "published_e2e_window": int(
+            ev0.get("published", 0) - events0.get("published", 0)),
+        "deliveries": len(lags),
+        "lag_ms": {"p50": _pctl(0.50), "p99": _pctl(0.99),
+                   "max": round(lags[-1], 3) if lags else 0.0},
+        "lost_non_evicted": lost,
+        "dups": dups,
+        "gap_marked_subs": gap_subs,
+        "subscriber_evictions": int(
+            ev1.get("subscriber_evictions", 0)
+            - ev0.get("subscriber_evictions", 0)),
+        "ab": {"on": on, "off": off},
+        "publish_overhead_pct": over,
     }
 
 

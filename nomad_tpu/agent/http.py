@@ -40,6 +40,41 @@ class PlainText:
         self.content_type = content_type
 
 
+class JsonLineStream:
+    """Marker payload: a push stream. `lines` is a generator of JSON
+    strings; the handler writes each as one chunked-transfer frame and
+    holds the connection open until the generator ends or the client
+    disconnects (/v1/event/stream?stream=1)."""
+
+    def __init__(self, lines) -> None:
+        self.lines = lines
+
+
+def _event_stream_lines(sub, heartbeat: float):
+    """Push-stream body: event batches as they arrive, a heartbeat line
+    (`{"index": N, "heartbeat": true}`) after `heartbeat` idle seconds
+    so proxies and clients can tell a quiet cluster from a dead
+    connection. Runs until the consumer disconnects; the finally drops
+    the broker subscription."""
+    try:
+        last = sub.last_delivered
+        next_beat = time.time() + heartbeat
+        while True:
+            batch = sub.poll(timeout=min(heartbeat, 1.0))
+            if batch:
+                last = sub.last_delivered
+                yield json.dumps(
+                    {"index": last,
+                     "events": [to_json_tree(to_wire(e))
+                                for e in batch]})
+                next_beat = time.time() + heartbeat
+            elif time.time() >= next_beat:
+                yield json.dumps({"index": last, "heartbeat": True})
+                next_beat = time.time() + heartbeat
+    finally:
+        sub.close()
+
+
 class HTTPApi:
     """Routes /v1/* to server endpoints. `agent` carries .server (leader
     methods), optional .client, and optional .cluster (ClusterServer)."""
@@ -67,6 +102,29 @@ class HTTPApi:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _stream(self, payload: JsonLineStream) -> None:
+                """Chunked transfer encoding, one JSON line per chunk.
+                The generator runs until the client hangs up (the write
+                raises) — its finally-block drops the subscription, so a
+                dead consumer can't pin broker state."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Nomad-Event-Stream", "1")
+                self.end_headers()
+                try:
+                    for line in payload.lines:
+                        data = (line + "\n").encode()
+                        self.wfile.write(
+                            b"%x\r\n%s\r\n" % (len(data), data))
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:  # noqa: BLE001 — client went away
+                    pass
+                finally:
+                    payload.lines.close()
+                    self.close_connection = True
 
             def _respond_html(self, code: int, html: str) -> None:
                 body = html.encode()
@@ -101,6 +159,9 @@ class HTTPApi:
                                     token=token,
                                     traceparent=self.headers.get(
                                         "traceparent"))
+                    if isinstance(out, JsonLineStream):
+                        self._stream(out)
+                        return
                     self._respond(200, out)
                 except HttpError as e:
                     self._respond(e.code, {"error": str(e)})
@@ -1479,12 +1540,39 @@ class HTTPApi:
             require_ns("read-job")
             return server.search(b.get("prefix", ""),
                                  b.get("context", "all"), ns)
+        # /v1/event/stream — the FSM-sourced cluster event stream
+        # (nomad/stream/event_broker.go + event_endpoint.go). Two modes:
+        # the long-poll compat shape (one {"index", "events"} response),
+        # and ?stream=1 — chunked transfer, one JSON line per batch,
+        # heartbeat keepalives while idle, resume via &index=N (a
+        # lost-gap marker leads when N has been evicted).
         if parts == ["event", "stream"]:
             topics = [t for t in query.get("topic", "").split(",") if t]
-            index = int(query.get("index", 0) or 0)
-            wait = min(float(query.get("wait", 0) or 0), 60.0)
-            idx, events = server.events.events_after(index, topics or None,
-                                                     timeout=wait)
+            try:
+                wait = min(float(query.get("wait", 0) or 0), 60.0)
+                resume = (int(query["index"]) if "index" in query
+                          else None)
+            except ValueError as e:
+                raise HttpError(400, f"index/wait must be numeric: {e}")
+            if query.get("stream") == "1":
+                try:
+                    heartbeat = min(max(float(
+                        query.get("heartbeat", 10) or 10), 0.2), 60.0)
+                except ValueError as e:
+                    raise HttpError(
+                        400, f"heartbeat must be numeric: {e}")
+                try:
+                    sub = server.events.subscribe(
+                        topics or None, from_index=resume)
+                except ValueError as e:
+                    raise HttpError(400, str(e))
+                return JsonLineStream(
+                    _event_stream_lines(sub, heartbeat))
+            try:
+                idx, events = server.events.events_after(
+                    resume or 0, topics or None, timeout=wait)
+            except ValueError as e:
+                raise HttpError(400, str(e))
             return {"index": idx,
                     "events": [to_wire(e) for e in events]}
         # /v1/scheduler/timeline — dispatch-pipeline records
@@ -1680,6 +1768,18 @@ class HTTPApi:
             "counts": sp.counts(),
             "slo": (slo.snapshot() if slo is not None else {}),
         }
+        # cluster event stream (ISSUE 18): broker health + the recent
+        # tail, so a bundle shows WHAT the cluster just did (state
+        # transitions) next to the flight recorder's WHY (operational
+        # anomalies)
+        ev = getattr(server, "events", None)
+        if ev is not None and hasattr(ev, "stats"):
+            out["events"] = {
+                "stats": ev.stats(),
+                "recent": [to_wire(e) for e in ev.buffered(limit=256)],
+            }
+        else:
+            out["events"] = {"stats": {}, "recent": []}
         missing = [s for s in DEBUG_SECTIONS if s not in out]
         assert not missing, f"debug sections missing: {missing}"
         return out

@@ -84,6 +84,14 @@ def _sink_kind(d: str, call: ast.Call) -> Optional[str]:
         return f"log sink {d}()"
     if leaf == "record" and "flight" in d.lower():
         return f"flight recorder {d}()"
+    if leaf in ("publish", "publish_entry") and "." in d:
+        # ISSUE 18: the cluster event broker is an egress surface —
+        # every subscriber (HTTP stream, CLI, debug bundle) receives
+        # the payload verbatim, so a secret published once is served
+        # forever from the replay buffer
+        recv = d.rsplit(".", 1)[0].lower()
+        if "event" in recv or "broker" in recv:
+            return f"event publish {d}()"
     if not d and isinstance(call.func, ast.Attribute) \
             and call.func.attr == "record" \
             and isinstance(call.func.value, ast.Call):
@@ -124,6 +132,24 @@ def _contains_producer(expr: ast.AST, resolved, rb: Set[int]) -> bool:
         if callee is not None and id(callee) in rb:
             return True
     return False
+
+
+def _flow_names(expr: ast.AST) -> Set[str]:
+    """Names through which a WHOLE object flows into an expression.
+    `node.status` reads one non-secret field, not the bearer — skip
+    it; `node` bare, `node.secret_id`, or `{"n": tree}` all count."""
+    out: Set[str] = set()
+    todo = [expr]
+    while todo:
+        n = todo.pop()
+        if isinstance(n, ast.Attribute) \
+                and n.attr not in SECRET_FIELDS \
+                and isinstance(n.value, ast.Name):
+            continue
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        todo.extend(ast.iter_child_nodes(n))
+    return out
 
 
 def _own_stmts(node):
@@ -199,7 +225,13 @@ def _is_surface(fi: FuncInfo) -> bool:
 
 
 def _scan_surface(fi: FuncInfo, rb: Set[int],
-                  findings: List[Finding]) -> None:
+                  findings: List[Finding],
+                  surface: bool = True) -> None:
+    """Tracked-name flow scan. Return-egress fires only on RPC/HTTP
+    `surface` functions; the event-publish sink check runs EVERYWHERE
+    a bearer/tree name is trackable — the broker lives outside the
+    surface files, and a secret published there streams to every
+    subscriber."""
     node = fi.node
     if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return
@@ -242,6 +274,23 @@ def _scan_surface(fi: FuncInfo, rb: Set[int],
                 and isinstance(st.args[0], ast.Constant) \
                 and st.args[0].value in SECRET_FIELDS:
             tracked.pop(st.func.value.id, None)
+        elif isinstance(st, ast.Call):
+            sink = _sink_kind(_dotted(st.func), st)
+            if sink is not None and sink.startswith("event publish"):
+                leaked = sorted({
+                    name
+                    for a in list(st.args)
+                    + [kw.value for kw in st.keywords]
+                    for name in _flow_names(a)
+                    if name in tracked})
+                if leaked:
+                    kind = tracked[leaked[0]]
+                    findings.append(Finding(
+                        fi.rel, st.lineno, "NLS01",
+                        f"secret-bearing {kind} {leaked[0]!r} flows "
+                        f"into {sink} un-redacted — the broker "
+                        f"replays it to every subscriber",
+                        hint=_HINTS["NLS01"], context=fi.qual))
         elif isinstance(st, ast.Delete):
             for t in st.targets:
                 if isinstance(t, ast.Subscript) \
@@ -250,6 +299,8 @@ def _scan_surface(fi: FuncInfo, rb: Set[int],
                         and t.slice.value in SECRET_FIELDS:
                     tracked.pop(t.value.id, None)
         elif isinstance(st, ast.Return):
+            if not surface:
+                continue
             v = st.value
             if v is None or (isinstance(v, ast.Call)
                              and _is_redaction(v)):
@@ -292,6 +343,5 @@ def analyze_secrets(prog: Program) -> List[Finding]:
                     f"secret field .{fields[0]} flows into {sink} — "
                     f"plaintext credential in telemetry/debug output",
                     hint=_HINTS["NLS01"], context=fi.qual))
-        if _is_surface(fi):
-            _scan_surface(fi, rb, findings)
+        _scan_surface(fi, rb, findings, surface=_is_surface(fi))
     return findings

@@ -48,6 +48,27 @@ FLIGHT_TYPES = frozenset({
                            # slow-window alerting threshold
 })
 
+# ---- cluster event stream (server/event_broker.py) -------------------------
+
+#: the closed event-topic vocabulary — the tenth telemetry layer's
+#: taxonomy (README table). Topic filters (`Topic`, `Topic:key`,
+#: `Topic:*`) and the NLV01 literal check key on these; the broker
+#: rejects a published event whose topic is not listed.
+EVENT_TOPICS = frozenset({
+    "Job", "Eval", "Alloc", "Deployment", "Node", "Plan",
+})
+
+#: the closed event-type vocabulary (one state-transition verb per
+#: FSM-op shape; `lost-gap` is a stream-control marker, NOT a type).
+EVENT_TYPES = frozenset({
+    "JobRegistered", "JobUpdated", "JobDeregistered", "JobStable",
+    "EvalUpdated", "EvalDeleted",
+    "AllocUpdated", "AllocDeleted",
+    "DeploymentUpserted", "DeploymentDeleted",
+    "NodeRegistered", "NodeUpdated", "NodeDeregistered",
+    "PlanApplied",
+})
+
 # ---- Prometheus series names (tests/test_metrics_names.py) -----------------
 
 #: every series name the repo PROMISES (post-mangle, nomad_ prefix).
@@ -135,6 +156,16 @@ PROM_REQUIRED = frozenset({
     "nomad_slo_budget_remaining_low",
     "nomad_slo_latency_high_ms", "nomad_slo_latency_normal_ms",
     "nomad_slo_latency_low_ms",
+    # FSM-sourced cluster event stream (ISSUE 18): publish volume,
+    # per-topic counters, live subscriber gauge, resume-window bounds,
+    # slow-subscriber evictions — the bench e2e_events tail and the
+    # lost-gap runbook read these
+    "nomad_events_published", "nomad_events_subscribers",
+    "nomad_events_subscriber_evictions",
+    "nomad_events_oldest_index", "nomad_events_last_index",
+    "nomad_events_topic_job", "nomad_events_topic_eval",
+    "nomad_events_topic_alloc", "nomad_events_topic_deployment",
+    "nomad_events_topic_node", "nomad_events_topic_plan",
 })
 
 #: the raft node's promised series (ISSUE 13) — exposed from the NODE's
@@ -191,6 +222,8 @@ ALLOWED_PREFIXES = (
     "nomad_trace_",           # distributed-tracing SpanStore mirrors
                               # (ISSUE 17)
     "nomad_slo_",             # per-priority scheduling SLOs (ISSUE 17)
+    "nomad_events_",          # FSM-sourced cluster event stream
+                              # (ISSUE 18, server/event_broker.py)
 )
 
 #: the only label names any exposed series may carry

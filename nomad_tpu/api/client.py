@@ -22,7 +22,7 @@ class ApiError(Exception):
 DEBUG_SECTIONS = (
     "server", "control", "metrics", "prometheus", "timeline",
     "transfer_sites", "hbm", "drain", "flight", "raft", "wal",
-    "eval_traces", "trace",
+    "eval_traces", "trace", "events",
 )
 
 
@@ -544,6 +544,84 @@ class NomadClient:
             params["wait"] = str(wait)
         return self._request("GET", f"/v1/trace/{trace_id}",
                              params=params)
+
+    def events(self, index: int = 0,
+               topics: Optional[List[str]] = None,
+               wait: float = 0.0) -> dict:
+        """One page of the cluster event stream (GET /v1/event/stream,
+        long-poll compat shape): {"index": N, "events": [...]} with
+        events past `index`, topic-filtered (`Topic`, `Topic:key`,
+        `Topic:*`). A leading `lost-gap` event means `index` predates
+        the broker's retained window — resume from its
+        `resume_from`."""
+        params: Dict[str, str] = {"index": str(index)}
+        if wait:
+            params["wait"] = str(wait)
+        if topics:
+            params["topic"] = ",".join(topics)
+        return self._request("GET", "/v1/event/stream", params=params)
+
+    def event_stream(self, topics: Optional[List[str]] = None,
+                     index: Optional[int] = None,
+                     heartbeat: float = 10.0,
+                     yield_heartbeats: bool = False):
+        """Push-native consumer of the cluster event stream (GET
+        /v1/event/stream?stream=1, chunked transfer). Yields batch
+        dicts {"index": N, "events": [wire trees]} as the server emits
+        them; `index=None` starts live, `index=N` resumes past N.
+
+        Auto-resume: on a dropped connection the generator reconnects
+        and resumes from the last delivered index — a `lost-gap` event
+        leads the next batch if the outage outlived the broker's
+        buffer, so consumers see an explicit marker instead of a
+        silent hole. The FIRST connection failing raises (unreachable
+        agent / unknown topic); close() the generator to stop."""
+        import time as _time
+
+        last = index
+        first = True
+        while True:
+            conn = self._connect()
+            try:
+                params: Dict[str, str] = {
+                    "stream": "1", "heartbeat": str(heartbeat)}
+                if self.region:
+                    params["region"] = self.region
+                if topics:
+                    params["topic"] = ",".join(topics)
+                if last is not None:
+                    params["index"] = str(last)
+                headers = {}
+                if self.token:
+                    headers["X-Nomad-Token"] = self.token
+                conn.request(
+                    "GET", f"/v1/event/stream?{urlencode(params)}",
+                    headers=headers)
+                res = conn.getresponse()
+                if res.status >= 400:
+                    data = from_json_tree(
+                        json.loads(res.read() or b"null"))
+                    raise ApiError(
+                        res.status,
+                        (data or {}).get("error", "request failed"))
+                first = False
+                while True:
+                    raw = res.readline()
+                    if not raw:
+                        break  # server side ended → reconnect
+                    batch = from_json_tree(json.loads(raw))
+                    last = batch.get("index", last)
+                    if batch.get("heartbeat") and not yield_heartbeats:
+                        continue
+                    yield batch
+            except ApiError:
+                raise  # 4xx won't heal by retrying
+            except (OSError, ValueError):
+                if first:
+                    raise
+            finally:
+                conn.close()
+            _time.sleep(0.5)
 
     def operator_debug(self) -> dict:
         """One server's full debug capture (GET /v1/operator/debug):

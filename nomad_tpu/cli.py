@@ -1567,6 +1567,55 @@ def cmd_operator_flight(args) -> int:
     return 0
 
 
+def cmd_event_stream(args) -> int:
+    """`nomad-tpu event stream` — follow the FSM-sourced cluster event
+    stream (/v1/event/stream?stream=1, chunked push). `-topic`
+    (repeatable, Topic / Topic:key / Topic:*) filters server-side;
+    `-index N` resumes past index N (a gap line appears when N predates
+    the broker's window); `-json` prints one JSON doc per event.
+    Ctrl-C flushes the last delivered index to stderr and exits 0 so
+    the cursor survives for the next invocation."""
+    from .api import ApiError
+
+    if args.index is not None and args.index < 0:
+        print("Error: -index must be >= 0", file=sys.stderr)
+        return 1
+    api = _client(args)
+    last = args.index
+    gen = api.event_stream(topics=args.topic or None, index=args.index)
+    try:
+        for batch in gen:
+            last = batch.get("index", last)
+            for e in batch.get("events") or []:
+                if args.json:
+                    print(json.dumps(e, default=str), flush=True)
+                elif e.get("type") == "lost-gap":
+                    pay = e.get("payload") or {}
+                    print(f"[gap] events through index "
+                          f"{pay.get('lost_through', e.get('index'))} "
+                          f"were evicted; resuming from "
+                          f"{pay.get('resume_from')}", flush=True)
+                else:
+                    print(f"{e.get('index', ''):>8}  "
+                          f"{e.get('topic', ''):<10} "
+                          f"{e.get('type', ''):<20} "
+                          f"{e.get('namespace') or '-':<10} "
+                          f"{e.get('key', '')}", flush=True)
+    except KeyboardInterrupt:
+        # resumable cursor: rerun with `-index <this>` to continue
+        if last is not None:
+            print(f"last index: {last}", file=sys.stderr)
+        return 0
+    except (ApiError, OSError) as e:
+        # unreachable agent or unknown topic (400): one-line error +
+        # exit 1, never a traceback (the operator flight convention)
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        gen.close()
+    return 0
+
+
 def cmd_trace(args) -> int:
     """`nomad-tpu trace <trace-id>` — stitch one distributed trace back
     together from every gossip-discovered server (each process only
@@ -2009,6 +2058,20 @@ def build_parser() -> argparse.ArgumentParser:
     evp.add_argument("eval_id")
     evp.add_argument("-verbose", action="store_true")
     evp.set_defaults(fn=cmd_eval_placement)
+
+    evst = sub.add_parser(
+        "event", help="cluster event stream").add_subparsers(
+        dest="sub", required=True)
+    es = evst.add_parser("stream",
+                         help="follow the FSM-sourced event stream")
+    es.add_argument("-topic", action="append", default=[],
+                    help="Topic / Topic:key / Topic:* filter "
+                         "(repeatable)")
+    es.add_argument("-index", type=int, default=None,
+                    help="resume past this raft index")
+    es.add_argument("-json", action="store_true",
+                    help="one JSON doc per event")
+    es.set_defaults(fn=cmd_event_stream)
 
     aclp = sub.add_parser("acl", help="ACL commands").add_subparsers(
         dest="sub", required=True)

@@ -58,7 +58,13 @@ class InMemState:
         self.cluster.upsert_node(node)
 
     def delete_node(self, node_id: str) -> None:
-        self._nodes.pop(node_id, None)
+        # Deletes advance the index like every other table write (the
+        # reference bumps the raft index on deletion too) — blocking
+        # queries wake and the event stream gets a unique per-entry
+        # index; a no-op delete stays index-silent.
+        if self._nodes.pop(node_id, None) is None:
+            return
+        next(self.index)
         self.cluster.remove_node(node_id)
 
     def upsert_job(self, job: Job) -> None:
@@ -218,6 +224,8 @@ class InMemState:
         cur = self._jobs.get((namespace, job_id))
         if cur is not None and cur.version == version:
             cur.stable = True
+        if job is not None or cur is not None:
+            next(self.index)
 
     def latest_deployment_by_job(self, namespace: str, job_id: str
                                  ) -> Optional[Deployment]:
@@ -242,24 +250,28 @@ class InMemState:
     # DeleteJob, DeleteNode, DeleteDeployment) ----
 
     def delete_eval(self, eval_id: str) -> None:
-        self._evals.pop(eval_id, None)
+        if self._evals.pop(eval_id, None) is not None:
+            next(self.index)
 
     def delete_alloc(self, alloc_id: str) -> None:
         a = self._allocs.pop(alloc_id, None)
         if a is None:
             return
+        next(self.index)
         self._allocs_by_job.get((a.namespace, a.job_id), {}).pop(alloc_id, None)
         self._allocs_by_node.get(a.node_id, {}).pop(alloc_id, None)
         self.cluster.remove_alloc(alloc_id, a.job_id)
 
     def delete_job(self, namespace: str, job_id: str) -> None:
-        self._jobs.pop((namespace, job_id), None)
+        if self._jobs.pop((namespace, job_id), None) is not None:
+            next(self.index)
         for key in [k for k in self._job_versions
                     if k[0] == namespace and k[1] == job_id]:
             del self._job_versions[key]
 
     def delete_deployment(self, deployment_id: str) -> None:
-        self._deployments.pop(deployment_id, None)
+        if self._deployments.pop(deployment_id, None) is not None:
+            next(self.index)
 
     def scheduler_config(self) -> SchedulerConfiguration:
         return self._config

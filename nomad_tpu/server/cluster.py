@@ -97,13 +97,19 @@ class RaftStateStore(StateStore):
 
         self.reset_for_restore()
         # restore runs through the normal mutators — they must write
-        # DIRECT, not re-enter raft.apply (self-deadlock on the applier)
+        # DIRECT, not re-enter raft.apply (self-deadlock on the applier),
+        # and must NOT re-announce the snapshot's history on the event
+        # stream (subscribers resume by index; the broker marks the
+        # folded range as a lost-gap instead)
         prev = getattr(self._local, "direct", False)
         self._local.direct = True
         try:
-            restore_state(self, blob)
+            with self.suspend_events():
+                restore_state(self, blob)
         finally:
             self._local.direct = prev
+        if self.event_broker is not None:
+            self.event_broker.mark_restored(self.index.value)
 
     def transact(self):
         """Serializes watcher read-modify-write sections against each other

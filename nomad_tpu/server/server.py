@@ -16,6 +16,7 @@ Endpoint behaviors implemented as methods (HTTP layer calls these):
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -227,7 +228,7 @@ class Server:
         from ..lib import TimeTable
         from .deployments import DeploymentsWatcher
         from .drainer import NodeDrainer
-        from .events import EventBroker
+        from .event_broker import ClusterEventBroker
         from .periodic import PeriodicDispatch
         from .volumewatcher import VolumeWatcher
 
@@ -235,7 +236,19 @@ class Server:
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatch(self)
         self.volume_watcher = VolumeWatcher(self)
-        self.events = EventBroker()
+        # FSM-sourced cluster event stream (server/event_broker.py):
+        # the broker belongs to the STATE STORE (it must survive the
+        # leadership-gated Server rebuild and receive follower-side FSM
+        # applies), so reuse an already-attached one and only re-bind
+        # its instruments to this Server's registry. NOMAD_TPU_EVENTS=0
+        # detaches the store hook entirely (the bench A/B arm).
+        broker = getattr(self.state, "event_broker", None)
+        if broker is None:
+            broker = ClusterEventBroker()
+            if os.environ.get("NOMAD_TPU_EVENTS", "1") != "0":
+                self.state.event_broker = broker
+        broker.bind_metrics(self.metrics)
+        self.events = broker
         self.timetable = TimeTable()
         self._gc_thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -494,14 +507,6 @@ class Server:
 
     # ---- eval application (FSM upsertEvals analog, fsm.go:692) ----
 
-    def _publish(self, topic: str, type_: str, key: str,
-                 namespace: str = "") -> None:
-        from .events import Event
-
-        self.events.publish(Event(topic=topic, type=type_, key=key,
-                                  namespace=namespace,
-                                  index=self.state.index.value))
-
     def apply_eval_update(self, eval: Evaluation, reblock: bool = False) -> None:
         # leader-minted modify stamp, BEFORE the journaled upsert: it
         # rides the `upsert_eval` log entry (like `now=` in
@@ -510,7 +515,6 @@ class Server:
         # bench `e2e_slo` tail reads modify_time − create_time)
         eval.modify_time = time.time()
         self.state.upsert_eval(eval)
-        self._publish("Eval", "EvalUpdated", eval.id, eval.namespace)
         if reblock or eval.should_block():
             self.blocked.block(eval)
             for dup in self.blocked.duplicates():
@@ -623,7 +627,6 @@ class Server:
             else:
                 job.version = existing.version + 1
         self.state.upsert_job(job)
-        self._publish("Job", "JobRegistered", job.id, job.namespace)
         if job.is_periodic() or job.is_parameterized():
             # Periodic/parameterized jobs produce no eval at register time:
             # the dispatcher (or Job.Dispatch) creates child jobs later
@@ -650,7 +653,6 @@ class Server:
         job = copy.copy(job)  # snapshots keep the pre-stop view
         job.stop = True
         self.state.upsert_job(job)
-        self._publish("Job", "JobDeregistered", job.id, job.namespace)
         self._scaling_events.pop((namespace, job_id), None)
         if job.is_periodic():
             self.periodic.remove(namespace, job_id)
@@ -697,7 +699,6 @@ class Server:
                         f"node_register denied for {node.id!r}: identity "
                         f"secret does not match the registered one")
             self.state.upsert_node(node)
-        self._publish("Node", "NodeRegistered", node.id)
         self.heartbeater.reset(node.id)
         if node.status == NODE_STATUS_READY:
             # capacity may have appeared (node_endpoint.go:270)
@@ -765,7 +766,6 @@ class Server:
         node.status = status
         node.status_description = description
         self.state.upsert_node(node)
-        self._publish("Node", "NodeStatusChanged", node.id)
         evals = []
         if status == NODE_STATUS_DOWN:
             self.heartbeater.remove(node_id)
@@ -792,7 +792,6 @@ class Server:
         self.state.delete_node(node_id)
         self._drop_node_identity_lock(node_id)
         evals = self._create_node_evals(node_id)
-        self._publish("Node", "NodeDeregistered", node_id)
         return evals
 
     def _drop_node_identity_lock(self, node_id: str) -> None:
@@ -890,9 +889,6 @@ class Server:
 
     def update_service_registrations(self, regs) -> None:
         self.state.upsert_service_registrations(regs)
-        for r in regs:
-            self._publish("Service", "ServiceRegistered", r.id,
-                          r.namespace)
 
     def remove_service_registrations(self, alloc_id: str) -> None:
         self.state.delete_service_registrations_by_alloc(alloc_id)
@@ -1263,8 +1259,6 @@ class Server:
             if merged.client_status == "running" and (
                     prev is None or prev.client_status != "running"):
                 self._observe_slo_start(merged)
-            self._publish("Alloc", "AllocUpdated", merged.id,
-                          merged.namespace)
             if merged.terminal_status():
                 node = self.state.node_by_id(merged.node_id)
                 if node is not None:
@@ -1558,7 +1552,6 @@ class Server:
             "EvalID": ev.id if ev else "",
         })
         del events[group][:-10]  # bounded history (structs.JobScalingEvents)
-        self._publish("Job", "JobScaled", job_id, namespace)
         return ev
 
     def job_scale_status(self, namespace: str, job_id: str) -> Dict:

@@ -82,6 +82,24 @@ class StateSnapshot(InMemState):
         return self
 
 
+class _EventSuspension:
+    """`with store.suspend_events():` — restores (WAL replay finished
+    elsewhere, raft InstallSnapshot) rebuild state through the normal
+    mutators without re-announcing history on the event stream."""
+
+    def __init__(self, store: "StateStore") -> None:
+        self._store = store
+
+    def __enter__(self):
+        self._prev = self._store._events_suspended
+        self._store._events_suspended = True
+        return self
+
+    def __exit__(self, *exc):
+        self._store._events_suspended = self._prev
+        return False
+
+
 class StateStore(InMemState):
     """Thread-safe store with index watching (blocking queries)."""
 
@@ -90,38 +108,91 @@ class StateStore(InMemState):
         self.index = _IndexCounter()
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
+        #: cluster event stream (server/event_broker.py): attached by
+        #: the owning Server (None ⇒ no events, e.g. NOMAD_TPU_EVENTS=0)
+        self.event_broker = None
+        self._emit_local = threading.local()
+        #: restores replay history through the normal mutators — they
+        #: must rebuild state, not re-announce it as fresh events
+        self._events_suspended = False
+
+    # -- event emission (the FSM-sourced stream's ONE hook) --
+    #
+    # Every top-level applied op in EVENT_SOURCE_OPS that advanced the
+    # index publishes its derived events, inside the store lock, so the
+    # stream order IS the apply order on every path (endpoint write,
+    # WAL replay, raft FSM apply on each replica). Nested mutations
+    # (upsert_plan_results → upsert_alloc) are depth-suppressed: the
+    # outermost entry derives the whole batch (event_broker.py).
+
+    def _emit_enter(self) -> int:
+        depth = getattr(self._emit_local, "depth", 0)
+        self._emit_local.depth = depth + 1
+        return depth
+
+    def _emit_exit(self, depth: int) -> None:
+        self._emit_local.depth = depth
+
+    def _emit_entry(self, op: str, args, before_index: int) -> None:
+        broker = self.event_broker
+        if broker is None or self._events_suspended:
+            return
+        if self.index.value == before_index:
+            return  # no state write → no event (indexes stay unique
+            # per entry, so index-based resume never splits one)
+        broker.publish_entry(op, args, self.index.value)
+
+    def suspend_events(self) -> "_EventSuspension":
+        return _EventSuspension(self)
 
     # -- copy-on-write alloc indexes so snapshots are iteration-safe --
 
     def upsert_alloc(self, alloc: Allocation) -> None:
         with self._cv:
-            jk = (alloc.namespace, alloc.job_id)
-            prev = self._allocs.get(alloc.id)
-            if prev is not None and prev.node_id != alloc.node_id:
-                old = dict(self._allocs_by_node.get(prev.node_id, {}))
-                old.pop(alloc.id, None)
-                self._allocs_by_node[prev.node_id] = old
-            self._allocs[alloc.id] = alloc
-            alloc.modify_index = next(self.index)
-            if not alloc.create_index:
-                alloc.create_index = alloc.modify_index
-            by_job = dict(self._allocs_by_job.get(jk, {}))
-            by_job[alloc.id] = alloc
-            self._allocs_by_job[jk] = by_job
-            by_node = dict(self._allocs_by_node.get(alloc.node_id, {}))
-            by_node[alloc.id] = alloc
-            self._allocs_by_node[alloc.node_id] = by_node
-            self.cluster.upsert_alloc(alloc)
+            depth = self._emit_enter()
+            before = self.index.value
+            try:
+                jk = (alloc.namespace, alloc.job_id)
+                prev = self._allocs.get(alloc.id)
+                if prev is not None and prev.node_id != alloc.node_id:
+                    old = dict(self._allocs_by_node.get(prev.node_id, {}))
+                    old.pop(alloc.id, None)
+                    self._allocs_by_node[prev.node_id] = old
+                self._allocs[alloc.id] = alloc
+                alloc.modify_index = next(self.index)
+                if not alloc.create_index:
+                    alloc.create_index = alloc.modify_index
+                by_job = dict(self._allocs_by_job.get(jk, {}))
+                by_job[alloc.id] = alloc
+                self._allocs_by_job[jk] = by_job
+                by_node = dict(self._allocs_by_node.get(alloc.node_id, {}))
+                by_node[alloc.id] = alloc
+                self._allocs_by_node[alloc.node_id] = by_node
+                self.cluster.upsert_alloc(alloc)
+            finally:
+                self._emit_exit(depth)
+            if depth == 0:
+                self._emit_entry("upsert_alloc", (alloc,), before)
             self._cv.notify_all()
 
     # -- locked mutators --
 
     def _locked(name):  # noqa: N805 — decorator factory over parent methods
+        from .event_broker import EVENT_SOURCE_OPS
+
         parent = getattr(InMemState, name)
+        emits = name in EVENT_SOURCE_OPS
 
         def method(self, *args, **kwargs):
             with self._cv:
-                out = parent(self, *args, **kwargs)
+                depth = self._emit_enter()
+                before = self.index.value
+                try:
+                    out = parent(self, *args, **kwargs)
+                finally:
+                    self._emit_exit(depth)
+                if emits and depth == 0:
+                    self._emit_entry(name, args, before)
                 self._cv.notify_all()
                 return out
 
@@ -179,26 +250,34 @@ class StateStore(InMemState):
         # Copy-on-write variant of InMemState.delete_alloc: snapshots hold
         # references to the inner per-job/per-node maps.
         with self._cv:
-            a = self._allocs.pop(alloc_id, None)
-            if a is None:
-                # still sweep the catalog: registrations must never
-                # outlive their alloc, even across delete races
+            depth = self._emit_enter()
+            before = self.index.value
+            try:
+                a = self._allocs.pop(alloc_id, None)
+                if a is None:
+                    # still sweep the catalog: registrations must never
+                    # outlive their alloc, even across delete races
+                    InMemState.delete_service_registrations_by_alloc(
+                        self, alloc_id)
+                    self._cv.notify_all()
+                    return
+                next(self.index)
+                jk = (a.namespace, a.job_id)
+                by_job = dict(self._allocs_by_job.get(jk, {}))
+                by_job.pop(alloc_id, None)
+                self._allocs_by_job[jk] = by_job
+                by_node = dict(self._allocs_by_node.get(a.node_id, {}))
+                by_node.pop(alloc_id, None)
+                self._allocs_by_node[a.node_id] = by_node
+                self.cluster.remove_alloc(alloc_id, a.job_id)
+                # a GC'd alloc takes its service registrations with it (the
+                # safety net behind the client's own deregistration)
                 InMemState.delete_service_registrations_by_alloc(
                     self, alloc_id)
-                self._cv.notify_all()
-                return
-            jk = (a.namespace, a.job_id)
-            by_job = dict(self._allocs_by_job.get(jk, {}))
-            by_job.pop(alloc_id, None)
-            self._allocs_by_job[jk] = by_job
-            by_node = dict(self._allocs_by_node.get(a.node_id, {}))
-            by_node.pop(alloc_id, None)
-            self._allocs_by_node[a.node_id] = by_node
-            self.cluster.remove_alloc(alloc_id, a.job_id)
-            # a GC'd alloc takes its service registrations with it (the
-            # safety net behind the client's own deregistration)
-            InMemState.delete_service_registrations_by_alloc(
-                self, alloc_id)
+            finally:
+                self._emit_exit(depth)
+            if depth == 0:
+                self._emit_entry("delete_alloc", (alloc_id,), before)
             self._cv.notify_all()
 
     def update_alloc_from_client(self, update: Allocation) -> Optional[Allocation]:
@@ -208,15 +287,23 @@ class StateStore(InMemState):
         import copy
 
         with self._cv:
-            existing = self._allocs.get(update.id)
-            if existing is None:
-                return None
-            merged = copy.copy(existing)
-            merged.client_status = update.client_status
-            merged.client_description = getattr(update, "client_description", "")
-            merged.task_states = dict(update.task_states)
-            merged.deployment_status = update.deployment_status or merged.deployment_status
-            self.upsert_alloc(merged)
+            depth = self._emit_enter()
+            before = self.index.value
+            try:
+                existing = self._allocs.get(update.id)
+                if existing is None:
+                    return None
+                merged = copy.copy(existing)
+                merged.client_status = update.client_status
+                merged.client_description = getattr(update, "client_description", "")
+                merged.task_states = dict(update.task_states)
+                merged.deployment_status = update.deployment_status or merged.deployment_status
+                self.upsert_alloc(merged)
+            finally:
+                self._emit_exit(depth)
+            if depth == 0:
+                self._emit_entry("update_alloc_from_client", (update,),
+                                 before)
             self._cv.notify_all()
             return merged
 
@@ -240,7 +327,8 @@ class StateStore(InMemState):
         index counter OBJECT — its value is pinned by restore_state) so a
         raft InstallSnapshot can rebuild the FSM from the leader's
         snapshot (fsm.go Restore :1256 wipes memdb the same way)."""
-        keep = {"index", "_lock", "_cv", "raft", "_intent_lock", "_local"}
+        keep = {"index", "_lock", "_cv", "raft", "_intent_lock", "_local",
+                "event_broker", "_emit_local", "_events_suspended"}
         kept = {k: v for k, v in self.__dict__.items() if k in keep}
         with self._cv:
             self.__dict__.clear()

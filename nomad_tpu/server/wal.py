@@ -216,13 +216,19 @@ class DurableStateStore(StateStore):
         snap, entries = self.wal.load()
         self._restoring = True
         try:
-            if snap is not None:
-                restore_state(self, snap)
-            fsm = FSM(self)
-            for entry in entries:
-                fsm.apply_resilient(entry)
+            # replayed history must not re-announce itself on the event
+            # stream (the broker — attached by the Server after restore —
+            # starts at the restored index; earlier ranges are a gap)
+            with self.suspend_events():
+                if snap is not None:
+                    restore_state(self, snap)
+                fsm = FSM(self)
+                for entry in entries:
+                    fsm.apply_resilient(entry)
         finally:
             self._restoring = False
+        if self.event_broker is not None:
+            self.event_broker.mark_restored(self.index.value)
         return len(entries)
 
     # -- journaling wrapper --

@@ -43,3 +43,23 @@ class Server:
     def debug_node(self, node):
         log.info("node %s registered (%s)", node.id, node.status)
         print("registered", node.id)
+
+
+class NodeWatcher:
+    """Publish-sink twin of the violations fixture: the tree is
+    redacted (popped) before it reaches the broker, and the value
+    publish mentions only non-secret fields — NLS01 stays silent."""
+
+    def __init__(self, state, broker):
+        self.state = state
+        self.event_broker = broker
+
+    def announce(self, node_id):
+        node = self.state.node_by_id(node_id)
+        tree = to_wire(node)
+        tree.pop("secret_id", None)
+        self.event_broker.publish([tree])
+
+    def announce_value(self, node):
+        self.event_broker.publish([{"id": node.id,
+                                    "status": node.status}])

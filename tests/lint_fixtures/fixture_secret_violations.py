@@ -41,3 +41,27 @@ class Server:
         default_flight().record(  # NLS01 (a LEGAL flight event type:
             "membership.change",  # the leak is the secret field, the
             sec=node.secret_id)   # vocab rule must not co-fire here)
+
+
+class _Broker:
+    def publish(self, events):
+        pass
+
+
+class NodeWatcher:
+    """NOT a Server / surface file — the event-publish sink check
+    must fire anyway: the broker replays payloads to every
+    subscriber, so publish IS an egress."""
+
+    def __init__(self, state, broker):
+        self.state = state
+        self.event_broker = broker
+
+    def announce(self, node_id):
+        node = self.state.node_by_id(node_id)
+        tree = to_wire(node)
+        self.event_broker.publish([tree])  # NLS01
+
+    def announce_value(self, node):
+        self.event_broker.publish(  # NLS01
+            [{"secret": node.secret_id}])

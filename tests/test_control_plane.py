@@ -283,10 +283,15 @@ class TestReplicaDeterminism:
         import random as _random
         from unittest import mock as um
 
+        from nomad_tpu.server.event_broker import ClusterEventBroker
         from nomad_tpu.server.fsm import FSM, state_fingerprint
         from nomad_tpu.server.state import StateStore
 
         state = (store_cls or StateStore)()
+        # every replica derives its event stream from the same entries
+        # — the broker rides every replay so the event-payload
+        # fingerprint is checked under the same skew
+        state.event_broker = ClusterEventBroker()
         fsm = FSM(state)
         _random.seed(seed)
         with um.patch("time.time", lambda: clock):
@@ -348,6 +353,46 @@ class TestReplicaDeterminism:
         _, fp2 = self._replay(log, 1.0e9, 1, store_cls=PreFixRngStore)
         assert fp1 != fp2, \
             "fingerprint gate is blind to apply-path entropy"
+
+    def test_replica_event_payloads_byte_identical(self):
+        """ISSUE 18 acceptance: the event stream is FSM-sourced, so
+        every replica derives BYTE-IDENTICAL event payloads from the
+        same entries — same indexes, same order, same trees — under
+        skewed local clock and RNG."""
+        from nomad_tpu.server.event_broker import events_fingerprint
+
+        log = self._log()
+        replays = [self._replay(log, clock, seed)[0]
+                   for clock, seed in ((1.0e9, 1), (2.0e9, 2),
+                                       (3.0e9, 3))]
+        fps = [events_fingerprint(s.event_broker.buffered())
+               for s in replays]
+        assert fps[0] == fps[1] == fps[2]
+        # non-vacuous: the log actually announced typed events with
+        # raft-apply indexes
+        evs = replays[0].event_broker.buffered()
+        assert {e.topic for e in evs} >= {"Node", "Job", "Eval",
+                                          "Alloc"}
+        assert all(e.index > 0 for e in evs)
+        assert [e.index for e in evs] == sorted(e.index for e in evs)
+
+    def test_event_fingerprint_identical_across_store_variants(
+            self, tmp_path):
+        """The in-memory and WAL-journaling stores announce the same
+        entries identically — the emission hook lives in the shared
+        mutators, not in any one store subclass."""
+        from nomad_tpu.server.event_broker import events_fingerprint
+        from nomad_tpu.server.wal import DurableStateStore, Wal
+
+        log = self._log()
+        mem, _ = self._replay(log, 1.0e9, 1)
+
+        def durable():
+            return DurableStateStore(Wal(str(tmp_path / "w")))
+
+        dur, _ = self._replay(log, 2.0e9, 2, store_cls=durable)
+        assert events_fingerprint(mem.event_broker.buffered()) \
+            == events_fingerprint(dur.event_broker.buffered())
 
     def test_blocked_eval_timestamps_ride_the_entry(self):
         ev = mock.eval_()
@@ -441,6 +486,13 @@ class TestOperatorDebugEndpoint:
         assert dbg["eval_traces"], "no eval traces captured"
         assert "nomad_broker_ready_depth" in dbg["prometheus"]
         assert dbg["control"]["plan_apply"]["applied"] >= 1
+        # the events section is live, not a stub: the job lifecycle
+        # above emitted FSM-sourced events into the broker ring
+        assert dbg["events"]["stats"]["last_index"] >= 1
+        assert dbg["events"]["recent"], "no events captured"
+        topics = {e["topic"] for e in dbg["events"]["recent"]}
+        assert topics <= {"Job", "Eval", "Alloc", "Deployment",
+                          "Node", "Plan"}
 
 
 # ---- the acceptance e2e: 3-server cluster + operator debug bundle ----
@@ -618,6 +670,119 @@ class TestOperatorDebugCluster:
         ftypes = {e["type"]: e for e in old_flight["events"]}
         assert "leadership.gained" in ftypes
         assert "leadership.lost" in ftypes
+
+
+class TestClusterEventStream:
+    """ISSUE 18 acceptance on a live 3-server cluster: replicas derive
+    identical event streams from the replicated log, a consumer's
+    index cursor survives leader failover, eviction shows up as an
+    explicit gap marker, and the broker/flight separation holds."""
+
+    def test_failover_resume_by_index_gap_marked_no_dups(
+            self, cluster3):
+        from nomad_tpu.server.event_broker import (GAP_TYPE,
+                                                   events_fingerprint)
+
+        agents, apis = cluster3
+        assert _wait(lambda: _leader_of(agents) is not None)
+        old = _leader_of(agents)
+        assert _wait(lambda: old.server._running)
+        # replicated traffic the stream must announce
+        old.call("node_register", mock.node())
+        job = mock.job()
+        ev = old.call("job_register", job)
+        assert old.server.wait_for_eval(ev.id, timeout=20.0) is not None
+        # consume a first page from the OLD leader; remember the cursor
+        idx, first = old.server.events.events_after(0, timeout=10.0)
+        assert first, "no events announced on the leader"
+        cursor = max(e.index for e in first)
+        topics0 = {e.topic for e in first}
+        assert {"Node", "Job", "Eval"} <= topics0
+
+        # leadership transition (the debug-bundle e2e's nudge)
+        def transitioned():
+            cur = _leader_of(agents)
+            return (cur is not None and cur is not old
+                    and cur.server._running)
+
+        for _ in range(10):
+            followers = [a for a in agents
+                         if a is not old
+                         and a.raft.log.last_index()
+                         == old.raft.log.last_index()]
+            if not followers:
+                time.sleep(0.2)
+                continue
+            followers[0].raft._run_election()
+            if _wait(transitioned, timeout=5.0):
+                break
+        assert transitioned(), "no leadership transition happened"
+        new = _leader_of(agents)
+
+        # the NEW leader applied the same log, so its broker can serve
+        # the same cursor: resume-by-index continues without overlap
+        assert _wait(
+            lambda: new.server.events.last_index() >= cursor)
+        new.call("node_register", mock.node())
+        _, more = new.server.events.events_after(cursor, timeout=10.0)
+        live = [e for e in more if e.type != GAP_TYPE]
+        idxs = [e.index for e in first] + [e.index for e in live]
+        assert idxs == sorted(idxs), "resume went backwards"
+        # all events of ONE entry share its apply index (batch-atomic
+        # delivery) — dedup on the full event identity
+        keys = [(e.index, e.topic, e.type, e.key)
+                for e in first + live]
+        assert len(set(keys)) == len(keys), \
+            "duplicate event across failover"
+        assert any(e.index > cursor for e in more), \
+            "post-failover traffic not announced"
+
+        # a slow subscriber on the new leader: flooding past its queue
+        # bound must surface as ONE explicit gap marker, zero dups
+        sub = new.server.events.subscribe(
+            topics=["Node"], from_index=cursor, max_pending=4)
+        for _ in range(12):
+            new.call("node_register", mock.node())
+        seen, gaps = [], []
+
+        def drained():
+            for e in sub.poll(timeout=0.2):
+                (gaps if e.type == GAP_TYPE else seen).append(e)
+            return gaps and seen \
+                and seen[-1].index >= new.server.events.last_index()
+
+        assert _wait(drained, timeout=20.0), \
+            "slow subscriber never saw the gap + tail"
+        sub.close()
+        assert len(gaps) >= 1
+        got = [e.index for e in seen]
+        assert got == sorted(got) and len(set(got)) == len(got)
+        covered = set(got)
+        for g in gaps:
+            covered.update(range(g.payload["requested_index"] + 1,
+                                 g.payload["lost_through"] + 1))
+        expect = {e.index for e in
+                  new.server.events.buffered() if e.topic == "Node"
+                  and e.index > cursor}
+        assert expect <= covered, "silent loss past the gap marker"
+
+        # replica determinism at cluster level: identical fingerprints
+        # over the common applied prefix
+        low = min(a.server.events.last_index() for a in agents)
+        fps = {events_fingerprint(
+            [e for e in a.server.events.buffered() if e.index <= low])
+            for a in agents}
+        assert len(fps) == 1, "replicas derived different events"
+
+        # separation: leadership/membership stay flight-recorder-only
+        # signals — the broker's topic set is the closed taxonomy, and
+        # the flight recorder still owns the operational stream
+        for a in agents:
+            assert {e.topic for e in a.server.events.buffered()} <= {
+                "Job", "Eval", "Alloc", "Deployment", "Node", "Plan"}
+        _, fevs = default_flight().records_after(0)
+        assert any(e["type"].startswith("leadership.")
+                   for e in fevs), "flight lost the leadership stream"
 
     def test_cli_robustness_exit_one(self, tmp_path):
         """`operator debug`/`operator flight` follow the CLI-robustness

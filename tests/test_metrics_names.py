@@ -290,6 +290,29 @@ class TestSeriesNameStability:
 
 
 
+    def test_event_stream_series_are_live(self, loaded_agent):
+        """The tenth-layer families (ISSUE 18) are fed by the real FSM
+        apply flow, not eagerly-created zeros: every node/job/eval/
+        alloc mutation above published a typed event, and the whole
+        per-topic family is present from first exposition."""
+        a, api = loaded_agent
+        snap = a.server.metrics.snapshot()
+        assert snap["counters"].get("events.published", 0) >= 1
+        assert snap["counters"].get("events.topic.job", 0) >= 1
+        assert snap["counters"].get("events.topic.eval", 0) >= 1
+        assert snap["counters"].get("events.topic.alloc", 0) >= 1
+        assert a.server.metrics.gauge("events.last_index").value >= 1
+        names, _, _ = _parse(api.metrics_prometheus())
+        for t in ("job", "eval", "alloc", "deployment", "node",
+                  "plan"):
+            assert f"nomad_events_topic_{t}" in names
+        assert "nomad_events_published" in names
+        assert "nomad_events_subscribers" in names
+        assert "nomad_events_subscriber_evictions" in names
+        assert "nomad_events_oldest_index" in names
+        assert "nomad_events_last_index" in names
+
+
 class TestControlPlaneSeries:
     """nomad_raft_* pinning + the flight-event type vocabulary,
     NON-vacuously: a 1-node ClusterServer drives a real leader
