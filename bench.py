@@ -913,37 +913,64 @@ def _e2e_spec(s, spec0: dict, rng, count: int) -> dict:
         "wasted_kernel_ms": delta("wasted_kernel_ms"),
     }
 
-    def arm(enabled: bool, n: int = 48) -> dict:
+    def arm(enabled: bool, n: Optional[int] = None,
+            adopt: Optional[bool] = None) -> dict:
+        from nomad_tpu.lib.metrics import default_registry
         from nomad_tpu.server.select_batch import SPEC_PARK_ENV
 
+        ADOPT_ENV = "NOMAD_TPU_SPEC_CHAIN_ADOPT"
         prev = os.environ.get(SPECULATE_ENV)
         prev_park = os.environ.get(SPEC_PARK_ENV)
+        prev_adopt = os.environ.get(ADOPT_ENV)
         os.environ[SPECULATE_ENV] = "1" if enabled else "0"
+        if adopt is not None:
+            os.environ[ADOPT_ENV] = "1" if adopt else "0"
         # a loaded bench host parks slower than the 30ms default; the
         # A/B instrument should measure speculation's EFFECT, not
         # whether the rendezvous won a scheduling race
         os.environ[SPEC_PARK_ENV] = "200"
         try:
             idx0 = s.timeline.last_index()
+            # view/resync counters live in the PROCESS registry
+            # (scheduler/stack.py), not the server's
+            v0 = default_registry().counters(prefix="view.")
+            sp0 = default_registry().counters(prefix="spec.")
             t0 = time.time()
-            evs = []
-            for i in range(n):
-                ev = s.job_register(synth_service_job(
-                    rng, count=count, datacenter=f"dc{1 + i % 3}"))
-                if ev is not None:
-                    evs.append(ev.id)
             done = 0
-            for eid in evs:
-                got = s.wait_for_eval(
-                    eid, statuses=("complete", "failed", "blocked",
-                                   "cancelled"), timeout=120.0)
-                if got is not None:
-                    done += 1
+            # two waves per arm, each 1.5× the drain cap: every wave
+            # overflows into a pipelined successor batch (the one that
+            # can launch speculatively), and the SECOND wave's opening
+            # refresh adopts the first wave's chain carry (or pays the
+            # resync with adoption off) — the adoption cost/saving
+            # lands inside the arm that caused it
+            eb = (s.workers[0].eval_batch if s.workers
+                  else s.config.eval_batch)
+            wave_n = eb + max(eb // 2, 1)
+            total = n if n is not None else 2 * wave_n
+            for w0 in range(0, total, wave_n):
+                evs = []
+                for i in range(w0, min(w0 + wave_n, total)):
+                    ev = s.job_register(synth_service_job(
+                        rng, count=count, datacenter=f"dc{1 + i % 3}"))
+                    if ev is not None:
+                        evs.append(ev.id)
+                for eid in evs:
+                    got = s.wait_for_eval(
+                        eid, statuses=("complete", "failed", "blocked",
+                                       "cancelled"), timeout=120.0)
+                    if got is not None:
+                        done += 1
             dt = time.time() - t0
             _idx, recs = s.timeline.records_after(idx0, timeout=0.0)
             bub = [r["bubble_ms"] for r in recs
                    if r["bubble_ms"] is not None
                    and r.get("spec_outcome") != "rolled_back"]
+            v1 = default_registry().counters(prefix="view.")
+            sp1 = default_registry().counters(prefix="spec.")
+
+            def vd(k: str) -> int:
+                return int(v1.get(k, 0) - v0.get(k, 0))
+
             return {
                 "evals": done,
                 "evals_per_sec": round(done / dt, 2) if dt else 0.0,
@@ -952,6 +979,11 @@ def _e2e_spec(s, spec0: dict, rng, count: int) -> dict:
                                    if r.get("speculative")),
                 "bubble_ms_mean": round(sum(bub) / len(bub), 3)
                 if bub else None,
+                "upload_bytes": vd("upload_bytes"),
+                "chain_adopts": vd("chain_adopts"),
+                "resync_bytes_saved": int(
+                    sp1.get("resync_bytes_saved", 0)
+                    - sp0.get("resync_bytes_saved", 0)),
             }
         finally:
             if prev is None:
@@ -962,6 +994,10 @@ def _e2e_spec(s, spec0: dict, rng, count: int) -> dict:
                 os.environ.pop(SPEC_PARK_ENV, None)
             else:
                 os.environ[SPEC_PARK_ENV] = prev_park
+            if prev_adopt is None:
+                os.environ.pop(ADOPT_ENV, None)
+            elif adopt is not None:
+                os.environ[ADOPT_ENV] = prev_adopt
 
     # shared warmup (discarded), SAME width as the arms: the program
     # shapes AND the batch-width chain bucket compile here, so neither
@@ -969,6 +1005,11 @@ def _e2e_spec(s, spec0: dict, rng, count: int) -> dict:
     # compile order
     arm(True)
     out["ab"] = {"on": arm(True), "off": arm(False)}
+    # chain-resync A/B (ISSUE 20): speculation ON in both arms, the
+    # certified chain-carry ADOPTION toggled — the delta is the view
+    # resync bytes the refresh after each chain no longer uploads
+    out["chain_ab"] = {"on": arm(True, adopt=True),
+                       "off": arm(True, adopt=False)}
     return out
 
 

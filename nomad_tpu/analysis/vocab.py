@@ -88,6 +88,14 @@ PROM_REQUIRED = frozenset({
     "nomad_view_hot_log_len", "nomad_view_ports_log_len",
     # device-to-device plan deltas (ISSUE 10: dispatch-carry adoption)
     "nomad_view_carry_adopts", "nomad_view_carry_rows",
+    # certified chain-carry adoption (ISSUE 20): a speculation chain's
+    # HEAD carry adopted at refresh, per-row skip/reject counts, the
+    # resync bytes it avoided — the r08 zero-resync read steers on these
+    "nomad_view_chain_adopts", "nomad_view_chain_rows",
+    "nomad_view_chain_rejects", "nomad_spec_resync_bytes_saved",
+    # delta-log ring wrap mid-chain: certification evidence lost, every
+    # speculative result rolled back (size via NOMAD_TPU_DELTA_LOG)
+    "nomad_spec_chain_unprovable_wrap",
     # transfer ledger mirrors + labeled per-site exposition
     "nomad_transfer_bytes", "nomad_transfer_count", "nomad_transfer_ms",
     "nomad_transfer_bytes_total", "nomad_transfer_count_total",

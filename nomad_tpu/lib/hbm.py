@@ -21,7 +21,15 @@ mirror + labeled Prometheus exposition):
   referenced", which is exactly when their HBM is still held. Re-siting
   is first-class: a dispatch carry adopted into the view moves its
   bytes from `select_batch.carry` to `stack.view_hot` instead of
-  double-counting. Sites are dotted names (README's residency-site
+  double-counting — and the certified chain HEAD carry (ISSUE 20)
+  follows the same discipline: the folded k-deep carry a clean certify
+  publishes re-sites on adoption exactly like a single-dispatch carry,
+  while a view rebuild retires the chain so the REPLACED generation's
+  hot buffers (its `base_arrays`) actually die and release their
+  booking instead of being pinned by a chain that can never certify
+  again (the leak-gate round in tests/test_hbm.py pins both: adoption
+  leaves per-site residency flat, retirement keeps exactly one
+  generation live). Sites are dotted names (README's residency-site
   table); shards are device ids, split per-device for sharded arrays so
   mesh state reads per-chip.
 

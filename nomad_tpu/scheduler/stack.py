@@ -28,7 +28,7 @@ from ..utils import bucket as _shared_bucket, widen_lut
 from ..structs import Allocation, Job, TaskGroup
 from ..structs.job import (CONSTRAINT_DISTINCT_HOSTS,
                            CONSTRAINT_DISTINCT_PROPERTY)
-from ..tensor.cluster import R_TOTAL, ClusterTensors
+from ..tensor.cluster import DELTA_LOG_LEN, R_TOTAL, ClusterTensors
 from ..tensor.constraints import (
     CompiledAffinities,
     CompiledConstraints,
@@ -365,6 +365,7 @@ def spec_chain_view(cluster, lease_token) -> Optional[ClusterArrays]:
                     "checked_version": ent["version"],
                     "checked_ports": ent["ports_version"],
                     "stale": set(),
+                    "proven": set(),
                     "expect": {},
                     "windows": [],
                     "last_rejected": set(),
@@ -430,8 +431,19 @@ def spec_chain_certify(cluster) -> Optional[frozenset]:
     foreign mutations, partial commits, retry plans under other
     tokens, phantom placements of uncommitted evals, any port-bitmap
     mutation (never modeled by the carry) — goes stale and stays
-    stale for the life of the chain."""
+    stale for the life of the chain.
+
+    Multi-token coverage (chain-carry adoption): besides the stale
+    SUPERSET, certification accumulates the complement — `proven`, the
+    rows every certified window vouched for (clean+exact commit of an
+    expected token, predicted placement row). Across a chain of k
+    dispatches under k commit windows those are exactly the rows whose
+    values the folded HEAD carry holds bit-identically, which is what
+    lets `spec_chain_publish_carry` hand the carry to the view cache
+    for zero-transfer adoption (`TPUStack.device_arrays`)."""
     cl = cluster
+    wrap = None
+    result = None
     with _DEV_CACHE_LOCK:
         ent = _DEV_CACHE.get(cl)
         arrays = ent["arrays"] if ent is not None else None
@@ -454,80 +466,208 @@ def spec_chain_certify(cluster) -> Optional[frozenset]:
             v_now = cl.version
             p_now = cl.ports_version
             hot = cl.hot_entries_since(chain["checked_version"], cl.n_cap)
-            if hot is None:
-                return None
-            hot = [(ver, rows) for ver, rows in hot if ver <= v_now]
-            ports = cl.port_words_since(chain["checked_ports"], cl.n_cap)
-            if ports is None:
-                return None
-            # windows: observer-captured ∪ ring — the observer survives
-            # ring wrap, the ring covers windows marked before the
-            # observer was installed
-            seen = set()
-            windows = []
-            for w in (chain["windows"]
-                      + cl.plan_windows_since(chain["checked_version"])):
-                k = (w[0], w[1], w[2], w[4])
-                if k not in seen:
-                    seen.add(k)
-                    windows.append(w)
-            chain["windows"] = []
-            expect = chain["expect"]
-            stale = chain["stale"]
-            # optimistic-rejection diagnostics: the rows whose
-            # placements verification dropped this interval — surfaced
-            # in the spec.rollback flight detail (their staleness is
-            # already covered by the predicted-uncovered rule)
-            chain["last_rejected"] = {
-                int(r) for w in windows if w[5] for r in w[5]}
-            covered = set()   # (eval_id, token) committed clean+exact
-            for _lo, _hi, eid, ok, tok, _rej in windows:
-                if ok and tok in expect and eid in expect[tok]["evals"]:
-                    covered.add((eid, tok))
-            allowed_rows: Dict[int, set] = {}
-            for tok, rec in expect.items():
-                pred = rec["predicted"]
-                if pred is None:
-                    # expected dispatch never resolved its outputs: its
-                    # placements are unprovable
-                    return None
-                rows_ok = set(rec["stops"])
-                for eid, rows in pred.items():
-                    if rows and (eid, tok) not in covered:
-                        # phantom placements: the carry baked them in,
-                        # no clean+exact commit vouches for them
-                        stale.update(rows)
-                    else:
-                        rows_ok.update(rows)
-                allowed_rows[tok] = rows_ok
-            for ver, rows in hot:
-                w = None
-                for v_lo, v_hi, eid, ok, tok, _rej in windows:
-                    if v_lo < ver <= v_hi:
-                        w = (eid, ok, tok)
-                        break
-                if w is None:
-                    stale.update(rows)      # foreign mutation
-                    continue
-                eid, ok, tok = w
-                if not (ok and tok in expect and (eid, tok) in covered):
-                    stale.update(rows)      # partial/inexact/other-token
-                    continue
-                stale.update(r for r in rows
-                             if r not in allowed_rows[tok])
-            # the carry never models the port bitmap: every touched
-            # port row diverges from the chain view's base ports
-            # (entries past the p_now capture are examined again next
-            # certify — stale is a set, re-adding is idempotent)
-            stale.update(int(r) for r in ports)
-            chain["checked_version"] = v_now
-            chain["checked_ports"] = p_now
-            # expected tokens are single-shot: their plans all committed
-            # before this certification ran (the worker finishes batch k
-            # before it certifies batch k+1), so their windows were in
-            # THIS interval and must not be re-judged against the next
-            chain["expect"] = {}
-            return frozenset(stale)
+            ports = (cl.port_words_since(chain["checked_ports"], cl.n_cap)
+                     if hot is not None else None)
+            if hot is None or ports is None:
+                # a delta-log ring wrap ate the interval's evidence:
+                # unprovable, but NOT silently — note the details here
+                # (under the locks, where the cursors are stable) and
+                # emit the counter + flight event after release
+                wrap = {
+                    "log": "hot" if hot is None else "ports",
+                    "checked_version": int(chain["checked_version"]
+                                           if hot is None
+                                           else chain["checked_ports"]),
+                    "version_now": int(v_now if hot is None else p_now),
+                    "log_len": int(getattr(cl, "delta_log_len", 0) or 0),
+                }
+            else:
+                result = _certify_interval_locked(
+                    cl, chain, hot, ports, v_now, p_now)
+    if wrap is not None:
+        _chain_wrap_unprovable(cl, wrap)
+        return None
+    return result
+
+
+def _certify_interval_locked(cl, chain, hot, ports, v_now, p_now):
+    """Certification interval fold (both locks held, delta-log reads
+    already resolved — see spec_chain_certify for the soundness
+    argument). Returns the cumulative stale frozenset."""
+    hot = [(ver, rows) for ver, rows in hot if ver <= v_now]
+    # windows: observer-captured ∪ ring — the observer survives
+    # ring wrap, the ring covers windows marked before the
+    # observer was installed
+    seen = set()
+    windows = []
+    for w in (chain["windows"]
+              + cl.plan_windows_since(chain["checked_version"])):
+        k = (w[0], w[1], w[2], w[4])
+        if k not in seen:
+            seen.add(k)
+            windows.append(w)
+    chain["windows"] = []
+    expect = chain["expect"]
+    stale = chain["stale"]
+    proven = chain.setdefault("proven", set())
+    # optimistic-rejection diagnostics: the rows whose
+    # placements verification dropped this interval — surfaced
+    # in the spec.rollback flight detail (their staleness is
+    # already covered by the predicted-uncovered rule)
+    chain["last_rejected"] = {
+        int(r) for w in windows if w[5] for r in w[5]}
+    covered = set()   # (eval_id, token) committed clean+exact
+    for _lo, _hi, eid, ok, tok, _rej in windows:
+        if ok and tok in expect and eid in expect[tok]["evals"]:
+            covered.add((eid, tok))
+    allowed_rows: Dict[int, set] = {}
+    for tok, rec in expect.items():
+        pred = rec["predicted"]
+        if pred is None:
+            # expected dispatch never resolved its outputs: its
+            # placements are unprovable
+            return None
+        rows_ok = set(rec["stops"])
+        for eid, rows in pred.items():
+            if rows and (eid, tok) not in covered:
+                # phantom placements: the carry baked them in,
+                # no clean+exact commit vouches for them
+                stale.update(rows)
+            else:
+                rows_ok.update(rows)
+        allowed_rows[tok] = rows_ok
+    for ver, rows in hot:
+        w = None
+        for v_lo, v_hi, eid, ok, tok, _rej in windows:
+            if v_lo < ver <= v_hi:
+                w = (eid, ok, tok)
+                break
+        if w is None:
+            stale.update(rows)      # foreign mutation
+            continue
+        eid, ok, tok = w
+        if not (ok and tok in expect and (eid, tok) in covered):
+            stale.update(rows)      # partial/inexact/other-token
+            continue
+        # the window's clean+exact commit vouches for its predicted
+        # placement rows bit-identically — the PROVEN complement the
+        # published chain carry adopts; anything else in the entry
+        # (stops already went stale on fold) diverges
+        for r in rows:
+            if r in allowed_rows[tok]:
+                proven.add(int(r))
+            else:
+                stale.add(r)
+    # the carry never models the port bitmap: every touched
+    # port row diverges from the chain view's base ports
+    # (entries past the p_now capture are examined again next
+    # certify — stale is a set, re-adding is idempotent)
+    stale.update(int(r) for r in ports)
+    chain["checked_version"] = v_now
+    chain["checked_ports"] = p_now
+    # expected tokens are single-shot: their plans all committed
+    # before this certification ran (the worker finishes batch k
+    # before it certifies batch k+1), so their windows were in
+    # THIS interval and must not be re-judged against the next
+    chain["expect"] = {}
+    return frozenset(stale)
+
+
+def _chain_wrap_unprovable(cluster, detail: dict) -> None:
+    """A delta-log ring wrap mid-chain lost the certification evidence
+    for the interval — previously a silent `None` (roll everything
+    back). Count it and leave an actionable trace: the fix is sizing
+    `NOMAD_TPU_DELTA_LOG` above the per-interval mutation volume.
+    Called OUTSIDE the cache/spec locks (flight sinks may fan out)."""
+    default_registry().inc("spec.chain_unprovable_wrap")
+    try:
+        from ..lib.flight import default_flight
+
+        default_flight().record(
+            "spec.rollback",
+            key="chain-wrap:%s" % detail.get("log"),
+            severity="warn",
+            detail=dict(
+                detail,
+                reason="delta_log_wrap",
+                finding=(
+                    "speculation chain unprovable: the %s delta-log ring "
+                    "wrapped past the chain's certification cursor "
+                    "(checked %d, now %d, ring %d entries) — every "
+                    "speculative result rolls back. Raise "
+                    "NOMAD_TPU_DELTA_LOG (default %d) above the mutation "
+                    "volume of one commit interval, or certify more "
+                    "often." % (detail.get("log"),
+                                detail.get("checked_version", -1),
+                                detail.get("version_now", -1),
+                                detail.get("log_len", 0),
+                                DELTA_LOG_LEN)),
+            ))
+    except Exception:  # noqa: BLE001 — telemetry only
+        pass
+
+
+def chain_adopt_enabled() -> bool:
+    """Chain-carry adoption default: ON (a certified-clean chain's HEAD
+    carry IS the post-commit view for the rows it proved — adopting it
+    is a buffer swap, zero transfer); NOMAD_TPU_SPEC_CHAIN_ADOPT=0 opts
+    out, which the bench A/B arm uses to price the resync it avoids."""
+    return os.environ.get("NOMAD_TPU_SPEC_CHAIN_ADOPT", "1") \
+        .strip().lower() not in ("0", "off", "false")
+
+
+def spec_chain_publish_carry(cluster) -> bool:
+    """Hand the chain's certified HEAD carry to the view cache as an
+    adoptable CHAIN carry — called by the coordinator on every CLEAN
+    certification (select_batch._certify_spec), never on rollback.
+
+    The published record extends the single-dispatch carry note with
+    the chain's accumulated certification evidence: `adopt_rows` (the
+    proven complement — every row some clean+exact window of an
+    expected token vouched for), `stale` (the cumulative superset of
+    divergence, always overlaid), and `proven_version` (the certify
+    cursor — mutations PAST it are judged at adoption time against the
+    head token's own windows, because the head's plans commit after
+    the certify that published it). A refresh landing mid-chain or
+    post-chain then pays only the genuinely-foreign delta
+    (device_arrays._chain_carry_overlay), never a full resync of
+    spec-committed rows.
+
+    Overwrites any previous publication (each clean certify supersedes
+    the last); survives spec_chain_reset — the evidence is already
+    certified, the chain object is not needed to use it. Returns True
+    when a carry was published."""
+    if not chain_adopt_enabled():
+        return False
+    with _DEV_CACHE_LOCK:
+        ent = _DEV_CACHE.get(cluster)
+        if ent is None:
+            return False
+        with _SPEC_LOCK:
+            chain = _SPEC_CHAINS.get(cluster)
+            if chain is None or chain["head"] is None:
+                return False
+            if (ent.get("arrays") is not chain["base_arrays"]
+                    or ent["static_key"] != chain["static_key"]
+                    or cluster.node_version != chain["node_version"]):
+                return False
+            head = chain["head"]
+            ent["carry"] = {
+                "chain": True,
+                "token": head["token"],
+                "base_arrays": chain["base_arrays"],
+                "evals": set(head["evals"]),
+                "stop_rows": set(head["stops"]),
+                "used": head["used"],
+                "dyn_free": head["dyn_free"],
+                # may still be None here — carry_predicted fills it by
+                # token match when the head's outputs land host-side
+                "predicted": head["predicted"],
+                "proven_version": chain["checked_version"],
+                "stale": set(chain["stale"]),
+                "adopt_rows": set(chain.get("proven", ())),
+            }
+            return True
 
 
 def spec_chain_reset(cluster) -> None:
@@ -743,15 +883,48 @@ class TPUStack:
                 for _ver, rs in hot_entries:
                     hot_rows.update(rs)
             skip: set = set()
+            overlay: Optional[set] = None
             adopted = False
-            if carry is not None and hot_rows:
+            if (carry is not None and carry.get("chain")
+                    and not chain_adopt_enabled()):
+                # opt-out mid-life (publish is gated too, but a carry
+                # published before the flip may still be pending):
+                # plain refresh, no adopt/reject accounting
+                carry = None
+            if carry is not None and carry.get("chain"):
+                # certified speculation-chain HEAD carry
+                # (spec_chain_publish_carry): its own evidence replaces
+                # the small-limit hot_entries read — a long chain's row
+                # set routinely exceeds it, and the proof lives in the
+                # chain's certify cursor + the head token's windows
+                res = (self._chain_carry_overlay(cl, ent, carry, prev,
+                                                 mesh)
+                       if can_delta else None)
+                if res is not None:
+                    skip, overlay = res
+                    adopted = True
+                    reg.inc("view.chain_adopts")
+                    reg.inc("view.chain_rows", len(skip))
+                    # the bytes a post-chain refresh would otherwise
+                    # re-upload for the spec-committed rows: one delta
+                    # row (idx + used + node_ok + dyn_free) per skip
+                    row_nb = (4 + cl.used.shape[-1] * 4
+                              + cl.node_ok.dtype.itemsize
+                              + cl.dyn_free.nbytes
+                              // max(cl.dyn_free.shape[0], 1))
+                    reg.inc("spec.resync_bytes_saved",
+                            row_nb * len(skip))
+                else:
+                    reg.inc("view.chain_rejects")
+                    carry = None
+            if not adopted and carry is not None and hot_rows:
                 skip = self._carry_skip_rows(cl, ent, carry, prev,
                                              hot_entries, mesh)
                 adopted = skip is not None
                 if not adopted:
                     skip = set()
                     reg.inc("view.carry_rejects")
-            elif carry is not None:
+            elif not adopted and carry is not None:
                 reg.inc("view.carry_rejects")
             if adopted:
                 # D2D plan delta: the dispatch's own chain carry IS the
@@ -766,10 +939,11 @@ class TPUStack:
                 # a phantom release on rows no hot entry names.
                 used, dyn_free = carry["used"], carry["dyn_free"]
                 node_ok = prev.node_ok
-                overlay = (hot_rows - skip) | {
-                    r for r in carry["stop_rows"] if r < cl.n_cap}
-                reg.inc("view.carry_adopts")
-                reg.inc("view.carry_rows", len(skip))
+                if overlay is None:
+                    overlay = (hot_rows - skip) | {
+                        r for r in carry["stop_rows"] if r < cl.n_cap}
+                    reg.inc("view.carry_adopts")
+                    reg.inc("view.carry_rows", len(skip))
                 if overlay:
                     idx, uvals, ovals, dvals = _delta_rows_host(
                         overlay, cl.used, cl.node_ok, cl.dyn_free)
@@ -874,6 +1048,18 @@ class TPUStack:
                 "n_cap": cl.n_cap, "mesh": mesh,
                 "leases": leases, "carry": None,
             }
+            # a chain anchored to the REPLACED arrays can never certify
+            # or publish again (the object-identity guard fails), so it
+            # is dead weight that pins a full generation of hot buffers
+            # — retire it with the rebuild. Its published carry was
+            # snapshotted into the old entry and already consumed (or
+            # rejected) above; in-flight dispatches observe the same
+            # None-certify → rollback they would have anyway.
+            with _SPEC_LOCK:
+                chain = _SPEC_CHAINS.get(cl)
+                if (chain is not None
+                        and chain["base_arrays"] is not arrays):
+                    _spec_reset_locked(cl, chain)
             return arrays
 
     @staticmethod
@@ -931,6 +1117,73 @@ class TPUStack:
             pred_rows.update(rows)
         return ((covered_rows & pred_rows) - uncovered_rows
                 - carry["stop_rows"])
+
+    @staticmethod
+    def _chain_carry_overlay(cl, ent, carry, prev, mesh):
+        """Decide whether a certified CHAIN carry
+        (spec_chain_publish_carry) is adoptable and split the rows into
+        (skip, overlay), or return None to reject outright.
+
+        Evidence layout: rows changed in [entry version,
+        proven_version] were classified by chain certification into
+        `adopt_rows` (proven: clean+exact window of an expected token,
+        predicted placement row — the carry holds their committed
+        values bit-identically) or `stale` (everything else); rows
+        changed PAST proven_version (the head's own commits land after
+        the certify that published the carry, and anything foreign can
+        land too) are judged HERE against the head token's windows with
+        exactly the single-dispatch `_carry_skip_rows` rules. The
+        overlay — host-authoritative rewrite — is the union of stale,
+        the head's stop rows, the unproven tail, and any head
+        prediction no clean window vouches for (a refresh landing
+        mid-chain: the in-flight dispatch's placements are phantoms
+        until their windows commit — overlaying them keeps the proven
+        prefix adoptable instead of rejecting the whole carry).
+        Everything in neither set is unchanged since the entry's
+        upload, and the carry equals the base there by construction."""
+        if mesh is not None or ent["mesh"] is not None:
+            return None
+        if carry["base_arrays"] is not prev:
+            return None
+        predicted = carry["predicted"]
+        if predicted is None:
+            # head outputs never landed: its placement rows are unknown
+            # — nothing bounds the phantom set, reject
+            return None
+        tail = cl.hot_entries_since(carry["proven_version"], cl.n_cap)
+        if tail is None:
+            return None
+        windows = cl.plan_windows_since(carry["proven_version"])
+        token = carry["token"]
+        covered_evals = {w[2] for w in windows
+                         if w[3] and w[4] == token
+                         and w[2] in carry["evals"]}
+        phantom: set = set()
+        for eid, rows in predicted.items():
+            if rows and eid not in covered_evals:
+                phantom.update(rows)
+        covered_rows: set = set()
+        uncovered_rows: set = set()
+        for ver, rs in tail:
+            cov = False
+            for v_lo, v_hi, eid, ok, w_tok, _rej in windows:
+                if v_lo < ver <= v_hi:
+                    cov = (ok and w_tok == token
+                           and eid in covered_evals)
+                    break
+            (covered_rows if cov else uncovered_rows).update(rs)
+        pred_rows: set = set()
+        for rows in predicted.values():
+            pred_rows.update(rows)
+        tail_skip = ((covered_rows & pred_rows) - uncovered_rows
+                     - carry["stop_rows"])
+        n = cl.n_cap
+        overlay = {r for r in carry["stale"] if r < n}
+        overlay.update(r for r in carry["stop_rows"] if r < n)
+        overlay.update(r for r in uncovered_rows if r < n)
+        overlay.update(r for r in phantom if r < n)
+        skip = ((carry["adopt_rows"] | tail_skip) - overlay)
+        return skip, overlay
 
     @staticmethod
     def _apply_port_words(cl, ports_buf, port_words, donate, led, reg):

@@ -1146,6 +1146,12 @@ class SelectCoordinator:
             if self.timeline is not None:
                 self.timeline.spec_resolve(spec["seq"], "certified")
             _gate_for(cluster).record(False)
+            # hand the certified HEAD carry to the view cache instead
+            # of dropping it at chain end: a refresh landing mid-chain
+            # or after the chain winds down adopts the chain's folded
+            # view and overlays only the genuinely-foreign delta
+            # (stack.spec_chain_publish_carry / _chain_carry_overlay)
+            stack_mod.spec_chain_publish_carry(cluster)
             # chain continues: this dispatch's carry predicts the next
             # post-commit view while THESE plans commit
             self._offer_spec(cluster)

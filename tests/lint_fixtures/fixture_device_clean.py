@@ -89,5 +89,20 @@ def bitwise_lane_fold(rows, base):
     return folded, n_changed, dyn_l
 
 
+def bitwise_chain_fold(dispatches, base):
+    # chain-carry adoption fold (ISSUE 20): each dispatch's certified
+    # rows REPLACE the base by jnp.where selection — bit-exact
+    # adoption, never an arithmetic merge of the carries
+    used_l, dyn_l = jax.vmap(_lane)(dispatches)
+    folded = base
+    for k in range(3):
+        take = jnp.any(used_l[k] != folded, axis=-1)
+        folded = jnp.where(take, used_l[k], folded)
+    adopted = jnp.any(used_l != base[None], axis=-1)
+    n_adopted = jnp.sum(adopted.astype(jnp.int32))  # a mask count,
+    # not a carry fold — comparison killed the taint
+    return folded, n_adopted, dyn_l
+
+
 def _lane(row):
     return row, row

@@ -47,5 +47,17 @@ def arithmetic_lane_fold(rows):
     return bad, worse
 
 
+def arithmetic_chain_fold(dispatches):
+    """Multi-carry CHAIN fold (ISSUE 20): merging the per-dispatch
+    carries arithmetically is the same float-order hazard as a lane
+    fold — an adopted chain carry must be folded by bitwise selection
+    against the certified rows, never summed or averaged."""
+    used_l, dyn_l = jax.vmap(_lane)(dispatches)
+    folded = used_l[0] + used_l[1]  # NLD04
+    folded = folded + used_l[2]  # NLD04
+    avg = jnp.mean(dyn_l, axis=0)  # NLD04
+    return folded, avg
+
+
 def _lane(row):
     return row, row

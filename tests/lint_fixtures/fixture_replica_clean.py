@@ -75,3 +75,16 @@ def scan_certified(cl, chain):
     rows = cl.hot_rows_since(chain["checked_version"], 64)
     chain["checked_version"] = v_now
     return rows
+
+
+def certify_chain_interval(cl, chain):
+    # the multi-window chain-certify discipline
+    # (stack._certify_interval_locked): BOTH cursors captured before
+    # either log is read, advanced only to the captured values
+    v_now = cl.version
+    p_now = cl.ports_version
+    hot = cl.hot_entries_since(chain["checked_version"], 64)
+    ports = cl.port_words_since(chain["checked_ports"], 64)
+    chain["checked_version"] = v_now
+    chain["checked_ports"] = p_now
+    return hot, ports

@@ -92,3 +92,15 @@ def scan_late_capture(cl, chain):
     v_now = cl.version
     chain["checked_version"] = v_now  # NLR04
     return ents
+
+
+def certify_chain_interval(cl, chain):
+    # the chain-certification read-before-capture shape (ISSUE 20):
+    # both logs are read FIRST, then the cursors jump to LIVE version
+    # reads — a commit landing between read and capture is silently
+    # skipped by the next certified interval
+    hot = cl.hot_entries_since(chain["checked_version"], 64)
+    ports = cl.port_words_since(chain["checked_ports"], 64)
+    chain["checked_version"] = cl.version  # NLR04
+    chain["checked_ports"] = cl.ports_version  # NLR04
+    return hot, ports

@@ -508,34 +508,44 @@ class TestWaveServerParity:
 
 class TestLoadedWindowCounters:
     def _loaded_window(self, monkeypatch, waves, wave_width, eval_batch,
-                       min_mean_width):
+                       min_mean_width, speculate=False):
         """Acceptance triplet for the mega-batch steady state: park
         `wave_width` evals per wave (broker disabled during
         registration), release each wave as one drain, and gate the
         measured window (everything after the warmup wave) on:
         mean fused-dispatch width ≥ min_mean_width, ZERO packed-program
         uploads, ZERO kernel-attributable hot-delta bytes, clean under
-        transfer_guard("disallow"), with the wave path engaged."""
+        transfer_guard("disallow"), with the wave path engaged.
+
+        speculate=False pins the NON-speculative steady state
+        (ISSUE 12: every dispatch refreshes the view and ADOPTS the
+        predecessor carry → hot_delta == 0).
+
+        speculate=True pins the SPECULATIVE steady state (ISSUE 20):
+        wave_width exceeds eval_batch so each wave drains as two
+        batches — the second launches speculatively against the
+        chain's predicted view (no refresh at all) while the first's
+        plans commit, and the NEXT wave's opening refresh adopts the
+        certified chain HEAD carry. hot_delta stays ZERO anyway: the
+        last host↔device byte stream of the loop is closed."""
         from nomad_tpu.lib.metrics import default_registry
         from nomad_tpu.lib.transfer import default_ledger
         from nomad_tpu.server import Server, ServerConfig
         from nomad_tpu.synth import synth_node
 
         monkeypatch.delenv("NOMAD_TPU_EVAL_BATCH", raising=False)
-        # a pinned window makes each wave drain as ONE batch: the hold
+        # a pinned window makes each wave drain as one FULL batch (plus,
+        # with speculate, the overflow successor batch): the hold
         # bridges the enqueue loop; jobs are identical-shaped so the
         # steady state has zero table inserts
         monkeypatch.setenv("NOMAD_TPU_DRAIN_WINDOW_MS", "300")
-        # this gate pins the NON-speculative steady state (ISSUE 12:
-        # every dispatch refreshes the view and ADOPTS the predecessor
-        # carry → hot_delta == 0). A speculative chain (ISSUE 15) skips
-        # refreshes entirely while it holds — zero view transfer — and
-        # pays the skipped rows' delta at the next real refresh, which
-        # reads here as hot_delta > 0 whenever speculation happens to
-        # engage. The speculative steady state has its own gates
-        # (tests/test_spec.py, e2e_spec); folding chain carries into
-        # adoption to zero the resync too is ROADMAP follow-up work.
-        monkeypatch.setenv("NOMAD_TPU_SPECULATE", "0")
+        monkeypatch.setenv("NOMAD_TPU_SPECULATE",
+                           "1" if speculate else "0")
+        if speculate:
+            # generous rendezvous: the successor batch must park before
+            # the predecessor's dispatch gives up on offering it a
+            # speculative launch
+            monkeypatch.setenv("NOMAD_TPU_SPEC_PARK_MS", "2000")
         rng = random.Random(29)
         s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
                                 eval_batch=eval_batch))
@@ -571,14 +581,17 @@ class TestLoadedWindowCounters:
                     # view.* counters live in the PROCESS registry
                     # (scheduler/stack.py), not the server's
                     adopts0 = default_registry().counters(
-                        prefix="view.").get("carry_adopts", 0)
+                        prefix="view.").get(
+                        "chain_adopts" if speculate else "carry_adopts",
+                        0)
                     monkeypatch.setenv("NOMAD_TPU_TRANSFER_GUARD",
                                        "disallow")
             led1 = led.snapshot()
             hist1 = s.metrics.histogram("drain.batch_width").summary()
             ctr = s.metrics.counters()
             adopts1 = default_registry().counters(
-                prefix="view.").get("carry_adopts", 0)
+                prefix="view.").get(
+                "chain_adopts" if speculate else "carry_adopts", 0)
         finally:
             s.shutdown()
 
@@ -597,15 +610,35 @@ class TestLoadedWindowCounters:
         assert delta("stack.hot_full") == 0
         assert ctr.get("wave.dispatches", 0) >= waves - 1, ctr
         assert ctr.get("wave.collisions", 0) == 0
-        assert adopts1 > adopts0, "measured window never adopted a carry"
+        if speculate:
+            assert ctr.get("spec.launches", 0) >= 1, \
+                (ctr, "loaded window never speculated")
+            assert adopts1 > adopts0, \
+                "measured window never adopted a chain carry"
+        else:
+            assert adopts1 > adopts0, \
+                "measured window never adopted a carry"
 
     def test_loaded_window_width_gate(self, monkeypatch):
-        # tier-1 sized: 3×96-eval waves, mean fused width ≥ 64
+        # tier-1 sized (ISSUE 20): 3×192-eval waves drained as 128+64
+        # batches — the second batch of every wave launches
+        # speculatively, the next wave's refresh adopts the chain
+        # carry, and hot-delta bytes stay ZERO end to end
+        self._loaded_window(monkeypatch, waves=3, wave_width=192,
+                            eval_batch=128, min_mean_width=64,
+                            speculate=True)
+
+    def test_loaded_window_width_gate_no_spec(self, monkeypatch):
+        # the ISSUE 12 twin: speculation hard-disabled, every dispatch
+        # does a real refresh that adopts the predecessor's carry
         self._loaded_window(monkeypatch, waves=3, wave_width=96,
                             eval_batch=128, min_mean_width=64)
 
     @pytest.mark.slow
     def test_loaded_1024_eval_window(self, monkeypatch):
-        # the full ISSUE 12 acceptance window: 1024 evals steady-state
-        self._loaded_window(monkeypatch, waves=8, wave_width=128,
-                            eval_batch=256, min_mean_width=64)
+        # the full acceptance window, speculation ON: 2048 evals
+        # steady-state, every wave overflowing into a speculative
+        # successor batch
+        self._loaded_window(monkeypatch, waves=8, wave_width=256,
+                            eval_batch=192, min_mean_width=64,
+                            speculate=True)

@@ -403,6 +403,55 @@ class TestLeakGate:
         assert led.totals()[0] == base_live
         assert stack_mod.spec_chain_head_token(cl) is None
 
+    def test_chain_adoption_round_leaks_nothing(self, monkeypatch):
+        """ISSUE 20 extension of the leak gate: a certified-clean
+        speculation chain whose HEAD carry the next refresh ADOPTS
+        must leave zero outstanding leases, zero unfreed carries or
+        lazy outputs, and per-site live-bytes exactly at the warm
+        baseline — the adopted (used, dyn_free) buffers are re-sited
+        from the carry to the view, accounted once, never twice."""
+        import tests.test_spec as tsp
+        from nomad_tpu.lib.transfer import guard_scope
+
+        led = _fresh_global_ledger(monkeypatch)
+        cl = tsp._dc_cluster()
+        reg = MetricsRegistry()
+        # warm round: the quiesced baseline with steady buffer shapes
+        _c0, res0 = tpt._run_round(
+            cl, [tsp._dc_job("dc1"), tsp._dc_job("dc2")],
+            eval_ids=["w1", "w2"])
+        tpt._commit_round(cl, res0, ["w1", "w2"])
+        _view_stack(cl).device_arrays()
+        res0 = None
+        gc.collect()
+        base = led.snapshot()
+        base_live = led.totals()[0]
+        assert base_live > 0
+        assert led.outstanding_leases() == 0
+
+        # three certified-clean speculative dispatches, nothing rolls
+        # back, then the refresh adopts the chain HEAD carry
+        _r, fin_res, fin_ids = tsp._drive_chain(cl, monkeypatch, k=3,
+                                                reg=reg)
+        tpt._commit_round(cl, fin_res, fin_ids)
+        adopts0 = tpt._counter("chain_adopts")
+        with guard_scope("disallow"):
+            _view_stack(cl).device_arrays()
+        assert tpt._counter("chain_adopts") == adopts0 + 1
+        fin_res = None
+        gc.collect()
+
+        assert led.outstanding_leases() == 0, "leaked chain view lease"
+        snap = led.snapshot()
+        assert snap.get("select_batch.carry", {}).get(
+            "live_bytes", 0) == 0, "unfreed chain carry"
+        assert snap.get("select_batch.batch_out", {}).get(
+            "live_bytes", 0) == 0, "unresolved chain outputs"
+        for site, row in sorted(snap.items()):
+            assert row["live_bytes"] == base.get(site, {}).get(
+                "live_bytes", 0), f"residency grew at {site}"
+        assert led.totals()[0] == base_live
+
     def test_unreleased_lease_is_visible(self, monkeypatch):
         """A dispatch that takes a view lease and never releases it
         must show up as outstanding (and, past the watermark, stuck) —

@@ -571,7 +571,8 @@ def test_cursor_discipline_holds_on_stack():
     fixture = _analyze_fixture("fixture_replica_violations.py",
                                _scope_rel("raft", "fixture_replica.py"))
     ctxs = {f.context for f in fixture if f.rule == "NLR04"}
-    assert ctxs == {"scan_live_cursor", "scan_late_capture"}
+    assert ctxs == {"scan_live_cursor", "scan_late_capture",
+                    "certify_chain_interval"}
 
 
 def test_analyzer_needs_no_jax_import():

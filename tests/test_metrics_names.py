@@ -177,6 +177,31 @@ def loaded_agent(tmp_path, monkeypatch):
             t.join(30.0)
         stack_mod.spec_chain_reset(cl)
 
+    # chain-carry adoption families (ISSUE 20), NON-vacuously: a
+    # 3-deep certified chain whose next refresh ADOPTS the published
+    # HEAD carry (view.chain_adopts/chain_rows,
+    # spec.resync_bytes_saved — process registry, like view.carry_*)
+    cl3 = tsp._dc_cluster()
+    _r3, fin_res, fin_ids = tsp._drive_chain(cl3, monkeypatch, k=3,
+                                             reg=s.metrics)
+    tpt._commit_round(cl3, fin_res, fin_ids)
+    stack_mod.TPUStack(cl3).device_arrays()
+    # ...one delta-log ring WRAP mid-chain (certification can no
+    # longer prove the interval → spec.chain_unprovable_wrap) whose
+    # published carry the next refresh must then REJECT
+    # (view.chain_rejects) — same unprovable tail
+    monkeypatch.setenv("NOMAD_TPU_DELTA_LOG", "8")
+    cl4 = tsp._dc_cluster()
+    monkeypatch.delenv("NOMAD_TPU_DELTA_LOG")
+    _r4, fin_res4, fin_ids4 = tsp._drive_chain(cl4, monkeypatch, k=1,
+                                               reg=s.metrics)
+    tpt._commit_round(cl4, fin_res4, fin_ids4)
+    for _ in range(12):  # blow past the 8-slot ring
+        cl4._log_hot(0)
+        cl4.version += 1
+    assert stack_mod.spec_chain_certify(cl4) is None
+    stack_mod.TPUStack(cl4).device_arrays()
+
     # mesh-CA denial outcomes (ISSUE 14 + 16), NON-vacuously: one
     # identity rejection (unknown node) and one allocation-binding
     # rejection (verified node identity, but no live alloc of the
@@ -253,6 +278,17 @@ class TestSeriesNameStability:
         assert snap["counters"].get("spec.rolled_back", 0) >= 1
         assert snap["counters"].get("spec.redispatch_programs", 0) >= 1
         assert snap["counters"].get("spec.wasted_kernel_ms", 0) > 0
+        # the chain-adoption rounds drove one ADOPTED refresh, one
+        # REJECTED carry, and one ring-wrap — the ISSUE 20 pins are
+        # live flows (process registry, like the view.* family)
+        from nomad_tpu.lib.metrics import default_registry
+        view = default_registry().counters(prefix="view.")
+        assert view.get("chain_adopts", 0) >= 1
+        assert view.get("chain_rows", 0) >= 1
+        assert view.get("chain_rejects", 0) >= 1
+        proc_spec = default_registry().counters(prefix="spec.")
+        assert proc_spec.get("resync_bytes_saved", 0) > 0
+        assert proc_spec.get("chain_unprovable_wrap", 0) >= 1
         # the connect denial series are live deny flows with DISTINCT
         # per-reason counters (ISSUE 16), not eagerly-created zeros
         assert snap["counters"].get("connect.issue_denied", 0) >= 2

@@ -511,6 +511,54 @@ class TestCarryAdoption:
         for f, a in view.items():
             assert np.array_equal(a, cold[f]), f
 
+    def test_chain_carry_overlay_classification(self):
+        """Row-math unit for the CHAIN carry decision (ISSUE 20):
+        certified evidence (adopt_rows/stale) rides verbatim, tail
+        mutations past proven_version are judged against the head
+        token's windows, stops/phantoms/foreign always overlay."""
+        cl = _mini_cluster()
+        TPUStack(cl).device_arrays()
+        ent = _DEV_CACHE[cl]
+        prev = ent["arrays"]
+        carry = {
+            "chain": True, "token": 777, "base_arrays": prev,
+            "evals": {"eh"}, "stop_rows": {4},
+            "used": prev.used, "dyn_free": prev.dyn_free,
+            "predicted": {"eh": {5, 6}},
+            "proven_version": cl.version,
+            "stale": {3}, "adopt_rows": {1, 2},
+        }
+        # tail: the head's own clean+exact commit on row 5, then a
+        # foreign bump on row 7 no window covers
+        v_lo = cl.version
+        cl._log_hot(5)
+        cl.version += 1
+        cl.mark_plan_window("eh", v_lo, cl.version, clean=True,
+                            exact=True, token=777)
+        cl._log_hot(7)
+        cl.version += 1
+        res = TPUStack._chain_carry_overlay(cl, ent, carry, prev, None)
+        assert res is not None
+        skip, overlay = res
+        # proven prefix {1,2} + covered tail prediction {5} skip;
+        # stale {3}, stop {4}, foreign {7} overlay; predicted-but-
+        # unplaced row 6 is in neither (nothing ever touched it)
+        assert skip == {1, 2, 5}
+        assert overlay == {3, 4, 7}
+        # head never resolved its outputs → reject outright
+        unresolved = dict(carry, predicted=None)
+        assert TPUStack._chain_carry_overlay(
+            cl, ent, unresolved, prev, None) is None
+        # an UNCOMMITTED head prediction is a phantom: it overlays
+        # instead of poisoning the proven prefix
+        phantom = dict(carry, token=778, predicted={"eh": {5, 6}})
+        res2 = TPUStack._chain_carry_overlay(cl, ent, phantom, prev,
+                                             None)
+        assert res2 is not None
+        skip2, overlay2 = res2
+        assert skip2 == {1, 2}
+        assert {5, 6} <= overlay2
+
 
 class TestPortWordDelta:
     def test_port_flip_ships_words_not_rows(self):

@@ -554,6 +554,276 @@ class TestSpecDispatchParity:
         assert res2[0][0][0] is not None
 
 
+def _drive_chain(cl, monkeypatch, k=3, reg=None):
+    """Round 0 REAL dispatch, then k speculative rounds: each round's
+    batch parks, launches against the chain view, the predecessor's
+    plans commit, and the coordinator certifies CLEAN — publishing the
+    chain HEAD carry to the view cache every round (ISSUE 20). Returns
+    (reg, last_res, last_ids) with the FINAL speculative round's plans
+    still uncommitted — the caller decides how the chain ends."""
+    monkeypatch.setenv("NOMAD_TPU_SPEC_ROLLBACK_MAX", "1.0")
+    reg = reg if reg is not None else MetricsRegistry()
+    prev_ids = ["c0-a", "c0-b"]
+    _c0, prev_res = tpt._run_round(
+        cl, [_dc_job("dc1"), _dc_job("dc2")], eval_ids=prev_ids)
+    for n in range(1, k + 1):
+        ids = [f"c{n}-a", f"c{n}-b"]
+        jobs = [_dc_job("dc1", cpu=100 + 10 * n),
+                _dc_job("dc2", cpu=100 + 10 * n)]
+        coord = SelectCoordinator(registry=reg)
+        coord.trace_ids = dict(enumerate(ids))
+        coord.group_ids = {0: 0, 1: 1}
+        coord.footprints = {0: _dc_mask(cl, "dc1"),
+                            1: _dc_mask(cl, "dc2")}
+        threads, res = _start_parked(cl, jobs, coord)
+        assert coord.try_spec_launch(cl), f"round {n} never speculated"
+        tpt._commit_round(cl, prev_res, prev_ids)
+        coord.run()
+        for t in threads:
+            t.join(30.0)
+        # _start_parked results carry scores; _commit_round wants
+        # (node_ids, ask, carry_token)
+        prev_res = {i: (r[0], r[2], r[3]) for i, r in res.items()}
+        prev_ids = ids
+    return reg, prev_res, prev_ids
+
+
+class TestChainCarryAdoption:
+    """Certified chain-carry adoption (ISSUE 20): a view refresh
+    landing mid-chain or post-chain consumes the published chain HEAD
+    carry and pays only the genuinely-foreign delta — never a full
+    resync of spec-committed rows — while staying bit-identical to a
+    cold full upload."""
+
+    @staticmethod
+    def _delta(led0, led1, site):
+        return (led1.get(site, {}).get("bytes", 0)
+                - led0.get(site, {}).get("bytes", 0))
+
+    @staticmethod
+    def _saved():
+        from nomad_tpu.lib.metrics import default_registry
+        return default_registry().counters(
+            prefix="spec.").get("resync_bytes_saved", 0)
+
+    def _parity(self, arrays, cl):
+        view = tpt._np_view(arrays)
+        cold = tpt._cold_view(cl)
+        for f, a in view.items():
+            assert np.array_equal(a, cold[f]), \
+                f"adopted view diverges from cold upload in {f}"
+
+    def test_zero_resync_refresh_after_certified_chain(self,
+                                                       monkeypatch):
+        """The acceptance gate: ≥3 consecutive certified-clean
+        speculative dispatches, final plans committed, then a refresh
+        under transfer_guard('disallow') with ZERO hot-upload bytes,
+        view.chain_adopts ≥ 1, and bit-identical adoption."""
+        from nomad_tpu.lib.transfer import default_ledger, guard_scope
+
+        cl = _dc_cluster()
+        reg, last_res, last_ids = _drive_chain(cl, monkeypatch, k=3)
+        c = reg.counters()
+        assert c.get("spec.launches", 0) >= 3
+        assert c.get("spec.certified", 0) >= 3
+        assert not c.get("spec.rolled_back", 0)
+        tpt._commit_round(cl, last_res, last_ids)
+        led0 = default_ledger().snapshot()
+        adopts0 = tpt._counter("chain_adopts")
+        rows0 = tpt._counter("chain_rows")
+        saved0 = self._saved()
+        with guard_scope("disallow"):
+            arrays = TPUStack(cl).device_arrays()
+        led1 = default_ledger().snapshot()
+        for site in ("stack.hot_full", "stack.hot_delta",
+                     "stack.static_full", "stack.ports_full"):
+            assert self._delta(led0, led1, site) == 0, \
+                f"chained steady state shipped bytes at {site}"
+        assert tpt._counter("chain_adopts") == adopts0 + 1
+        assert tpt._counter("chain_rows") > rows0
+        assert self._saved() > saved0
+        self._parity(arrays, cl)
+
+    def test_mid_chain_refresh_overlays_inflight_head(self,
+                                                      monkeypatch):
+        """A refresh landing MID-chain (head dispatch's plans not yet
+        committed) still adopts: the head's in-flight placements are
+        phantoms until their windows commit, so they overlay from host
+        instead of poisoning the proven prefix."""
+        cl = _dc_cluster()
+        _reg, _res, _ids = _drive_chain(cl, monkeypatch, k=3)
+        # final round NOT committed — its predictions are uncovered
+        adopts0 = tpt._counter("chain_adopts")
+        arrays = TPUStack(cl).device_arrays()
+        assert tpt._counter("chain_adopts") == adopts0 + 1
+        self._parity(arrays, cl)
+
+    def test_foreign_churn_after_chain_pays_only_delta(self,
+                                                       monkeypatch):
+        """Foreign mutations + a port-bitmap flip after the chain:
+        adoption overlays exactly the foreign rows (hot_delta > 0,
+        hot_full == 0) and stays bit-identical."""
+        from nomad_tpu.lib.transfer import default_ledger
+
+        cl = _dc_cluster()
+        _reg, last_res, last_ids = _drive_chain(cl, monkeypatch, k=3)
+        tpt._commit_round(cl, last_res, last_ids)
+        dc1_node = next(nid for nid in cl.row_of
+                        if cl.nodes[nid].datacenter == "dc1")
+        cl.upsert_alloc(_foreign_alloc(dc1_node))
+        # real port-bitmap flip on a row the chain never touched
+        prow = cl.row_of[dc1_node]
+        cl._log_ports(prow, word=3)
+        cl.ports_used[prow, 3] ^= np.uint32(1)
+        cl.ports_version += 1
+        led0 = default_ledger().snapshot()
+        adopts0 = tpt._counter("chain_adopts")
+        arrays = TPUStack(cl).device_arrays()
+        led1 = default_ledger().snapshot()
+        assert tpt._counter("chain_adopts") == adopts0 + 1
+        assert self._delta(led0, led1, "stack.hot_full") == 0
+        assert self._delta(led0, led1, "stack.hot_delta") > 0
+        self._parity(arrays, cl)
+
+    def test_node_growth_mid_chain(self, monkeypatch):
+        """Node growth mid-chain: inside the row bucket the new row
+        overlays (adoption survives); growth that re-buckets n_cap
+        rejects the carry (shape change) — both bit-identical."""
+        cl = _dc_cluster()
+        _reg, last_res, last_ids = _drive_chain(cl, monkeypatch, k=2)
+        tpt._commit_round(cl, last_res, last_ids)
+        n = mock.node()
+        n.id = "grown-1"
+        n.datacenter = "dc1"
+        cl.upsert_node(n)
+        adopts0 = tpt._counter("chain_adopts")
+        arrays = TPUStack(cl).device_arrays()
+        assert tpt._counter("chain_adopts") == adopts0 + 1
+        self._parity(arrays, cl)
+
+    def test_node_growth_rebucket_rejects_carry(self, monkeypatch):
+        cl = _dc_cluster()
+        _reg, last_res, last_ids = _drive_chain(cl, monkeypatch, k=2)
+        tpt._commit_round(cl, last_res, last_ids)
+        n_cap0 = cl.n_cap
+        i = 0
+        while cl.n_cap == n_cap0:
+            n = mock.node()
+            n.id = f"grown-{i}"
+            n.datacenter = "dc2"
+            cl.upsert_node(n)
+            i += 1
+        rejects0 = tpt._counter("chain_rejects")
+        arrays = TPUStack(cl).device_arrays()
+        assert tpt._counter("chain_rejects") == rejects0 + 1
+        self._parity(arrays, cl)
+
+    def test_partial_final_window_overlays_head(self, monkeypatch):
+        """The final round commits INEXACT: no window vouches for the
+        head's placements, so they overlay — adoption still fires for
+        the proven prefix and parity holds."""
+        cl = _dc_cluster()
+        _reg, last_res, last_ids = _drive_chain(cl, monkeypatch, k=2)
+        tpt._commit_round(cl, last_res, last_ids, exact=False)
+        adopts0 = tpt._counter("chain_adopts")
+        arrays = TPUStack(cl).device_arrays()
+        assert tpt._counter("chain_adopts") == adopts0 + 1
+        self._parity(arrays, cl)
+
+    def test_adopt_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_SPEC_CHAIN_ADOPT", "0")
+        cl = _dc_cluster()
+        _reg, last_res, last_ids = _drive_chain(cl, monkeypatch, k=2)
+        tpt._commit_round(cl, last_res, last_ids)
+        adopts0 = tpt._counter("chain_adopts")
+        arrays = TPUStack(cl).device_arrays()
+        # nothing was ever published: no adopt, no reject — the plain
+        # delta/full path serviced the refresh
+        assert tpt._counter("chain_adopts") == adopts0
+        self._parity(arrays, cl)
+
+    def test_randomized_churn_parity(self, monkeypatch):
+        """Property sweep: random foreign mutations, partial windows,
+        port flips, committed/uncommitted chain ends — the adopted (or
+        rejected) view is ALWAYS bit-identical to a cold upload."""
+        for seed in (3, 11, 23):
+            rng = random.Random(seed)
+            cl = _dc_cluster()
+            _reg, last_res, last_ids = _drive_chain(
+                cl, monkeypatch, k=rng.choice((1, 2, 3)))
+            if rng.random() < 0.7:
+                tpt._commit_round(cl, last_res, last_ids,
+                                  exact=rng.random() < 0.8,
+                                  clean=rng.random() < 0.8)
+            for _ in range(rng.randrange(0, 4)):
+                nid = rng.choice(list(cl.row_of))
+                cl.upsert_alloc(_foreign_alloc(nid))
+            if rng.random() < 0.5:
+                row = rng.choice(list(cl.row_of.values()))
+                word = rng.randrange(0, 8)
+                cl._log_ports(row, word=word)
+                cl.ports_used[row, word] ^= np.uint32(1)
+                cl.ports_version += 1
+            arrays = TPUStack(cl).device_arrays()
+            self._parity(arrays, cl)
+
+
+class TestDeltaLogWrap:
+    """Satellite bugfix: a delta-log ring wrap mid-chain was a SILENT
+    unprovable — now counted, flight-recorded with reason + sizing
+    guidance, and the ring length is operator-tunable."""
+
+    def test_env_knob_sizes_ring(self, monkeypatch):
+        from nomad_tpu.tensor.cluster import (DELTA_LOG_LEN,
+                                              ClusterTensors)
+
+        monkeypatch.setenv("NOMAD_TPU_DELTA_LOG", "16")
+        cl = ClusterTensors()
+        assert cl.delta_log_len == 16
+        for i in range(40):
+            cl._log_hot(i % 4)
+            cl.version += 1
+        assert len(cl._hot_log) == 16
+        monkeypatch.setenv("NOMAD_TPU_DELTA_LOG", "not-a-number")
+        assert ClusterTensors().delta_log_len == DELTA_LOG_LEN
+        monkeypatch.delenv("NOMAD_TPU_DELTA_LOG")
+        assert ClusterTensors().delta_log_len == DELTA_LOG_LEN
+
+    def test_wrap_mid_chain_counts_and_flight_records(self,
+                                                      monkeypatch):
+        from nomad_tpu.lib.flight import default_flight
+        from nomad_tpu.lib.metrics import default_registry
+
+        monkeypatch.setenv("NOMAD_TPU_DELTA_LOG", "8")
+        cl = _dc_cluster()
+        _seed_chain(cl, predicted={"e1": set()})
+        assert stack_mod.spec_chain_view(cl, lease_token=1) is not None
+        import jax.numpy as jnp
+
+        z = jnp.zeros(1)
+        stack_mod.spec_chain_advance(cl, 9202, ["e2"], set(), z, z)
+        for i in range(12):   # wrap the 8-entry ring past the cursor
+            cl._log_hot(i % 4)
+            cl.version += 1
+        wraps0 = default_registry().counters(
+            prefix="spec.").get("chain_unprovable_wrap", 0)
+        idx0 = default_flight().last_index()
+        assert stack_mod.spec_chain_certify(cl) is None
+        wraps1 = default_registry().counters(
+            prefix="spec.").get("chain_unprovable_wrap", 0)
+        assert wraps1 == wraps0 + 1
+        _i, evs = default_flight().records_after(idx0)
+        recs = [e for e in evs if e["type"] == "spec.rollback"
+                and e.get("detail", {}).get("reason")
+                == "delta_log_wrap"]
+        assert recs, "wrap never flight-recorded"
+        d = recs[0]["detail"]
+        assert d["log"] == "hot" and d["log_len"] == 8
+        assert "NOMAD_TPU_DELTA_LOG" in d["finding"]
+        stack_mod.release_view(cl, 1)
+        stack_mod.spec_chain_reset(cl)
+
+
 class TestTimelineSpec:
     def test_rolled_back_kernel_is_wasted_not_overlap(self):
         from nomad_tpu.lib.transfer import DispatchTimeline
